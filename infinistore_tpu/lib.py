@@ -1,0 +1,423 @@
+"""Client API (reference parity: infinistore/lib.py).
+
+``InfinityConnection`` exposes the same surface as the reference client:
+``connect``/``connect_async``, batched zero-copy ``write_cache_async`` /
+``read_cache_async`` (aliased as ``rdma_write_cache_async`` /
+``rdma_read_cache_async`` for drop-in compatibility), single-key
+``tcp_write_cache``/``tcp_read_cache``, ``check_exist``,
+``get_match_last_index``, ``delete_keys``, ``register_mr``.
+
+Transport: instead of RDMA verbs, the zero-copy path maps the server's
+POSIX-shm pools (same host -- the TPU-VM case, where the store and the
+inference engine share the host) and memcpys blocks directly; the server only
+does bookkeeping (ALLOC/COMMIT/DESC round-trips).  Cross-host clients use the
+inline-batch TCP ops (the DCN path).  JAX arrays enter via
+``infinistore_tpu.kv.transfer`` which stages HBM<->host through these calls.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import ctypes
+import json
+import mmap
+import os
+import socket
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from . import protocol as P
+from .config import (  # noqa: F401 - re-exported for parity
+    ClientConfig,
+    ServerConfig,
+    TYPE_SHM,
+    TYPE_TCP,
+    TYPE_RDMA,
+    LINK_ICI,
+    LINK_DCN,
+    LINK_ETHERNET,
+    LINK_IB,
+)
+from .mempool import SHM_DIR
+from .utils.logging import Logger
+
+
+class InfiniStoreException(Exception):
+    pass
+
+
+class InfiniStoreKeyNotFound(InfiniStoreException):
+    pass
+
+
+_STATUS_EXC = {
+    P.KEY_NOT_FOUND: InfiniStoreKeyNotFound,
+}
+
+
+def _raise_for_status(status: int, what: str):
+    if status == P.FINISH or status == P.TASK_ACCEPTED:
+        return
+    exc = _STATUS_EXC.get(status, InfiniStoreException)
+    raise exc(f"{what} failed, ret = {status}")
+
+
+def _ptr_view(ptr: int, size: int) -> memoryview:
+    """A writable memoryview over raw memory at ``ptr`` (the moral equivalent
+    of the reference handing ``data_ptr()`` to ibverbs)."""
+    return memoryview((ctypes.c_char * size).from_address(ptr)).cast("B")
+
+
+class _MappedPool:
+    def __init__(self, name: str, size: int):
+        self.name = name
+        path = os.path.join(SHM_DIR, name)
+        fd = os.open(path, os.O_RDWR)
+        try:
+            self.mm = mmap.mmap(fd, size)
+        finally:
+            os.close(fd)
+        self.buf = memoryview(self.mm)
+
+    def close(self):
+        self.buf.release()
+        self.mm.close()
+
+
+class Connection:
+    """Synchronous wire client; one TCP control/data socket.
+
+    The native C++ client (src/store_client.cpp) implements the same calls
+    with GIL-free IO; this Python implementation is the portable fallback
+    and the spec for the protocol.
+    """
+
+    def __init__(self, config: ClientConfig):
+        self.config = config
+        self.sock: Optional[socket.socket] = None
+        self.pools: List[_MappedPool] = []
+        self.pool_meta: List[Tuple[str, int, int]] = []
+        self.shm_mode = False
+        self._registered: Dict[int, int] = {}  # base ptr -> size
+
+    # -- plumbing --
+
+    def connect(self) -> None:
+        if self.sock is not None:
+            raise InfiniStoreException("Already connected to remote instance")
+        s = socket.create_connection(
+            (self.config.host_addr, self.config.service_port), timeout=30
+        )
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.sock = s
+        status, body = self._request(P.OP_HELLO, P.pack_hello(os.getpid()))
+        _raise_for_status(status, "hello")
+        self.pool_meta = P.unpack_pool_table(memoryview(body))
+        if self.config.connection_type == TYPE_SHM:
+            try:
+                self._map_pools()
+                self.shm_mode = True
+            except OSError as e:
+                raise InfiniStoreException(
+                    f"SHM transport requested but server pools are not mappable "
+                    f"(different host?): {e}"
+                )
+
+    def _map_pools(self) -> None:
+        for name, pool_size, _bs in self.pool_meta[len(self.pools) :]:
+            self.pools.append(_MappedPool(name, pool_size))
+
+    def _refresh_pools(self) -> None:
+        status, body = self._request(P.OP_POOLS, b"")
+        _raise_for_status(status, "pools")
+        self.pool_meta = P.unpack_pool_table(memoryview(body))
+        if self.shm_mode:
+            self._map_pools()
+
+    def close(self) -> None:
+        if self.sock is not None:
+            try:
+                self.sock.close()
+            finally:
+                self.sock = None
+        for p in self.pools:
+            p.close()
+        self.pools.clear()
+
+    def _send_frame(self, op: int, body: bytes, payload: Sequence[memoryview] = ()) -> None:
+        # sendall per buffer: sendmsg can partially send under backpressure and
+        # is capped at IOV_MAX vectors; coalesce the small frame parts instead.
+        self.sock.sendall(P.pack_header(op, len(body)) + body)
+        for view in payload:
+            self.sock.sendall(view)
+
+    def _recv_exact_into(self, view: memoryview) -> None:
+        got = 0
+        size = len(view)
+        while got < size:
+            n = self.sock.recv_into(view[got:], size - got)
+            if n == 0:
+                raise InfiniStoreException("connection closed by server")
+            got += n
+
+    def _recv_resp(self) -> Tuple[int, bytes]:
+        hdr = bytearray(P.RESP_SIZE)
+        self._recv_exact_into(memoryview(hdr))
+        status, body_len = P.RESP.unpack(bytes(hdr))
+        body = bytearray(body_len)
+        if body_len:
+            self._recv_exact_into(memoryview(body))
+        return status, bytes(body)
+
+    def _request(self, op: int, body: bytes, payload: Sequence[memoryview] = ()) -> Tuple[int, bytes]:
+        if self.sock is None:
+            raise InfiniStoreException("not connected")
+        self._send_frame(op, body, payload)
+        return self._recv_resp()
+
+    # -- zero-copy batched ops (reference: rdma_write_cache/rdma_read_cache) --
+
+    def _pool_view(self, pool_idx: int, offset: int, size: int) -> memoryview:
+        if pool_idx >= len(self.pools):
+            self._refresh_pools()
+        return self.pools[pool_idx].buf[offset : offset + size]
+
+    def write_cache(self, blocks: Sequence[Tuple[str, int]], block_size: int, ptr: int) -> int:
+        """Batched put: key i's payload is ``block_size`` bytes at
+        ``ptr + offset_i`` (reference: lib.py:425-481)."""
+        keys = P.encode_keys([k for k, _ in blocks])
+        offsets = [off for _, off in blocks]
+        src = _ptr_view(ptr, max(offsets) + block_size if offsets else 0)
+        if self.shm_mode:
+            status, body = self._request(P.OP_ALLOC_PUT, P.pack_alloc_put(keys, block_size))
+            _raise_for_status(status, "alloc_put")
+            descs = P.unpack_descs(memoryview(body))
+            for (pool_idx, pool_off, size), src_off in zip(descs, offsets):
+                dst = self._pool_view(pool_idx, pool_off, block_size)
+                dst[:] = src[src_off : src_off + block_size]
+            status, body = self._request(P.OP_COMMIT_PUT, P.pack_keys(keys))
+            _raise_for_status(status, "commit_put")
+        else:
+            payload = [src[off : off + block_size] for off in offsets]
+            status, _ = self._request(
+                P.OP_PUT_INLINE_BATCH, P.pack_put_inline_batch(keys, block_size), payload
+            )
+            _raise_for_status(status, "put_inline_batch")
+        return P.FINISH
+
+    def read_cache(self, blocks: Sequence[Tuple[str, int]], block_size: int, ptr: int) -> int:
+        """Batched get into ``ptr + offset_i`` (reference: lib.py:483-542)."""
+        keys = P.encode_keys([k for k, _ in blocks])
+        offsets = [off for _, off in blocks]
+        dst = _ptr_view(ptr, max(offsets) + block_size if offsets else 0)
+        if self.shm_mode:
+            status, body = self._request(P.OP_GET_DESC, P.pack_alloc_put(keys, block_size))
+            _raise_for_status(status, "get_desc")
+            descs = P.unpack_descs(memoryview(body))
+            for (pool_idx, pool_off, size), dst_off in zip(descs, offsets):
+                src = self._pool_view(pool_idx, pool_off, size)
+                dst[dst_off : dst_off + size] = src
+        else:
+            body = P.pack_get_inline_batch(keys, block_size)
+            self._send_frame(P.OP_GET_INLINE_BATCH, body)
+            hdr = bytearray(P.RESP_SIZE)
+            self._recv_exact_into(memoryview(hdr))
+            status, body_len = P.RESP.unpack(bytes(hdr))
+            if status != P.FINISH:
+                if body_len:
+                    self._recv_exact_into(memoryview(bytearray(body_len)))
+                _raise_for_status(status, "get_inline_batch")
+            # resp = n x size:u32, then payloads at their stored sizes
+            sizes_buf = bytearray(4 * len(keys))
+            self._recv_exact_into(memoryview(sizes_buf))
+            sizes = np.frombuffer(sizes_buf, dtype="<u4")
+            for size, dst_off in zip(sizes, offsets):
+                self._recv_exact_into(dst[dst_off : dst_off + int(size)])
+        return P.FINISH
+
+    # -- inline single-key ops (reference: w_tcp/r_tcp) --
+
+    def w_tcp(self, key: str, ptr: int, size: int) -> int:
+        payload = _ptr_view(ptr, size)
+        body = P.pack_put_inline(key.encode(), size)
+        status, _ = self._request(P.OP_PUT_INLINE, body + bytes(payload))
+        _raise_for_status(status, "tcp write")
+        return 0
+
+    def w_tcp_bytes(self, key: str, data: bytes) -> int:
+        body = P.pack_put_inline(key.encode(), len(data))
+        status, _ = self._request(P.OP_PUT_INLINE, body + data)
+        _raise_for_status(status, "tcp write")
+        return 0
+
+    def r_tcp(self, key: str) -> np.ndarray:
+        status, body = self._request(P.OP_GET_INLINE, P.pack_keys([key.encode()]))
+        _raise_for_status(status, "tcp read")
+        return np.frombuffer(body, dtype=np.uint8)
+
+    # -- metadata ops --
+
+    def check_exist(self, key: str) -> int:
+        status, body = self._request(P.OP_EXIST, P.pack_keys([key.encode()]))
+        _raise_for_status(status, "check_exist")
+        return P.unpack_i32(body)  # 0 => exists (reference: src/infinistore.cpp:771-784)
+
+    def get_match_last_index(self, keys: Sequence[str]) -> int:
+        status, body = self._request(P.OP_MATCH_LAST_IDX, P.pack_keys(P.encode_keys(keys)))
+        _raise_for_status(status, "get_match_last_index")
+        return P.unpack_i32(body)
+
+    def delete_keys(self, keys: Sequence[str]) -> int:
+        status, body = self._request(P.OP_DELETE_KEYS, P.pack_keys(P.encode_keys(keys)))
+        _raise_for_status(status, "delete_keys")
+        return P.unpack_i32(body)
+
+    def purge(self) -> int:
+        status, body = self._request(P.OP_PURGE, b"")
+        _raise_for_status(status, "purge")
+        return P.unpack_i32(body)
+
+    def stats(self) -> dict:
+        status, body = self._request(P.OP_STATS, b"")
+        _raise_for_status(status, "stats")
+        return json.loads(body.decode())
+
+    def evict(self, min_threshold: float, max_threshold: float) -> None:
+        status, _ = self._request(P.OP_EVICT, P.pack_evict(min_threshold, max_threshold))
+        _raise_for_status(status, "evict")
+
+    def register_mr(self, ptr: int, size: int) -> int:
+        """Record a client buffer region for zero-copy ops.  No NIC to
+        register with on a TPU-VM; kept for API parity and sanity checks
+        (reference: lib.py:580-616)."""
+        self._registered[ptr] = size
+        return 0
+
+
+class InfinityConnection:
+    """Reference parity: infinistore/lib.py:288-636."""
+
+    OP_RDMA_READ = "A"  # parity constant
+
+    def __init__(self, config: ClientConfig):
+        config.verify()
+        self.conn = Connection(config)
+        self.config = config
+        self.rdma_connected = False  # parity name: true when zero-copy path is up
+        self.semaphore = asyncio.BoundedSemaphore(128)
+        Logger.set_log_level(config.log_level)
+
+    @staticmethod
+    def resolve_hostname(hostname: str) -> str:
+        try:
+            socket.inet_aton(hostname)
+            return hostname
+        except socket.error:
+            pass
+        Logger.info(f"Resolving hostname: {hostname}")
+        try:
+            infos = socket.getaddrinfo(hostname, None, socket.AF_INET, socket.SOCK_STREAM)
+            return infos[0][4][0]
+        except socket.gaierror as e:
+            raise InfiniStoreException(f"Failed to resolve hostname '{hostname}': {e}")
+
+    def connect(self) -> None:
+        if self.rdma_connected:
+            raise InfiniStoreException("Already connected to remote instance")
+        self.config.host_addr = self.resolve_hostname(self.config.host_addr)
+        self.conn.connect()
+        if self.config.connection_type == TYPE_SHM:
+            self.rdma_connected = True
+
+    async def connect_async(self) -> None:
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(None, self.connect)
+
+    def close(self) -> None:
+        self.conn.close()
+        self.rdma_connected = False
+
+    # -- zero-copy batched API --
+
+    def write_cache(self, blocks: Sequence[Tuple[str, int]], block_size: int, ptr: int) -> int:
+        return self.conn.write_cache(blocks, block_size, ptr)
+
+    def read_cache(self, blocks: Sequence[Tuple[str, int]], block_size: int, ptr: int) -> int:
+        return self.conn.read_cache(blocks, block_size, ptr)
+
+    async def write_cache_async(
+        self, blocks: Sequence[Tuple[str, int]], block_size: int, ptr: int
+    ) -> int:
+        async with self.semaphore:
+            loop = asyncio.get_running_loop()
+            return await loop.run_in_executor(
+                None, self.conn.write_cache, blocks, block_size, ptr
+            )
+
+    async def read_cache_async(
+        self, blocks: Sequence[Tuple[str, int]], block_size: int, ptr: int
+    ) -> int:
+        async with self.semaphore:
+            loop = asyncio.get_running_loop()
+            return await loop.run_in_executor(
+                None, self.conn.read_cache, blocks, block_size, ptr
+            )
+
+    # drop-in aliases for reference callers
+    rdma_write_cache_async = write_cache_async
+    rdma_read_cache_async = read_cache_async
+
+    def rdma_write_cache(self, blocks, block_size, ptr):
+        return self.write_cache(blocks, block_size, ptr)
+
+    def rdma_read_cache(self, blocks, block_size, ptr):
+        return self.read_cache(blocks, block_size, ptr)
+
+    # -- inline single-key API --
+
+    def tcp_write_cache(self, key: str, ptr: int, size: int, **kwargs) -> None:
+        if key == "":
+            raise InfiniStoreException("key is empty")
+        if size == 0:
+            raise InfiniStoreException("size is 0")
+        if ptr == 0:
+            raise InfiniStoreException("ptr is 0")
+        self.conn.w_tcp(key, ptr, size)
+
+    def tcp_read_cache(self, key: str, **kwargs) -> np.ndarray:
+        return self.conn.r_tcp(key)
+
+    # -- metadata --
+
+    def check_exist(self, key: str) -> bool:
+        return self.conn.check_exist(key) == 0
+
+    def get_match_last_index(self, keys: Sequence[str]) -> int:
+        ret = self.conn.get_match_last_index(keys)
+        if ret < 0:
+            raise InfiniStoreException("can't find a match")
+        return ret
+
+    def delete_keys(self, keys: Sequence[str]) -> int:
+        ret = self.conn.delete_keys(keys)
+        if ret < 0:
+            raise InfiniStoreException(
+                "somethings are wrong, not all the specified keys were deleted"
+            )
+        return ret
+
+    def register_mr(self, arg: Union[int, "np.ndarray"], size: Optional[int] = None) -> int:
+        if isinstance(arg, (int, np.integer)):
+            if not self.rdma_connected and self.config.connection_type == TYPE_SHM:
+                raise InfiniStoreException(
+                    "this function is only valid for a connected zero-copy client"
+                )
+            if size is None:
+                raise InfiniStoreException("size is required")
+            return self.conn.register_mr(int(arg), size)
+        if isinstance(arg, np.ndarray):
+            return self.conn.register_mr(
+                arg.ctypes.data, arg.size * arg.itemsize
+            )
+        raise NotImplementedError(f"not supported: {type(arg)}")

@@ -1,0 +1,98 @@
+import pytest
+
+from infinistore_tpu.mempool import MM, Pool
+
+
+@pytest.fixture
+def pool():
+    p = Pool("istpu_test_pool", 1 << 20, 4096)  # 256 blocks
+    yield p
+    p.close()
+
+
+def test_basic_alloc_free(pool):
+    off = pool.allocate(4096)
+    assert off == 0
+    off2 = pool.allocate(4096)
+    assert off2 == 4096
+    pool.deallocate(off, 4096)
+    pool.deallocate(off2, 4096)
+    assert pool.allocated_blocks == 0
+
+
+def test_alloc_rounds_up_to_block(pool):
+    off = pool.allocate(100)  # rounds up to one 4 KB block
+    assert off is not None
+    assert pool.allocated_blocks == 1
+    pool.deallocate(off, 100)
+    assert pool.allocated_blocks == 0
+
+
+def test_multiblock_contiguous(pool):
+    off = pool.allocate(4096 * 10)
+    assert off is not None
+    assert pool.allocated_blocks == 10
+    pool.deallocate(off, 4096 * 10)
+
+
+def test_exhaustion(pool):
+    offs = [pool.allocate(4096) for _ in range(256)]
+    assert all(o is not None for o in offs)
+    assert pool.allocate(4096) is None
+    pool.deallocate(offs[17], 4096)
+    assert pool.allocate(4096) == offs[17]
+
+
+def test_fragmentation_run_search(pool):
+    offs = [pool.allocate(4096) for _ in range(256)]
+    # free every other block: no run of 2 exists
+    for i in range(0, 256, 2):
+        pool.deallocate(offs[i], 4096)
+    assert pool.allocate(8192) is None
+    # free one neighbor: exactly one run of 2
+    pool.deallocate(offs[1], 4096)
+    assert pool.allocate(8192) == 0
+
+
+def test_writes_visible_through_view(pool):
+    off = pool.allocate(4096)
+    pool.buf[off : off + 4] = b"abcd"
+    assert bytes(pool.buf[off : off + 4]) == b"abcd"
+    pool.deallocate(off, 4096)
+
+
+def test_mm_multi_region_and_rollback():
+    mm = MM(pool_size=1 << 20, block_size=4096)
+    try:
+        regions = mm.allocate(4096, 200)
+        assert regions is not None and len(regions) == 200
+        # not enough room for 100 more: all-or-nothing rollback
+        before = mm.usage()
+        assert mm.allocate(4096, 100) is None
+        assert mm.need_extend
+        assert mm.usage() == before
+    finally:
+        mm.close()
+
+
+def test_mm_extend():
+    mm = MM(pool_size=1 << 20, block_size=4096)
+    try:
+        assert mm.allocate(4096, 256) is not None
+        assert mm.allocate(4096, 1) is None
+        mm.add_mempool(1 << 20)
+        regions = mm.allocate(4096, 1)
+        assert regions == [(1, 0)]
+        assert len(mm.pool_table()) == 2
+    finally:
+        mm.close()
+
+
+def test_mm_usage():
+    mm = MM(pool_size=1 << 20, block_size=4096)
+    try:
+        assert mm.usage() == 0.0
+        mm.allocate(4096, 128)
+        assert mm.usage() == pytest.approx(0.5)
+    finally:
+        mm.close()

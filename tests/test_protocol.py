@@ -1,0 +1,65 @@
+import pytest
+
+from infinistore_tpu import protocol as P
+
+
+def test_header_roundtrip():
+    raw = P.pack_header(P.OP_ALLOC_PUT, 1234, req_id=7, flags=3)
+    assert len(raw) == P.HEADER_SIZE
+    op, flags, body_len, req_id = P.unpack_header(raw)
+    assert (op, flags, body_len, req_id) == (P.OP_ALLOC_PUT, 3, 1234, 7)
+
+
+def test_header_bad_magic():
+    raw = b"\x00" * P.HEADER_SIZE
+    with pytest.raises(ValueError):
+        P.unpack_header(raw)
+
+
+def test_keys_roundtrip():
+    keys = [b"a", b"key2", b"x" * 300]
+    buf = P.pack_keys(keys)
+    out, off = P.unpack_keys(memoryview(buf))
+    assert out == keys
+    assert off == len(buf)
+
+
+def test_alloc_put_roundtrip():
+    buf = P.pack_alloc_put([b"k1", b"k2"], 65536)
+    keys, block_size = P.unpack_alloc_put(memoryview(buf))
+    assert keys == [b"k1", b"k2"]
+    assert block_size == 65536
+
+
+def test_descs_roundtrip():
+    descs = [(0, 0, 4096), (1, 1 << 33, 65536)]
+    buf = P.pack_descs(descs)
+    assert P.unpack_descs(memoryview(buf)) == descs
+
+
+def test_pool_table_roundtrip():
+    pools = [("istpu_x_p0", 1 << 30, 65536), ("istpu_x_p1", 10 << 30, 65536)]
+    buf = P.pack_pool_table(pools)
+    assert P.unpack_pool_table(memoryview(buf)) == pools
+
+
+def test_put_inline_head():
+    body = P.pack_put_inline(b"mykey", 777)
+    key, vlen, consumed = P.unpack_put_inline_head(memoryview(body))
+    assert key == b"mykey"
+    assert vlen == 777
+    assert consumed == len(body)
+
+
+def test_resp_roundtrip():
+    raw = P.pack_resp(P.FINISH, b"hello")
+    status, body_len = P.RESP.unpack(raw[: P.RESP_SIZE])
+    assert status == P.FINISH
+    assert raw[P.RESP_SIZE :] == b"hello"
+
+
+def test_evict_roundtrip():
+    buf = P.pack_evict(0.6, 0.8)
+    mn, mx = P.unpack_evict(memoryview(buf))
+    assert mn == pytest.approx(0.6)
+    assert mx == pytest.approx(0.8)

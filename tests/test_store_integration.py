@@ -1,0 +1,338 @@
+"""End-to-end tests against a live server subprocess.
+
+Mirrors the reference integration suite (infinistore/test_infinistore.py):
+a module-scoped server fixture, then every scenario drives the public client
+API.  Buffers are numpy arrays standing in for host staging buffers (the JAX
+HBM paths are covered in test_kv.py).
+"""
+
+import asyncio
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from multiprocessing import Process
+
+import numpy as np
+import pytest
+
+import infinistore_tpu as ist
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+SERVICE_PORT = _free_port()
+MANAGE_PORT = _free_port()
+
+
+@pytest.fixture(scope="module")
+def server():
+    proc = subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "infinistore_tpu.server",
+            "--service-port",
+            str(SERVICE_PORT),
+            "--manage-port",
+            str(MANAGE_PORT),
+            "--prealloc-size",
+            "1",
+            "--minimal-allocate-size",
+            "16",
+            "--log-level",
+            "warning",
+            "--backend",
+            "python",
+        ],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    # wait for the data plane to accept connections
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            pytest.fail("server process failed to start")
+        try:
+            socket.create_connection(("127.0.0.1", SERVICE_PORT), timeout=0.5).close()
+            break
+        except OSError:
+            time.sleep(0.1)
+    else:
+        pytest.fail("server did not come up")
+    yield proc
+    proc.send_signal(signal.SIGINT)
+    try:
+        proc.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+
+
+def make_conn(connection_type=ist.TYPE_SHM):
+    config = ist.ClientConfig(
+        host_addr="127.0.0.1",
+        service_port=SERVICE_PORT,
+        connection_type=connection_type,
+    )
+    conn = ist.InfinityConnection(config)
+    conn.connect()
+    return conn
+
+
+def rand_key(n=10):
+    import random
+    import string
+
+    return "".join(random.choice(string.ascii_letters + string.digits) for _ in range(n))
+
+
+@pytest.mark.parametrize("dtype", [np.float16, np.float32])
+def test_basic_read_write_cache(server, dtype):
+    """Reference parity: test_basic_read_write_cache."""
+    conn = make_conn()
+    key = rand_key()
+    src = np.arange(4096, dtype=dtype)
+    conn.register_mr(src)
+    esize = src.itemsize
+
+    asyncio.run(conn.write_cache_async([(key, 0)], 4096 * esize, src.ctypes.data))
+    conn.close()
+
+    conn = make_conn()
+    dst = np.zeros(4096, dtype=dtype)
+    conn.register_mr(dst)
+    asyncio.run(conn.read_cache_async([(key, 0)], 4096 * esize, dst.ctypes.data))
+    np.testing.assert_array_equal(src, dst)
+    conn.close()
+
+
+@pytest.mark.parametrize("connection_type", [ist.TYPE_SHM, ist.TYPE_TCP])
+def test_batch_read_write_cache(server, connection_type):
+    """Reference parity: test_batch_read_write_cache (both transports)."""
+    conn = make_conn(connection_type)
+    num_blocks, block_elems = 10, 4096
+    src = np.arange(num_blocks * block_elems, dtype=np.float32)
+    conn.register_mr(src)
+
+    async def run():
+        for _ in range(3):
+            keys = [rand_key() for _ in range(num_blocks)]
+            blocks = [(keys[i], i * block_elems * 4) for i in range(num_blocks)]
+            await conn.write_cache_async(blocks, block_elems * 4, src.ctypes.data)
+            dst = np.zeros(num_blocks * block_elems, dtype=np.float32)
+            conn.register_mr(dst)
+            await conn.read_cache_async(blocks, block_elems * 4, dst.ctypes.data)
+            np.testing.assert_array_equal(src, dst)
+
+    asyncio.run(run())
+    conn.close()
+
+
+def _client_roundtrip(port):
+    config = ist.ClientConfig(
+        host_addr="127.0.0.1", service_port=port, connection_type=ist.TYPE_SHM
+    )
+    conn = ist.InfinityConnection(config)
+    conn.connect()
+    key = rand_key()
+    src = np.arange(4096, dtype=np.float32)
+    conn.register_mr(src)
+    asyncio.run(conn.write_cache_async([(key, 0)], 4096 * 4, src.ctypes.data))
+    conn.close()
+
+    conn = ist.InfinityConnection(config)
+    conn.connect()
+    dst = np.zeros(4096, dtype=np.float32)
+    conn.register_mr(dst)
+    asyncio.run(conn.read_cache_async([(key, 0)], 4096 * 4, dst.ctypes.data))
+    np.testing.assert_array_equal(src, dst)
+    conn.close()
+
+
+def test_multiple_clients(server):
+    """Reference parity: test_multiple_clients."""
+    procs = [Process(target=_client_roundtrip, args=(SERVICE_PORT,)) for _ in range(2)]
+    for p in procs:
+        p.start()
+    for p in procs:
+        p.join()
+        assert p.exitcode == 0
+
+
+def test_key_check(server):
+    conn = make_conn()
+    key = rand_key(5)
+    src = np.random.randn(4096).astype(np.float32)
+    conn.register_mr(src)
+    asyncio.run(conn.write_cache_async([(key, 0)], 4096 * 4, src.ctypes.data))
+    assert conn.check_exist(key)
+    assert not conn.check_exist("definitely_missing")
+    conn.close()
+
+
+def test_get_match_last_index(server):
+    """Reference parity: test_get_match_last_index."""
+    conn = make_conn()
+    src = np.random.randn(4096).astype(np.float32)
+    conn.register_mr(src)
+    asyncio.run(
+        conn.write_cache_async(
+            [("key1", 0), ("key2", 1024), ("key3", 2048)], 1024 * 4, src.ctypes.data
+        )
+    )
+    assert conn.get_match_last_index(["A", "B", "C", "key1", "D", "E"]) == 3
+    conn.close()
+
+
+def test_get_match_no_match_raises(server):
+    conn = make_conn()
+    with pytest.raises(ist.InfiniStoreException):
+        conn.get_match_last_index(["zzz_no", "zzz_way"])
+    conn.close()
+
+
+def test_key_not_found(server):
+    """Reference parity: test_key_not_found / test_read_non_exist_key."""
+    conn = make_conn()
+    dst = np.zeros(4096, dtype=np.float32)
+    conn.register_mr(dst)
+    with pytest.raises(ist.InfiniStoreKeyNotFound):
+        asyncio.run(
+            conn.read_cache_async([("non_exist_key", 0)], 4096 * 4, dst.ctypes.data)
+        )
+    conn.close()
+
+
+def test_upload_one_conn_download_another(server):
+    """Reference parity: test_upload_cpu_download_gpu."""
+    src_conn = make_conn()
+    dst_conn = make_conn()
+    key = rand_key(5)
+    src = np.random.randn(4096).astype(np.float32)
+    dst = np.zeros(4096, dtype=np.float32)
+    src_conn.register_mr(src)
+    dst_conn.register_mr(dst)
+
+    async def run():
+        await src_conn.write_cache_async([(key, 0)], 4096 * 4, src.ctypes.data)
+        await dst_conn.read_cache_async([(key, 0)], 4096 * 4, dst.ctypes.data)
+
+    asyncio.run(run())
+    np.testing.assert_array_equal(src, dst)
+    src_conn.close()
+    dst_conn.close()
+
+
+def test_async_api(server):
+    """Reference parity: test_async_api (connect_async + awaited ops)."""
+    config = ist.ClientConfig(
+        host_addr="127.0.0.1",
+        service_port=SERVICE_PORT,
+        connection_type=ist.TYPE_SHM,
+    )
+    conn = ist.InfinityConnection(config)
+
+    async def run():
+        await conn.connect_async()
+        key = rand_key(5)
+        src = np.random.randn(4096).astype(np.float32)
+        dst = np.zeros(4096, dtype=np.float32)
+        conn.register_mr(src)
+        conn.register_mr(dst)
+        await conn.write_cache_async([(key, 0)], 4096 * 4, src.ctypes.data)
+        await conn.read_cache_async([(key, 0)], 4096 * 4, dst.ctypes.data)
+        np.testing.assert_array_equal(src, dst)
+        conn.close()
+
+    asyncio.run(run())
+
+
+def test_delete_keys(server):
+    """Reference parity: test_delete_keys."""
+    conn = make_conn()
+    src = np.random.randn(4096).astype(np.float32)
+    keys = [rand_key() for _ in range(3)]
+    conn.register_mr(src)
+    asyncio.run(
+        conn.write_cache_async(
+            [(keys[i], i * 1024 * 4) for i in range(3)], 1024 * 4, src.ctypes.data
+        )
+    )
+    for k in keys:
+        assert conn.check_exist(k)
+    assert conn.delete_keys([keys[0], keys[2]]) == 2
+    assert conn.check_exist(keys[1])
+    assert not conn.check_exist(keys[0])
+    assert not conn.check_exist(keys[2])
+    conn.close()
+
+
+def test_simple_tcp_read_write(server):
+    """Reference parity: test_simple_tcp_read_write."""
+    conn = make_conn(ist.TYPE_TCP)
+    key = rand_key()
+    size = 256 * 1024
+    src = np.arange(size, dtype=np.uint8) % 200
+    conn.tcp_write_cache(key, src.ctypes.data, size)
+    dst = conn.tcp_read_cache(key)
+    np.testing.assert_array_equal(np.asarray(dst), src)
+    conn.close()
+
+
+def test_overwrite_tcp(server):
+    """Reference parity: test_overwrite_tcp."""
+    conn = make_conn(ist.TYPE_TCP)
+    key = rand_key()
+    size = 256 * 1024
+    src = np.arange(size, dtype=np.uint8) % 200
+    conn.tcp_write_cache(key, src.ctypes.data, size)
+    src2 = np.arange(size, dtype=np.uint8) % 100
+    conn.tcp_write_cache(key, src2.ctypes.data, size)
+    dst = conn.tcp_read_cache(key)
+    np.testing.assert_array_equal(np.asarray(dst), src2)
+    conn.close()
+
+
+def test_manage_plane(server):
+    import json
+    import urllib.request
+
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{MANAGE_PORT}/selftest", timeout=5
+    ) as r:
+        assert json.load(r)["status"] == "ok"
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{MANAGE_PORT}/kvmap_len", timeout=5
+    ) as r:
+        assert json.load(r)["len"] >= 0
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{MANAGE_PORT}/metrics", timeout=5
+    ) as r:
+        m = json.load(r)
+    assert "usage" in m and "puts" in m
+
+
+def test_purge_via_manage_plane(server):
+    import json
+    import urllib.request
+
+    conn = make_conn()
+    src = np.ones(1024, dtype=np.float32)
+    conn.register_mr(src)
+    key = rand_key()
+    asyncio.run(conn.write_cache_async([(key, 0)], 1024 * 4, src.ctypes.data))
+    assert conn.check_exist(key)
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{MANAGE_PORT}/purge", method="POST"
+    )
+    with urllib.request.urlopen(req, timeout=5) as r:
+        assert json.load(r)["status"] == "ok"
+    assert not conn.check_exist(key)
+    conn.close()

@@ -1,0 +1,158 @@
+"""Unit tests for the store core (semantics per reference src/infinistore.cpp)."""
+
+import pytest
+
+from infinistore_tpu import protocol as P
+from infinistore_tpu.config import ServerConfig
+from infinistore_tpu.store import Store
+
+
+def make_store(prealloc_mb=1, block_kb=16, **kw):
+    cfg = ServerConfig(
+        service_port=1, manage_port=1, prealloc_size=1, minimal_allocate_size=block_kb, **kw
+    )
+    # shrink the pool for tests: bypass the GB unit
+    cfg.prealloc_size = 0
+    store = Store.__new__(Store)
+    from infinistore_tpu.mempool import MM
+    from infinistore_tpu.store import Stats
+    from collections import OrderedDict
+
+    store.config = cfg
+    store.mm = MM(pool_size=prealloc_mb << 20, block_size=block_kb << 10)
+    store.kv = OrderedDict()
+    store.pending = {}
+    store.stats = Stats()
+    return store
+
+
+@pytest.fixture
+def store():
+    s = make_store()
+    yield s
+    s.close()
+
+
+def test_put_get_inline(store):
+    assert store.put_inline(b"k", b"hello world") == P.FINISH
+    assert bytes(store.get_inline(b"k")) == b"hello world"
+    assert store.get_inline(b"missing") is None
+
+
+def test_overwrite_inline(store):
+    store.put_inline(b"k", b"aaaa")
+    store.put_inline(b"k", b"bb")
+    assert bytes(store.get_inline(b"k")) == b"bb"
+    assert store.kvmap_len() == 1
+
+
+def test_alloc_commit_visibility(store):
+    status, descs = store.alloc_put([b"k1", b"k2"], 1024)
+    assert status == P.FINISH and len(descs) == 2
+    # uncommitted entries are invisible (reference: kv_map insert at commit)
+    assert not store.exist(b"k1")
+    st, _ = store.get_desc([b"k1"])
+    assert st == P.KEY_NOT_FOUND
+    status, count = store.commit_put([b"k1", b"k2"])
+    assert status == P.FINISH and count == 2
+    assert store.exist(b"k1") and store.exist(b"k2")
+
+
+def test_get_desc_any_missing_404(store):
+    store.put_inline(b"a", b"1234")
+    st, descs = store.get_desc([b"a", b"nope"])
+    assert st == P.KEY_NOT_FOUND and descs == []
+
+
+def test_get_desc_size_check(store):
+    # stored entry bigger than reader's block size -> INVALID_REQ
+    # (reference: src/infinistore.cpp:620-624)
+    store.put_inline(b"big", b"x" * 4096)
+    st, _ = store.get_desc([b"big"], block_size=1024)
+    assert st == P.INVALID_REQ
+    st, descs = store.get_desc([b"big"], block_size=4096)
+    assert st == P.FINISH and descs[0][2] == 4096
+
+
+def test_match_last_index(store):
+    for k in (b"k0", b"k1", b"k2"):
+        store.put_inline(k, b"v")
+    assert store.match_last_index([b"k0", b"k1", b"k2", b"x", b"y"]) == 2
+    assert store.match_last_index([b"x", b"y"]) == -1
+    # reference test shape (test_infinistore.py:291-311)
+    assert store.match_last_index([b"A", b"B", b"C", b"k1", b"D", b"E"]) == 3
+
+
+def test_delete_keys(store):
+    for k in (b"a", b"b", b"c"):
+        store.put_inline(k, b"v")
+    assert store.delete_keys([b"a", b"c", b"zz"]) == 2
+    assert not store.exist(b"a")
+    assert store.exist(b"b")
+
+
+def test_purge_and_reuse(store):
+    for i in range(5):
+        store.put_inline(f"k{i}".encode(), b"v" * 100)
+    assert store.purge() == 5
+    assert store.kvmap_len() == 0
+    assert store.usage() == 0.0
+    assert store.put_inline(b"new", b"v") == P.FINISH
+
+
+def test_lru_eviction_order(store):
+    # fill half the 1 MB pool (stay under the on-demand evict threshold)
+    for i in range(32):
+        assert store.put_inline(f"k{i}".encode(), b"x" * (16 << 10)) == P.FINISH
+    # touch k0 so it becomes MRU
+    assert store.get_inline(b"k0") is not None
+    store.kv[b"k0"].lease = 0  # drop the read lease for this test
+    evicted = store.evict(0.25, 0.4)
+    assert evicted > 0
+    # k0 was MRU: survives; k1 (LRU head) evicted
+    assert store.exist(b"k0")
+    assert not store.exist(b"k1")
+
+
+def test_on_demand_evict_on_pressure(store):
+    # pool = 64 blocks; fill it, then keep writing: old entries are evicted
+    for i in range(64):
+        assert store.put_inline(f"k{i}".encode(), b"x" * (16 << 10)) == P.FINISH
+    assert store.put_inline(b"overflow", b"y" * (16 << 10)) == P.FINISH
+    assert store.exist(b"overflow")
+
+
+def test_oom_without_auto_increase(store):
+    # allocation larger than the whole pool
+    st, _ = store.alloc_put([b"huge"], 2 << 20)
+    assert st == P.OUT_OF_MEMORY
+
+
+def test_auto_extend():
+    s = make_store(auto_increase=True)
+    s.config.auto_increase = True
+    # patch extend size down for the test
+    import infinistore_tpu.mempool as mp
+
+    orig = mp.EXTEND_POOL_SIZE
+    mp.EXTEND_POOL_SIZE = 1 << 20
+    try:
+        # leases on freshly-read entries block eviction; just fill the pool
+        for i in range(64):
+            assert s.put_inline(f"k{i}".encode(), b"x" * (16 << 10)) == P.FINISH
+        # evicting is possible, but extension path triggers when alloc fails
+        s.mm.need_extend = True
+        assert s.maybe_extend()
+        assert len(s.mm.pools) == 2
+    finally:
+        mp.EXTEND_POOL_SIZE = orig
+        s.close()
+
+
+def test_stats(store):
+    store.put_inline(b"k", b"hello")
+    store.get_inline(b"k")
+    store.get_inline(b"nope")
+    d = store.stats_dict()
+    assert d["puts"] == 1 and d["hits"] == 1 and d["misses"] == 1
+    assert d["kvmap_len"] == 1
