@@ -1,0 +1,185 @@
+"""Inference engine: paged prefill/decode with store-backed prefix reuse.
+
+One class serves both roles of a disaggregated deployment (reference
+docs/source/design.rst: prefill nodes write KV to the store layer-by-layer;
+decode nodes download KV and decode):
+
+* as a *prefill* engine: ``prefill()`` computes the prompt, pages the KV into
+  HBM, and pushes complete pages to the store;
+* as a *decode* engine: ``prefill()`` finds the longest store-resident prefix
+  (``get_match_last_index`` under the hood), pulls those pages into HBM, and
+  only computes the tail locally; ``decode()`` then runs paged single-token
+  steps entirely from HBM.
+
+Non-disaggregated mode is the same object without a store connection, or
+with one for cross-host prefix reuse (reference README "extra large KV cache
+pool").  All device work is jitted with static shapes; page bookkeeping
+stays in Python.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..kv.cache import (
+    BlockAllocator,
+    PagedCacheConfig,
+    init_cache,
+    prefill_to_pages,
+    read_pages,
+    write_pages,
+)
+from ..kv.hashing import chunk_keys
+from ..kv.transfer import KVTransferEngine
+from ..models.llama import LlamaConfig, decode_forward, prefill_forward
+
+
+@dataclass
+class SequenceState:
+    seq_id: int
+    tokens: List[int]
+    block_ids: List[int]
+    chunk_keys: List[str]
+    reused_chunks: int = 0
+    last_logits: Optional[jax.Array] = None
+
+
+class InferenceEngine:
+    def __init__(
+        self,
+        params,
+        cfg: LlamaConfig,
+        pc: PagedCacheConfig,
+        conn=None,
+        model_id: str = "llama",
+        max_seqs: int = 8,
+    ):
+        assert pc.n_layers == cfg.n_layers
+        self.params = params
+        self.cfg = cfg
+        self.pc = pc
+        self.model_id = model_id
+        self.cache = init_cache(pc)
+        self.alloc = BlockAllocator(pc.n_blocks)
+        self.transfer = KVTransferEngine(conn, pc) if conn is not None else None
+        self.max_seqs = max_seqs
+        self.max_pages = pc.n_blocks
+        self.seqs: Dict[int, SequenceState] = {}
+        self._next_id = 0
+        self._prefill_jit = jax.jit(
+            partial(prefill_forward, cfg=self.cfg), static_argnames=()
+        )
+        self._decode_jit = jax.jit(partial(decode_forward, cfg=self.cfg))
+
+    # ---- prefill ----
+
+    def prefill(self, tokens: Sequence[int]) -> SequenceState:
+        T = self.pc.block_tokens
+        tokens = list(tokens)
+        S_total = len(tokens)
+        assert S_total >= 1
+        keys = chunk_keys(tokens, self.model_id, chunk_tokens=T)
+
+        # longest reusable store prefix, capped so >=1 token is computed
+        # locally (we need last-token logits to start decoding)
+        reused = 0
+        if self.transfer is not None and keys:
+            reused = self.transfer.lookup_prefix(keys)
+            reused = min(reused, (S_total - 1) // T)
+        P = reused * T
+
+        # pages for the whole sequence (incl. a partial tail page)
+        n_pages_total = -(-S_total // T)
+        block_ids = self.alloc.alloc(n_pages_total)
+
+        prefix_kv = None
+        if reused:
+            self.cache = self.transfer.load_pages(
+                self.cache, block_ids[:reused], keys[:reused]
+            )
+            pages = read_pages(self.cache, jnp.asarray(block_ids[:reused]))
+            L, _, n, _, H, D = pages.shape
+            prefix_kv = pages.reshape(L, 2, 1, n * T, H, D)
+
+        # compute the tail; pad to a whole number of pages for paging
+        suffix = tokens[P:]
+        S = len(suffix)
+        pad = (-S) % T
+        suffix_arr = jnp.asarray(suffix + [0] * pad, dtype=jnp.int32)[None]
+        logits, kv = self._prefill_jit(
+            self.params, tokens=suffix_arr, prefix_kv=prefix_kv
+        )
+        n_suffix_pages = (S + pad) // T
+        pages_new = prefill_to_pages(kv[:, :, 0], n_suffix_pages, T)
+        self.cache = write_pages(
+            self.cache, jnp.asarray(block_ids[reused:]), pages_new
+        )
+
+        # push complete chunks to the store (prefill-node role)
+        if self.transfer is not None:
+            n_complete = S_total // T
+            if n_complete > reused:
+                ids = block_ids[reused:n_complete]
+                self.transfer.save_pages(self.cache, ids, keys[reused:n_complete])
+
+        state = SequenceState(
+            seq_id=self._next_id,
+            tokens=tokens,
+            block_ids=block_ids,
+            chunk_keys=keys,
+            reused_chunks=reused,
+            last_logits=logits[0, S - 1],
+        )
+        self._next_id += 1
+        self.seqs[state.seq_id] = state
+        return state
+
+    # ---- decode ----
+
+    def _table_for(self, state: SequenceState) -> jax.Array:
+        table = np.zeros((1, self.max_pages), dtype=np.int32)
+        table[0, : len(state.block_ids)] = state.block_ids
+        return jnp.asarray(table)
+
+    def decode(self, state: SequenceState, n_steps: int, sample: str = "greedy") -> List[int]:
+        """Greedy-decode ``n_steps`` tokens for one sequence."""
+        T = self.pc.block_tokens
+        out: List[int] = []
+        logits = state.last_logits
+        for _ in range(n_steps):
+            next_tok = int(jnp.argmax(logits))
+            out.append(next_tok)
+            state.tokens.append(next_tok)
+            pos = len(state.tokens) - 1  # position of next_tok
+            page_idx = pos // T
+            if page_idx >= len(state.block_ids):
+                state.block_ids.extend(self.alloc.alloc(1))
+            block_table = self._table_for(state)
+            logits_b, self.cache = self._decode_jit(
+                self.params,
+                tokens=jnp.asarray([next_tok], dtype=jnp.int32),
+                positions=jnp.asarray([pos], dtype=jnp.int32),
+                cache=self.cache,
+                block_table=block_table,
+                seq_lens=jnp.asarray([pos + 1], dtype=jnp.int32),
+                slot_block_ids=jnp.asarray([state.block_ids[page_idx]], dtype=jnp.int32),
+                slot_ids=jnp.asarray([pos % T], dtype=jnp.int32),
+            )
+            logits = logits_b[0]
+        state.last_logits = logits
+        return out
+
+    def generate(self, tokens: Sequence[int], n_steps: int) -> List[int]:
+        state = self.prefill(tokens)
+        return self.decode(state, n_steps)
+
+    def release(self, state: SequenceState) -> None:
+        self.alloc.free(state.block_ids)
+        state.block_ids = []
+        self.seqs.pop(state.seq_id, None)

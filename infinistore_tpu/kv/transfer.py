@@ -1,0 +1,118 @@
+"""HBM <-> store movement for paged KV.
+
+The reference moves KV between GPU memory and the store pool with GPUDirect
+RDMA against ``tensor.data_ptr()`` offsets (reference: infinistore/lib.py:425-
+542, benchmark.py:163-247).  On a TPU-VM the device side is a ``jax.Array``
+in HBM, so the path is: one fused gather on device -> a single device-to-host
+transfer into a reusable staging buffer -> zero-copy batched put into the
+store's shm pool (and the mirror image for reads).  The staging buffer is the
+"registered MR": allocated once, registered with the connection, reused.
+
+Key layout: page (layer L, chunk c) of a sequence is stored under
+``layer_key(chunk_keys(tokens)[c], L)`` so prefix reuse works per chunk while
+layer-by-layer streaming (reference design.rst prefill flow) stays possible.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .cache import PagedCacheConfig, read_pages, write_pages
+from .hashing import layer_key
+
+
+class KVTransferEngine:
+    """Moves pages between a paged HBM cache and an infinistore-tpu server."""
+
+    def __init__(self, conn, cfg: PagedCacheConfig):
+        # accept the public InfinityConnection or the raw wire Connection
+        self.conn = getattr(conn, "conn", conn)
+        self.cfg = cfg
+        self._staging: Optional[np.ndarray] = None
+
+    def _ensure_staging(self, nbytes: int) -> np.ndarray:
+        if self._staging is None or self._staging.nbytes < nbytes:
+            self._staging = np.empty(nbytes, dtype=np.uint8)
+            self.conn.register_mr(self._staging.ctypes.data, self._staging.nbytes)
+        return self._staging
+
+    def _page_keys(self, chunk_keys_: Sequence[str]) -> List[str]:
+        return [
+            layer_key(ck, layer)
+            for layer in range(self.cfg.n_layers)
+            for ck in chunk_keys_
+        ]
+
+    def save_pages(
+        self, cache: jax.Array, block_ids: Sequence[int], chunk_keys_: Sequence[str]
+    ) -> int:
+        """Gather pages from HBM and put them into the store.
+
+        ``block_ids[i]`` holds the page whose key stem is ``chunk_keys_[i]``.
+        Returns bytes written.
+        """
+        assert len(block_ids) == len(chunk_keys_)
+        n = len(block_ids)
+        if n == 0:
+            return 0
+        ids = jnp.asarray(np.asarray(block_ids, dtype=np.int32))
+        gathered = read_pages(cache, ids)  # [L, 2, n, T, H, D]
+        # -> [L, n, 2, T, H, D] so each (layer, chunk) page is contiguous
+        pages = jnp.swapaxes(gathered, 1, 2)
+        host = np.asarray(jax.device_get(pages))  # one D2H transfer
+        flat = host.reshape(-1)
+        view = flat.view(np.uint8)
+        pb = self.cfg.page_bytes
+        staging = self._ensure_staging(view.nbytes)
+        staging[: view.nbytes] = view
+        keys = self._page_keys(chunk_keys_)
+        blocks = [(k, i * pb) for i, k in enumerate(keys)]
+        self.conn.write_cache(blocks, pb, staging.ctypes.data)
+        return view.nbytes
+
+    def load_pages(
+        self, cache: jax.Array, block_ids: Sequence[int], chunk_keys_: Sequence[str]
+    ) -> jax.Array:
+        """Get pages from the store and scatter them into HBM.
+
+        Returns the updated cache array.  Raises InfiniStoreKeyNotFound if
+        any page is missing (reference read semantics).
+        """
+        assert len(block_ids) == len(chunk_keys_)
+        n = len(block_ids)
+        if n == 0:
+            return cache
+        pb = self.cfg.page_bytes
+        keys = self._page_keys(chunk_keys_)
+        nbytes = len(keys) * pb
+        staging = self._ensure_staging(nbytes)
+        blocks = [(k, i * pb) for i, k in enumerate(keys)]
+        self.conn.read_cache(blocks, pb, staging.ctypes.data)
+        L = self.cfg.n_layers
+        host = (
+            staging[:nbytes]
+            .view(jnp.dtype(self.cfg.dtype))
+            .reshape((L, n) + self.cfg.page_shape)
+        )
+        pages = jnp.swapaxes(jnp.asarray(host), 1, 2)  # [L, 2, n, T, H, D]
+        ids = jnp.asarray(np.asarray(block_ids, dtype=np.int32))
+        return write_pages(cache, ids, pages)
+
+    def lookup_prefix(self, chunk_keys_: Sequence[str]) -> int:
+        """Longest store-resident prefix, in chunks.  Probes layer 0 keys
+        (a chunk is only readable if every layer committed; layer 0 is
+        written first, so verify the last layer before trusting a hit)."""
+        if not chunk_keys_:
+            return 0
+        probe = [layer_key(ck, 0) for ck in chunk_keys_]
+        idx = self.conn.get_match_last_index(probe)
+        while idx >= 0:
+            last = layer_key(chunk_keys_[idx], self.cfg.n_layers - 1)
+            if self.conn.check_exist(last) == 0:  # 0 => exists (wire semantics)
+                break
+            idx -= 1
+        return idx + 1

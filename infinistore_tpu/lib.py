@@ -100,6 +100,9 @@ class Connection:
         self.pool_meta: List[Tuple[str, int, int]] = []
         self.shm_mode = False
         self._registered: Dict[int, int] = {}  # base ptr -> size
+        # one socket, possibly many executor threads (async API): every
+        # request/response exchange must be atomic on the wire
+        self._io_lock = __import__("threading").Lock()
 
     # -- plumbing --
 
@@ -173,8 +176,9 @@ class Connection:
     def _request(self, op: int, body: bytes, payload: Sequence[memoryview] = ()) -> Tuple[int, bytes]:
         if self.sock is None:
             raise InfiniStoreException("not connected")
-        self._send_frame(op, body, payload)
-        return self._recv_resp()
+        with self._io_lock:
+            self._send_frame(op, body, payload)
+            return self._recv_resp()
 
     # -- zero-copy batched ops (reference: rdma_write_cache/rdma_read_cache) --
 
@@ -191,6 +195,13 @@ class Connection:
         src = _ptr_view(ptr, max(offsets) + block_size if offsets else 0)
         if self.shm_mode:
             status, body = self._request(P.OP_ALLOC_PUT, P.pack_alloc_put(keys, block_size))
+            for _ in range(20):  # RETRY: another writer is streaming these keys
+                if status != P.RETRY:
+                    break
+                __import__("time").sleep(0.05)
+                status, body = self._request(
+                    P.OP_ALLOC_PUT, P.pack_alloc_put(keys, block_size)
+                )
             _raise_for_status(status, "alloc_put")
             descs = P.unpack_descs(memoryview(body))
             for (pool_idx, pool_off, size), src_off in zip(descs, offsets):
@@ -220,20 +231,21 @@ class Connection:
                 dst[dst_off : dst_off + size] = src
         else:
             body = P.pack_get_inline_batch(keys, block_size)
-            self._send_frame(P.OP_GET_INLINE_BATCH, body)
-            hdr = bytearray(P.RESP_SIZE)
-            self._recv_exact_into(memoryview(hdr))
-            status, body_len = P.RESP.unpack(bytes(hdr))
-            if status != P.FINISH:
-                if body_len:
-                    self._recv_exact_into(memoryview(bytearray(body_len)))
-                _raise_for_status(status, "get_inline_batch")
-            # resp = n x size:u32, then payloads at their stored sizes
-            sizes_buf = bytearray(4 * len(keys))
-            self._recv_exact_into(memoryview(sizes_buf))
-            sizes = np.frombuffer(sizes_buf, dtype="<u4")
-            for size, dst_off in zip(sizes, offsets):
-                self._recv_exact_into(dst[dst_off : dst_off + int(size)])
+            with self._io_lock:  # whole exchange: frame + streamed payload
+                self._send_frame(P.OP_GET_INLINE_BATCH, body)
+                hdr = bytearray(P.RESP_SIZE)
+                self._recv_exact_into(memoryview(hdr))
+                status, body_len = P.RESP.unpack(bytes(hdr))
+                if status != P.FINISH:
+                    if body_len:
+                        self._recv_exact_into(memoryview(bytearray(body_len)))
+                    _raise_for_status(status, "get_inline_batch")
+                # resp = n x size:u32, then payloads at their stored sizes
+                sizes_buf = bytearray(4 * len(keys))
+                self._recv_exact_into(memoryview(sizes_buf))
+                sizes = np.frombuffer(sizes_buf, dtype="<u4")
+                for size, dst_off in zip(sizes, offsets):
+                    self._recv_exact_into(dst[dst_off : dst_off + int(size)])
         return P.FINISH
 
     # -- inline single-key ops (reference: w_tcp/r_tcp) --

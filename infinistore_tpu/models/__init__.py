@@ -1,0 +1,37 @@
+from .llama import (
+    LLAMA3_1B,
+    LLAMA3_8B,
+    LLAMA3_70B,
+    TINY,
+    LlamaConfig,
+    decode_forward,
+    init_params,
+    loss_fn,
+    prefill_forward,
+    scaled,
+    train_step_fn,
+)
+from .attention import (
+    apply_rope,
+    causal_attention,
+    paged_decode_attention,
+    repeat_kv,
+)
+
+__all__ = [
+    "LlamaConfig",
+    "LLAMA3_8B",
+    "LLAMA3_70B",
+    "LLAMA3_1B",
+    "TINY",
+    "init_params",
+    "prefill_forward",
+    "decode_forward",
+    "loss_fn",
+    "train_step_fn",
+    "scaled",
+    "apply_rope",
+    "causal_attention",
+    "paged_decode_attention",
+    "repeat_kv",
+]

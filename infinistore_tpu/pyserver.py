@@ -80,8 +80,9 @@ class StoreServer:
                     Logger.error(f"body too large: {body_len}")
                     break
                 body = memoryview(await reader.readexactly(body_len)) if body_len else memoryview(b"")
-                resp = await self._dispatch(op, body, reader, conn_pending)
-                writer.write(resp)
+                resp = await self._dispatch(op, body, reader, writer, conn_pending)
+                if resp is not None:  # streaming ops write directly
+                    writer.write(resp)
                 await writer.drain()
         except (ConnectionResetError, BrokenPipeError):
             pass
@@ -97,8 +98,13 @@ class StoreServer:
                 pass
 
     async def _dispatch(
-        self, op: int, body: memoryview, reader: asyncio.StreamReader, conn_pending: set
-    ) -> bytes:
+        self,
+        op: int,
+        body: memoryview,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+        conn_pending: set,
+    ) -> bytes | None:
         st = self.store
         if op == P.OP_HELLO:
             return P.pack_resp(P.FINISH, P.pack_pool_table(st.mm.pool_table()))
@@ -112,6 +118,8 @@ class StoreServer:
             return P.pack_resp(st.put_inline(key, payload))
         if op == P.OP_GET_INLINE:
             keys, _ = P.unpack_keys(body)
+            if not keys:
+                return P.pack_resp(P.INVALID_REQ)
             view = st.get_inline(keys[0])
             if view is None:
                 return P.pack_resp(P.KEY_NOT_FOUND)
@@ -133,6 +141,8 @@ class StoreServer:
             return P.pack_resp(status, P.pack_descs(descs))
         if op == P.OP_EXIST:
             keys, _ = P.unpack_keys(body)
+            if not keys:
+                return P.pack_resp(P.INVALID_REQ)
             return P.pack_resp(P.FINISH, P.pack_i32(0 if st.exist(keys[0]) else 1))
         if op == P.OP_MATCH_LAST_IDX:
             keys, _ = P.unpack_keys(body)
@@ -162,7 +172,9 @@ class StoreServer:
                     remaining -= len(chunk)
                 return P.pack_resp(status)
             # mark busy: a concurrent purge/realloc must not free these
-            # regions while we await payload chunks
+            # regions while we await payload chunks; track in conn_pending so
+            # a mid-stream disconnect reclaims them
+            conn_pending.update(keys)
             for key in keys:
                 st.pending[key].busy = True
             try:
@@ -182,17 +194,21 @@ class StoreServer:
                     if e is not None:
                         e.busy = False
             status, count = st.commit_put(keys)
+            conn_pending.difference_update(keys)
             return P.pack_resp(status, P.pack_i32(count))
         if op == P.OP_GET_INLINE_BATCH:
             keys, block_size = P.unpack_alloc_put(body)
             status, descs = st.get_desc(keys, block_size)
             if status != P.FINISH:
                 return P.pack_resp(status)
-            # resp body = n x size:u32 | concatenated payloads at stored sizes
+            # resp body = n x size:u32 | payloads streamed straight from the
+            # shm pool (no batch-sized intermediate copies)
+            total = sum(size for (_, _, size) in descs)
             sizes = b"".join(P._U32.pack(size) for (_, _, size) in descs)
-            payload = b"".join(
-                bytes(st.mm.view(pool_idx, offset, size))
-                for (pool_idx, offset, size) in descs
-            )
-            return P.pack_resp(P.FINISH, sizes + payload)
+            writer.write(P.RESP.pack(P.FINISH, len(sizes) + total))
+            writer.write(sizes)
+            for (pool_idx, offset, size) in descs:
+                writer.write(bytes(st.mm.view(pool_idx, offset, size)))
+                await writer.drain()
+            return None
         return P.pack_resp(P.INVALID_REQ)

@@ -168,13 +168,17 @@ class Store:
         """Batched allocate for zero-copy writes.  Returns (status, descs)."""
         if len(set(keys)) != len(keys):
             return P.INVALID_REQ, []
+        # another op is actively streaming into one of these keys: back off
+        # rather than stomp its pending region
+        if any((e := self.pending.get(k)) is not None and e.busy for k in keys):
+            return P.RETRY, []
         regions = self._allocate(block_size, len(keys))
         if regions is None:
             return P.OUT_OF_MEMORY, []
         descs = []
         for key, (pool_idx, offset) in zip(keys, regions):
             old = self.pending.pop(key, None)
-            if old is not None and not old.busy:
+            if old is not None:
                 self._free(old)
             self.pending[key] = Entry(pool_idx, offset, block_size)
             descs.append((pool_idx, offset, block_size))

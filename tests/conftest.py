@@ -3,9 +3,17 @@ import, so sharding tests (tp/dp/sp/pp) run without TPU hardware."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+# Tests always run on a virtual 8-device CPU mesh (the real chip is reserved
+# for bench.py); set ISTPU_TEST_TPU=1 to run against real hardware instead.
+# The platform plugin pins jax_platforms at interpreter start, so the env var
+# alone is not enough -- override the config after import too.
+if not os.environ.get("ISTPU_TEST_TPU"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

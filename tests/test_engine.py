@@ -1,0 +1,178 @@
+"""Engine tests: paged generation correctness, PD-disagg over a live store,
+and cross-engine prefix reuse."""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import infinistore_tpu as ist
+from infinistore_tpu.engine import InferenceEngine, StoreConnector
+from infinistore_tpu.kv import PagedCacheConfig
+from infinistore_tpu.models import TINY, init_params, prefill_forward, scaled
+
+
+CFG = scaled(TINY, dtype=jnp.float32)
+PARAMS = init_params(CFG, jax.random.PRNGKey(7))
+T = 4  # block tokens (small for tests)
+
+
+def make_pc(n_blocks=64):
+    return PagedCacheConfig(
+        n_layers=CFG.n_layers,
+        n_kv_heads=CFG.n_kv_heads,
+        head_dim=CFG.head_dim,
+        n_blocks=n_blocks,
+        block_tokens=T,
+        dtype=CFG.dtype,
+    )
+
+
+def dense_greedy(tokens, n_steps):
+    """Exact reference: full dense forward each step."""
+    toks = list(tokens)
+    out = []
+    for _ in range(n_steps):
+        logits, _ = prefill_forward(PARAMS, CFG, jnp.asarray(toks, dtype=jnp.int32)[None])
+        nxt = int(jnp.argmax(logits[0, -1]))
+        out.append(nxt)
+        toks.append(nxt)
+    return out
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+@pytest.fixture(scope="module")
+def server():
+    port, mport = _free_port(), _free_port()
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "infinistore_tpu.server",
+         "--service-port", str(port), "--manage-port", str(mport),
+         "--prealloc-size", "1", "--minimal-allocate-size", "16",
+         "--backend", "python"],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            pytest.fail("server failed to start")
+        try:
+            socket.create_connection(("127.0.0.1", port), timeout=0.5).close()
+            break
+        except OSError:
+            time.sleep(0.1)
+    yield port
+    proc.send_signal(signal.SIGINT)
+    try:
+        proc.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+
+
+def _conn(port):
+    c = ist.InfinityConnection(
+        ist.ClientConfig(host_addr="127.0.0.1", service_port=port,
+                         connection_type=ist.TYPE_SHM)
+    )
+    c.connect()
+    return c
+
+
+PROMPT = [11, 42, 7, 99, 5, 3, 17, 28, 64, 1, 2]  # 11 tokens: 2 full chunks + tail
+
+
+def test_generate_matches_dense_no_store():
+    eng = InferenceEngine(PARAMS, CFG, make_pc())
+    got = eng.generate(PROMPT, 8)
+    want = dense_greedy(PROMPT, 8)
+    assert got == want
+
+
+def test_prefill_exact_multiple_of_chunk():
+    prompt = PROMPT[:8]  # exactly 2 chunks
+    eng = InferenceEngine(PARAMS, CFG, make_pc())
+    assert eng.generate(prompt, 5) == dense_greedy(prompt, 5)
+
+
+def test_single_token_prompt():
+    eng = InferenceEngine(PARAMS, CFG, make_pc())
+    assert eng.generate([42], 4) == dense_greedy([42], 4)
+
+
+def test_pd_disaggregation(server):
+    """Prefill engine pushes KV to the store; a separate decode engine pulls
+    it and must produce the same tokens as the dense reference."""
+    prefill_conn, decode_conn = _conn(server), _conn(server)
+    prefill_eng = InferenceEngine(
+        PARAMS, CFG, make_pc(), conn=prefill_conn, model_id="pd-test"
+    )
+    decode_eng = InferenceEngine(
+        PARAMS, CFG, make_pc(), conn=decode_conn, model_id="pd-test"
+    )
+
+    # prefill node: process the prompt, KV lands in the store
+    st = prefill_eng.prefill(PROMPT)
+    assert st.reused_chunks == 0
+
+    # decode node: admits the same prompt; must reuse the stored prefix
+    st2 = decode_eng.prefill(PROMPT)
+    assert st2.reused_chunks == len(PROMPT) // T  # all complete chunks reused
+    got = decode_eng.decode(st2, 8)
+    assert got == dense_greedy(PROMPT, 8)
+    prefill_conn.close()
+    decode_conn.close()
+
+
+def test_cross_request_prefix_reuse(server):
+    """Second request sharing a long prefix reuses stored chunks."""
+    conn = _conn(server)
+    eng = InferenceEngine(PARAMS, CFG, make_pc(), conn=conn, model_id="reuse-test")
+    prompt_a = list(range(40, 56))  # 4 chunks
+    eng.prefill(prompt_a)
+    prompt_b = prompt_a[:12] + [200, 201, 202, 203, 204]
+    st = eng.prefill(prompt_b)
+    assert st.reused_chunks == 3  # 12 shared tokens = 3 chunks
+    got = eng.decode(st, 6)
+    assert got == dense_greedy(prompt_b, 6)
+    conn.close()
+
+
+def test_connector_roundtrip(server):
+    from infinistore_tpu.kv import BlockAllocator, init_cache, prefill_to_pages, write_pages
+
+    conn = _conn(server)
+    pc = make_pc()
+    connector = StoreConnector(conn, pc, model_id="connector-test")
+    tokens = list(range(16))  # 4 chunks
+    assert connector.lookup(tokens) == 0
+
+    cache = init_cache(pc)
+    _, kv = prefill_forward(PARAMS, CFG, jnp.asarray(tokens, dtype=jnp.int32)[None])
+    pages = prefill_to_pages(kv[:, :, 0], 4, T)
+    cache = write_pages(cache, jnp.asarray([0, 1, 2, 3]), pages)
+    connector.store_kv(tokens, cache, [0, 1, 2, 3])
+    assert connector.lookup(tokens) == 16
+
+    cache2 = init_cache(pc)
+    cache2, n = connector.retrieve_kv(tokens, cache2, [8, 9, 10, 11])
+    assert n == 16
+    np.testing.assert_array_equal(
+        np.asarray(cache2[:, :, 8:12]), np.asarray(cache[:, :, 0:4])
+    )
+
+    assert connector.invalidate(tokens) == 4 * CFG.n_layers
+    assert connector.lookup(tokens) == 0
+    conn.close()

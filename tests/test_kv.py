@@ -1,0 +1,192 @@
+"""KV layer: hashing, paged cache ops, and HBM<->store transfer."""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import infinistore_tpu as ist
+from infinistore_tpu.kv import (
+    BlockAllocator,
+    KVTransferEngine,
+    PagedCacheConfig,
+    chunk_keys,
+    init_cache,
+    layer_key,
+    matched_token_count,
+    read_pages,
+    write_pages,
+)
+
+
+# ---- hashing ----
+
+def test_chunk_keys_prefix_property():
+    t1 = list(range(64))
+    t2 = list(range(48)) + [999] * 16
+    k1 = chunk_keys(t1, "llama3-8b")
+    k2 = chunk_keys(t2, "llama3-8b")
+    assert len(k1) == 4
+    assert k1[:3] == k2[:3]  # shared 48-token prefix -> same first 3 keys
+    assert k1[3] != k2[3]
+
+
+def test_chunk_keys_prefix_commitment():
+    # same chunk content, different prefix -> different key
+    a = chunk_keys([1] * 16 + [2] * 16, "m")
+    b = chunk_keys([3] * 16 + [2] * 16, "m")
+    assert a[1] != b[1]
+
+
+def test_chunk_keys_incomplete_tail():
+    assert len(chunk_keys(list(range(31)), "m")) == 1
+    assert len(chunk_keys(list(range(15)), "m")) == 0
+
+
+def test_model_id_separation():
+    a = chunk_keys(list(range(16)), "model-a")
+    b = chunk_keys(list(range(16)), "model-b")
+    assert a[0] != b[0]
+
+
+def test_layer_key_and_match_count():
+    assert layer_key("m:abc", 3) == "m:abc#L3"
+    assert matched_token_count(-1) == 0
+    assert matched_token_count(2) == 48
+
+
+# ---- paged cache ----
+
+def test_page_roundtrip():
+    pc = PagedCacheConfig(n_layers=2, n_kv_heads=2, head_dim=8, n_blocks=8, block_tokens=4, dtype=jnp.float32)
+    cache = init_cache(pc)
+    pages = jax.random.normal(jax.random.PRNGKey(0), (2, 2, 3, 4, 2, 8), jnp.float32)
+    ids = jnp.asarray([5, 1, 7], dtype=jnp.int32)
+    cache = write_pages(cache, ids, pages)
+    out = read_pages(cache, ids)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(pages))
+    # untouched pages remain zero
+    assert float(jnp.abs(cache[:, :, 0]).max()) == 0.0
+
+
+def test_block_allocator():
+    a = BlockAllocator(4)
+    ids = a.alloc(3)
+    assert len(set(ids)) == 3 and a.n_free == 1
+    with pytest.raises(MemoryError):
+        a.alloc(2)
+    a.free(ids)
+    assert a.n_free == 4
+
+
+def test_page_bytes_llama8b_shape():
+    pc = PagedCacheConfig(n_layers=32, n_kv_heads=8, head_dim=128, n_blocks=1, block_tokens=16)
+    assert pc.page_bytes == 64 * 1024  # 2*16*8*128*2B
+
+
+# ---- transfer through a live store ----
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture(scope="module")
+def server():
+    port, mport = _free_port(), _free_port()
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "infinistore_tpu.server",
+         "--service-port", str(port), "--manage-port", str(mport),
+         "--prealloc-size", "1", "--minimal-allocate-size", "16",
+         "--backend", "python"],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            pytest.fail("server failed to start")
+        try:
+            socket.create_connection(("127.0.0.1", port), timeout=0.5).close()
+            break
+        except OSError:
+            time.sleep(0.1)
+    yield port
+    proc.send_signal(signal.SIGINT)
+    try:
+        proc.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+
+
+@pytest.fixture
+def conn(server):
+    config = ist.ClientConfig(
+        host_addr="127.0.0.1", service_port=server, connection_type=ist.TYPE_SHM
+    )
+    c = ist.InfinityConnection(config)
+    c.connect()
+    yield c
+    c.close()
+
+
+def test_save_load_pages(conn):
+    pc = PagedCacheConfig(
+        n_layers=2, n_kv_heads=2, head_dim=16, n_blocks=8, block_tokens=16, dtype=jnp.float32
+    )
+    eng = KVTransferEngine(conn, pc)
+    cache = init_cache(pc)
+    pages = jax.random.normal(jax.random.PRNGKey(1), (2, 2, 2, 16, 2, 16), jnp.float32)
+    cache = write_pages(cache, jnp.asarray([0, 1]), pages)
+
+    tokens = list(range(32))
+    keys = chunk_keys(tokens, "tinymodel")
+    nbytes = eng.save_pages(cache, [0, 1], keys)
+    assert nbytes == 2 * 2 * pc.page_bytes  # layers x chunks
+
+    # load into fresh pages of a fresh cache
+    cache2 = init_cache(pc)
+    cache2 = eng.load_pages(cache2, [4, 5], keys)
+    out = read_pages(cache2, jnp.asarray([4, 5]))
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(pages))
+
+
+def test_lookup_prefix(conn):
+    pc = PagedCacheConfig(
+        n_layers=2, n_kv_heads=2, head_dim=16, n_blocks=8, block_tokens=16, dtype=jnp.float32
+    )
+    eng = KVTransferEngine(conn, pc)
+    cache = init_cache(pc)
+    pages = jax.random.normal(jax.random.PRNGKey(2), (2, 2, 3, 16, 2, 16), jnp.float32)
+    cache = write_pages(cache, jnp.asarray([0, 1, 2]), pages)
+
+    tokens = list(range(77))  # 4 complete chunks... 77//16 = 4
+    keys = chunk_keys(tokens, "m-lookup")
+    # store only the first 3 chunks
+    eng.save_pages(cache, [0, 1, 2], keys[:3])
+    assert eng.lookup_prefix(keys) == 3
+    assert eng.lookup_prefix(chunk_keys([9] * 32, "m-lookup")) == 0
+    # a longer stored prefix than asked about
+    assert eng.lookup_prefix(keys[:2]) == 2
+
+
+def test_lookup_prefix_requires_all_layers(conn):
+    """A chunk whose last layer is missing must not count as a hit."""
+    pc = PagedCacheConfig(
+        n_layers=2, n_kv_heads=2, head_dim=16, n_blocks=8, block_tokens=16, dtype=jnp.float32
+    )
+    eng = KVTransferEngine(conn, pc)
+    keys = chunk_keys(list(range(16)), "m-partial")
+    # write only layer 0 of chunk 0 by hand
+    payload = np.zeros(pc.page_bytes, dtype=np.uint8)
+    conn.conn.w_tcp_bytes(layer_key(keys[0], 0), payload.tobytes())
+    assert eng.lookup_prefix(keys) == 0

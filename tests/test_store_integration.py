@@ -336,3 +336,28 @@ def test_purge_via_manage_plane(server):
         assert json.load(r)["status"] == "ok"
     assert not conn.check_exist(key)
     conn.close()
+
+
+def test_concurrent_async_writers_one_connection(server):
+    """Many in-flight async ops on one connection must not corrupt frames."""
+    conn = make_conn()
+    src = np.arange(64 * 1024, dtype=np.float32)
+    conn.register_mr(src)
+
+    async def run():
+        tasks = []
+        for j in range(16):
+            blocks = [(f"cc{j}_{i}", i * 4096) for i in range(8)]
+            tasks.append(conn.write_cache_async(blocks, 4096, src.ctypes.data))
+        await asyncio.gather(*tasks)
+        dst = np.zeros_like(src)
+        conn.register_mr(dst)
+        reads = []
+        for j in range(16):
+            blocks = [(f"cc{j}_{i}", i * 4096) for i in range(8)]
+            reads.append(conn.read_cache_async(blocks, 4096, dst.ctypes.data))
+        await asyncio.gather(*reads)
+        np.testing.assert_array_equal(dst[: 8 * 1024], src[: 8 * 1024])
+
+    asyncio.run(run())
+    conn.close()
