@@ -1,0 +1,138 @@
+"""Driver benchmark: Llama-3-8B-shaped KV block put/get bandwidth.
+
+Workload (SURVEY.md §6 config 2): pages of Llama-3-8B KV cache — 32 layers,
+8 KV heads, 128 head dim, bf16, 16-token chunks → 64 KiB per (layer, chunk)
+page — moved between a client buffer and a live infinistore-tpu server on the
+same host (the TPU-VM serving topology).
+
+Measured path: the zero-copy SHM transport (our RDMA analog).
+Baseline path:  single-stream loopback TCP inline transfer — the proxy for
+the reference's TCP transport measured on identical hardware (BASELINE.md).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from infinistore_tpu import ClientConfig, InfinityConnection  # noqa: E402
+from infinistore_tpu.config import TYPE_SHM, TYPE_TCP  # noqa: E402
+
+PAGE_BYTES = 2 * 16 * 8 * 128 * 2  # K+V, 16 tok, 8 kv-heads, 128 dim, bf16 = 64 KiB
+N_LAYERS = 32
+CHUNKS = 64  # pages per layer per round -> 128 MiB per round
+ROUND_BYTES = PAGE_BYTES * N_LAYERS * CHUNKS
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def start_server():
+    service, manage = _free_port(), _free_port()
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "infinistore_tpu.server",
+            "--service-port", str(service), "--manage-port", str(manage),
+            "--prealloc-size", "2", "--minimal-allocate-size", "64",
+            "--log-level", "warning", "--auto-increase",
+        ],
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        try:
+            socket.create_connection(("127.0.0.1", service), timeout=1).close()
+            return proc, service
+        except OSError:
+            time.sleep(0.2)
+    proc.kill()
+    raise RuntimeError("server did not come up")
+
+
+def bench_conn(conn_type: str, port: int, rounds: int, tag: str,
+               force_python: bool = False):
+    cfg = ClientConfig(host_addr="127.0.0.1", service_port=port,
+                       connection_type=conn_type, log_level="warning")
+    if force_python:
+        # the baseline leg is a stable proxy for the reference's single-stream
+        # loopback TCP (BASELINE.md); pin it to the Python client so it does
+        # not drift with native-client optimizations
+        from infinistore_tpu.lib import Connection
+
+        conn = InfinityConnection.__new__(InfinityConnection)
+        conn.config = cfg
+        conn.conn = Connection(cfg)
+        conn.rdma_connected = False
+        import asyncio
+
+        conn.semaphore = asyncio.BoundedSemaphore(128)
+    else:
+        conn = InfinityConnection(cfg)
+    conn.connect()
+    buf = np.random.randint(0, 256, size=ROUND_BYTES, dtype=np.uint8)
+    conn.register_mr(buf)
+    ptr = buf.ctypes.data
+
+    put_t = get_t = 0.0
+    for r in range(rounds):
+        blocks = [
+            (f"{tag}-r{r}-L{layer}-c{c}", (layer * CHUNKS + c) * PAGE_BYTES)
+            for layer in range(N_LAYERS)
+            for c in range(CHUNKS)
+        ]
+        t0 = time.perf_counter()
+        conn.write_cache(blocks, PAGE_BYTES, ptr)
+        put_t += time.perf_counter() - t0
+        t0 = time.perf_counter()
+        conn.read_cache(blocks, PAGE_BYTES, ptr)
+        get_t += time.perf_counter() - t0
+        conn.delete_keys([k for k, _ in blocks])
+    conn.close()
+    gb = rounds * ROUND_BYTES / 1e9
+    return gb / put_t, gb / get_t
+
+
+def main():
+    proc, port = start_server()
+    try:
+        # warmup (compilation-free path, but page in the pools)
+        bench_conn(TYPE_SHM, port, 1, "warm")
+        shm_put, shm_get = bench_conn(TYPE_SHM, port, 6, "shm")
+        tcp_put, tcp_get = bench_conn(TYPE_TCP, port, 2, "tcp", force_python=True)
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+    shm_bw = 2 / (1 / shm_put + 1 / shm_get)  # harmonic mean put/get
+    tcp_bw = 2 / (1 / tcp_put + 1 / tcp_get)
+    print(
+        f"# shm put {shm_put:.2f} get {shm_get:.2f} GB/s | "
+        f"tcp put {tcp_put:.2f} get {tcp_get:.2f} GB/s",
+        file=sys.stderr,
+    )
+    print(json.dumps({
+        "metric": "llama8b_kv_put_get_bandwidth_shm",
+        "value": round(shm_bw, 3),
+        "unit": "GB/s",
+        "vs_baseline": round(shm_bw / tcp_bw, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

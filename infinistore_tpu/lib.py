@@ -39,7 +39,7 @@ from .config import (  # noqa: F401 - re-exported for parity
     LINK_ETHERNET,
     LINK_IB,
 )
-from .mempool import SHM_DIR
+from .mempool import SHM_DIR, _prefault
 from .utils.logging import Logger
 
 
@@ -78,6 +78,10 @@ class _MappedPool:
             self.mm = mmap.mmap(fd, size)
         finally:
             os.close(fd)
+        # server already populated the pages; this maps them into our page
+        # table up front so the data path takes no minor faults.  write=False:
+        # this is the server's pool -- the write fallback would zero it.
+        _prefault(self.mm, size, write=False)
         self.buf = memoryview(self.mm)
 
     def close(self):
@@ -307,6 +311,29 @@ class Connection:
         return 0
 
 
+def _make_connection(config: ClientConfig):
+    """Native C++ client when built (GIL-free IO), Python fallback otherwise.
+
+    ``ISTPU_CLIENT=python`` forces the fallback; ``=native`` makes a missing
+    native build a hard error."""
+    mode = os.environ.get("ISTPU_CLIENT", "auto")
+    if mode != "python":
+        try:
+            from . import _native
+        except (ImportError, OSError):
+            _native = None
+            if mode == "native":
+                raise
+        # only a missing/unloadable library falls through; real errors from
+        # the native client itself must surface, not mask as a silent
+        # slow-path fallback
+        if _native is not None and _native.available():
+            return _native.NativeConnection(config)
+        if mode == "native":
+            raise InfiniStoreException("ISTPU_CLIENT=native but libistpu.so not built")
+    return Connection(config)
+
+
 class InfinityConnection:
     """Reference parity: infinistore/lib.py:288-636."""
 
@@ -314,7 +341,7 @@ class InfinityConnection:
 
     def __init__(self, config: ClientConfig):
         config.verify()
-        self.conn = Connection(config)
+        self.conn = _make_connection(config)
         self.config = config
         self.rdma_connected = False  # parity name: true when zero-copy path is up
         self.semaphore = asyncio.BoundedSemaphore(128)

@@ -93,7 +93,21 @@ def make_mesh(
         else:
             n = len(devices) if devices is not None else len(jax.devices())
             shape = factor_devices(n)
-    devs = list(devices) if devices is not None else jax.devices()[: shape.n_devices]
+    if devices is not None:
+        devs = list(devices)
+    else:
+        all_devs = jax.devices()
+        if len(all_devs) > shape.n_devices:
+            # pinned axis sizes that don't cover the slice: surface it --
+            # silently running on a subset wastes hardware
+            import warnings
+
+            warnings.warn(
+                f"mesh {shape} uses {shape.n_devices} of {len(all_devs)} "
+                f"devices; pass devices= or absorb the rest into dp",
+                stacklevel=2,
+            )
+        devs = all_devs[: shape.n_devices]
     if len(devs) < shape.n_devices:
         raise ValueError(
             f"mesh {shape} needs {shape.n_devices} devices, have {len(devs)}"

@@ -67,8 +67,8 @@ def ring_attention_local(
         x = jnp.broadcast_to(x[:, :, :, None, :], (B, S, Hkv, n_rep, D))
         return x.reshape(B, S, H, D)
 
-    def step(carry, t):
-        kb, vb, m, l, o = carry
+    def attend(mlo, kb, vb, t):
+        m, l, o = mlo
         ki = (idx - t) % n  # which global chunk this K/V block is
         s = jnp.einsum(
             "bqhd,bkhd->bhqk", qf, rep(kb).astype(jnp.float32)
@@ -84,10 +84,15 @@ def ring_attention_local(
         o = o * corr[..., None] + jnp.einsum(
             "bhqk,bkhd->bhqd", p, rep(vb).astype(jnp.float32)
         )
+        return (m_new, l, o)
+
+    def step(carry, t):
+        kb, vb, mlo = carry
+        mlo = attend(mlo, kb, vb, t)
         perm = [(j, (j + 1) % n) for j in range(n)]
         kb = lax.ppermute(kb, axis_name, perm)
         vb = lax.ppermute(vb, axis_name, perm)
-        return (kb, vb, m_new, l, o), None
+        return (kb, vb, mlo), None
 
     vma = (
         set(jax.typeof(q).vma) | set(jax.typeof(k).vma)
@@ -96,7 +101,10 @@ def ring_attention_local(
     m0 = _match_vma(jnp.full((B, H, S), NEG_INF, jnp.float32), vma)
     l0 = _match_vma(jnp.zeros((B, H, S), jnp.float32), vma)
     o0 = _match_vma(jnp.zeros((B, H, S, D), jnp.float32), vma)
-    (_, _, _, l, o), _ = lax.scan(step, (k, v, m0, l0, o0), jnp.arange(n))
+    # n-1 rotated steps; the final block is consumed without the (wasted)
+    # last rotation
+    (k, v, mlo), _ = lax.scan(step, (k, v, (m0, l0, o0)), jnp.arange(n - 1))
+    (_, l, o) = attend(mlo, k, v, n - 1)
     out = o / l[..., None]  # [B, H, S, D]
     return jnp.swapaxes(out, 1, 2).astype(q.dtype)
 

@@ -73,6 +73,7 @@ def _check_divisible(cfg: LlamaConfig, mesh: Mesh, batch: int, seq: int, n_mb: i
         (cfg.n_heads % ax["tp"] == 0, "n_heads % tp"),
         (cfg.n_kv_heads % ax["tp"] == 0, "n_kv_heads % tp"),
         (cfg.vocab_size % ax["tp"] == 0, "vocab_size % tp"),
+        (cfg.ffn_dim % ax["tp"] == 0, "ffn_dim % tp"),
         (seq % ax["sp"] == 0, "seq % sp"),
         (batch % ax["dp"] == 0, "batch % dp"),
         ((batch // ax["dp"]) % n_mb == 0, "local batch % n_microbatches"),

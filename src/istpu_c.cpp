@@ -1,0 +1,86 @@
+// C ABI for the native server - driven from Python via ctypes
+// (infinistore_tpu/_native.py), replacing the reference's pybind11 module
+// (reference: src/pybind.cpp) since pybind11 isn't in the image.
+#include <cstring>
+#include <mutex>
+#include <string>
+
+#include "store.h"
+
+namespace istpu {
+class StoreServer;
+StoreServer* make_server(const StoreConfig& cfg, int port);
+bool server_start(StoreServer* s);
+void server_stop(StoreServer* s);
+void server_destroy(StoreServer* s);
+Store* server_store(StoreServer* s);
+std::mutex* server_mutex(StoreServer* s);
+}  // namespace istpu
+
+using istpu::Store;
+using istpu::StoreConfig;
+using istpu::StoreServer;
+
+extern "C" {
+
+void* istpu_server_create(const char* shm_prefix, uint64_t prealloc_bytes,
+                          uint64_t block_bytes, int auto_increase, int port) {
+  StoreConfig cfg;
+  cfg.shm_prefix = shm_prefix ? shm_prefix : "";
+  cfg.prealloc_bytes = prealloc_bytes;
+  cfg.block_bytes = block_bytes;
+  cfg.auto_increase = auto_increase != 0;
+  try {
+    return istpu::make_server(cfg, port);
+  } catch (...) {
+    return nullptr;
+  }
+}
+
+int istpu_server_start(void* h) {
+  return istpu::server_start(static_cast<StoreServer*>(h)) ? 0 : -1;
+}
+
+void istpu_server_stop(void* h) { istpu::server_stop(static_cast<StoreServer*>(h)); }
+
+void istpu_server_destroy(void* h) {
+  auto* s = static_cast<StoreServer*>(h);
+  istpu::server_stop(s);
+  istpu::server_destroy(s);
+}
+
+uint64_t istpu_server_kvmap_len(void* h) {
+  auto* s = static_cast<StoreServer*>(h);
+  std::lock_guard<std::mutex> g(*istpu::server_mutex(s));
+  return istpu::server_store(s)->kvmap_len();
+}
+
+int istpu_server_purge(void* h) {
+  auto* s = static_cast<StoreServer*>(h);
+  std::lock_guard<std::mutex> g(*istpu::server_mutex(s));
+  return istpu::server_store(s)->purge();
+}
+
+long long istpu_server_evict(void* h, double mn, double mx) {
+  auto* s = static_cast<StoreServer*>(h);
+  std::lock_guard<std::mutex> g(*istpu::server_mutex(s));
+  return istpu::server_store(s)->evict(mn, mx);
+}
+
+double istpu_server_usage(void* h) {
+  auto* s = static_cast<StoreServer*>(h);
+  std::lock_guard<std::mutex> g(*istpu::server_mutex(s));
+  return istpu::server_store(s)->usage();
+}
+
+int istpu_server_stats_json(void* h, char* buf, int cap) {
+  auto* s = static_cast<StoreServer*>(h);
+  std::lock_guard<std::mutex> g(*istpu::server_mutex(s));
+  std::string j = istpu::server_store(s)->stats_json();
+  int n = std::min<int>(cap - 1, j.size());
+  std::memcpy(buf, j.data(), n);
+  buf[n] = 0;
+  return n;
+}
+
+}  // extern "C"

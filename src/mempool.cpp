@@ -1,0 +1,153 @@
+#include "mempool.h"
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstring>
+#include <stdexcept>
+
+#ifndef MADV_POPULATE_WRITE
+#define MADV_POPULATE_WRITE 23  // linux >= 5.14
+#endif
+
+namespace istpu {
+
+static uint64_t round_up(uint64_t x, uint64_t align) {
+  return (x + align - 1) / align * align;
+}
+
+Pool::Pool(const std::string& name, uint64_t pool_size, uint64_t block_size)
+    : name_(name),
+      path_("/dev/shm/" + name),
+      pool_size_(pool_size),
+      block_size_(block_size),
+      total_blocks_(pool_size / block_size),
+      bitmap_((pool_size / block_size + 63) / 64, 0) {
+  if (pool_size % block_size != 0) throw std::invalid_argument("pool_size % block_size");
+  int fd = open(path_.c_str(), O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) throw std::runtime_error("shm open failed: " + path_);
+  if (ftruncate(fd, static_cast<off_t>(pool_size)) != 0) {
+    close(fd);
+    unlink(path_.c_str());
+    throw std::runtime_error("ftruncate failed: " + path_);
+  }
+  void* p = mmap(nullptr, pool_size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (p == MAP_FAILED) {
+    unlink(path_.c_str());
+    throw std::runtime_error("mmap failed: " + path_);
+  }
+  base_ = static_cast<uint8_t*>(p);
+  // pre-fault (the ibv_reg_mr-pin analog); fall back to a touch loop
+  if (madvise(base_, pool_size, MADV_POPULATE_WRITE) != 0) {
+    for (uint64_t off = 0; off < pool_size; off += 4096) base_[off] = 0;
+  }
+}
+
+Pool::~Pool() {
+  if (base_) munmap(base_, pool_size_);
+  unlink(path_.c_str());
+}
+
+int64_t Pool::find_run(uint64_t k) {
+  // scan from the rover with wraparound; bitmap word = 64 blocks
+  auto bit_free = [&](uint64_t i) {
+    return (bitmap_[i >> 6] & (1ULL << (i & 63))) == 0;
+  };
+  uint64_t start = rover_ % total_blocks_;
+  for (int pass = 0; pass < 2; pass++) {
+    uint64_t lo = pass == 0 ? start : 0;
+    uint64_t hi = pass == 0 ? total_blocks_ : start;
+    uint64_t run = 0, run_start = 0;
+    for (uint64_t i = lo; i < hi; i++) {
+      // skip full words fast when starting a fresh run
+      if (run == 0 && (i & 63) == 0 && bitmap_[i >> 6] == ~0ULL) {
+        i += 63;
+        continue;
+      }
+      if (bit_free(i)) {
+        if (run == 0) run_start = i;
+        if (++run == k) return static_cast<int64_t>(run_start);
+      } else {
+        run = 0;
+      }
+    }
+  }
+  return -1;
+}
+
+int64_t Pool::allocate(uint64_t size) {
+  uint64_t k = round_up(size, block_size_) / block_size_;
+  if (k == 0 || k > total_blocks_ - allocated_blocks_) return -1;
+  int64_t idx = find_run(k);
+  if (idx < 0) return -1;
+  for (uint64_t i = idx; i < idx + k; i++) bitmap_[i >> 6] |= 1ULL << (i & 63);
+  allocated_blocks_ += k;
+  rover_ = (idx + k) % total_blocks_;
+  return idx * static_cast<int64_t>(block_size_);
+}
+
+void Pool::deallocate(uint64_t offset, uint64_t size) {
+  uint64_t k = round_up(size, block_size_) / block_size_;
+  uint64_t idx = offset / block_size_;
+  for (uint64_t i = idx; i < idx + k; i++) bitmap_[i >> 6] &= ~(1ULL << (i & 63));
+  allocated_blocks_ -= k;
+}
+
+MM::MM(uint64_t pool_size, uint64_t block_size, const std::string& name_prefix)
+    : block_size_(block_size), name_prefix_(name_prefix) {
+  char buf[256];
+  snprintf(buf, sizeof(buf), "%s_p0", name_prefix_.c_str());
+  pools_.emplace_back(
+      std::make_unique<Pool>(buf, round_up(pool_size, block_size), block_size));
+}
+
+Pool* MM::add_pool(uint64_t pool_size) {
+  char buf[256];
+  snprintf(buf, sizeof(buf), "%s_p%zu", name_prefix_.c_str(), pools_.size());
+  pools_.emplace_back(
+      std::make_unique<Pool>(buf, round_up(pool_size, block_size_), block_size_));
+  return pools_.back().get();
+}
+
+bool MM::allocate(uint64_t size, size_t n, std::vector<Region>* out) {
+  size_t start = out->size();
+  for (size_t i = 0; i < n; i++) {
+    bool placed = false;
+    for (uint32_t pi = 0; pi < pools_.size(); pi++) {
+      int64_t off = pools_[pi]->allocate(size);
+      if (off >= 0) {
+        out->push_back({pi, static_cast<uint64_t>(off)});
+        placed = true;
+        break;
+      }
+    }
+    if (!placed) {  // roll back: all-or-nothing
+      need_extend = true;
+      for (size_t j = start; j < out->size(); j++) {
+        pools_[(*out)[j].pool_idx]->deallocate((*out)[j].offset, size);
+      }
+      out->resize(start);
+      return false;
+    }
+  }
+  return true;
+}
+
+void MM::deallocate(uint32_t pool_idx, uint64_t offset, uint64_t size) {
+  pools_[pool_idx]->deallocate(offset, size);
+}
+
+double MM::usage() const {
+  uint64_t total = 0, used = 0;
+  for (const auto& p : pools_) {
+    total += p->total_blocks();
+    used += p->allocated_blocks();
+  }
+  return total ? static_cast<double>(used) / total : 0.0;
+}
+
+}  // namespace istpu

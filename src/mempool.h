@@ -1,0 +1,85 @@
+// Slab memory pools over POSIX shared memory - C++ native runtime.
+//
+// TPU-native counterpart of the reference's RDMA-registered pinned pool
+// (reference: src/mempool.{h,cpp}): fixed-block bitmap allocator, multi-pool
+// manager with 10 GB auto-extend.  Pools are /dev/shm segments so local
+// clients (the inference engine on the same TPU-VM host) map them and move
+// KV blocks with plain memcpy - the GPUDirect/RDMA analog.  Pages are
+// pre-faulted at creation (MADV_POPULATE_WRITE), the moral equivalent of
+// ibv_reg_mr's pin: the data path never takes a tmpfs first-touch fault.
+#pragma once
+
+#include <cstdint>
+#include <memory>
+#include <string>
+#include <vector>
+
+namespace istpu {
+
+constexpr uint64_t kExtendPoolSize = 10ULL << 30;  // reference: src/mempool.h:12
+
+class Pool {
+ public:
+  Pool(const std::string& name, uint64_t pool_size, uint64_t block_size);
+  ~Pool();
+  Pool(const Pool&) = delete;
+  Pool& operator=(const Pool&) = delete;
+
+  // Returns byte offset into the pool, or -1.  size is rounded up to blocks.
+  int64_t allocate(uint64_t size);
+  void deallocate(uint64_t offset, uint64_t size);
+
+  uint8_t* data() const { return base_; }
+  const std::string& name() const { return name_; }
+  uint64_t pool_size() const { return pool_size_; }
+  uint64_t block_size() const { return block_size_; }
+  uint64_t total_blocks() const { return total_blocks_; }
+  uint64_t allocated_blocks() const { return allocated_blocks_; }
+
+ private:
+  int64_t find_run(uint64_t k);  // first free run of k blocks, or -1
+
+  std::string name_;
+  std::string path_;
+  uint64_t pool_size_;
+  uint64_t block_size_;
+  uint64_t total_blocks_;
+  uint64_t allocated_blocks_ = 0;
+  uint64_t rover_ = 0;
+  uint8_t* base_ = nullptr;
+  std::vector<uint64_t> bitmap_;  // bit set => block in use
+};
+
+struct Region {
+  uint32_t pool_idx;
+  uint64_t offset;
+};
+
+class MM {
+ public:
+  MM(uint64_t pool_size, uint64_t block_size, const std::string& name_prefix);
+  ~MM() = default;
+
+  Pool* add_pool(uint64_t pool_size = kExtendPoolSize);
+
+  // All-or-nothing batch allocate of n regions of `size` bytes each
+  // (reference: src/mempool.cpp MM::allocate's callback-per-region loop).
+  bool allocate(uint64_t size, size_t n, std::vector<Region>* out);
+  void deallocate(uint32_t pool_idx, uint64_t offset, uint64_t size);
+
+  uint8_t* view(uint32_t pool_idx, uint64_t offset) const {
+    return pools_[pool_idx]->data() + offset;
+  }
+  double usage() const;
+  uint64_t block_size() const { return block_size_; }
+  const std::vector<std::unique_ptr<Pool>>& pools() const { return pools_; }
+
+  bool need_extend = false;
+
+ private:
+  uint64_t block_size_;
+  std::string name_prefix_;
+  std::vector<std::unique_ptr<Pool>> pools_;
+};
+
+}  // namespace istpu

@@ -1,0 +1,163 @@
+// Wire protocol - C++ mirror of infinistore_tpu/protocol.py.
+//
+// Same concept as the reference's packed {magic, op, body_size} header
+// (reference: src/protocol.h:35-72) with hand-rolled little-endian bodies
+// instead of flatbuffers.  Layouts MUST stay byte-identical to protocol.py:
+// the Python client and C++ server interoperate on one socket.
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace istpu {
+
+constexpr uint32_t MAGIC = 0x54504B56;  // "VKPT"
+constexpr uint8_t VERSION = 1;
+
+#pragma pack(push, 1)
+struct Header {
+  uint32_t magic;
+  uint8_t version;
+  uint8_t op;
+  uint16_t flags;
+  uint32_t body_len;
+  uint32_t req_id;
+};
+struct RespHeader {
+  int32_t status;
+  uint32_t body_len;
+};
+struct Desc {
+  uint32_t pool_idx;
+  uint64_t offset;
+  uint64_t size;
+};
+#pragma pack(pop)
+
+static_assert(sizeof(Header) == 16, "header layout");
+static_assert(sizeof(RespHeader) == 8, "resp layout");
+static_assert(sizeof(Desc) == 20, "desc layout");
+
+// ops (protocol.py:45-59)
+enum Op : uint8_t {
+  OP_HELLO = 1,
+  OP_PUT_INLINE = 2,
+  OP_GET_INLINE = 3,
+  OP_ALLOC_PUT = 4,
+  OP_COMMIT_PUT = 5,
+  OP_GET_DESC = 6,
+  OP_EXIST = 7,
+  OP_MATCH_LAST_IDX = 8,
+  OP_DELETE_KEYS = 9,
+  OP_PURGE = 10,
+  OP_STATS = 11,
+  OP_EVICT = 12,
+  OP_PUT_INLINE_BATCH = 13,
+  OP_GET_INLINE_BATCH = 14,
+  OP_POOLS = 15,
+};
+
+// status codes (same numbers as reference src/protocol.h:55-62)
+enum Status : int32_t {
+  INVALID_REQ = 400,
+  FINISH = 200,
+  TASK_ACCEPTED = 202,
+  INTERNAL_ERROR = 500,
+  KEY_NOT_FOUND = 404,
+  RETRY = 408,
+  SYSTEM_ERROR = 503,
+  OUT_OF_MEMORY = 507,
+};
+
+inline const char* op_name(uint8_t op) {
+  switch (op) {
+    case OP_HELLO: return "HELLO";
+    case OP_PUT_INLINE: return "PUT_INLINE";
+    case OP_GET_INLINE: return "GET_INLINE";
+    case OP_ALLOC_PUT: return "ALLOC_PUT";
+    case OP_COMMIT_PUT: return "COMMIT_PUT";
+    case OP_GET_DESC: return "GET_DESC";
+    case OP_EXIST: return "EXIST";
+    case OP_MATCH_LAST_IDX: return "MATCH_LAST_IDX";
+    case OP_DELETE_KEYS: return "DELETE_KEYS";
+    case OP_PURGE: return "PURGE";
+    case OP_STATS: return "STATS";
+    case OP_EVICT: return "EVICT";
+    case OP_PUT_INLINE_BATCH: return "PUT_INLINE_BATCH";
+    case OP_GET_INLINE_BATCH: return "GET_INLINE_BATCH";
+    case OP_POOLS: return "POOLS";
+    default: return "UNKNOWN";
+  }
+}
+
+// ---- body readers/writers (bounds-checked cursor) ----
+
+class Reader {
+ public:
+  Reader(const uint8_t* p, size_t n) : p_(p), n_(n) {}
+  bool ok() const { return ok_; }
+  size_t remaining() const { return n_ - off_; }
+
+  template <typename T>
+  T get() {
+    T v{};
+    if (off_ + sizeof(T) > n_) { ok_ = false; return v; }
+    std::memcpy(&v, p_ + off_, sizeof(T));
+    off_ += sizeof(T);
+    return v;
+  }
+
+  bool get_bytes(std::string* out, size_t len) {
+    if (off_ + len > n_) { ok_ = false; return false; }
+    out->assign(reinterpret_cast<const char*>(p_ + off_), len);
+    off_ += len;
+    return true;
+  }
+
+  // keys: n u32 | n x { len u16 | bytes }  (protocol.py pack_keys)
+  bool get_keys(std::vector<std::string>* keys) {
+    uint32_t n = get<uint32_t>();
+    if (!ok_) return false;
+    keys->reserve(n);
+    for (uint32_t i = 0; i < n; i++) {
+      uint16_t klen = get<uint16_t>();
+      std::string k;
+      if (!ok_ || !get_bytes(&k, klen)) return false;
+      keys->push_back(std::move(k));
+    }
+    return true;
+  }
+
+ private:
+  const uint8_t* p_;
+  size_t n_;
+  size_t off_ = 0;
+  bool ok_ = true;
+};
+
+class Writer {
+ public:
+  explicit Writer(std::string* out) : out_(out) {}
+
+  template <typename T>
+  void put(T v) {
+    out_->append(reinterpret_cast<const char*>(&v), sizeof(T));
+  }
+  void put_bytes(const void* p, size_t n) {
+    out_->append(reinterpret_cast<const char*>(p), n);
+  }
+  void put_keys(const std::vector<std::string>& keys) {
+    put<uint32_t>(static_cast<uint32_t>(keys.size()));
+    for (const auto& k : keys) {
+      put<uint16_t>(static_cast<uint16_t>(k.size()));
+      put_bytes(k.data(), k.size());
+    }
+  }
+
+ private:
+  std::string* out_;
+};
+
+}  // namespace istpu

@@ -1,0 +1,260 @@
+#include "store.h"
+
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstring>
+
+namespace istpu {
+
+static std::string rand_prefix() {
+  char buf[64];
+  snprintf(buf, sizeof(buf), "istpu_%d_%08x", getpid(),
+           static_cast<unsigned>(std::chrono::steady_clock::now().time_since_epoch().count()));
+  return buf;
+}
+
+Store::Store(const StoreConfig& cfg)
+    : cfg_(cfg),
+      mm_(cfg.prealloc_bytes, cfg.block_bytes,
+          cfg.shm_prefix.empty() ? rand_prefix() : cfg.shm_prefix) {}
+
+double Store::now() {
+  return std::chrono::duration<double>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+void Store::touch(Slot& s, const std::string& key) {
+  lru_.erase(s.lru_it);
+  lru_.push_back(key);
+  s.lru_it = std::prev(lru_.end());
+}
+
+void Store::insert_committed(const std::string& key, const Entry& e) {
+  auto it = kv_.find(key);
+  if (it != kv_.end()) {  // overwrite frees the old region
+    free_entry(it->second.e);
+    lru_.erase(it->second.lru_it);
+    kv_.erase(it);
+  }
+  lru_.push_back(key);
+  kv_.emplace(key, Slot{e, std::prev(lru_.end())});
+}
+
+int64_t Store::evict(double min_threshold, double max_threshold) {
+  int64_t evicted = 0;
+  if (mm_.usage() >= max_threshold) {
+    double t = now();
+    size_t rotated = 0;
+    while (mm_.usage() >= min_threshold && !lru_.empty()) {
+      const std::string key = lru_.front();
+      auto it = kv_.find(key);
+      if (it == kv_.end()) {  // should not happen; keep structures in sync
+        lru_.pop_front();
+        continue;
+      }
+      if (it->second.e.lease > t) {
+        // leased for an in-flight shm read; rotate past it
+        touch(it->second, key);
+        if (++rotated >= kv_.size()) break;
+        continue;
+      }
+      free_entry(it->second.e);
+      lru_.pop_front();
+      kv_.erase(it);
+      evicted++;
+    }
+  }
+  stats_.evicted += evicted;
+  return evicted;
+}
+
+bool Store::allocate(uint64_t size, size_t n, std::vector<Region>* out) {
+  // on-demand evict + allocate + auto-extend retry (src/infinistore.cpp:437-452)
+  evict(kOnDemandMin, kOnDemandMax);
+  if (mm_.allocate(size, n, out)) return true;
+  if (cfg_.auto_increase && mm_.need_extend) {
+    mm_.add_pool();
+    mm_.need_extend = false;
+    return mm_.allocate(size, n, out);
+  }
+  return false;
+}
+
+Status Store::alloc_put(const std::vector<std::string>& keys, uint64_t block_size,
+                        std::vector<Desc>* descs) {
+  // duplicate keys in one batch would hand out two regions for one map slot
+  {
+    std::unordered_map<std::string, int> seen;
+    for (const auto& k : keys) {
+      if (seen.count(k)) return INVALID_REQ;
+      seen.emplace(k, 1);
+    }
+  }
+  for (const auto& k : keys) {
+    auto it = pending_.find(k);
+    if (it != pending_.end() && it->second.busy) return RETRY;
+  }
+  std::vector<Region> regions;
+  regions.reserve(keys.size());
+  if (!allocate(block_size, keys.size(), &regions)) return OUT_OF_MEMORY;
+  descs->reserve(keys.size());
+  for (size_t i = 0; i < keys.size(); i++) {
+    auto it = pending_.find(keys[i]);
+    if (it != pending_.end()) {
+      free_entry(it->second);
+      pending_.erase(it);
+    }
+    pending_.emplace(keys[i],
+                     Entry{regions[i].pool_idx, regions[i].offset, block_size});
+    descs->push_back({regions[i].pool_idx, regions[i].offset, block_size});
+  }
+  return FINISH;
+}
+
+void Store::abort_put(const std::vector<std::string>& keys) {
+  for (const auto& k : keys) {
+    auto it = pending_.find(k);
+    if (it != pending_.end()) {
+      free_entry(it->second);
+      pending_.erase(it);
+    }
+  }
+}
+
+Status Store::commit_put(const std::vector<std::string>& keys, int32_t* committed) {
+  *committed = 0;
+  for (const auto& k : keys) {
+    auto it = pending_.find(k);
+    if (it == pending_.end()) continue;
+    Entry e = it->second;
+    e.busy = false;
+    pending_.erase(it);
+    insert_committed(k, e);
+    (*committed)++;
+    stats_.puts++;
+    stats_.bytes_in += e.size;
+  }
+  return *committed == static_cast<int32_t>(keys.size()) ? FINISH : INVALID_REQ;
+}
+
+Status Store::get_desc(const std::vector<std::string>& keys, uint64_t block_size,
+                       std::vector<Desc>* descs) {
+  descs->reserve(keys.size());
+  for (const auto& k : keys) {
+    auto it = kv_.find(k);
+    if (it == kv_.end()) {
+      stats_.misses++;
+      descs->clear();
+      return KEY_NOT_FOUND;
+    }
+    if (block_size && it->second.e.size > block_size) {
+      descs->clear();
+      return INVALID_REQ;
+    }
+    descs->push_back({it->second.e.pool_idx, it->second.e.offset, it->second.e.size});
+  }
+  double t = now();
+  for (const auto& k : keys) {
+    auto& s = kv_.find(k)->second;
+    s.e.lease = t + kReadLeaseS;
+    touch(s, k);
+    stats_.gets++;
+    stats_.hits++;
+    stats_.bytes_out += s.e.size;
+  }
+  return FINISH;
+}
+
+Status Store::put_inline(const std::string& key, const uint8_t* data, uint64_t size) {
+  std::vector<Region> regions;
+  if (!allocate(size, 1, &regions)) return OUT_OF_MEMORY;
+  std::memcpy(mm_.view(regions[0].pool_idx, regions[0].offset), data, size);
+  insert_committed(key, Entry{regions[0].pool_idx, regions[0].offset, size});
+  stats_.puts++;
+  stats_.bytes_in += size;
+  return FINISH;
+}
+
+const Entry* Store::get_inline(const std::string& key) {
+  auto it = kv_.find(key);
+  if (it == kv_.end()) {
+    stats_.misses++;
+    return nullptr;
+  }
+  touch(it->second, key);
+  stats_.gets++;
+  stats_.hits++;
+  stats_.bytes_out += it->second.e.size;
+  return &it->second.e;
+}
+
+int32_t Store::match_last_index(const std::vector<std::string>& keys) const {
+  // binary search: assumes present keys form a prefix (src/infinistore.cpp:786-802)
+  int32_t left = 0, right = static_cast<int32_t>(keys.size());
+  while (left < right) {
+    int32_t mid = (left + right) / 2;
+    if (kv_.count(keys[mid]))
+      left = mid + 1;
+    else
+      right = mid;
+  }
+  return left - 1;
+}
+
+int32_t Store::delete_keys(const std::vector<std::string>& keys) {
+  int32_t count = 0;
+  for (const auto& k : keys) {
+    auto it = kv_.find(k);
+    if (it == kv_.end()) continue;
+    free_entry(it->second.e);
+    lru_.erase(it->second.lru_it);
+    kv_.erase(it);
+    count++;
+  }
+  return count;
+}
+
+int32_t Store::purge() {
+  int32_t n = static_cast<int32_t>(kv_.size());
+  for (auto& [k, s] : kv_) free_entry(s.e);
+  kv_.clear();
+  lru_.clear();
+  // keep regions an op is actively streaming into; free the rest
+  std::unordered_map<std::string, Entry> keep;
+  for (auto& [k, e] : pending_) {
+    if (e.busy)
+      keep.emplace(k, e);
+    else
+      free_entry(e);
+  }
+  pending_ = std::move(keep);
+  return n;
+}
+
+Entry* Store::pending_entry(const std::string& key) {
+  auto it = pending_.find(key);
+  return it == pending_.end() ? nullptr : &it->second;
+}
+
+std::string Store::stats_json() const {
+  char buf[512];
+  snprintf(buf, sizeof(buf),
+           "{\"kvmap_len\": %zu, \"pending\": %zu, \"usage\": %.6f, "
+           "\"pools\": %zu, \"block_size\": %llu, \"puts\": %llu, "
+           "\"gets\": %llu, \"hits\": %llu, \"misses\": %llu, "
+           "\"evicted\": %llu, \"bytes_in\": %llu, \"bytes_out\": %llu}",
+           kv_.size(), pending_.size(), mm_.usage(), mm_.pools().size(),
+           static_cast<unsigned long long>(mm_.block_size()),
+           static_cast<unsigned long long>(stats_.puts),
+           static_cast<unsigned long long>(stats_.gets),
+           static_cast<unsigned long long>(stats_.hits),
+           static_cast<unsigned long long>(stats_.misses),
+           static_cast<unsigned long long>(stats_.evicted),
+           static_cast<unsigned long long>(stats_.bytes_in),
+           static_cast<unsigned long long>(stats_.bytes_out));
+  return buf;
+}
+
+}  // namespace istpu

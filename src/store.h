@@ -1,0 +1,101 @@
+// KV store core: kv_map + intrusive LRU + pending (uncommitted) entries.
+//
+// C++ native runtime counterpart of infinistore_tpu/store.py; mirrors the
+// reference server state (reference: src/infinistore.cpp:26-53 kv_map +
+// lru_queue + MM) and its op semantics:
+//  * entries visible only at commit (src/infinistore.cpp:405-418)
+//  * reads touch LRU, 404 if any key missing (src/infinistore.cpp:612-634)
+//  * eviction pops LRU until usage < min threshold (src/infinistore.cpp:223-234)
+//  * on-demand thresholds 0.8/0.95 before allocation (src/infinistore.cpp:52-53)
+//  * match_last_index binary search (src/infinistore.cpp:786-802)
+#pragma once
+
+#include <chrono>
+#include <list>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "mempool.h"
+#include "protocol.h"
+
+namespace istpu {
+
+constexpr double kOnDemandMin = 0.8;
+constexpr double kOnDemandMax = 0.95;
+constexpr double kReadLeaseS = 5.0;
+
+struct Entry {
+  uint32_t pool_idx;
+  uint64_t offset;
+  uint64_t size;
+  double lease = 0.0;
+  bool busy = false;  // an op is streaming payload into this pending region
+};
+
+struct StoreStats {
+  uint64_t puts = 0, gets = 0, hits = 0, misses = 0, evicted = 0;
+  uint64_t bytes_in = 0, bytes_out = 0;
+};
+
+struct StoreConfig {
+  uint64_t prealloc_bytes = 1ULL << 30;
+  uint64_t block_bytes = 64 << 10;
+  bool auto_increase = false;
+  std::string shm_prefix;
+};
+
+class Store {
+ public:
+  explicit Store(const StoreConfig& cfg);
+
+  // ---- zero-copy batched ops ----
+  Status alloc_put(const std::vector<std::string>& keys, uint64_t block_size,
+                   std::vector<Desc>* descs);
+  void abort_put(const std::vector<std::string>& keys);
+  Status commit_put(const std::vector<std::string>& keys, int32_t* committed);
+  Status get_desc(const std::vector<std::string>& keys, uint64_t block_size,
+                  std::vector<Desc>* descs);
+
+  // ---- inline ops ----
+  Status put_inline(const std::string& key, const uint8_t* data, uint64_t size);
+  const Entry* get_inline(const std::string& key);  // touches LRU; null if miss
+
+  // ---- metadata ----
+  bool exist(const std::string& key) const { return kv_.count(key) != 0; }
+  int32_t match_last_index(const std::vector<std::string>& keys) const;
+  int32_t delete_keys(const std::vector<std::string>& keys);
+  int32_t purge();
+  int64_t evict(double min_threshold, double max_threshold);
+
+  uint8_t* view(uint32_t pool_idx, uint64_t offset) { return mm_.view(pool_idx, offset); }
+  double usage() const { return mm_.usage(); }
+  size_t kvmap_len() const { return kv_.size(); }
+  size_t pending_len() const { return pending_.size(); }
+  const MM& mm() const { return mm_; }
+  const StoreStats& stats() const { return stats_; }
+  std::string stats_json() const;
+  Entry* pending_entry(const std::string& key);
+
+ private:
+  using LruList = std::list<std::string>;  // front = LRU, back = MRU
+  struct Slot {
+    Entry e;
+    LruList::iterator lru_it;
+  };
+
+  void free_entry(const Entry& e) { mm_.deallocate(e.pool_idx, e.offset, e.size); }
+  void insert_committed(const std::string& key, const Entry& e);
+  void touch(Slot& s, const std::string& key);
+  bool allocate(uint64_t size, size_t n, std::vector<Region>* out);
+  static double now();
+
+  StoreConfig cfg_;
+  MM mm_;
+  std::unordered_map<std::string, Slot> kv_;
+  std::unordered_map<std::string, Entry> pending_;
+  LruList lru_;
+  StoreStats stats_;
+};
+
+}  // namespace istpu

@@ -1,0 +1,447 @@
+// Native client engine - counterpart of the reference's libinfinistore.cpp
+// Connection (reference: src/libinfinistore.cpp: TCP socket + RDMA QP,
+// batched WR chains).  Here the zero-copy path maps the server's /dev/shm
+// pools and memcpys blocks directly (the RDMA-WRITE/READ analog on a shared
+// TPU-VM host); remote clients use the inline batch ops over TCP.
+//
+// All calls are blocking on one socket; Python drives them via ctypes, which
+// releases the GIL around foreign calls - the GIL-free IO the reference gets
+// from its CQ-polling thread.
+#include <arpa/inet.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <string.h>
+#include <sys/mman.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "protocol.h"
+
+#ifndef MADV_POPULATE_WRITE
+#define MADV_POPULATE_WRITE 23
+#endif
+
+namespace istpu {
+
+struct MappedPool {
+  std::string name;
+  uint8_t* base = nullptr;
+  uint64_t size = 0;
+};
+
+class Client {
+ public:
+  ~Client() { close_conn(); }
+
+  // returns 0 on success, negative errno-style on failure
+  int connect_to(const char* host, int port, bool use_shm) {
+    fd_ = socket(AF_INET, SOCK_STREAM, 0);
+    if (fd_ < 0) return -1;
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) return -2;
+    if (connect(fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0)
+      return -3;
+    int one = 1;
+    setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    // HELLO: pid u32 | flags u32 -> pool table
+    std::string body;
+    Writer w(&body);
+    w.put<uint32_t>(static_cast<uint32_t>(getpid()));
+    w.put<uint32_t>(0);
+    std::string resp;
+    int32_t st = request(OP_HELLO, body, &resp);
+    if (st != FINISH) return -4;
+    if (!parse_pool_table(resp)) return -5;
+    shm_ = use_shm;
+    if (shm_ && !map_pools()) return -6;
+    return 0;
+  }
+
+  void close_conn() {
+    if (fd_ >= 0) {
+      close(fd_);
+      fd_ = -1;
+    }
+    for (auto& p : pools_) {
+      if (p.base) munmap(p.base, p.size);
+      p.base = nullptr;
+    }
+    pools_.clear();
+  }
+
+  // ---- batched zero-copy ops (reference: rdma_write_cache / rdma_read_cache) ----
+
+  int32_t write_cache(const char* const* keys, const uint64_t* offsets, size_t n,
+                      uint64_t block_size, const uint8_t* base) {
+    std::lock_guard<std::mutex> g(mu_);
+    if (shm_) {
+      std::string body = pack_block_req(keys, n, block_size);
+      std::string resp;
+      int32_t st = request(OP_ALLOC_PUT, body, &resp);
+      for (int retry = 0; retry < 20 && st == RETRY; retry++) {
+        usleep(50000);
+        st = request(OP_ALLOC_PUT, body, &resp);
+      }
+      if (st != FINISH) return st;
+      size_t nd = resp.size() / sizeof(Desc);
+      if (nd != n) return INTERNAL_ERROR;
+      const Desc* descs = reinterpret_cast<const Desc*>(resp.data());
+      for (size_t i = 0; i < n; i++) {
+        uint8_t* dst = pool_ptr(descs[i].pool_idx, descs[i].offset);
+        if (!dst) return INTERNAL_ERROR;
+        std::memcpy(dst, base + offsets[i], block_size);
+      }
+      std::string commit;
+      Writer w(&commit);
+      put_keys(&w, keys, n);
+      std::string resp2;
+      return request(OP_COMMIT_PUT, commit, &resp2);
+    }
+    // inline path: frame + n*block_size payload
+    std::string body = pack_block_req(keys, n, block_size);
+    Header hdr{MAGIC, VERSION, OP_PUT_INLINE_BATCH, 0,
+               static_cast<uint32_t>(body.size()), 0};
+    if (!send_all(&hdr, sizeof(hdr)) || !send_all(body.data(), body.size()))
+      return SYSTEM_ERROR;
+    for (size_t i = 0; i < n; i++) {
+      if (!send_all(base + offsets[i], block_size)) return SYSTEM_ERROR;
+    }
+    std::string resp;
+    return read_resp(&resp);
+  }
+
+  int32_t read_cache(const char* const* keys, const uint64_t* offsets, size_t n,
+                     uint64_t block_size, uint8_t* base) {
+    std::lock_guard<std::mutex> g(mu_);
+    if (shm_) {
+      std::string body = pack_block_req(keys, n, block_size);
+      std::string resp;
+      int32_t st = request(OP_GET_DESC, body, &resp);
+      if (st != FINISH) return st;
+      size_t nd = resp.size() / sizeof(Desc);
+      if (nd != n) return INTERNAL_ERROR;
+      const Desc* descs = reinterpret_cast<const Desc*>(resp.data());
+      for (size_t i = 0; i < n; i++) {
+        uint8_t* src = pool_ptr(descs[i].pool_idx, descs[i].offset);
+        if (!src) return INTERNAL_ERROR;
+        std::memcpy(base + offsets[i], src, descs[i].size);
+      }
+      return FINISH;
+    }
+    std::string body = pack_block_req(keys, n, block_size);
+    Header hdr{MAGIC, VERSION, OP_GET_INLINE_BATCH, 0,
+               static_cast<uint32_t>(body.size()), 0};
+    if (!send_all(&hdr, sizeof(hdr)) || !send_all(body.data(), body.size()))
+      return SYSTEM_ERROR;
+    RespHeader rh;
+    if (!recv_all(&rh, sizeof(rh))) return SYSTEM_ERROR;
+    if (rh.status != FINISH) {
+      std::string drain(rh.body_len, 0);
+      if (rh.body_len && !recv_all(drain.data(), rh.body_len)) return SYSTEM_ERROR;
+      return rh.status;
+    }
+    std::vector<uint32_t> sizes(n);
+    if (!recv_all(sizes.data(), 4 * n)) return SYSTEM_ERROR;
+    for (size_t i = 0; i < n; i++) {
+      if (!recv_all(base + offsets[i], sizes[i])) return SYSTEM_ERROR;
+    }
+    return FINISH;
+  }
+
+  // ---- single-key inline ----
+
+  int32_t put_inline(const char* key, const uint8_t* data, uint64_t size) {
+    std::lock_guard<std::mutex> g(mu_);
+    std::string body;
+    Writer w(&body);
+    size_t klen = strlen(key);
+    w.put<uint16_t>(static_cast<uint16_t>(klen));
+    w.put_bytes(key, klen);
+    w.put<uint64_t>(size);
+    w.put_bytes(data, size);
+    std::string resp;
+    return request(OP_PUT_INLINE, body, &resp);
+  }
+
+  // out must hold cap bytes; *out_size gets stored size (fails if > cap)
+  int32_t get_inline(const char* key, uint8_t* out, uint64_t cap,
+                     uint64_t* out_size) {
+    std::lock_guard<std::mutex> g(mu_);
+    std::string body;
+    Writer w(&body);
+    put_keys(&w, &key, 1);
+    Header hdr{MAGIC, VERSION, OP_GET_INLINE, 0,
+               static_cast<uint32_t>(body.size()), 0};
+    if (!send_all(&hdr, sizeof(hdr)) || !send_all(body.data(), body.size()))
+      return SYSTEM_ERROR;
+    RespHeader rh;
+    if (!recv_all(&rh, sizeof(rh))) return SYSTEM_ERROR;
+    if (rh.status != FINISH || rh.body_len > cap) {
+      std::string drain(rh.body_len, 0);
+      if (rh.body_len && !recv_all(drain.data(), rh.body_len)) return SYSTEM_ERROR;
+      if (rh.status == FINISH) {  // caller buffer too small
+        *out_size = rh.body_len;
+        return INVALID_REQ;
+      }
+      return rh.status;
+    }
+    if (rh.body_len && !recv_all(out, rh.body_len)) return SYSTEM_ERROR;
+    *out_size = rh.body_len;
+    return FINISH;
+  }
+
+  // ---- metadata ----
+
+  int32_t simple_i32(uint8_t op, const char* const* keys, size_t n, int32_t* out) {
+    std::lock_guard<std::mutex> g(mu_);
+    std::string body;
+    Writer w(&body);
+    put_keys(&w, keys, n);
+    std::string resp;
+    int32_t st = request(op, body, &resp);
+    if (st == FINISH && resp.size() >= 4) std::memcpy(out, resp.data(), 4);
+    return st;
+  }
+
+  int32_t purge(int32_t* out) {
+    std::lock_guard<std::mutex> g(mu_);
+    std::string resp;
+    int32_t st = request(OP_PURGE, "", &resp);
+    if (st == FINISH && resp.size() >= 4) std::memcpy(out, resp.data(), 4);
+    return st;
+  }
+
+  int32_t evict(float mn, float mx) {
+    std::lock_guard<std::mutex> g(mu_);
+    std::string body;
+    Writer w(&body);
+    w.put<float>(mn);
+    w.put<float>(mx);
+    std::string resp;
+    return request(OP_EVICT, body, &resp);
+  }
+
+  int32_t stats_json(char* buf, int cap) {
+    std::lock_guard<std::mutex> g(mu_);
+    std::string resp;
+    int32_t st = request(OP_STATS, "", &resp);
+    if (st != FINISH) return st;
+    int n = std::min<int>(cap - 1, resp.size());
+    std::memcpy(buf, resp.data(), n);
+    buf[n] = 0;
+    return FINISH;
+  }
+
+ private:
+  static std::string pack_block_req(const char* const* keys, size_t n,
+                                    uint64_t block_size) {
+    std::string body;
+    Writer w(&body);
+    w.put<uint64_t>(block_size);
+    put_keys(&w, keys, n);
+    return body;
+  }
+
+  static void put_keys(Writer* w, const char* const* keys, size_t n) {
+    w->put<uint32_t>(static_cast<uint32_t>(n));
+    for (size_t i = 0; i < n; i++) {
+      size_t klen = strlen(keys[i]);
+      w->put<uint16_t>(static_cast<uint16_t>(klen));
+      w->put_bytes(keys[i], klen);
+    }
+  }
+
+  bool parse_pool_table(const std::string& resp) {
+    Reader rd(reinterpret_cast<const uint8_t*>(resp.data()), resp.size());
+    uint32_t n = rd.get<uint32_t>();
+    if (!rd.ok()) return false;
+    std::vector<MappedPool> table;
+    for (uint32_t i = 0; i < n; i++) {
+      uint16_t nlen = rd.get<uint16_t>();
+      MappedPool p;
+      if (!rd.ok() || !rd.get_bytes(&p.name, nlen)) return false;
+      p.size = rd.get<uint64_t>();
+      rd.get<uint64_t>();  // block_size (informational)
+      if (!rd.ok()) return false;
+      table.push_back(std::move(p));
+    }
+    // preserve existing mappings by name
+    for (auto& np : table) {
+      for (auto& op : pools_) {
+        if (op.base && op.name == np.name) {
+          np.base = op.base;
+          op.base = nullptr;
+        }
+      }
+    }
+    for (auto& op : pools_) {
+      if (op.base) munmap(op.base, op.size);
+    }
+    pools_ = std::move(table);
+    return true;
+  }
+
+  bool map_pools() {
+    for (auto& p : pools_) {
+      if (p.base) continue;
+      std::string path = "/dev/shm/" + p.name;
+      int fd = open(path.c_str(), O_RDWR);
+      if (fd < 0) return false;
+      void* m = mmap(nullptr, p.size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+      close(fd);
+      if (m == MAP_FAILED) return false;
+      // server already populated the pages; this maps them into our page
+      // table so the data path takes no minor faults
+      madvise(m, p.size, MADV_POPULATE_WRITE);
+      p.base = static_cast<uint8_t*>(m);
+    }
+    return true;
+  }
+
+  uint8_t* pool_ptr(uint32_t idx, uint64_t off) {
+    if (idx >= pools_.size() || !pools_[idx].base) {
+      // pool table grew (auto-extend): refresh + remap
+      std::string resp;
+      if (request(OP_POOLS, "", &resp) != FINISH || !parse_pool_table(resp) ||
+          !map_pools() || idx >= pools_.size())
+        return nullptr;
+    }
+    return pools_[idx].base + off;
+  }
+
+  bool send_all(const void* p, size_t n) {
+    const char* b = static_cast<const char*>(p);
+    while (n) {
+      ssize_t r = send(fd_, b, n, MSG_NOSIGNAL);
+      if (r <= 0) return false;
+      b += r;
+      n -= r;
+    }
+    return true;
+  }
+
+  bool recv_all(void* p, size_t n) {
+    char* b = static_cast<char*>(p);
+    while (n) {
+      ssize_t r = recv(fd_, b, n, 0);
+      if (r <= 0) return false;
+      b += r;
+      n -= r;
+    }
+    return true;
+  }
+
+  int32_t read_resp(std::string* body) {
+    RespHeader rh;
+    if (!recv_all(&rh, sizeof(rh))) return SYSTEM_ERROR;
+    body->resize(rh.body_len);
+    if (rh.body_len && !recv_all(body->data(), rh.body_len)) return SYSTEM_ERROR;
+    return rh.status;
+  }
+
+  int32_t request(uint8_t op, const std::string& body, std::string* resp) {
+    Header hdr{MAGIC, VERSION, op, 0, static_cast<uint32_t>(body.size()), 0};
+    if (!send_all(&hdr, sizeof(hdr))) return SYSTEM_ERROR;
+    if (!body.empty() && !send_all(body.data(), body.size())) return SYSTEM_ERROR;
+    return read_resp(resp);
+  }
+
+  int fd_ = -1;
+  bool shm_ = false;
+  std::vector<MappedPool> pools_;
+  std::mutex mu_;
+};
+
+Client* make_client() { return new Client(); }
+
+}  // namespace istpu
+
+// ---- C ABI for ctypes (infinistore_tpu/_native.py) ----
+
+using istpu::Client;
+
+extern "C" {
+
+void* istpu_client_create() { return new Client(); }
+
+int istpu_client_connect(void* h, const char* host, int port, int use_shm) {
+  return static_cast<Client*>(h)->connect_to(host, port, use_shm != 0);
+}
+
+void istpu_client_close(void* h) { static_cast<Client*>(h)->close_conn(); }
+void istpu_client_destroy(void* h) { delete static_cast<Client*>(h); }
+
+int istpu_client_write_cache(void* h, const char* const* keys,
+                             const uint64_t* offsets, int n,
+                             uint64_t block_size, const void* base) {
+  return static_cast<Client*>(h)->write_cache(
+      keys, offsets, n, block_size, static_cast<const uint8_t*>(base));
+}
+
+int istpu_client_read_cache(void* h, const char* const* keys,
+                            const uint64_t* offsets, int n, uint64_t block_size,
+                            void* base) {
+  return static_cast<Client*>(h)->read_cache(keys, offsets, n, block_size,
+                                             static_cast<uint8_t*>(base));
+}
+
+int istpu_client_put_inline(void* h, const char* key, const void* data,
+                            uint64_t size) {
+  return static_cast<Client*>(h)->put_inline(
+      key, static_cast<const uint8_t*>(data), size);
+}
+
+int istpu_client_get_inline(void* h, const char* key, void* out, uint64_t cap,
+                            uint64_t* out_size) {
+  return static_cast<Client*>(h)->get_inline(key, static_cast<uint8_t*>(out),
+                                             cap, out_size);
+}
+
+int istpu_client_exist(void* h, const char* key, int* out) {
+  int32_t v = 0;
+  int st = static_cast<Client*>(h)->simple_i32(istpu::OP_EXIST, &key, 1, &v);
+  *out = v;
+  return st;
+}
+
+int istpu_client_match_last_index(void* h, const char* const* keys, int n,
+                                  int* out) {
+  int32_t v = -1;
+  int st = static_cast<Client*>(h)->simple_i32(istpu::OP_MATCH_LAST_IDX, keys,
+                                               n, &v);
+  *out = v;
+  return st;
+}
+
+int istpu_client_delete_keys(void* h, const char* const* keys, int n, int* out) {
+  int32_t v = 0;
+  int st = static_cast<Client*>(h)->simple_i32(istpu::OP_DELETE_KEYS, keys, n, &v);
+  *out = v;
+  return st;
+}
+
+int istpu_client_purge(void* h, int* out) {
+  int32_t v = 0;
+  int st = static_cast<Client*>(h)->purge(&v);
+  *out = v;
+  return st;
+}
+
+int istpu_client_evict(void* h, float mn, float mx) {
+  return static_cast<Client*>(h)->evict(mn, mx);
+}
+
+int istpu_client_stats_json(void* h, char* buf, int cap) {
+  return static_cast<Client*>(h)->stats_json(buf, cap);
+}
+
+}  // extern "C"

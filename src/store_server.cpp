@@ -1,0 +1,536 @@
+// Data-plane server: single-threaded epoll event loop.
+//
+// Native counterpart of infinistore_tpu/pyserver.py and of the reference's
+// libuv server (reference: src/infinistore.cpp:887-1029).  Same per-
+// connection state machine (READ_HEADER -> READ_BODY -> optional payload
+// streaming straight into pool memory, mirroring the reference's
+// READ_VALUE_THROUGH_TCP state), same wire protocol as protocol.py, so
+// Python and C++ clients are interchangeable.
+//
+// Concurrency model: one epoll thread owns all sockets; the Store is guarded
+// by a mutex so the Python manage plane (purge/evict/stats via the C ABI,
+// see istpu_c.cpp) can call in from other threads -- the reference instead
+// queues manage ops onto the loop; a mutex is simpler and the ops are rare.
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/eventfd.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <memory>
+#include <mutex>
+#include <thread>
+#include <unordered_map>
+
+#include "protocol.h"
+#include "store.h"
+
+namespace istpu {
+
+namespace {
+constexpr size_t kMaxBody = 1ULL << 30;
+
+enum class ConnState { kHeader, kBody, kStreamPayload };
+
+struct Conn {
+  int fd;
+  ConnState state = ConnState::kHeader;
+  std::string in;          // accumulating header+body bytes
+  size_t need = sizeof(Header);
+  Header hdr{};
+  std::string out;         // pending response bytes
+  size_t out_off = 0;
+  // zero-copy tail: segments sent straight from pool memory after `out`
+  // (GET_INLINE_BATCH streams pool pages without building a copy; the
+  // 5 s read lease keeps the entries alive while queued)
+  std::vector<std::pair<const uint8_t*, uint64_t>> out_segs;
+  size_t seg_idx = 0;
+  uint64_t seg_off = 0;
+  // payload streaming (PUT_INLINE_BATCH)
+  std::vector<std::string> stream_keys;
+  std::vector<Desc> stream_descs;
+  size_t stream_idx = 0;
+  uint64_t stream_off = 0;
+  uint64_t discard_bytes = 0;  // drain-and-drop after a failed batch alloc
+  int32_t discard_status = 0;
+  // keys allocated but not yet committed by this connection
+  std::vector<std::string> pending_keys;
+};
+}  // namespace
+
+class StoreServer {
+ public:
+  StoreServer(const StoreConfig& cfg, int port) : store_(cfg), port_(port) {}
+
+  ~StoreServer() { stop(); }
+
+  bool start() {
+    listen_fd_ = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+    if (listen_fd_ < 0) return false;
+    int one = 1;
+    setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = INADDR_ANY;
+    addr.sin_port = htons(static_cast<uint16_t>(port_));
+    if (bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+        listen(listen_fd_, 128) != 0) {
+      close(listen_fd_);
+      listen_fd_ = -1;
+      return false;
+    }
+    ep_fd_ = epoll_create1(0);
+    wake_fd_ = eventfd(0, EFD_NONBLOCK);
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.fd = listen_fd_;
+    epoll_ctl(ep_fd_, EPOLL_CTL_ADD, listen_fd_, &ev);
+    ev.data.fd = wake_fd_;
+    epoll_ctl(ep_fd_, EPOLL_CTL_ADD, wake_fd_, &ev);
+    running_ = true;
+    thread_ = std::thread([this] { loop(); });
+    return true;
+  }
+
+  void stop() {
+    if (!running_.exchange(false)) return;
+    uint64_t one = 1;
+    [[maybe_unused]] ssize_t r = write(wake_fd_, &one, sizeof(one));
+    if (thread_.joinable()) thread_.join();
+    for (auto& [fd, c] : conns_) close(fd);
+    conns_.clear();
+    if (listen_fd_ >= 0) close(listen_fd_);
+    if (ep_fd_ >= 0) close(ep_fd_);
+    if (wake_fd_ >= 0) close(wake_fd_);
+    listen_fd_ = ep_fd_ = wake_fd_ = -1;
+  }
+
+  Store* store() { return &store_; }
+  std::mutex* store_mutex() { return &mu_; }
+
+ private:
+  void loop() {
+    epoll_event evs[64];
+    while (running_) {
+      int n = epoll_wait(ep_fd_, evs, 64, 500);
+      for (int i = 0; i < n; i++) {
+        int fd = evs[i].data.fd;
+        if (fd == wake_fd_) {
+          uint64_t v;
+          [[maybe_unused]] ssize_t r = read(wake_fd_, &v, sizeof(v));
+          continue;
+        }
+        if (fd == listen_fd_) {
+          accept_conns();
+          continue;
+        }
+        auto it = conns_.find(fd);
+        if (it == conns_.end()) continue;
+        Conn* c = it->second.get();
+        bool alive = true;
+        if (evs[i].events & (EPOLLHUP | EPOLLERR)) alive = false;
+        if (alive && (evs[i].events & EPOLLIN)) alive = on_readable(c);
+        if (alive && (evs[i].events & EPOLLOUT)) alive = flush(c);
+        if (!alive) drop(fd);
+      }
+    }
+  }
+
+  void accept_conns() {
+    while (true) {
+      int fd = accept4(listen_fd_, nullptr, nullptr, SOCK_NONBLOCK);
+      if (fd < 0) break;
+      int one = 1;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      auto c = std::make_unique<Conn>();
+      c->fd = fd;
+      epoll_event ev{};
+      ev.events = EPOLLIN;
+      ev.data.fd = fd;
+      epoll_ctl(ep_fd_, EPOLL_CTL_ADD, fd, &ev);
+      conns_.emplace(fd, std::move(c));
+    }
+  }
+
+  void drop(int fd) {
+    auto it = conns_.find(fd);
+    if (it == conns_.end()) return;
+    if (!it->second->pending_keys.empty()) {
+      // client went away mid-write: reclaim uncommitted regions
+      std::lock_guard<std::mutex> g(mu_);
+      store_.abort_put(it->second->pending_keys);
+    }
+    epoll_ctl(ep_fd_, EPOLL_CTL_DEL, fd, nullptr);
+    close(fd);
+    conns_.erase(it);
+  }
+
+  // returns false if the connection died
+  bool on_readable(Conn* c) {
+    char buf[1 << 16];
+    while (true) {
+      if (c->state == ConnState::kStreamPayload) {
+        if (!stream_payload(c)) return false;
+        if (c->state == ConnState::kStreamPayload) return true;  // EAGAIN
+        continue;
+      }
+      size_t want = c->need - c->in.size();
+      ssize_t r = recv(c->fd, buf, std::min(want, sizeof(buf)), 0);
+      if (r == 0) return false;
+      if (r < 0) return errno == EAGAIN || errno == EWOULDBLOCK;
+      c->in.append(buf, r);
+      if (c->in.size() < c->need) continue;
+      if (c->state == ConnState::kHeader) {
+        std::memcpy(&c->hdr, c->in.data(), sizeof(Header));
+        if (c->hdr.magic != MAGIC || c->hdr.version != VERSION ||
+            c->hdr.body_len > kMaxBody)
+          return false;  // bad magic => reset (reference: connection teardown)
+        c->in.clear();
+        if (c->hdr.body_len == 0) {
+          if (!dispatch(c, nullptr, 0)) return false;
+        } else {
+          c->state = ConnState::kBody;
+          c->need = c->hdr.body_len;
+        }
+      } else {  // kBody complete
+        std::string body = std::move(c->in);
+        c->in.clear();
+        c->state = ConnState::kHeader;
+        c->need = sizeof(Header);
+        if (!dispatch(c, reinterpret_cast<const uint8_t*>(body.data()),
+                      body.size()))
+          return false;
+      }
+      if (!c->out.empty() && !flush(c)) return false;
+    }
+  }
+
+  // stream PUT_INLINE_BATCH payload straight into pool regions
+  bool stream_payload(Conn* c) {
+    if (c->discard_bytes) {  // failed alloc: drain payload to stay in sync
+      char sink[1 << 16];
+      while (c->discard_bytes) {
+        ssize_t r = recv(c->fd, sink,
+                         std::min<uint64_t>(c->discard_bytes, sizeof(sink)), 0);
+        if (r == 0) return false;
+        if (r < 0) return errno == EAGAIN || errno == EWOULDBLOCK;
+        c->discard_bytes -= r;
+      }
+      respond(c, c->discard_status, "");
+      c->state = ConnState::kHeader;
+      c->need = sizeof(Header);
+      return flush(c);
+    }
+    while (c->stream_idx < c->stream_descs.size()) {
+      const Desc& d = c->stream_descs[c->stream_idx];
+      uint8_t* dst;
+      {
+        std::lock_guard<std::mutex> g(mu_);
+        dst = store_.view(d.pool_idx, d.offset);
+      }
+      while (c->stream_off < d.size) {
+        ssize_t r = recv(c->fd, dst + c->stream_off, d.size - c->stream_off, 0);
+        if (r == 0) goto dead;
+        if (r < 0) {
+          if (errno == EAGAIN || errno == EWOULDBLOCK) return true;
+          goto dead;
+        }
+        c->stream_off += r;
+      }
+      c->stream_idx++;
+      c->stream_off = 0;
+    }
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      for (const auto& k : c->stream_keys) {
+        Entry* e = store_.pending_entry(k);
+        if (e) e->busy = false;
+      }
+      int32_t committed = 0;
+      Status st = store_.commit_put(c->stream_keys, &committed);
+      remove_pending(c, c->stream_keys);
+      std::string body(reinterpret_cast<const char*>(&committed), 4);
+      respond(c, st, body);
+    }
+    c->stream_keys.clear();
+    c->stream_descs.clear();
+    c->stream_idx = 0;
+    c->state = ConnState::kHeader;
+    c->need = sizeof(Header);
+    return flush(c);
+  dead : {
+    std::lock_guard<std::mutex> g(mu_);
+    for (const auto& k : c->stream_keys) {
+      Entry* e = store_.pending_entry(k);
+      if (e) e->busy = false;
+    }
+    store_.abort_put(c->stream_keys);
+    remove_pending(c, c->stream_keys);
+  }
+    return false;
+  }
+
+  static void remove_pending(Conn* c, const std::vector<std::string>& keys) {
+    for (const auto& k : keys) {
+      for (auto it = c->pending_keys.begin(); it != c->pending_keys.end(); ++it) {
+        if (*it == k) {
+          c->pending_keys.erase(it);
+          break;
+        }
+      }
+    }
+  }
+
+  void respond(Conn* c, int32_t status, const std::string& body) {
+    RespHeader rh{status, static_cast<uint32_t>(body.size())};
+    c->out.append(reinterpret_cast<const char*>(&rh), sizeof(rh));
+    c->out.append(body);
+  }
+
+  // returns false if the connection died; registers EPOLLOUT when blocked
+  bool flush(Conn* c) {
+    while (c->out_off < c->out.size()) {
+      ssize_t r = send(c->fd, c->out.data() + c->out_off,
+                       c->out.size() - c->out_off, MSG_NOSIGNAL);
+      if (r < 0) {
+        if (errno == EAGAIN || errno == EWOULDBLOCK) return want_out(c);
+        return false;
+      }
+      c->out_off += r;
+    }
+    while (c->seg_idx < c->out_segs.size()) {
+      auto [p, sz] = c->out_segs[c->seg_idx];
+      ssize_t r = send(c->fd, p + c->seg_off, sz - c->seg_off, MSG_NOSIGNAL);
+      if (r < 0) {
+        if (errno == EAGAIN || errno == EWOULDBLOCK) return want_out(c);
+        return false;
+      }
+      c->seg_off += r;
+      if (c->seg_off == sz) {
+        c->seg_idx++;
+        c->seg_off = 0;
+      }
+    }
+    c->out.clear();
+    c->out_off = 0;
+    c->out_segs.clear();
+    c->seg_idx = 0;
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.fd = c->fd;
+    epoll_ctl(ep_fd_, EPOLL_CTL_MOD, c->fd, &ev);
+    return true;
+  }
+
+  bool want_out(Conn* c) {
+    epoll_event ev{};
+    ev.events = EPOLLIN | EPOLLOUT;
+    ev.data.fd = c->fd;
+    epoll_ctl(ep_fd_, EPOLL_CTL_MOD, c->fd, &ev);
+    return true;
+  }
+
+  bool dispatch(Conn* c, const uint8_t* body, size_t body_len) {
+    Reader rd(body, body_len);
+    std::lock_guard<std::mutex> g(mu_);
+    switch (c->hdr.op) {
+      case OP_HELLO:
+      case OP_POOLS: {
+        std::string out;
+        Writer w(&out);
+        const auto& pools = store_.mm().pools();
+        w.put<uint32_t>(static_cast<uint32_t>(pools.size()));
+        for (const auto& p : pools) {
+          w.put<uint16_t>(static_cast<uint16_t>(p->name().size()));
+          w.put_bytes(p->name().data(), p->name().size());
+          w.put<uint64_t>(p->pool_size());
+          w.put<uint64_t>(p->block_size());
+        }
+        respond(c, FINISH, out);
+        return true;
+      }
+      case OP_PUT_INLINE: {
+        uint16_t klen = rd.get<uint16_t>();
+        std::string key;
+        if (!rd.ok() || !rd.get_bytes(&key, klen)) return bad(c);
+        uint64_t vlen = rd.get<uint64_t>();
+        if (!rd.ok() || rd.remaining() != vlen) return bad(c);
+        respond(c, store_.put_inline(key, body + (body_len - vlen), vlen), "");
+        return true;
+      }
+      case OP_GET_INLINE: {
+        std::vector<std::string> keys;
+        if (!rd.get_keys(&keys) || keys.empty()) return bad(c);
+        const Entry* e = store_.get_inline(keys[0]);
+        if (!e) {
+          respond(c, KEY_NOT_FOUND, "");
+          return true;
+        }
+        std::string out(reinterpret_cast<const char*>(store_.view(e->pool_idx, e->offset)),
+                        e->size);
+        respond(c, FINISH, out);
+        return true;
+      }
+      case OP_ALLOC_PUT: {
+        uint64_t block_size = rd.get<uint64_t>();
+        std::vector<std::string> keys;
+        if (!rd.ok() || !rd.get_keys(&keys)) return bad(c);
+        std::vector<Desc> descs;
+        Status st = store_.alloc_put(keys, block_size, &descs);
+        if (st == FINISH)
+          c->pending_keys.insert(c->pending_keys.end(), keys.begin(), keys.end());
+        std::string out(reinterpret_cast<const char*>(descs.data()),
+                        descs.size() * sizeof(Desc));
+        respond(c, st, out);
+        return true;
+      }
+      case OP_COMMIT_PUT: {
+        std::vector<std::string> keys;
+        if (!rd.get_keys(&keys)) return bad(c);
+        int32_t committed = 0;
+        Status st = store_.commit_put(keys, &committed);
+        remove_pending(c, keys);
+        respond(c, st, std::string(reinterpret_cast<const char*>(&committed), 4));
+        return true;
+      }
+      case OP_GET_DESC: {
+        uint64_t block_size = rd.get<uint64_t>();
+        std::vector<std::string> keys;
+        if (!rd.ok() || !rd.get_keys(&keys)) return bad(c);
+        std::vector<Desc> descs;
+        Status st = store_.get_desc(keys, block_size, &descs);
+        std::string out(reinterpret_cast<const char*>(descs.data()),
+                        descs.size() * sizeof(Desc));
+        respond(c, st, out);
+        return true;
+      }
+      case OP_EXIST: {
+        std::vector<std::string> keys;
+        if (!rd.get_keys(&keys) || keys.empty()) return bad(c);
+        int32_t v = store_.exist(keys[0]) ? 0 : 1;
+        respond(c, FINISH, std::string(reinterpret_cast<const char*>(&v), 4));
+        return true;
+      }
+      case OP_MATCH_LAST_IDX: {
+        std::vector<std::string> keys;
+        if (!rd.get_keys(&keys)) return bad(c);
+        int32_t v = store_.match_last_index(keys);
+        respond(c, FINISH, std::string(reinterpret_cast<const char*>(&v), 4));
+        return true;
+      }
+      case OP_DELETE_KEYS: {
+        std::vector<std::string> keys;
+        if (!rd.get_keys(&keys)) return bad(c);
+        int32_t v = store_.delete_keys(keys);
+        respond(c, FINISH, std::string(reinterpret_cast<const char*>(&v), 4));
+        return true;
+      }
+      case OP_PURGE: {
+        int32_t v = store_.purge();
+        respond(c, FINISH, std::string(reinterpret_cast<const char*>(&v), 4));
+        return true;
+      }
+      case OP_STATS: {
+        respond(c, FINISH, store_.stats_json());
+        return true;
+      }
+      case OP_EVICT: {
+        float mn = rd.get<float>(), mx = rd.get<float>();
+        if (!rd.ok()) return bad(c);
+        store_.evict(mn, mx);
+        respond(c, FINISH, "");
+        return true;
+      }
+      case OP_PUT_INLINE_BATCH: {
+        uint64_t block_size = rd.get<uint64_t>();
+        std::vector<std::string> keys;
+        if (!rd.ok() || !rd.get_keys(&keys)) return bad(c);
+        std::vector<Desc> descs;
+        Status st = store_.alloc_put(keys, block_size, &descs);
+        if (st != FINISH) {
+          // payload still arrives; drain it so the stream stays in sync
+          // (pyserver.py does the same)
+          c->discard_bytes = block_size * keys.size();
+          c->discard_status = st;
+          c->state = ConnState::kStreamPayload;
+          return true;
+        }
+        for (const auto& k : keys) {
+          Entry* e = store_.pending_entry(k);
+          if (e) e->busy = true;  // purge must not free mid-stream regions
+        }
+        c->pending_keys.insert(c->pending_keys.end(), keys.begin(), keys.end());
+        c->stream_keys = std::move(keys);
+        c->stream_descs = std::move(descs);
+        c->stream_idx = 0;
+        c->stream_off = 0;
+        c->state = ConnState::kStreamPayload;
+        return true;
+      }
+      case OP_GET_INLINE_BATCH: {
+        uint64_t block_size = rd.get<uint64_t>();
+        std::vector<std::string> keys;
+        if (!rd.ok() || !rd.get_keys(&keys)) return bad(c);
+        std::vector<Desc> descs;
+        Status st = store_.get_desc(keys, block_size, &descs);
+        if (st != FINISH) {
+          respond(c, st, "");
+          return true;
+        }
+        uint64_t total = 0;
+        for (const auto& d : descs) total += d.size;
+        // resp = sizes array in `out`, payloads streamed from pool memory
+        std::string sizes;
+        sizes.reserve(4 * descs.size());
+        for (const auto& d : descs) {
+          uint32_t sz = static_cast<uint32_t>(d.size);
+          sizes.append(reinterpret_cast<const char*>(&sz), 4);
+        }
+        RespHeader rh{FINISH, static_cast<uint32_t>(sizes.size() + total)};
+        c->out.append(reinterpret_cast<const char*>(&rh), sizeof(rh));
+        c->out.append(sizes);
+        for (const auto& d : descs) {
+          c->out_segs.emplace_back(store_.view(d.pool_idx, d.offset), d.size);
+        }
+        return true;
+      }
+      default:
+        return bad(c);
+    }
+  }
+
+  bool bad(Conn* c) {
+    respond(c, INVALID_REQ, "");
+    return true;
+  }
+
+  Store store_;
+  std::mutex mu_;
+  int port_;
+  int listen_fd_ = -1;
+  int ep_fd_ = -1;
+  int wake_fd_ = -1;
+  std::atomic<bool> running_{false};
+  std::thread thread_;
+  std::unordered_map<int, std::unique_ptr<Conn>> conns_;
+};
+
+}  // namespace istpu
+
+// factory used by the C ABI (istpu_c.cpp)
+namespace istpu {
+StoreServer* make_server(const StoreConfig& cfg, int port) {
+  return new StoreServer(cfg, port);
+}
+bool server_start(StoreServer* s) { return s->start(); }
+void server_stop(StoreServer* s) { s->stop(); }
+void server_destroy(StoreServer* s) { delete s; }
+Store* server_store(StoreServer* s) { return s->store(); }
+std::mutex* server_mutex(StoreServer* s) { return s->store_mutex(); }
+}  // namespace istpu
