@@ -27,10 +27,27 @@ _LIB_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "libistpu.s
 _lib = None
 
 
+def _build():
+    """Build libistpu.so from src/ if a toolchain is present (idempotent)."""
+    src = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "src")
+    if not os.path.exists(os.path.join(src, "Makefile")):
+        return
+    import subprocess
+
+    try:
+        subprocess.run(
+            ["make", "-C", src], check=True, capture_output=True, timeout=300
+        )
+    except (OSError, subprocess.SubprocessError):
+        pass
+
+
 def _load():
     global _lib
     if _lib is not None:
         return _lib
+    if not os.path.exists(_LIB_PATH) and not os.environ.get("ISTPU_NO_BUILD"):
+        _build()
     if not os.path.exists(_LIB_PATH):
         return None
     try:
