@@ -27,19 +27,37 @@ _LIB_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "libistpu.s
 _lib = None
 
 
+_build_attempted = False
+
+
 def _build():
-    """Build libistpu.so from src/ if a toolchain is present (idempotent)."""
+    """Build libistpu.so from src/ if a toolchain is present (once per
+    process; a failure is logged, not swallowed, so a broken toolchain is
+    diagnosable and doesn't re-block every later call)."""
+    global _build_attempted
+    if _build_attempted:
+        return
+    _build_attempted = True
     src = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "src")
     if not os.path.exists(os.path.join(src, "Makefile")):
         return
     import subprocess
+    import sys
 
     try:
         subprocess.run(
             ["make", "-C", src], check=True, capture_output=True, timeout=300
         )
-    except (OSError, subprocess.SubprocessError):
-        pass
+    except subprocess.CalledProcessError as e:
+        print(
+            f"[infinistore_tpu] native build failed (falling back to Python):\n"
+            f"{e.stderr.decode(errors='replace')[-2000:]}",
+            file=sys.stderr,
+        )
+    except (OSError, subprocess.SubprocessError) as e:
+        print(
+            f"[infinistore_tpu] native build unavailable: {e!r}", file=sys.stderr
+        )
 
 
 def _load():

@@ -31,6 +31,7 @@ from ..kv.cache import (
     BlockAllocator,
     PagedCacheConfig,
     init_cache,
+    pages_to_seq_kv,
     prefill_to_pages,
     read_pages,
     write_pages,
@@ -104,8 +105,7 @@ class InferenceEngine:
                 self.cache, block_ids[:reused], keys[:reused]
             )
             pages = read_pages(self.cache, jnp.asarray(block_ids[:reused]))
-            L, _, n, _, H, D = pages.shape
-            prefix_kv = pages.reshape(L, 2, 1, n * T, H, D)
+            prefix_kv = pages_to_seq_kv(pages)  # [L, 2, 1, n*T, H, D]
 
         # compute the tail; pad to a whole number of pages for paging
         suffix = tokens[P:]

@@ -5,13 +5,17 @@ The reference's client stores KV blocks from GPU memory (GPUDirect RDMA from
 one fused ``jax.Array`` of pages and moves whole pages with gather/scatter
 under ``jit``:
 
-    kv : [n_layers, 2(K|V), n_blocks, block_tokens, n_kv_heads, head_dim]
+    kv : [n_layers, 2(K|V), n_kv_heads, n_blocks, block_tokens, head_dim]
 
-A page is ``block_tokens`` consecutive tokens of one layer's K+V -- the unit
-that maps 1:1 onto a store key (kv/hashing.chunk_keys x layer).  With Llama-3
--8B shapes (8 kv-heads x 128 dim, 16-token pages, bf16) a page is 64 KiB -
-twice the reference's default ``minimal_allocate_size`` granularity wise, and
-identical when split K/V.
+Heads sit OUTSIDE the block axis so a (head, page) tile [block_tokens,
+head_dim] = [16, 128] is contiguous -- exactly the bf16 min tile, which lets
+the Pallas decode kernel (ops/pallas_attention.py) stream pages HBM->VMEM by
+block-table lookup with no layout shuffle.
+
+A page is ``block_tokens`` consecutive tokens of one layer's K+V (all heads)
+-- the unit that maps 1:1 onto a store key (kv/hashing.chunk_keys x layer).
+With Llama-3-8B shapes (8 kv-heads x 128 dim, 16-token pages, bf16) a page
+is 64 KiB.
 
 Static shapes everywhere: gathers/scatters take fixed-width index vectors so
 XLA compiles one program per (n_pages,) width; the host-side ``BlockAllocator``
@@ -39,19 +43,20 @@ class PagedCacheConfig:
 
     @property
     def page_bytes(self) -> int:
-        """Bytes of one (layer, chunk) page: K+V."""
+        """Bytes of one (layer, chunk) page: K+V, all heads."""
         return 2 * self.block_tokens * self.n_kv_heads * self.head_dim * np.dtype(
             jnp.dtype(self.dtype)
         ).itemsize
 
     @property
     def page_shape(self) -> Tuple[int, ...]:
-        return (2, self.block_tokens, self.n_kv_heads, self.head_dim)
+        """Shape of one (layer, chunk) page as stored: [2, H_kv, T, D]."""
+        return (2, self.n_kv_heads, self.block_tokens, self.head_dim)
 
 
 def init_cache(cfg: PagedCacheConfig) -> jax.Array:
     return jnp.zeros(
-        (cfg.n_layers, 2, cfg.n_blocks, cfg.block_tokens, cfg.n_kv_heads, cfg.head_dim),
+        (cfg.n_layers, 2, cfg.n_kv_heads, cfg.n_blocks, cfg.block_tokens, cfg.head_dim),
         dtype=cfg.dtype,
     )
 
@@ -59,15 +64,14 @@ def init_cache(cfg: PagedCacheConfig) -> jax.Array:
 def write_pages(cache: jax.Array, block_ids: jax.Array, pages: jax.Array) -> jax.Array:
     """Scatter pages for all layers at once.
 
-    pages: [n_layers, 2, n, block_tokens, n_kv_heads, head_dim]
-    block_ids: [n] int32
+    pages: [n_layers, 2, H_kv, n, T, D]; block_ids: [n] int32
     """
-    return cache.at[:, :, block_ids].set(pages)
+    return cache.at[:, :, :, block_ids].set(pages)
 
 
 def read_pages(cache: jax.Array, block_ids: jax.Array) -> jax.Array:
-    """Gather pages for all layers: -> [n_layers, 2, n, T, H, D]."""
-    return cache[:, :, block_ids]
+    """Gather pages for all layers: -> [n_layers, 2, H_kv, n, T, D]."""
+    return cache[:, :, :, block_ids]
 
 
 def write_token_kv(
@@ -83,18 +87,25 @@ def write_token_kv(
     block_ids/slot_ids: [B] page id and in-page slot for each sequence's
     current position; k/v: [B, n_kv_heads, head_dim].
     """
-    kv = jnp.stack([k, v], axis=0)  # [2, B, H, D]
-    return cache.at[layer, :, block_ids, slot_ids].set(jnp.swapaxes(kv, 0, 1))
+    kv = jnp.stack([k, v], axis=1)  # [B, 2, H, D]
+    # advanced indices (layer, block_ids, slot_ids) are separated by slices,
+    # so the broadcast batch dim lands in FRONT: target shape [B, 2, H, D]
+    return cache.at[layer, :, :, block_ids, slot_ids].set(kv)
 
 
-def prefill_to_pages(
-    kv: jax.Array, n_pages: int, block_tokens: int
-) -> jax.Array:
+def prefill_to_pages(kv: jax.Array, n_pages: int, block_tokens: int) -> jax.Array:
     """Reshape prefill KV [L, 2, S, H, D] (S = n_pages*block_tokens) into
-    pages [L, 2, n_pages, T, H, D]."""
+    pages [L, 2, H, n_pages, T, D]."""
     L, two, S, H, D = kv.shape
     assert S == n_pages * block_tokens, (S, n_pages, block_tokens)
-    return kv.reshape(L, two, n_pages, block_tokens, H, D)
+    kv = kv.reshape(L, two, n_pages, block_tokens, H, D)
+    return jnp.transpose(kv, (0, 1, 4, 2, 3, 5))
+
+
+def pages_to_seq_kv(pages: jax.Array) -> jax.Array:
+    """[L, 2, H, n, T, D] -> [L, 2, 1, n*T, H, D] (batch-1 sequence KV)."""
+    L, two, H, n, T, D = pages.shape
+    return jnp.transpose(pages, (0, 1, 3, 4, 2, 5)).reshape(L, two, 1, n * T, H, D)
 
 
 class BlockAllocator:

@@ -17,6 +17,11 @@ from typing import List, Sequence
 
 DEFAULT_CHUNK_TOKENS = 16
 
+# Versions the in-page byte layout ([2, H_kv, T, D] since v2); part of the
+# hash seed so pages persisted under a different layout can never be
+# reinterpreted silently -- they simply miss.
+KV_LAYOUT_VERSION = "kv2"
+
 
 def chunk_keys(
     tokens: Sequence[int],
@@ -33,7 +38,9 @@ def chunk_keys(
     """
     n_full = len(tokens) // chunk_tokens
     keys: List[str] = []
-    h = hashlib.blake2b(model_id.encode(), digest_size=16)
+    h = hashlib.blake2b(
+        f"{KV_LAYOUT_VERSION}:{model_id}".encode(), digest_size=16
+    )
     for c in range(n_full):
         chunk = tokens[c * chunk_tokens : (c + 1) * chunk_tokens]
         h = h.copy()

@@ -60,9 +60,9 @@ class KVTransferEngine:
         if n == 0:
             return 0
         ids = jnp.asarray(np.asarray(block_ids, dtype=np.int32))
-        gathered = read_pages(cache, ids)  # [L, 2, n, T, H, D]
-        # -> [L, n, 2, T, H, D] so each (layer, chunk) page is contiguous
-        pages = jnp.swapaxes(gathered, 1, 2)
+        gathered = read_pages(cache, ids)  # [L, 2, H, n, T, D]
+        # -> [L, n, 2, H, T, D] so each (layer, chunk) page is contiguous
+        pages = jnp.transpose(gathered, (0, 3, 1, 2, 4, 5))
         host = np.asarray(jax.device_get(pages))  # one D2H transfer
         flat = host.reshape(-1)
         view = flat.view(np.uint8)
@@ -96,9 +96,9 @@ class KVTransferEngine:
         host = (
             staging[:nbytes]
             .view(jnp.dtype(self.cfg.dtype))
-            .reshape((L, n) + self.cfg.page_shape)
+            .reshape((L, n) + self.cfg.page_shape)  # [L, n, 2, H, T, D]
         )
-        pages = jnp.swapaxes(jnp.asarray(host), 1, 2)  # [L, 2, n, T, H, D]
+        pages = jnp.transpose(jnp.asarray(host), (0, 2, 3, 1, 4, 5))  # [L,2,H,n,T,D]
         ids = jnp.asarray(np.asarray(block_ids, dtype=np.int32))
         return write_pages(cache, ids, pages)
 

@@ -71,26 +71,27 @@ def causal_attention(
     return out
 
 
-def paged_decode_attention(
+def paged_decode_attention_xla(
     q: jax.Array,
     layer_cache: jax.Array,
     block_table: jax.Array,
     seq_lens: jax.Array,
 ) -> jax.Array:
-    """One-token decode attention against the paged cache.
+    """One-token decode attention against the paged cache (XLA gather path).
 
     q: [B, H, D] (current token, RoPE already applied)
-    layer_cache: [2, n_blocks, T, H_kv, D] (one layer's pages)
+    layer_cache: [2, H_kv, n_blocks, T, D] (one layer's pages)
     block_table: [B, max_pages] int32
     seq_lens: [B] int32 -- number of valid tokens (including current)
     """
     B, H, D = q.shape
-    T = layer_cache.shape[2]
-    Hkv = layer_cache.shape[3]
+    Hkv, _, T = layer_cache.shape[1:4]
     max_pages = block_table.shape[1]
-    # gather pages: [B, max_pages, T, Hkv, D] -> [B, S_max, Hkv, D]
-    k = layer_cache[0][block_table].reshape(B, max_pages * T, Hkv, D)
-    v = layer_cache[1][block_table].reshape(B, max_pages * T, Hkv, D)
+    # gather pages: [Hkv, B, max_pages, T, D] -> [B, S_max, Hkv, D]
+    k = layer_cache[0][:, block_table]
+    v = layer_cache[1][:, block_table]
+    k = jnp.moveaxis(k, 0, 3).reshape(B, max_pages * T, Hkv, D)
+    v = jnp.moveaxis(v, 0, 3).reshape(B, max_pages * T, Hkv, D)
     k = repeat_kv(k, H // Hkv)
     v = repeat_kv(v, H // Hkv)
     scale = 1.0 / np.sqrt(D)
@@ -100,3 +101,25 @@ def paged_decode_attention(
     logits = jnp.where(mask[:, None, :], logits, -jnp.inf)
     probs = jax.nn.softmax(logits, axis=-1)
     return jnp.einsum("bhk,bkhd->bhd", probs.astype(v.dtype), v)
+
+
+def paged_decode_attention(
+    q: jax.Array,
+    layer_cache: jax.Array,
+    block_table: jax.Array,
+    seq_lens: jax.Array,
+) -> jax.Array:
+    """Paged decode attention; Pallas kernel on TPU, XLA gather elsewhere.
+
+    Same signature/layout as ``paged_decode_attention_xla`` -- the cache
+    layout [2, H_kv, n_blocks, T, D] IS the Pallas kernel layout, so the
+    kernel streams pages by block-table lookup with no shuffle.  Set
+    ``ISTPU_NO_PALLAS=1`` to force the XLA path.
+    """
+    import os
+
+    if jax.default_backend() == "tpu" and not os.environ.get("ISTPU_NO_PALLAS"):
+        from ..ops.pallas_attention import paged_decode_attention_pallas
+
+        return paged_decode_attention_pallas(q, layer_cache, block_table, seq_lens)
+    return paged_decode_attention_xla(q, layer_cache, block_table, seq_lens)

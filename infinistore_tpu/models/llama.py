@@ -176,11 +176,14 @@ def decode_forward(
 ) -> Tuple[jax.Array, jax.Array]:
     """Single-token paged decode.
 
-    tokens/positions: [B]; cache: [L, 2, n_blocks, T, Hkv, D];
-    block_table: [B, max_pages]; seq_lens: [B] (*including* this token);
-    slot_block_ids/slot_ids: [B] where to scatter this token's K/V.
-    Returns (logits [B, V], updated cache).
+    tokens/positions: [B]; cache: [L, 2, Hkv, n_blocks, T, D]
+    (kv/cache.py layout -- heads outside blocks so the Pallas decode kernel
+    streams [T, D] tiles); block_table: [B, max_pages]; seq_lens: [B]
+    (*including* this token); slot_block_ids/slot_ids: [B] where to scatter
+    this token's K/V.  Returns (logits [B, V], updated cache).
     """
+    from ..kv.cache import write_token_kv
+
     B = tokens.shape[0]
     x = params["embed"][tokens][:, None, :]  # [B, 1, dim]
     pos = positions[:, None]
@@ -189,10 +192,7 @@ def decode_forward(
         h = rmsnorm(x, layer["ln_attn"], cfg.norm_eps)
         q, k, v = _attn_qkv(layer, cfg, h, pos)
         # scatter this token's kv into its page slot
-        kv_tok = jnp.stack([k[:, 0], v[:, 0]], axis=0)  # [2, B, Hkv, D]
-        cache = cache.at[li, :, slot_block_ids, slot_ids].set(
-            jnp.swapaxes(kv_tok, 0, 1)
-        )
+        cache = write_token_kv(cache, li, slot_block_ids, slot_ids, k[:, 0], v[:, 0])
         attn = paged_decode_attention(q[:, 0], cache[li], block_table, seq_lens)
         x = x + (attn.reshape(B, -1) @ layer["wo"])[:, None, :]
         h = rmsnorm(x, layer["ln_mlp"], cfg.norm_eps)

@@ -75,7 +75,9 @@ def make_tp_prefill(cfg: LlamaConfig, mesh: Mesh):
 def make_tp_decode(cfg: LlamaConfig, mesh: Mesh):
     """Jitted tensor-parallel paged decode step (see models.llama.decode_forward)."""
     repl = NamedSharding(mesh, P())
-    cache_sharding = NamedSharding(mesh, P(None, None, None, None, "tp", None))
+    # cache [L, 2, H_kv, n_blocks, T, D]: shard the KV-head axis over tp so
+    # decode stays head-local (matches the head-sharded wk/wv)
+    cache_sharding = NamedSharding(mesh, P(None, None, "tp", None, None, None))
 
     def fn(params, tokens, positions, cache, block_table, seq_lens,
            slot_block_ids, slot_ids):

@@ -170,7 +170,7 @@ def test_connector_roundtrip(server):
     cache2, n = connector.retrieve_kv(tokens, cache2, [8, 9, 10, 11])
     assert n == 16
     np.testing.assert_array_equal(
-        np.asarray(cache2[:, :, 8:12]), np.asarray(cache[:, :, 0:4])
+        np.asarray(cache2[:, :, :, 8:12]), np.asarray(cache[:, :, :, 0:4])
     )
 
     assert connector.invalidate(tokens) == 4 * CFG.n_layers

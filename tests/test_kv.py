@@ -67,13 +67,14 @@ def test_layer_key_and_match_count():
 def test_page_roundtrip():
     pc = PagedCacheConfig(n_layers=2, n_kv_heads=2, head_dim=8, n_blocks=8, block_tokens=4, dtype=jnp.float32)
     cache = init_cache(pc)
-    pages = jax.random.normal(jax.random.PRNGKey(0), (2, 2, 3, 4, 2, 8), jnp.float32)
+    # pages: [L, 2, H_kv, n, T, D]
+    pages = jax.random.normal(jax.random.PRNGKey(0), (2, 2, 2, 3, 4, 8), jnp.float32)
     ids = jnp.asarray([5, 1, 7], dtype=jnp.int32)
     cache = write_pages(cache, ids, pages)
     out = read_pages(cache, ids)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(pages))
     # untouched pages remain zero
-    assert float(jnp.abs(cache[:, :, 0]).max()) == 0.0
+    assert float(jnp.abs(cache[:, :, :, 0]).max()) == 0.0
 
 
 def test_block_allocator():
@@ -145,7 +146,7 @@ def test_save_load_pages(conn):
     )
     eng = KVTransferEngine(conn, pc)
     cache = init_cache(pc)
-    pages = jax.random.normal(jax.random.PRNGKey(1), (2, 2, 2, 16, 2, 16), jnp.float32)
+    pages = jax.random.normal(jax.random.PRNGKey(1), (2, 2, 2, 2, 16, 16), jnp.float32)
     cache = write_pages(cache, jnp.asarray([0, 1]), pages)
 
     tokens = list(range(32))
@@ -166,7 +167,7 @@ def test_lookup_prefix(conn):
     )
     eng = KVTransferEngine(conn, pc)
     cache = init_cache(pc)
-    pages = jax.random.normal(jax.random.PRNGKey(2), (2, 2, 3, 16, 2, 16), jnp.float32)
+    pages = jax.random.normal(jax.random.PRNGKey(2), (2, 2, 2, 3, 16, 16), jnp.float32)
     cache = write_pages(cache, jnp.asarray([0, 1, 2]), pages)
 
     tokens = list(range(77))  # 4 complete chunks... 77//16 = 4

@@ -1,0 +1,56 @@
+"""Pallas kernels vs XLA reference math (interpret mode on the CPU mesh)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from infinistore_tpu.models.attention import paged_decode_attention_xla
+from infinistore_tpu.ops import paged_decode_attention_pallas
+
+
+def _setup(B, H, Hkv, D, T, n_blocks, max_pages, seed=0, dtype=jnp.float32):
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.standard_normal((B, H, D)), dtype)
+    # serving layout == kernel layout: [2, H_kv, n_blocks, T, D]
+    cache = jnp.asarray(rng.standard_normal((2, Hkv, n_blocks, T, D)), dtype)
+    # each sequence gets distinct pages; lengths straddle page boundaries
+    table = np.zeros((B, max_pages), dtype=np.int32)
+    lens = np.zeros((B,), dtype=np.int32)
+    free = list(range(1, n_blocks))
+    for b in range(B):
+        n_tok = int(rng.integers(1, max_pages * T))
+        n_pages = -(-n_tok // T)
+        ids = [free.pop() for _ in range(n_pages)]
+        table[b, :n_pages] = ids
+        lens[b] = n_tok
+    return q, cache, jnp.asarray(table), jnp.asarray(lens)
+
+
+@pytest.mark.parametrize("n_rep", [1, 4])
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_paged_decode_kernel_matches_xla(n_rep, dtype):
+    Hkv, D, T = 2, 128, 16
+    B, max_pages, n_blocks = 3, 4, 16
+    q, cache, table, lens = _setup(
+        B, Hkv * n_rep, Hkv, D, T, n_blocks, max_pages, dtype=dtype
+    )
+    want = paged_decode_attention_xla(q, cache, table, lens)
+    got = paged_decode_attention_pallas(q, cache, table, lens, interpret=True)
+    tol = 5e-6 if dtype == jnp.float32 else 2e-2
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32),
+        rtol=tol, atol=tol,
+    )
+
+
+def test_paged_decode_kernel_single_token():
+    # seq_len == 1: only the first slot of the first page is valid
+    Hkv, D, T = 2, 128, 16
+    q, cache, table, lens = _setup(1, 8, Hkv, D, T, 8, 2)
+    lens = jnp.asarray([1], jnp.int32)
+    want = paged_decode_attention_xla(q, cache, table, lens)
+    got = paged_decode_attention_pallas(q, cache, table, lens, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=5e-6, atol=5e-6
+    )

@@ -212,7 +212,7 @@ def test_tp_decode_matches_dense():
         fn = make_tp_decode(cfg, mesh)
         cache0 = jax.device_put(
             init_cache(pc),
-            NamedSharding(mesh, P(None, None, None, None, "tp", None)))
+            NamedSharding(mesh, P(None, None, "tp", None, None, None)))
         logits, cache = fn(sharded, tokens, positions, cache0,
                            table, seq_lens, slot_blocks, slots)
         jax.block_until_ready(logits)
