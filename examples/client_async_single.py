@@ -1,0 +1,50 @@
+"""Async client example: single awaited transfer + existence probes.
+
+Reference parity: infinistore/example/client_async_single.py.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import asyncio
+import uuid
+
+import numpy as np
+
+import infinistore_tpu as ist
+
+
+async def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--server", default="127.0.0.1")
+    ap.add_argument("--service-port", type=int, default=22345)
+    args = ap.parse_args()
+
+    conn = ist.InfinityConnection(
+        ist.ClientConfig(
+            host_addr=args.server,
+            service_port=args.service_port,
+            connection_type=ist.TYPE_SHM,
+        )
+    )
+    await conn.connect_async()
+
+    key = f"single-{uuid.uuid4().hex[:8]}"
+    src = np.arange(64 * 1024, dtype=np.uint8)
+    conn.register_mr(src)
+    await conn.write_cache_async([(key, 0)], src.nbytes, src.ctypes.data)
+    print("exists after write:", conn.check_exist(key))
+
+    dst = np.zeros_like(src)
+    conn.register_mr(dst)
+    await conn.read_cache_async([(key, 0)], dst.nbytes, dst.ctypes.data)
+    assert np.array_equal(src, dst)
+    print("single async round-trip OK")
+    conn.close()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,46 @@
+"""TCP inline client example (the cross-host / DCN path).
+
+Single-key tcp_write_cache / tcp_read_cache, as in the reference's
+infinistore/example/tcp_client.py.  Works against a server on another host.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import uuid
+
+import numpy as np
+
+import infinistore_tpu as ist
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--server", default="127.0.0.1")
+    ap.add_argument("--service-port", type=int, default=22345)
+    args = ap.parse_args()
+
+    conn = ist.InfinityConnection(
+        ist.ClientConfig(
+            host_addr=args.server,
+            service_port=args.service_port,
+            connection_type=ist.TYPE_TCP,
+        )
+    )
+    conn.connect()
+
+    key = f"tcp-{uuid.uuid4().hex[:8]}"
+    src = np.random.randint(0, 256, size=1 << 20, dtype=np.uint8)
+    conn.tcp_write_cache(key, src.ctypes.data, src.nbytes)
+    out = conn.tcp_read_cache(key)
+    assert np.array_equal(out, src)
+    print("tcp round-trip OK;", "exists:", conn.check_exist(key))
+    conn.delete_keys([key])
+    conn.close()
+
+
+if __name__ == "__main__":
+    main()

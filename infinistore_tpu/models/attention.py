@@ -108,6 +108,7 @@ def paged_decode_attention(
     layer_cache: jax.Array,
     block_table: jax.Array,
     seq_lens: jax.Array,
+    allow_pallas: bool = True,
 ) -> jax.Array:
     """Paged decode attention; Pallas kernel on TPU, XLA gather elsewhere.
 
@@ -115,10 +116,21 @@ def paged_decode_attention(
     layout [2, H_kv, n_blocks, T, D] IS the Pallas kernel layout, so the
     kernel streams pages by block-table lookup with no shuffle.  Set
     ``ISTPU_NO_PALLAS=1`` to force the XLA path.
+
+    ``allow_pallas=False`` MUST be passed when tracing under a
+    GSPMD-partitioned jit (parallel/sharding.py make_tp_decode): pallas_call
+    is an opaque custom call with no SPMD partitioning rule, so the
+    partitioner would replicate (all-gather) the sharded cache around it.
+    The sharded-kernel composition (shard_map around the kernel) is the
+    planned path for tensor-parallel Pallas decode.
     """
     import os
 
-    if jax.default_backend() == "tpu" and not os.environ.get("ISTPU_NO_PALLAS"):
+    if (
+        allow_pallas
+        and jax.default_backend() == "tpu"
+        and not os.environ.get("ISTPU_NO_PALLAS")
+    ):
         from ..ops.pallas_attention import paged_decode_attention_pallas
 
         return paged_decode_attention_pallas(q, layer_cache, block_table, seq_lens)

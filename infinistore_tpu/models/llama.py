@@ -173,8 +173,13 @@ def decode_forward(
     seq_lens: jax.Array,
     slot_block_ids: jax.Array,
     slot_ids: jax.Array,
+    use_pallas: bool = True,
 ) -> Tuple[jax.Array, jax.Array]:
     """Single-token paged decode.
+
+    ``use_pallas=False`` forces the XLA attention path; required when this
+    function is traced under a GSPMD-partitioned jit (see
+    models/attention.py:paged_decode_attention).
 
     tokens/positions: [B]; cache: [L, 2, Hkv, n_blocks, T, D]
     (kv/cache.py layout -- heads outside blocks so the Pallas decode kernel
@@ -193,7 +198,9 @@ def decode_forward(
         q, k, v = _attn_qkv(layer, cfg, h, pos)
         # scatter this token's kv into its page slot
         cache = write_token_kv(cache, li, slot_block_ids, slot_ids, k[:, 0], v[:, 0])
-        attn = paged_decode_attention(q[:, 0], cache[li], block_table, seq_lens)
+        attn = paged_decode_attention(
+            q[:, 0], cache[li], block_table, seq_lens, allow_pallas=use_pallas
+        )
         x = x + (attn.reshape(B, -1) @ layer["wo"])[:, None, :]
         h = rmsnorm(x, layer["ln_mlp"], cfg.norm_eps)
         x = x + _mlp(layer, h)

@@ -108,7 +108,11 @@ def paged_decode_attention_pallas(
     _, Hkv, _, T, Dc = cache_kl.shape
     assert Dc == D, (Dc, D)
     n_rep = H // Hkv
-    R = max(n_rep, 8)  # pad query groups to the fp32 sublane tile
+    # pad query groups to the dtype's native sublane tile: (8, 128) for
+    # fp32, (16, 128) for bf16 -- an 8-sublane bf16 block would be below
+    # the native tile and Mosaic may reject or mis-tile it
+    min_sublane = 8 if q.dtype == jnp.float32 else 16
+    R = max(n_rep, min_sublane)
     max_pages = block_table.shape[1]
     scale = 1.0 / np.sqrt(D)
 

@@ -55,8 +55,10 @@ def shardings_for(mesh: Mesh, specs):
 def make_tp_prefill(cfg: LlamaConfig, mesh: Mesh):
     """Jitted tensor-parallel prefill: (params, tokens[B,S]) -> (logits, kv).
 
-    KV comes out sharded over tp on the head axis ([L, 2, B, S, Hkv, D]),
-    which is exactly the layout the paged HBM cache wants on a tp mesh.
+    KV comes out sharded over tp on the head axis ([L, 2, B, S, Hkv, D]).
+    Paging it into the HBM cache (layout [L, 2, H_kv, n_blocks, T, D],
+    heads outside blocks) goes through kv/cache.py:prefill_to_pages, whose
+    transpose is tp-local -- the head axis stays sharded throughout.
     """
     data = NamedSharding(mesh, P("dp", None))
     kv_sharding = NamedSharding(mesh, P(None, None, "dp", None, "tp", None))
@@ -81,8 +83,11 @@ def make_tp_decode(cfg: LlamaConfig, mesh: Mesh):
 
     def fn(params, tokens, positions, cache, block_table, seq_lens,
            slot_block_ids, slot_ids):
+        # use_pallas=False: this jit is GSPMD-partitioned and pallas_call has
+        # no SPMD partitioning rule (see models/attention.py)
         return decode_forward(params, cfg, tokens, positions, cache,
-                              block_table, seq_lens, slot_block_ids, slot_ids)
+                              block_table, seq_lens, slot_block_ids, slot_ids,
+                              use_pallas=False)
 
     # donate the cache: it dominates HBM, and the functional update must not
     # allocate a second copy per token
