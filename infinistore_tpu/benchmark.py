@@ -78,7 +78,7 @@ def main():
     for it in range(args.iteration):
         blocks = [(f"bench-{run}-{it}-{i}", i * bs) for i in range(n_blocks)]
         if args.simulate_layers:
-            per = max(1, n_blocks // args.simulate_layers)
+            per = -(-n_blocks // args.simulate_layers)  # ceil: cover all blocks
             layer_blocks = [
                 blocks[li * per : (li + 1) * per]
                 for li in range(args.simulate_layers)

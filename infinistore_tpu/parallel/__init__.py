@@ -10,6 +10,12 @@ annotate shardings (or go fully manual with ``shard_map`` where the
 schedule matters -- ring attention, pipelining), let XLA do the rest.
 """
 
+from .distributed import (
+    dcn_aware_store_targets,
+    initialize,
+    make_hybrid_mesh,
+    process_local_batch,
+)
 from .mesh import MeshShape, factor_devices, make_mesh
 from .ring import make_ring_attention, ring_attention_local
 from .layers import tp_layer_forward
@@ -28,6 +34,10 @@ from .train import (
 )
 
 __all__ = [
+    "initialize",
+    "make_hybrid_mesh",
+    "process_local_batch",
+    "dcn_aware_store_targets",
     "MeshShape",
     "factor_devices",
     "make_mesh",

@@ -1,0 +1,133 @@
+"""Checkpoint/resume: sharded params round-trip and engine resume."""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import infinistore_tpu as ist
+from infinistore_tpu.engine.engine import InferenceEngine
+from infinistore_tpu.kv import PagedCacheConfig
+from infinistore_tpu.models import TINY, init_params, scaled
+from infinistore_tpu.parallel import make_mesh
+from infinistore_tpu.parallel.train import init_sharded_params
+from infinistore_tpu.utils.checkpoint import (
+    CheckpointManager,
+    resume_engine_state,
+    save_engine_state,
+)
+
+
+def test_sharded_params_roundtrip(tmp_path):
+    cfg = scaled(TINY, dtype=jnp.float32)
+    mesh = make_mesh(tp=2, pp=2, sp=1, dp=2)
+    params = init_sharded_params(cfg, mesh, jax.random.PRNGKey(0))
+
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), max_to_keep=2)
+    mgr.save(1, params, metadata={"step": 1, "model": "tiny"})
+    mgr.wait()
+    assert mgr.latest_step() == 1
+
+    restored = mgr.restore(like=params)
+    ok = jax.tree.map(
+        lambda a, b: np.array_equal(np.asarray(a), np.asarray(b)), params, restored
+    )
+    assert all(jax.tree.leaves(ok))
+    # restored into the same shardings
+    same = jax.tree.map(
+        lambda a, b: a.sharding == b.sharding, params, restored
+    )
+    assert all(jax.tree.leaves(same))
+    assert mgr.restore_metadata()["model"] == "tiny"
+    mgr.close()
+
+
+def test_keep_n(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "k"), max_to_keep=2)
+    state = {"w": jnp.arange(8.0)}
+    for s in (1, 2, 3):
+        mgr.save(s, state)
+    mgr.wait()
+    assert mgr.latest_step() == 3
+    assert 1 not in mgr.manager.all_steps()
+    mgr.close()
+
+
+# ---- engine resume through a live store ----
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture(scope="module")
+def server():
+    port, mport = _free_port(), _free_port()
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "infinistore_tpu.server",
+         "--service-port", str(port), "--manage-port", str(mport),
+         "--prealloc-size", "1", "--minimal-allocate-size", "16"],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        try:
+            socket.create_connection(("127.0.0.1", port), timeout=0.5).close()
+            break
+        except OSError:
+            time.sleep(0.1)
+    yield port
+    proc.send_signal(signal.SIGTERM)
+    proc.wait(timeout=10)
+
+
+def test_engine_resume(server, tmp_path):
+    cfg = scaled(TINY, dtype=jnp.float32)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    pc = PagedCacheConfig(
+        n_layers=cfg.n_layers, n_kv_heads=cfg.n_kv_heads,
+        head_dim=cfg.head_dim, n_blocks=32, block_tokens=16, dtype=cfg.dtype,
+    )
+
+    def mk_conn():
+        c = ist.InfinityConnection(ist.ClientConfig(
+            host_addr="127.0.0.1", service_port=server,
+            connection_type=ist.TYPE_SHM))
+        c.connect()
+        return c
+
+    prompt = list(np.random.default_rng(3).integers(0, cfg.vocab_size, 40))
+
+    eng1 = InferenceEngine(params, cfg, pc, conn=mk_conn(), model_id="ck")
+    st = eng1.prefill(prompt)
+    first = eng1.decode(st, 3)
+    path = str(tmp_path / "engine.json")
+    save_engine_state(path, eng1)
+
+    # "crash": a fresh engine with an empty HBM cache resumes from the store
+    eng2 = InferenceEngine(params, cfg, pc, conn=mk_conn(), model_id="ck")
+    assert resume_engine_state(path, eng2) == 1
+    st2 = eng2.seqs[st.seq_id]
+    assert st2.tokens == st.tokens
+    assert st2.reused_chunks > 0  # pages came from the store, not recompute
+    cont = eng2.decode(st2, 3)
+
+    # reference: an uninterrupted engine decoding 6 tokens straight
+    eng3 = InferenceEngine(params, cfg, pc, conn=None, model_id="ck")
+    ref = eng3.generate(prompt, 6)
+    assert first + cont == ref
+
+    # wrong model id must be rejected
+    eng4 = InferenceEngine(params, cfg, pc, conn=mk_conn(), model_id="other")
+    with pytest.raises(ValueError):
+        resume_engine_state(path, eng4)
