@@ -60,7 +60,12 @@ class InferenceEngine:
         conn=None,
         model_id: str = "llama",
         max_seqs: int = 8,
+        prefill_fn=None,
+        decode_fn=None,
     ):
+        """``prefill_fn``/``decode_fn`` plug in other model families with the
+        same contracts as models.llama.prefill_forward / decode_forward
+        (e.g. models.moe.moe_prefill_forward / moe_decode_forward)."""
         assert pc.n_layers == cfg.n_layers
         self.params = params
         self.cfg = cfg
@@ -74,9 +79,9 @@ class InferenceEngine:
         self.seqs: Dict[int, SequenceState] = {}
         self._next_id = 0
         self._prefill_jit = jax.jit(
-            partial(prefill_forward, cfg=self.cfg), static_argnames=()
+            partial(prefill_fn or prefill_forward, cfg=self.cfg)
         )
-        self._decode_jit = jax.jit(partial(decode_forward, cfg=self.cfg))
+        self._decode_jit = jax.jit(partial(decode_fn or decode_forward, cfg=self.cfg))
 
     # ---- prefill ----
 

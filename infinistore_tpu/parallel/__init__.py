@@ -19,6 +19,13 @@ from .distributed import (
 from .mesh import MeshShape, factor_devices, make_mesh
 from .ring import make_ring_attention, ring_attention_local
 from .layers import tp_layer_forward
+from .moe import (
+    make_moe_forward,
+    make_moe_mesh,
+    make_moe_train_step,
+    moe_param_specs,
+    init_sharded_moe_params,
+)
 from .pipeline import spmd_pipeline
 from .sharding import (
     llama_inference_specs,
@@ -34,6 +41,11 @@ from .train import (
 )
 
 __all__ = [
+    "make_moe_mesh",
+    "make_moe_forward",
+    "make_moe_train_step",
+    "moe_param_specs",
+    "init_sharded_moe_params",
     "initialize",
     "make_hybrid_mesh",
     "process_local_batch",
