@@ -94,6 +94,7 @@ def _load():
     lib.istpu_client_create.restype = ctypes.c_void_p
     lib.istpu_client_connect.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int,
     ]
     lib.istpu_client_close.argtypes = [ctypes.c_void_p]
     lib.istpu_client_destroy.argtypes = [ctypes.c_void_p]
@@ -247,6 +248,7 @@ class NativeConnection:
         ret = self._lib.istpu_client_connect(
             self._h, self.config.host_addr.encode(),
             int(self.config.service_port), use_shm,
+            int(getattr(self.config, "num_streams", 4)),
         )
         if ret != 0:
             self._lib.istpu_client_destroy(self._h)

@@ -39,6 +39,10 @@ class ClientConfig:
             "INFINISTORE_LOG_LEVEL", kwargs.get("log_level", "warning")
         )
         self.hint_gid_index = kwargs.get("hint_gid_index", -1)
+        # ours: TCP data sockets per connection.  Batched inline ops stripe
+        # their blocks across the streams (the role RDMA's multi-WR chains
+        # play in the reference); metadata ops ride stream 0.
+        self.num_streams = kwargs.get("num_streams", 4)
 
     def __repr__(self):
         return (
@@ -60,6 +64,8 @@ class ClientConfig:
             raise Exception("ib port of device should be greater than 0")
         if self.connection_type == TYPE_SHM and self.link_type not in _LINKS:
             raise Exception(f"link type should be one of {_LINKS}")
+        if not (1 <= int(self.num_streams) <= 64):
+            raise Exception("num_streams must be in [1, 64]")
 
 
 class ServerConfig:

@@ -23,7 +23,10 @@ import json
 import mmap
 import os
 import socket
-from typing import Dict, List, Optional, Sequence, Tuple, Union
+import threading
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
@@ -89,37 +92,162 @@ class _MappedPool:
         self.mm.close()
 
 
+class _Slot:
+    """One in-flight request: resolved by the channel's reader thread."""
+
+    __slots__ = ("ev", "consumer", "status", "result", "error")
+
+    def __init__(self, consumer: Optional[Callable] = None):
+        self.ev = threading.Event()
+        self.consumer = consumer
+        self.status = 0
+        self.result: Optional[bytes] = None
+        self.error: Optional[Exception] = None
+
+
+class _Channel:
+    """One pipelined socket: many requests may be in flight at once.
+
+    Sends are serialized by ``_send_lock`` (a frame must hit the wire
+    contiguously); responses are read by a dedicated reader thread and
+    matched FIFO -- both servers process a connection's frames strictly in
+    order, so no response tag is needed.  This plays the role of the
+    reference's CQ-polling thread + batched WR chains
+    (reference: src/libinfinistore.cpp:103 cq_handler, :596 w_rdma_async).
+    """
+
+    def __init__(self, host: str, port: int):
+        self.sock = socket.create_connection((host, port), timeout=30)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._send_lock = threading.Lock()
+        self._pending: deque = deque()
+        self._pending_lock = threading.Lock()
+        self._err: Optional[Exception] = None
+        self._reader: Optional[threading.Thread] = None
+
+    def start_reader(self) -> None:
+        """Switch from synchronous request/response to pipelined mode."""
+        self.sock.settimeout(None)  # reader blocks until data or close
+        self._reader = threading.Thread(target=self._read_loop, daemon=True)
+        self._reader.start()
+
+    # -- synchronous exchange (pre-pipeline bootstrap: HELLO) --
+
+    def exchange(self, op: int, body: bytes) -> Tuple[int, bytes]:
+        self.sock.sendall(P.pack_header(op, len(body)) + body)
+        hdr = bytearray(P.RESP_SIZE)
+        self._recv_exact_into(memoryview(hdr))
+        status, body_len = P.RESP.unpack(bytes(hdr))
+        resp = bytearray(body_len)
+        if body_len:
+            self._recv_exact_into(memoryview(resp))
+        return status, bytes(resp)
+
+    # -- pipelined exchange --
+
+    def request(
+        self,
+        op: int,
+        body: bytes,
+        payload: Sequence[memoryview] = (),
+        consumer: Optional[Callable] = None,
+    ) -> Tuple[int, object]:
+        slot = _Slot(consumer)
+        with self._send_lock:
+            if self._err is not None:
+                raise InfiniStoreException(f"connection dead: {self._err!r}")
+            with self._pending_lock:
+                self._pending.append(slot)
+            # sendall per buffer: sendmsg can partially send under
+            # backpressure and is capped at IOV_MAX vectors
+            self.sock.sendall(P.pack_header(op, len(body)) + body)
+            for view in payload:
+                self.sock.sendall(view)
+        slot.ev.wait()
+        if slot.error is not None:
+            raise InfiniStoreException(f"request failed: {slot.error!r}")
+        return slot.status, slot.result
+
+    def _read_loop(self) -> None:
+        try:
+            while True:
+                hdr = bytearray(P.RESP_SIZE)
+                self._recv_exact_into(memoryview(hdr))
+                status, body_len = P.RESP.unpack(bytes(hdr))
+                with self._pending_lock:
+                    slot = self._pending.popleft()
+                slot.status = status
+                if slot.consumer is not None:
+                    slot.result = slot.consumer(self, status, body_len)
+                else:
+                    body = bytearray(body_len)
+                    if body_len:
+                        self._recv_exact_into(memoryview(body))
+                    slot.result = bytes(body)
+                slot.ev.set()
+        except Exception as e:  # noqa: BLE001 - fail all in-flight requests
+            self._err = e
+            with self._pending_lock:
+                pending = list(self._pending)
+                self._pending.clear()
+            for slot in pending:
+                slot.error = e
+                slot.ev.set()
+
+    def _recv_exact_into(self, view: memoryview) -> None:
+        got = 0
+        size = len(view)
+        while got < size:
+            n = self.sock.recv_into(view[got:], size - got)
+            if n == 0:
+                raise InfiniStoreException("connection closed by server")
+            got += n
+
+    def close(self) -> None:
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self.sock.close()
+        if self._reader is not None:
+            self._reader.join(timeout=5)
+
+
 class Connection:
-    """Synchronous wire client; one TCP control/data socket.
+    """Python wire client: pipelined requests over striped TCP sockets.
 
     The native C++ client (src/store_client.cpp) implements the same calls
     with GIL-free IO; this Python implementation is the portable fallback
-    and the spec for the protocol.
+    and the spec for the protocol.  ``num_streams`` sockets are opened for
+    TCP (DCN) connections and batched inline ops stripe blocks across them;
+    SHM connections need only the control stream (payload moves through the
+    mapped pool, not the socket).
     """
 
     def __init__(self, config: ClientConfig):
         self.config = config
-        self.sock: Optional[socket.socket] = None
+        self.channels: List[_Channel] = []
         self.pools: List[_MappedPool] = []
         self.pool_meta: List[Tuple[str, int, int]] = []
         self.shm_mode = False
         self._registered: Dict[int, int] = {}  # base ptr -> size
-        # one socket, possibly many executor threads (async API): every
-        # request/response exchange must be atomic on the wire
-        self._io_lock = __import__("threading").Lock()
+        self._pool_lock = threading.Lock()
+        self._stripe_pool: Optional[ThreadPoolExecutor] = None
+
+    @property
+    def sock(self):  # backwards-compat probe: "is connected"
+        return self.channels[0].sock if self.channels else None
 
     # -- plumbing --
 
     def connect(self) -> None:
-        if self.sock is not None:
+        if self.channels:
             raise InfiniStoreException("Already connected to remote instance")
-        s = socket.create_connection(
-            (self.config.host_addr, self.config.service_port), timeout=30
-        )
-        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self.sock = s
-        status, body = self._request(P.OP_HELLO, P.pack_hello(os.getpid()))
+        ch0 = _Channel(self.config.host_addr, self.config.service_port)
+        status, body = ch0.exchange(P.OP_HELLO, P.pack_hello(os.getpid()))
         _raise_for_status(status, "hello")
+        ch0.start_reader()
+        self.channels.append(ch0)
         self.pool_meta = P.unpack_pool_table(memoryview(body))
         if self.config.connection_type == TYPE_SHM:
             try:
@@ -129,6 +257,20 @@ class Connection:
                 raise InfiniStoreException(
                     f"SHM transport requested but server pools are not mappable "
                     f"(different host?): {e}"
+                )
+        else:
+            # cross-host: stripe data ops over extra sockets (the role the
+            # reference's batched RDMA WR chains play for throughput)
+            for _ in range(int(self.config.num_streams) - 1):
+                ch = _Channel(self.config.host_addr, self.config.service_port)
+                st, _b = ch.exchange(P.OP_HELLO, P.pack_hello(os.getpid()))
+                _raise_for_status(st, "hello")
+                ch.start_reader()
+                self.channels.append(ch)
+            if len(self.channels) > 1:
+                self._stripe_pool = ThreadPoolExecutor(
+                    max_workers=len(self.channels),
+                    thread_name_prefix="istpu-stripe",
                 )
 
     def _map_pools(self) -> None:
@@ -143,53 +285,41 @@ class Connection:
             self._map_pools()
 
     def close(self) -> None:
-        if self.sock is not None:
-            try:
-                self.sock.close()
-            finally:
-                self.sock = None
+        if self._stripe_pool is not None:
+            self._stripe_pool.shutdown(wait=False)
+            self._stripe_pool = None
+        for ch in self.channels:
+            ch.close()
+        self.channels.clear()
         for p in self.pools:
             p.close()
         self.pools.clear()
 
-    def _send_frame(self, op: int, body: bytes, payload: Sequence[memoryview] = ()) -> None:
-        # sendall per buffer: sendmsg can partially send under backpressure and
-        # is capped at IOV_MAX vectors; coalesce the small frame parts instead.
-        self.sock.sendall(P.pack_header(op, len(body)) + body)
-        for view in payload:
-            self.sock.sendall(view)
-
-    def _recv_exact_into(self, view: memoryview) -> None:
-        got = 0
-        size = len(view)
-        while got < size:
-            n = self.sock.recv_into(view[got:], size - got)
-            if n == 0:
-                raise InfiniStoreException("connection closed by server")
-            got += n
-
-    def _recv_resp(self) -> Tuple[int, bytes]:
-        hdr = bytearray(P.RESP_SIZE)
-        self._recv_exact_into(memoryview(hdr))
-        status, body_len = P.RESP.unpack(bytes(hdr))
-        body = bytearray(body_len)
-        if body_len:
-            self._recv_exact_into(memoryview(body))
-        return status, bytes(body)
-
     def _request(self, op: int, body: bytes, payload: Sequence[memoryview] = ()) -> Tuple[int, bytes]:
-        if self.sock is None:
+        if not self.channels:
             raise InfiniStoreException("not connected")
-        with self._io_lock:
-            self._send_frame(op, body, payload)
-            return self._recv_resp()
+        return self.channels[0].request(op, body, payload)
 
     # -- zero-copy batched ops (reference: rdma_write_cache/rdma_read_cache) --
 
     def _pool_view(self, pool_idx: int, offset: int, size: int) -> memoryview:
         if pool_idx >= len(self.pools):
-            self._refresh_pools()
+            with self._pool_lock:
+                if pool_idx >= len(self.pools):
+                    self._refresh_pools()
         return self.pools[pool_idx].buf[offset : offset + size]
+
+    def _stripe(self, blocks: Sequence[Tuple[str, int]]) -> List[Tuple[int, List]]:
+        """Partition a batch across channels: [(channel_idx, sub_blocks)]."""
+        n = len(self.channels)
+        if n == 1 or len(blocks) == 1:
+            return [(0, list(blocks))]
+        per = -(-len(blocks) // n)
+        return [
+            (i, list(blocks[i * per : (i + 1) * per]))
+            for i in range(n)
+            if blocks[i * per : (i + 1) * per]
+        ]
 
     def write_cache(self, blocks: Sequence[Tuple[str, int]], block_size: int, ptr: int) -> int:
         """Batched put: key i's payload is ``block_size`` bytes at
@@ -214,19 +344,33 @@ class Connection:
             status, body = self._request(P.OP_COMMIT_PUT, P.pack_keys(keys))
             _raise_for_status(status, "commit_put")
         else:
-            payload = [src[off : off + block_size] for off in offsets]
-            status, _ = self._request(
-                P.OP_PUT_INLINE_BATCH, P.pack_put_inline_batch(keys, block_size), payload
-            )
-            _raise_for_status(status, "put_inline_batch")
+
+            def _put(chunk):
+                ch_idx, sub = chunk
+                sub_keys = P.encode_keys([k for k, _ in sub])
+                payload = [src[off : off + block_size] for _, off in sub]
+                st, _ = self.channels[ch_idx].request(
+                    P.OP_PUT_INLINE_BATCH,
+                    P.pack_put_inline_batch(sub_keys, block_size),
+                    payload,
+                )
+                return st
+
+            chunks = self._stripe(blocks)
+            if len(chunks) == 1:
+                statuses = [_put(chunks[0])]
+            else:
+                statuses = list(self._stripe_pool.map(_put, chunks))
+            for st in statuses:
+                _raise_for_status(st, "put_inline_batch")
         return P.FINISH
 
     def read_cache(self, blocks: Sequence[Tuple[str, int]], block_size: int, ptr: int) -> int:
         """Batched get into ``ptr + offset_i`` (reference: lib.py:483-542)."""
-        keys = P.encode_keys([k for k, _ in blocks])
         offsets = [off for _, off in blocks]
         dst = _ptr_view(ptr, max(offsets) + block_size if offsets else 0)
         if self.shm_mode:
+            keys = P.encode_keys([k for k, _ in blocks])
             status, body = self._request(P.OP_GET_DESC, P.pack_alloc_put(keys, block_size))
             _raise_for_status(status, "get_desc")
             descs = P.unpack_descs(memoryview(body))
@@ -234,22 +378,40 @@ class Connection:
                 src = self._pool_view(pool_idx, pool_off, size)
                 dst[dst_off : dst_off + size] = src
         else:
-            body = P.pack_get_inline_batch(keys, block_size)
-            with self._io_lock:  # whole exchange: frame + streamed payload
-                self._send_frame(P.OP_GET_INLINE_BATCH, body)
-                hdr = bytearray(P.RESP_SIZE)
-                self._recv_exact_into(memoryview(hdr))
-                status, body_len = P.RESP.unpack(bytes(hdr))
-                if status != P.FINISH:
-                    if body_len:
-                        self._recv_exact_into(memoryview(bytearray(body_len)))
-                    _raise_for_status(status, "get_inline_batch")
-                # resp = n x size:u32, then payloads at their stored sizes
-                sizes_buf = bytearray(4 * len(keys))
-                self._recv_exact_into(memoryview(sizes_buf))
-                sizes = np.frombuffer(sizes_buf, dtype="<u4")
-                for size, dst_off in zip(sizes, offsets):
-                    self._recv_exact_into(dst[dst_off : dst_off + int(size)])
+
+            def _get(chunk):
+                ch_idx, sub = chunk
+                sub_keys = P.encode_keys([k for k, _ in sub])
+                sub_offs = [off for _, off in sub]
+
+                def consumer(ch: _Channel, status: int, body_len: int):
+                    # runs on the channel's reader thread: stream payloads
+                    # straight into the destination buffer
+                    if status != P.FINISH:
+                        if body_len:
+                            ch._recv_exact_into(memoryview(bytearray(body_len)))
+                        return None
+                    sizes_buf = bytearray(4 * len(sub_keys))
+                    ch._recv_exact_into(memoryview(sizes_buf))
+                    sizes = np.frombuffer(sizes_buf, dtype="<u4")
+                    for size, dst_off in zip(sizes, sub_offs):
+                        ch._recv_exact_into(dst[dst_off : dst_off + int(size)])
+                    return True
+
+                st, _ = self.channels[ch_idx].request(
+                    P.OP_GET_INLINE_BATCH,
+                    P.pack_get_inline_batch(sub_keys, block_size),
+                    consumer=consumer,
+                )
+                return st
+
+            chunks = self._stripe(blocks)
+            if len(chunks) == 1:
+                statuses = [_get(chunks[0])]
+            else:
+                statuses = list(self._stripe_pool.map(_get, chunks))
+            for st in statuses:
+                _raise_for_status(st, "get_inline_batch")
         return P.FINISH
 
     # -- inline single-key ops (reference: w_tcp/r_tcp) --

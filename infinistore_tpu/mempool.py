@@ -22,7 +22,9 @@ from __future__ import annotations
 import ctypes
 import mmap
 import os
+import re
 import secrets
+import threading
 from typing import List, Optional, Tuple
 
 EXTEND_POOL_SIZE = 10 << 30  # reference: src/mempool.h:12
@@ -64,6 +66,43 @@ def _round_up(x: int, align: int) -> int:
     return -(-x // align) * align
 
 
+_SEGMENT_RE = re.compile(r"^istpu_(\d+)_")
+
+
+def sweep_stale_segments(shm_dir: str = SHM_DIR) -> List[str]:
+    """Remove ``istpu_<pid>_*`` segments whose owning pid is dead.
+
+    A server killed with SIGKILL never reaches ``Pool.close``, so its
+    segments would permanently eat host RAM; every new server reclaims them
+    at startup (segment names embed the creator's pid).  Returns the paths
+    removed."""
+    removed = []
+    try:
+        names = os.listdir(shm_dir)
+    except OSError:
+        return removed
+    for name in names:
+        m = _SEGMENT_RE.match(name)
+        if not m:
+            continue
+        pid = int(m.group(1))
+        if pid == os.getpid():
+            continue
+        try:
+            os.kill(pid, 0)
+            continue  # owner alive
+        except ProcessLookupError:
+            pass
+        except PermissionError:
+            continue  # alive, different uid
+        try:
+            os.unlink(os.path.join(shm_dir, name))
+            removed.append(os.path.join(shm_dir, name))
+        except OSError:
+            pass
+    return removed
+
+
 class Pool:
     """One shm-backed slab pool with a bitmap block allocator."""
 
@@ -84,8 +123,46 @@ class Pool:
             self.mm = mmap.mmap(fd, pool_size)
         finally:
             os.close(fd)
-        _prefault(self.mm, pool_size)
         self.buf = memoryview(self.mm)
+        # Pre-fault in the background so the server can bind/listen
+        # immediately (a 16 GiB pool takes minutes to fault in).  Only the
+        # madvise and read-touch strategies are concurrency-safe; the
+        # zero-fill fallback in _prefault would race live writes, so it is
+        # never used off-thread.
+        self.prefault_done = threading.Event()
+        self._closing = False
+        if os.environ.get("ISTPU_NO_PREFAULT"):
+            self.prefault_done.set()
+            self._prefault_thread = None
+        else:
+            self._prefault_thread = threading.Thread(
+                target=self._prefault_bg, args=(pool_size,), daemon=True
+            )
+            self._prefault_thread.start()
+
+    def _prefault_bg(self, size: int) -> None:
+        try:
+            addr = ctypes.addressof(ctypes.c_char.from_buffer(self.mm))
+            libc = ctypes.CDLL(None, use_errno=True)
+            step = 1 << 28  # 256 MB chunks so close() never waits long
+            for off in range(0, size, step):
+                if self._closing:
+                    return
+                n = min(step, size - off)
+                rc = libc.madvise(
+                    ctypes.c_void_p(addr + off),
+                    ctypes.c_size_t(n),
+                    MADV_POPULATE_WRITE,
+                )
+                if rc != 0:  # pre-5.14 kernel: read-touch (concurrency-safe)
+                    for o2 in range(off, off + n, mmap.PAGESIZE):
+                        if self._closing:
+                            return
+                        self.buf[o2]
+        except (ValueError, OSError, BufferError):
+            pass  # pool closed mid-prefault; remaining pages fault on first touch
+        finally:
+            self.prefault_done.set()
 
     # -- allocation --
 
@@ -129,6 +206,9 @@ class Pool:
         self.allocated_blocks -= k
 
     def close(self) -> None:
+        self._closing = True
+        if self._prefault_thread is not None:
+            self._prefault_thread.join(timeout=10.0)
         self.buf.release()
         self.mm.close()
         try:
@@ -145,6 +225,7 @@ class MM:
         self.name_prefix = name_prefix or f"istpu_{os.getpid()}_{secrets.token_hex(4)}"
         self.pools: List[Pool] = []
         self.need_extend = False
+        sweep_stale_segments()  # reclaim segments of SIGKILL'd servers
         self.add_mempool(pool_size, block_size)
 
     def _next_name(self) -> str:

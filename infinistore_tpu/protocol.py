@@ -134,6 +134,11 @@ def pack_keys(keys: Sequence[bytes]) -> bytes:
 def unpack_keys(buf: memoryview, off: int = 0) -> Tuple[List[bytes], int]:
     (n,) = _U32.unpack_from(buf, off)
     off += 4
+    # untrusted count: every key needs >= 2 bytes (its u16 length), so a
+    # count beyond remaining/2 is malformed -- reject up front instead of
+    # looping billions of times on an adversarial frame
+    if n > (len(buf) - off) // 2:
+        raise ValueError(f"key count {n} exceeds body size")
     keys = []
     for _ in range(n):
         (klen,) = _U16.unpack_from(buf, off)

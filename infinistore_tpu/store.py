@@ -76,12 +76,31 @@ class Store:
         self.kv: "OrderedDict[bytes, Entry]" = OrderedDict()
         # uncommitted allocations: key -> Entry (not visible to reads/exist)
         self.pending: Dict[bytes, Entry] = {}
+        # regions deleted/purged while leased: the key disappears at once,
+        # the blocks are freed only after the lease expires (an shm client
+        # may still be memcpying from them)
+        self._deferred: List[Tuple[float, Entry]] = []
         self.stats = Stats()
 
     # ---- helpers ----
 
     def _free(self, e: Entry) -> None:
         self.mm.deallocate(e.pool_idx, e.offset, e.size)
+
+    def _free_or_defer(self, e: Entry, now: float) -> None:
+        if e.lease > now:
+            self._deferred.append((e.lease, e))
+        else:
+            self._free(e)
+
+    def _reap_deferred(self, now: float) -> None:
+        keep = []
+        for expiry, e in self._deferred:
+            if expiry <= now:
+                self._free(e)
+            else:
+                keep.append((expiry, e))
+        self._deferred = keep
 
     def _touch(self, key: bytes) -> None:
         self.kv.move_to_end(key)
@@ -96,6 +115,7 @@ class Store:
 
     def evict(self, min_threshold: float, max_threshold: float) -> int:
         evicted = 0
+        self._reap_deferred(time.monotonic())
         if self.mm.usage() >= max_threshold:
             now = time.monotonic()
             skipped = []
@@ -207,7 +227,9 @@ class Store:
     def _insert_committed(self, key: bytes, e: Entry) -> None:
         old = self.kv.pop(key, None)
         if old is not None:
-            self._free(old)
+            # overwrite: an shm reader may hold a live lease on the old
+            # region; defer the free just like delete/purge do
+            self._free_or_defer(old, time.monotonic())
         self.kv[key] = e  # appended at MRU end
 
     def get_desc(self, keys: Sequence[bytes], block_size: int = 0):
@@ -246,17 +268,21 @@ class Store:
 
     def delete_keys(self, keys: Sequence[bytes]) -> int:
         count = 0
+        now = time.monotonic()
+        self._reap_deferred(now)
         for key in keys:
             e = self.kv.pop(key, None)
             if e is not None:
-                self._free(e)
+                self._free_or_defer(e, now)
                 count += 1
         return count
 
     def purge(self) -> int:
         n = len(self.kv)
+        now = time.monotonic()
+        self._reap_deferred(now)
         for e in self.kv.values():
-            self._free(e)
+            self._free_or_defer(e, now)
         self.kv.clear()
         # keep regions an op is actively streaming into (their op will
         # commit or abort them); free the rest

@@ -1,10 +1,15 @@
 #include "mempool.h"
 
+#include <dirent.h>
+#include <errno.h>
 #include <fcntl.h>
+#include <signal.h>
+#include <stdlib.h>
 #include <sys/mman.h>
 #include <sys/stat.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <cstdio>
 #include <cstring>
 #include <stdexcept>
@@ -41,13 +46,33 @@ Pool::Pool(const std::string& name, uint64_t pool_size, uint64_t block_size)
     throw std::runtime_error("mmap failed: " + path_);
   }
   base_ = static_cast<uint8_t*>(p);
-  // pre-fault (the ibv_reg_mr-pin analog); fall back to a touch loop
-  if (madvise(base_, pool_size, MADV_POPULATE_WRITE) != 0) {
-    for (uint64_t off = 0; off < pool_size; off += 4096) base_[off] = 0;
+  // Pre-fault in the background (the ibv_reg_mr-pin analog) so the server
+  // can bind/listen immediately; a 16 GiB pool takes minutes to fault in.
+  if (getenv("ISTPU_NO_PREFAULT")) {
+    prefault_done_ = true;
+  } else {
+    prefault_thread_ = std::thread([this] { prefault_bg(); });
   }
 }
 
+void Pool::prefault_bg() {
+  constexpr uint64_t kChunk = 1ULL << 28;  // 256 MB so teardown never waits long
+  for (uint64_t off = 0; off < pool_size_ && !closing_; off += kChunk) {
+    uint64_t n = std::min(kChunk, pool_size_ - off);
+    if (madvise(base_ + off, n, MADV_POPULATE_WRITE) != 0) {
+      // pre-5.14 kernel: read-touch.  Never zero-fill off-thread -- the
+      // data path may already be writing live blocks into these pages.
+      for (uint64_t o2 = off; o2 < off + n && !closing_; o2 += 4096) {
+        (void)*static_cast<volatile uint8_t*>(base_ + o2);
+      }
+    }
+  }
+  prefault_done_ = true;
+}
+
 Pool::~Pool() {
+  closing_ = true;
+  if (prefault_thread_.joinable()) prefault_thread_.join();
   if (base_) munmap(base_, pool_size_);
   unlink(path_.c_str());
 }
@@ -60,7 +85,10 @@ int64_t Pool::find_run(uint64_t k) {
   uint64_t start = rover_ % total_blocks_;
   for (int pass = 0; pass < 2; pass++) {
     uint64_t lo = pass == 0 ? start : 0;
-    uint64_t hi = pass == 0 ? total_blocks_ : start;
+    // pass 1 runs past `start` by k-1 blocks so a free run straddling the
+    // rover position (begins before it, ends after) is still found
+    uint64_t hi = pass == 0 ? total_blocks_
+                            : std::min(start + k - 1, total_blocks_);
     uint64_t run = 0, run_start = 0;
     for (uint64_t i = lo; i < hi; i++) {
       // skip full words fast when starting a fresh run
@@ -97,8 +125,25 @@ void Pool::deallocate(uint64_t offset, uint64_t size) {
   allocated_blocks_ -= k;
 }
 
+int sweep_stale_segments() {
+  int removed = 0;
+  DIR* d = opendir("/dev/shm");
+  if (!d) return 0;
+  while (dirent* ent = readdir(d)) {
+    int pid = 0;
+    if (sscanf(ent->d_name, "istpu_%d_", &pid) != 1 || pid <= 0) continue;
+    if (pid == getpid()) continue;
+    if (kill(pid, 0) == 0 || errno != ESRCH) continue;  // owner alive / EPERM
+    std::string path = std::string("/dev/shm/") + ent->d_name;
+    if (unlink(path.c_str()) == 0) removed++;
+  }
+  closedir(d);
+  return removed;
+}
+
 MM::MM(uint64_t pool_size, uint64_t block_size, const std::string& name_prefix)
     : block_size_(block_size), name_prefix_(name_prefix) {
+  sweep_stale_segments();  // reclaim segments of SIGKILL'd servers
   char buf[256];
   snprintf(buf, sizeof(buf), "%s_p0", name_prefix_.c_str());
   pools_.emplace_back(

@@ -9,9 +9,11 @@
 // ibv_reg_mr's pin: the data path never takes a tmpfs first-touch fault.
 #pragma once
 
+#include <atomic>
 #include <cstdint>
 #include <memory>
 #include <string>
+#include <thread>
 #include <vector>
 
 namespace istpu {
@@ -35,8 +37,10 @@ class Pool {
   uint64_t block_size() const { return block_size_; }
   uint64_t total_blocks() const { return total_blocks_; }
   uint64_t allocated_blocks() const { return allocated_blocks_; }
+  bool prefault_done() const { return prefault_done_.load(); }
 
  private:
+  void prefault_bg();  // chunked MADV_POPULATE_WRITE off-thread
   int64_t find_run(uint64_t k);  // first free run of k blocks, or -1
 
   std::string name_;
@@ -48,7 +52,14 @@ class Pool {
   uint64_t rover_ = 0;
   uint8_t* base_ = nullptr;
   std::vector<uint64_t> bitmap_;  // bit set => block in use
+  std::atomic<bool> closing_{false};
+  std::atomic<bool> prefault_done_{false};
+  std::thread prefault_thread_;
 };
+
+// Remove /dev/shm/istpu_<pid>_* segments whose owning pid is dead (a
+// SIGKILL'd server never unlinks; new servers reclaim at startup).
+int sweep_stale_segments();
 
 struct Region {
   uint32_t pool_idx;

@@ -120,6 +120,10 @@ class Reader {
   bool get_keys(std::vector<std::string>* keys) {
     uint32_t n = get<uint32_t>();
     if (!ok_) return false;
+    // n is untrusted wire data: each key needs >= 2 bytes (its u16 length),
+    // so any n beyond remaining()/2 is malformed -- reject before reserve()
+    // can attempt a multi-GB allocation.
+    if (n > remaining() / 2) { ok_ = false; return false; }
     keys->reserve(n);
     for (uint32_t i = 0; i < n; i++) {
       uint16_t klen = get<uint16_t>();

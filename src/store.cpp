@@ -25,6 +25,53 @@ double Store::now() {
       .count();
 }
 
+void Store::free_entry(const Entry& e) {
+  RegionId id{e.pool_idx, e.offset};
+  if (pins_.count(id)) {
+    zombies_[id] = e.size;  // blocks stay allocated until the final unpin
+    return;
+  }
+  mm_.deallocate(e.pool_idx, e.offset, e.size);
+}
+
+void Store::pin(const std::vector<Desc>& descs) {
+  for (const auto& d : descs) pins_[{d.pool_idx, d.offset}]++;
+}
+
+void Store::unpin(const std::vector<Desc>& descs) {
+  for (const auto& d : descs) {
+    RegionId id{d.pool_idx, d.offset};
+    auto it = pins_.find(id);
+    if (it == pins_.end()) continue;
+    if (--it->second == 0) {
+      pins_.erase(it);
+      auto z = zombies_.find(id);
+      if (z != zombies_.end()) {
+        mm_.deallocate(id.first, id.second, z->second);
+        zombies_.erase(z);
+      }
+    }
+  }
+}
+
+void Store::free_or_defer(const Entry& e, double now) {
+  if (e.lease > now)
+    deferred_.emplace_back(e.lease, e);
+  else
+    free_entry(e);
+}
+
+void Store::reap_deferred(double now) {
+  size_t w = 0;
+  for (size_t i = 0; i < deferred_.size(); i++) {
+    if (deferred_[i].first <= now)
+      free_entry(deferred_[i].second);
+    else
+      deferred_[w++] = deferred_[i];
+  }
+  deferred_.resize(w);
+}
+
 void Store::touch(Slot& s, const std::string& key) {
   lru_.erase(s.lru_it);
   lru_.push_back(key);
@@ -33,8 +80,8 @@ void Store::touch(Slot& s, const std::string& key) {
 
 void Store::insert_committed(const std::string& key, const Entry& e) {
   auto it = kv_.find(key);
-  if (it != kv_.end()) {  // overwrite frees the old region
-    free_entry(it->second.e);
+  if (it != kv_.end()) {  // overwrite: old region freed when safe
+    free_or_defer(it->second.e, now());
     lru_.erase(it->second.lru_it);
     kv_.erase(it);
   }
@@ -44,6 +91,7 @@ void Store::insert_committed(const std::string& key, const Entry& e) {
 
 int64_t Store::evict(double min_threshold, double max_threshold) {
   int64_t evicted = 0;
+  reap_deferred(now());
   if (mm_.usage() >= max_threshold) {
     double t = now();
     size_t rotated = 0;
@@ -205,10 +253,12 @@ int32_t Store::match_last_index(const std::vector<std::string>& keys) const {
 
 int32_t Store::delete_keys(const std::vector<std::string>& keys) {
   int32_t count = 0;
+  double t = now();
+  reap_deferred(t);
   for (const auto& k : keys) {
     auto it = kv_.find(k);
     if (it == kv_.end()) continue;
-    free_entry(it->second.e);
+    free_or_defer(it->second.e, t);
     lru_.erase(it->second.lru_it);
     kv_.erase(it);
     count++;
@@ -218,7 +268,9 @@ int32_t Store::delete_keys(const std::vector<std::string>& keys) {
 
 int32_t Store::purge() {
   int32_t n = static_cast<int32_t>(kv_.size());
-  for (auto& [k, s] : kv_) free_entry(s.e);
+  double t = now();
+  reap_deferred(t);
+  for (auto& [k, s] : kv_) free_or_defer(s.e, t);
   kv_.clear();
   lru_.clear();
   // keep regions an op is actively streaming into; free the rest

@@ -12,8 +12,10 @@
 
 #include <chrono>
 #include <list>
+#include <map>
 #include <string>
 #include <unordered_map>
+#include <utility>
 #include <vector>
 
 #include "mempool.h"
@@ -67,6 +69,12 @@ class Store {
   int32_t delete_keys(const std::vector<std::string>& keys);
   int32_t purge();
   int64_t evict(double min_threshold, double max_threshold);
+  // Region pinning: while a region's pages are queued as zero-copy response
+  // segments, any free of it (delete/evict/overwrite/lease expiry) is parked
+  // as a zombie and executed at the final unpin.  Unlike the time-based
+  // lease this cannot lapse under a stalled receiver.
+  void pin(const std::vector<Desc>& descs);
+  void unpin(const std::vector<Desc>& descs);
 
   uint8_t* view(uint32_t pool_idx, uint64_t offset) { return mm_.view(pool_idx, offset); }
   double usage() const { return mm_.usage(); }
@@ -84,7 +92,12 @@ class Store {
     LruList::iterator lru_it;
   };
 
-  void free_entry(const Entry& e) { mm_.deallocate(e.pool_idx, e.offset, e.size); }
+  void free_entry(const Entry& e);  // respects pins (zombie until unpin)
+  // delete/purge/overwrite of a leased entry must not yank pool memory out
+  // from under an in-flight shm read: the key disappears immediately, the
+  // region is freed once the lease expires
+  void free_or_defer(const Entry& e, double now);
+  void reap_deferred(double now);
   void insert_committed(const std::string& key, const Entry& e);
   void touch(Slot& s, const std::string& key);
   bool allocate(uint64_t size, size_t n, std::vector<Region>* out);
@@ -96,6 +109,10 @@ class Store {
   std::unordered_map<std::string, Entry> pending_;
   LruList lru_;
   StoreStats stats_;
+  std::vector<std::pair<double, Entry>> deferred_;  // (lease expiry, region)
+  using RegionId = std::pair<uint32_t, uint64_t>;   // (pool_idx, offset)
+  std::map<RegionId, int> pins_;                    // outstanding send refs
+  std::map<RegionId, uint64_t> zombies_;            // freed-while-pinned: size
 };
 
 }  // namespace istpu

@@ -1,12 +1,20 @@
 // Native client engine - counterpart of the reference's libinfinistore.cpp
-// Connection (reference: src/libinfinistore.cpp: TCP socket + RDMA QP,
-// batched WR chains).  Here the zero-copy path maps the server's /dev/shm
-// pools and memcpys blocks directly (the RDMA-WRITE/READ analog on a shared
-// TPU-VM host); remote clients use the inline batch ops over TCP.
+// Connection (reference: src/libinfinistore.cpp: TCP socket + RDMA QP, CQ
+// thread, batched WR chains).  Here the zero-copy path maps the server's
+// /dev/shm pools and memcpys blocks directly (the RDMA-WRITE/READ analog on
+// a shared TPU-VM host); remote clients use the inline batch ops over TCP.
 //
-// All calls are blocking on one socket; Python drives them via ctypes, which
-// releases the GIL around foreign calls - the GIL-free IO the reference gets
-// from its CQ-polling thread.
+// Concurrency model (the analog of the reference's async WR chains +
+// cq_handler thread, src/libinfinistore.cpp:103,596):
+//  * every channel (socket) is PIPELINED: requests are sent under a short
+//    send lock and matched FIFO by a dedicated reader thread, so many
+//    Python threads can have ops in flight on one connection at once;
+//  * TCP connections open `nstreams` channels and batched inline ops
+//    STRIPE their blocks across them, with per-chunk sender threads, so
+//    payload bandwidth scales across cores/flows;
+//  * payloads move with vectored IO (sendmsg/recvmsg) - one syscall per
+//    chunk instead of one per block.
+// Python drives this via ctypes, which releases the GIL around every call.
 #include <arpa/inet.h>
 #include <fcntl.h>
 #include <netinet/in.h>
@@ -15,11 +23,17 @@
 #include <sys/mman.h>
 #include <sys/socket.h>
 #include <sys/stat.h>
+#include <sys/uio.h>
 #include <unistd.h>
 
+#include <atomic>
+#include <condition_variable>
 #include <cstdint>
+#include <deque>
+#include <memory>
 #include <mutex>
 #include <string>
+#include <thread>
 #include <vector>
 
 #include "protocol.h"
@@ -36,12 +50,41 @@ struct MappedPool {
   uint64_t size = 0;
 };
 
-class Client {
- public:
-  ~Client() { close_conn(); }
+namespace {
 
-  // returns 0 on success, negative errno-style on failure
-  int connect_to(const char* host, int port, bool use_shm) {
+constexpr int kMaxIov = 64;  // < IOV_MAX; chunks larger than this loop
+
+// One in-flight request, resolved by its channel's reader thread.
+struct Slot {
+  std::mutex mu;
+  std::condition_variable cv;
+  bool done = false;
+  int32_t status = SYSTEM_ERROR;
+  std::string resp;  // simple responses land here...
+  // ...scatter responses (GET_INLINE_BATCH) land straight in caller memory:
+  uint8_t* scatter_base = nullptr;
+  const uint64_t* scatter_offs = nullptr;
+  size_t scatter_n = 0;
+
+  void wait() {
+    std::unique_lock<std::mutex> lk(mu);
+    cv.wait(lk, [this] { return done; });
+  }
+  void finish(int32_t st) {
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      status = st;
+      done = true;
+    }
+    cv.notify_one();
+  }
+};
+
+class Chan {
+ public:
+  ~Chan() { shutdown_close(); }
+
+  int connect_to(const char* host, int port) {
     fd_ = socket(AF_INET, SOCK_STREAM, 0);
     if (fd_ < 0) return -1;
     sockaddr_in addr{};
@@ -52,25 +95,234 @@ class Client {
       return -3;
     int one = 1;
     setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-    // HELLO: pid u32 | flags u32 -> pool table
-    std::string body;
-    Writer w(&body);
-    w.put<uint32_t>(static_cast<uint32_t>(getpid()));
-    w.put<uint32_t>(0);
-    std::string resp;
-    int32_t st = request(OP_HELLO, body, &resp);
-    if (st != FINISH) return -4;
-    if (!parse_pool_table(resp)) return -5;
+    return 0;
+  }
+
+  // synchronous exchange, only valid before start_reader() (HELLO bootstrap)
+  int32_t exchange(uint8_t op, const std::string& body, std::string* resp) {
+    Header hdr{MAGIC, VERSION, op, 0, static_cast<uint32_t>(body.size()), 0};
+    if (!send_all(&hdr, sizeof(hdr))) return SYSTEM_ERROR;
+    if (!body.empty() && !send_all(body.data(), body.size()))
+      return SYSTEM_ERROR;
+    RespHeader rh;
+    if (!recv_all(&rh, sizeof(rh))) return SYSTEM_ERROR;
+    resp->resize(rh.body_len);
+    if (rh.body_len && !recv_all(resp->data(), rh.body_len)) return SYSTEM_ERROR;
+    return rh.status;
+  }
+
+  void start_reader() {
+    reader_ = std::thread([this] { reader_loop(); });
+  }
+
+  // Send one framed request (header+body+optional payload iovecs) and
+  // enqueue `slot` for the reader.  Returns false if the channel is dead.
+  bool submit(Slot* slot, uint8_t op, const std::string& body,
+              const struct iovec* payload, int payload_cnt) {
+    std::lock_guard<std::mutex> g(send_mu_);
+    if (dead_) return false;
+    {
+      std::lock_guard<std::mutex> q(q_mu_);
+      q_.push_back(slot);
+    }
+    Header hdr{MAGIC, VERSION, op, 0, static_cast<uint32_t>(body.size()), 0};
+    struct iovec head[2];
+    head[0] = {const_cast<Header*>(&hdr), sizeof(hdr)};
+    head[1] = {const_cast<char*>(body.data()), body.size()};
+    bool ok = send_iov(head, body.empty() ? 1 : 2);
+    if (ok && payload_cnt) ok = send_iov(payload, payload_cnt);
+    if (!ok) {
+      fail_all();
+      return false;
+    }
+    return true;
+  }
+
+  void shutdown_close() {
+    if (fd_ >= 0) shutdown(fd_, SHUT_RDWR);
+    if (reader_.joinable()) reader_.join();
+    if (fd_ >= 0) {
+      close(fd_);
+      fd_ = -1;
+    }
+  }
+
+  bool alive() const { return !dead_; }
+
+ private:
+  void reader_loop() {
+    while (true) {
+      RespHeader rh;
+      if (!recv_all(&rh, sizeof(rh))) break;
+      Slot* slot;
+      {
+        std::lock_guard<std::mutex> q(q_mu_);
+        if (q_.empty()) break;  // protocol desync: kill the channel
+        slot = q_.front();
+        q_.pop_front();
+      }
+      if (slot->scatter_base && rh.status == FINISH) {
+        if (!consume_scatter(slot, rh.body_len)) {
+          slot->finish(SYSTEM_ERROR);
+          break;
+        }
+        slot->finish(rh.status);
+        continue;
+      }
+      slot->resp.resize(rh.body_len);
+      if (rh.body_len && !recv_all(slot->resp.data(), rh.body_len)) {
+        slot->finish(SYSTEM_ERROR);
+        break;
+      }
+      slot->finish(rh.status);
+    }
+    fail_all();
+  }
+
+  // GET_INLINE_BATCH response: n x size:u32, then payloads -> scatter
+  // straight into the caller's buffer with readv
+  bool consume_scatter(Slot* slot, uint32_t body_len) {
+    size_t n = slot->scatter_n;
+    std::vector<uint32_t> sizes(n);
+    if (!recv_all(sizes.data(), 4 * n)) return false;
+    uint64_t total = 0;
+    for (auto s : sizes) total += s;
+    if (4 * n + total != body_len) return false;  // framing mismatch
+    std::vector<struct iovec> iov(n);
+    for (size_t i = 0; i < n; i++) {
+      iov[i].iov_base = slot->scatter_base + slot->scatter_offs[i];
+      iov[i].iov_len = sizes[i];
+    }
+    return recv_iov(iov.data(), static_cast<int>(n));
+  }
+
+  void fail_all() {
+    dead_ = true;
+    std::deque<Slot*> q;
+    {
+      std::lock_guard<std::mutex> g(q_mu_);
+      q.swap(q_);
+    }
+    for (Slot* s : q) s->finish(SYSTEM_ERROR);
+  }
+
+  bool send_all(const void* p, size_t n) {
+    const char* b = static_cast<const char*>(p);
+    while (n) {
+      ssize_t r = send(fd_, b, n, MSG_NOSIGNAL);
+      if (r <= 0) return false;
+      b += r;
+      n -= r;
+    }
+    return true;
+  }
+
+  bool send_iov(const struct iovec* iov, int cnt) {
+    // loop over <= kMaxIov windows, advancing across partial sends
+    std::vector<struct iovec> cur(iov, iov + cnt);
+    size_t idx = 0;
+    while (idx < cur.size()) {
+      int take = static_cast<int>(std::min<size_t>(cur.size() - idx, kMaxIov));
+      msghdr msg{};
+      msg.msg_iov = &cur[idx];
+      msg.msg_iovlen = take;
+      ssize_t r = sendmsg(fd_, &msg, MSG_NOSIGNAL);
+      if (r <= 0) return false;
+      size_t left = static_cast<size_t>(r);
+      while (left && idx < cur.size()) {
+        if (left >= cur[idx].iov_len) {
+          left -= cur[idx].iov_len;
+          idx++;
+        } else {
+          cur[idx].iov_base = static_cast<char*>(cur[idx].iov_base) + left;
+          cur[idx].iov_len -= left;
+          left = 0;
+        }
+      }
+    }
+    return true;
+  }
+
+  bool recv_all(void* p, size_t n) const {
+    char* b = static_cast<char*>(p);
+    while (n) {
+      ssize_t r = recv(fd_, b, n, 0);
+      if (r <= 0) return false;
+      b += r;
+      n -= r;
+    }
+    return true;
+  }
+
+  bool recv_iov(struct iovec* iov, int cnt) const {
+    std::vector<struct iovec> cur(iov, iov + cnt);
+    size_t idx = 0;
+    // skip zero-length entries up front
+    while (idx < cur.size() && cur[idx].iov_len == 0) idx++;
+    while (idx < cur.size()) {
+      int take = static_cast<int>(std::min<size_t>(cur.size() - idx, kMaxIov));
+      msghdr msg{};
+      msg.msg_iov = &cur[idx];
+      msg.msg_iovlen = take;
+      ssize_t r = recvmsg(fd_, &msg, 0);
+      if (r <= 0) return false;
+      size_t left = static_cast<size_t>(r);
+      while (left && idx < cur.size()) {
+        if (left >= cur[idx].iov_len) {
+          left -= cur[idx].iov_len;
+          idx++;
+        } else {
+          cur[idx].iov_base = static_cast<char*>(cur[idx].iov_base) + left;
+          cur[idx].iov_len -= left;
+          left = 0;
+        }
+      }
+      while (idx < cur.size() && cur[idx].iov_len == 0) idx++;
+    }
+    return true;
+  }
+
+  int fd_ = -1;
+  std::mutex send_mu_;
+  std::mutex q_mu_;
+  std::deque<Slot*> q_;
+  std::thread reader_;
+  std::atomic<bool> dead_{false};
+};
+
+}  // namespace
+
+class Client {
+ public:
+  ~Client() { close_conn(); }
+
+  // returns 0 on success, negative errno-style on failure
+  int connect_to(const char* host, int port, bool use_shm, int nstreams) {
+    if (nstreams < 1) nstreams = 1;
+    if (nstreams > 64) nstreams = 64;
+    if (use_shm) nstreams = 1;  // payload never rides the socket in shm mode
+    for (int i = 0; i < nstreams; i++) {
+      auto ch = std::make_unique<Chan>();
+      int rc = ch->connect_to(host, port);
+      if (rc != 0) return rc;
+      std::string body;
+      Writer w(&body);
+      w.put<uint32_t>(static_cast<uint32_t>(getpid()));
+      w.put<uint32_t>(0);
+      std::string resp;
+      if (ch->exchange(OP_HELLO, body, &resp) != FINISH) return -4;
+      if (i == 0 && !parse_pool_table(resp)) return -5;
+      ch->start_reader();
+      chans_.push_back(std::move(ch));
+    }
     shm_ = use_shm;
     if (shm_ && !map_pools()) return -6;
     return 0;
   }
 
   void close_conn() {
-    if (fd_ >= 0) {
-      close(fd_);
-      fd_ = -1;
-    }
+    for (auto& ch : chans_) ch->shutdown_close();
+    chans_.clear();
     for (auto& p : pools_) {
       if (p.base) munmap(p.base, p.size);
       p.base = nullptr;
@@ -82,7 +334,6 @@ class Client {
 
   int32_t write_cache(const char* const* keys, const uint64_t* offsets, size_t n,
                       uint64_t block_size, const uint8_t* base) {
-    std::lock_guard<std::mutex> g(mu_);
     if (shm_) {
       std::string body = pack_block_req(keys, n, block_size);
       std::string resp;
@@ -106,22 +357,49 @@ class Client {
       std::string resp2;
       return request(OP_COMMIT_PUT, commit, &resp2);
     }
-    // inline path: frame + n*block_size payload
-    std::string body = pack_block_req(keys, n, block_size);
-    Header hdr{MAGIC, VERSION, OP_PUT_INLINE_BATCH, 0,
-               static_cast<uint32_t>(body.size()), 0};
-    if (!send_all(&hdr, sizeof(hdr)) || !send_all(body.data(), body.size()))
-      return SYSTEM_ERROR;
-    for (size_t i = 0; i < n; i++) {
-      if (!send_all(base + offsets[i], block_size)) return SYSTEM_ERROR;
-    }
-    std::string resp;
-    return read_resp(&resp);
+    // inline path: stripe blocks across channels, one sender thread per
+    // chunk so the payload copies into the kernel overlap
+    size_t nch = std::min(chans_.size(), n);
+    std::vector<int32_t> st(nch, FINISH);
+    auto send_chunk = [&](size_t ci) {
+      size_t per = (n + nch - 1) / nch;
+      size_t lo = ci * per, hi = std::min(n, lo + per);
+      if (lo >= hi) return;
+      std::string body;
+      Writer w(&body);
+      w.put<uint64_t>(block_size);
+      w.put<uint32_t>(static_cast<uint32_t>(hi - lo));
+      for (size_t i = lo; i < hi; i++) {
+        size_t klen = strlen(keys[i]);
+        w.put<uint16_t>(static_cast<uint16_t>(klen));
+        w.put_bytes(keys[i], klen);
+      }
+      std::vector<struct iovec> iov(hi - lo);
+      for (size_t i = lo; i < hi; i++) {
+        iov[i - lo].iov_base = const_cast<uint8_t*>(base + offsets[i]);
+        iov[i - lo].iov_len = block_size;
+      }
+      Slot slot;
+      if (!chans_[ci]->submit(&slot, OP_PUT_INLINE_BATCH, body, iov.data(),
+                              static_cast<int>(iov.size()))) {
+        st[ci] = SYSTEM_ERROR;
+        return;
+      }
+      slot.wait();
+      st[ci] = slot.status;
+    };
+    std::vector<std::thread> threads;
+    for (size_t ci = 1; ci < nch; ci++)
+      threads.emplace_back(send_chunk, ci);
+    send_chunk(0);
+    for (auto& t : threads) t.join();
+    for (int32_t s : st)
+      if (s != FINISH) return s;
+    return FINISH;
   }
 
   int32_t read_cache(const char* const* keys, const uint64_t* offsets, size_t n,
                      uint64_t block_size, uint8_t* base) {
-    std::lock_guard<std::mutex> g(mu_);
     if (shm_) {
       std::string body = pack_block_req(keys, n, block_size);
       std::string resp;
@@ -137,30 +415,53 @@ class Client {
       }
       return FINISH;
     }
-    std::string body = pack_block_req(keys, n, block_size);
-    Header hdr{MAGIC, VERSION, OP_GET_INLINE_BATCH, 0,
-               static_cast<uint32_t>(body.size()), 0};
-    if (!send_all(&hdr, sizeof(hdr)) || !send_all(body.data(), body.size()))
-      return SYSTEM_ERROR;
-    RespHeader rh;
-    if (!recv_all(&rh, sizeof(rh))) return SYSTEM_ERROR;
-    if (rh.status != FINISH) {
-      std::string drain(rh.body_len, 0);
-      if (rh.body_len && !recv_all(drain.data(), rh.body_len)) return SYSTEM_ERROR;
-      return rh.status;
+    // inline path: stripe the batch; each chunk's payload scatter-reads on
+    // its channel's reader thread, so chunks drain in parallel
+    size_t nch = std::min(chans_.size(), n);
+    size_t per = (n + nch - 1) / nch;
+    std::vector<std::unique_ptr<Slot>> slots;
+    std::vector<int32_t> st(nch, FINISH);
+    bool submitted_any = false;
+    for (size_t ci = 0; ci < nch; ci++) {
+      size_t lo = ci * per, hi = std::min(n, lo + per);
+      if (lo >= hi) {
+        slots.push_back(nullptr);
+        continue;
+      }
+      std::string body;
+      Writer w(&body);
+      w.put<uint64_t>(block_size);
+      w.put<uint32_t>(static_cast<uint32_t>(hi - lo));
+      for (size_t i = lo; i < hi; i++) {
+        size_t klen = strlen(keys[i]);
+        w.put<uint16_t>(static_cast<uint16_t>(klen));
+        w.put_bytes(keys[i], klen);
+      }
+      auto slot = std::make_unique<Slot>();
+      slot->scatter_base = base;
+      slot->scatter_offs = offsets + lo;
+      slot->scatter_n = hi - lo;
+      if (!chans_[ci]->submit(slot.get(), OP_GET_INLINE_BATCH, body, nullptr, 0))
+        st[ci] = SYSTEM_ERROR;
+      else
+        submitted_any = true;
+      slots.push_back(std::move(slot));
     }
-    std::vector<uint32_t> sizes(n);
-    if (!recv_all(sizes.data(), 4 * n)) return SYSTEM_ERROR;
-    for (size_t i = 0; i < n; i++) {
-      if (!recv_all(base + offsets[i], sizes[i])) return SYSTEM_ERROR;
+    for (size_t ci = 0; ci < nch; ci++) {
+      if (slots[ci] && st[ci] == FINISH) {
+        slots[ci]->wait();
+        st[ci] = slots[ci]->status;
+      }
     }
+    (void)submitted_any;
+    for (int32_t s : st)
+      if (s != FINISH) return s;
     return FINISH;
   }
 
   // ---- single-key inline ----
 
   int32_t put_inline(const char* key, const uint8_t* data, uint64_t size) {
-    std::lock_guard<std::mutex> g(mu_);
     std::string body;
     Writer w(&body);
     size_t klen = strlen(key);
@@ -175,34 +476,21 @@ class Client {
   // out must hold cap bytes; *out_size gets stored size (fails if > cap)
   int32_t get_inline(const char* key, uint8_t* out, uint64_t cap,
                      uint64_t* out_size) {
-    std::lock_guard<std::mutex> g(mu_);
     std::string body;
     Writer w(&body);
     put_keys(&w, &key, 1);
-    Header hdr{MAGIC, VERSION, OP_GET_INLINE, 0,
-               static_cast<uint32_t>(body.size()), 0};
-    if (!send_all(&hdr, sizeof(hdr)) || !send_all(body.data(), body.size()))
-      return SYSTEM_ERROR;
-    RespHeader rh;
-    if (!recv_all(&rh, sizeof(rh))) return SYSTEM_ERROR;
-    if (rh.status != FINISH || rh.body_len > cap) {
-      std::string drain(rh.body_len, 0);
-      if (rh.body_len && !recv_all(drain.data(), rh.body_len)) return SYSTEM_ERROR;
-      if (rh.status == FINISH) {  // caller buffer too small
-        *out_size = rh.body_len;
-        return INVALID_REQ;
-      }
-      return rh.status;
-    }
-    if (rh.body_len && !recv_all(out, rh.body_len)) return SYSTEM_ERROR;
-    *out_size = rh.body_len;
+    std::string resp;
+    int32_t st = request(OP_GET_INLINE, body, &resp);
+    if (st != FINISH) return st;
+    *out_size = resp.size();
+    if (resp.size() > cap) return INVALID_REQ;  // caller buffer too small
+    std::memcpy(out, resp.data(), resp.size());
     return FINISH;
   }
 
   // ---- metadata ----
 
   int32_t simple_i32(uint8_t op, const char* const* keys, size_t n, int32_t* out) {
-    std::lock_guard<std::mutex> g(mu_);
     std::string body;
     Writer w(&body);
     put_keys(&w, keys, n);
@@ -213,7 +501,6 @@ class Client {
   }
 
   int32_t purge(int32_t* out) {
-    std::lock_guard<std::mutex> g(mu_);
     std::string resp;
     int32_t st = request(OP_PURGE, "", &resp);
     if (st == FINISH && resp.size() >= 4) std::memcpy(out, resp.data(), 4);
@@ -221,7 +508,6 @@ class Client {
   }
 
   int32_t evict(float mn, float mx) {
-    std::lock_guard<std::mutex> g(mu_);
     std::string body;
     Writer w(&body);
     w.put<float>(mn);
@@ -231,7 +517,6 @@ class Client {
   }
 
   int32_t stats_json(char* buf, int cap) {
-    std::lock_guard<std::mutex> g(mu_);
     std::string resp;
     int32_t st = request(OP_STATS, "", &resp);
     if (st != FINISH) return st;
@@ -258,6 +543,16 @@ class Client {
       w->put<uint16_t>(static_cast<uint16_t>(klen));
       w->put_bytes(keys[i], klen);
     }
+  }
+
+  // pipelined request/response on channel 0 (metadata + shm control plane)
+  int32_t request(uint8_t op, const std::string& body, std::string* resp) {
+    if (chans_.empty()) return SYSTEM_ERROR;
+    Slot slot;
+    if (!chans_[0]->submit(&slot, op, body, nullptr, 0)) return SYSTEM_ERROR;
+    slot.wait();
+    *resp = std::move(slot.resp);
+    return slot.status;
   }
 
   bool parse_pool_table(const std::string& resp) {
@@ -309,56 +604,23 @@ class Client {
 
   uint8_t* pool_ptr(uint32_t idx, uint64_t off) {
     if (idx >= pools_.size() || !pools_[idx].base) {
-      // pool table grew (auto-extend): refresh + remap
-      std::string resp;
-      if (request(OP_POOLS, "", &resp) != FINISH || !parse_pool_table(resp) ||
-          !map_pools() || idx >= pools_.size())
-        return nullptr;
+      // pool table grew (auto-extend): refresh + remap.  Guarded so two
+      // caller threads don't remap concurrently.
+      std::lock_guard<std::mutex> g(pool_mu_);
+      if (idx >= pools_.size() || !pools_[idx].base) {
+        std::string resp;
+        if (request(OP_POOLS, "", &resp) != FINISH || !parse_pool_table(resp) ||
+            !map_pools() || idx >= pools_.size())
+          return nullptr;
+      }
     }
     return pools_[idx].base + off;
   }
 
-  bool send_all(const void* p, size_t n) {
-    const char* b = static_cast<const char*>(p);
-    while (n) {
-      ssize_t r = send(fd_, b, n, MSG_NOSIGNAL);
-      if (r <= 0) return false;
-      b += r;
-      n -= r;
-    }
-    return true;
-  }
-
-  bool recv_all(void* p, size_t n) {
-    char* b = static_cast<char*>(p);
-    while (n) {
-      ssize_t r = recv(fd_, b, n, 0);
-      if (r <= 0) return false;
-      b += r;
-      n -= r;
-    }
-    return true;
-  }
-
-  int32_t read_resp(std::string* body) {
-    RespHeader rh;
-    if (!recv_all(&rh, sizeof(rh))) return SYSTEM_ERROR;
-    body->resize(rh.body_len);
-    if (rh.body_len && !recv_all(body->data(), rh.body_len)) return SYSTEM_ERROR;
-    return rh.status;
-  }
-
-  int32_t request(uint8_t op, const std::string& body, std::string* resp) {
-    Header hdr{MAGIC, VERSION, op, 0, static_cast<uint32_t>(body.size()), 0};
-    if (!send_all(&hdr, sizeof(hdr))) return SYSTEM_ERROR;
-    if (!body.empty() && !send_all(body.data(), body.size())) return SYSTEM_ERROR;
-    return read_resp(resp);
-  }
-
-  int fd_ = -1;
   bool shm_ = false;
+  std::vector<std::unique_ptr<Chan>> chans_;
   std::vector<MappedPool> pools_;
-  std::mutex mu_;
+  std::mutex pool_mu_;
 };
 
 Client* make_client() { return new Client(); }
@@ -373,8 +635,10 @@ extern "C" {
 
 void* istpu_client_create() { return new Client(); }
 
-int istpu_client_connect(void* h, const char* host, int port, int use_shm) {
-  return static_cast<Client*>(h)->connect_to(host, port, use_shm != 0);
+int istpu_client_connect(void* h, const char* host, int port, int use_shm,
+                         int nstreams) {
+  return static_cast<Client*>(h)->connect_to(host, port, use_shm != 0,
+                                             nstreams);
 }
 
 void istpu_client_close(void* h) { static_cast<Client*>(h)->close_conn(); }

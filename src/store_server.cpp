@@ -23,6 +23,9 @@
 #include <unistd.h>
 
 #include <atomic>
+#include <cstdio>
+#include <chrono>
+#include <deque>
 #include <memory>
 #include <mutex>
 #include <thread>
@@ -40,18 +43,25 @@ enum class ConnState { kHeader, kBody, kStreamPayload };
 
 struct Conn {
   int fd;
+  int ep_fd = -1;  // the shard's epoll fd this connection lives on
   ConnState state = ConnState::kHeader;
   std::string in;          // accumulating header+body bytes
   size_t need = sizeof(Header);
   Header hdr{};
-  std::string out;         // pending response bytes
-  size_t out_off = 0;
-  // zero-copy tail: segments sent straight from pool memory after `out`
-  // (GET_INLINE_BATCH streams pool pages without building a copy; the
-  // 5 s read lease keeps the entries alive while queued)
-  std::vector<std::pair<const uint8_t*, uint64_t>> out_segs;
-  size_t seg_idx = 0;
-  uint64_t seg_off = 0;
+  // Ordered output queue.  With pipelined clients several responses can be
+  // queued before the first finishes flushing, and a response may mix
+  // copied bytes (headers/sizes) with zero-copy pool segments
+  // (GET_INLINE_BATCH payloads) -- the queue preserves wire order across
+  // both kinds.  Segment items borrow pool pages, which stay pinned in the
+  // Store until the queue drains.
+  struct OutItem {
+    std::string bytes;             // used when seg == nullptr
+    const uint8_t* seg = nullptr;  // borrowed pool pointer otherwise
+    uint64_t size = 0;             // seg length (bytes items use bytes.size())
+  };
+  std::deque<OutItem> outq;
+  uint64_t out_off = 0;            // send offset into outq.front()
+  std::vector<Desc> seg_descs;     // pinned regions backing queued segments
   // payload streaming (PUT_INLINE_BATCH)
   std::vector<std::string> stream_keys;
   std::vector<Desc> stream_descs;
@@ -66,7 +76,19 @@ struct Conn {
 
 class StoreServer {
  public:
-  StoreServer(const StoreConfig& cfg, int port) : store_(cfg), port_(port) {}
+  StoreServer(const StoreConfig& cfg, int port) : store_(cfg), port_(port) {
+    // Payload streaming (socket <-> pool memcpy) runs outside the store
+    // mutex, so sharding connections across event loops scales the data
+    // plane across cores -- the role the NIC's DMA engines play for the
+    // reference's RDMA path.  Metadata ops stay serialized on the mutex.
+    const char* env = getenv("ISTPU_SERVER_LOOPS");
+    int n = env ? atoi(env) : 0;
+    if (n <= 0) {
+      unsigned hw = std::thread::hardware_concurrency();
+      n = hw ? std::min(4u, hw) : 2;
+    }
+    for (int i = 0; i < n; i++) shards_.push_back(std::make_unique<Shard>());
+  }
 
   ~StoreServer() { stop(); }
 
@@ -85,59 +107,89 @@ class StoreServer {
       listen_fd_ = -1;
       return false;
     }
-    ep_fd_ = epoll_create1(0);
-    wake_fd_ = eventfd(0, EFD_NONBLOCK);
-    epoll_event ev{};
-    ev.events = EPOLLIN;
-    ev.data.fd = listen_fd_;
-    epoll_ctl(ep_fd_, EPOLL_CTL_ADD, listen_fd_, &ev);
-    ev.data.fd = wake_fd_;
-    epoll_ctl(ep_fd_, EPOLL_CTL_ADD, wake_fd_, &ev);
     running_ = true;
-    thread_ = std::thread([this] { loop(); });
+    for (size_t s = 0; s < shards_.size(); s++) {
+      Shard& sh = *shards_[s];
+      sh.ep_fd = epoll_create1(0);
+      sh.wake_fd = eventfd(0, EFD_NONBLOCK);
+      epoll_event ev{};
+      ev.events = EPOLLIN;
+      ev.data.fd = sh.wake_fd;
+      epoll_ctl(sh.ep_fd, EPOLL_CTL_ADD, sh.wake_fd, &ev);
+      if (s == 0) {  // shard 0 also owns the listen socket
+        ev.data.fd = listen_fd_;
+        epoll_ctl(sh.ep_fd, EPOLL_CTL_ADD, listen_fd_, &ev);
+      }
+      sh.thread = std::thread([this, &sh] { loop(sh); });
+    }
     return true;
   }
 
   void stop() {
     if (!running_.exchange(false)) return;
-    uint64_t one = 1;
-    [[maybe_unused]] ssize_t r = write(wake_fd_, &one, sizeof(one));
-    if (thread_.joinable()) thread_.join();
-    for (auto& [fd, c] : conns_) close(fd);
-    conns_.clear();
+    for (auto& shp : shards_) {
+      uint64_t one = 1;
+      [[maybe_unused]] ssize_t r = write(shp->wake_fd, &one, sizeof(one));
+    }
+    for (auto& shp : shards_) {
+      Shard& sh = *shp;
+      if (sh.thread.joinable()) sh.thread.join();
+      for (auto& [fd, c] : sh.conns) close(fd);
+      sh.conns.clear();
+      if (sh.ep_fd >= 0) close(sh.ep_fd);
+      if (sh.wake_fd >= 0) close(sh.wake_fd);
+      sh.ep_fd = sh.wake_fd = -1;
+    }
     if (listen_fd_ >= 0) close(listen_fd_);
-    if (ep_fd_ >= 0) close(ep_fd_);
-    if (wake_fd_ >= 0) close(wake_fd_);
-    listen_fd_ = ep_fd_ = wake_fd_ = -1;
+    listen_fd_ = -1;
   }
 
   Store* store() { return &store_; }
   std::mutex* store_mutex() { return &mu_; }
 
  private:
-  void loop() {
+  struct Shard {
+    int ep_fd = -1;
+    int wake_fd = -1;
+    std::thread thread;
+    std::mutex conns_mu;  // accept thread inserts, shard thread finds/erases
+    std::unordered_map<int, std::unique_ptr<Conn>> conns;
+  };
+
+  void loop(Shard& sh) {
     epoll_event evs[64];
     while (running_) {
-      int n = epoll_wait(ep_fd_, evs, 64, 500);
+      int n = epoll_wait(sh.ep_fd, evs, 64, 500);
       for (int i = 0; i < n; i++) {
         int fd = evs[i].data.fd;
-        if (fd == wake_fd_) {
+        if (fd == sh.wake_fd) {
           uint64_t v;
-          [[maybe_unused]] ssize_t r = read(wake_fd_, &v, sizeof(v));
+          [[maybe_unused]] ssize_t r = read(sh.wake_fd, &v, sizeof(v));
           continue;
         }
         if (fd == listen_fd_) {
           accept_conns();
           continue;
         }
-        auto it = conns_.find(fd);
-        if (it == conns_.end()) continue;
-        Conn* c = it->second.get();
+        Conn* c;
+        {
+          std::lock_guard<std::mutex> g(sh.conns_mu);
+          auto it = sh.conns.find(fd);
+          if (it == sh.conns.end()) continue;
+          c = it->second.get();
+        }
         bool alive = true;
-        if (evs[i].events & (EPOLLHUP | EPOLLERR)) alive = false;
-        if (alive && (evs[i].events & EPOLLIN)) alive = on_readable(c);
-        if (alive && (evs[i].events & EPOLLOUT)) alive = flush(c);
-        if (!alive) drop(fd);
+        // a malformed frame must cost the sender its connection, never the
+        // process: any exception out of parsing/dispatch (e.g. bad_alloc on
+        // an adversarial length) drops the connection
+        try {
+          if (evs[i].events & (EPOLLHUP | EPOLLERR)) alive = false;
+          if (alive && (evs[i].events & EPOLLIN)) alive = on_readable(c);
+          if (alive && (evs[i].events & EPOLLOUT)) alive = flush(c);
+        } catch (const std::exception&) {
+          alive = false;
+        }
+        if (!alive) drop(sh, fd);
       }
     }
   }
@@ -148,27 +200,39 @@ class StoreServer {
       if (fd < 0) break;
       int one = 1;
       setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      Shard& sh = *shards_[next_shard_++ % shards_.size()];
       auto c = std::make_unique<Conn>();
       c->fd = fd;
+      c->ep_fd = sh.ep_fd;
+      {
+        std::lock_guard<std::mutex> g(sh.conns_mu);
+        sh.conns.emplace(fd, std::move(c));
+      }
       epoll_event ev{};
       ev.events = EPOLLIN;
       ev.data.fd = fd;
-      epoll_ctl(ep_fd_, EPOLL_CTL_ADD, fd, &ev);
-      conns_.emplace(fd, std::move(c));
+      epoll_ctl(sh.ep_fd, EPOLL_CTL_ADD, fd, &ev);
     }
   }
 
-  void drop(int fd) {
-    auto it = conns_.find(fd);
-    if (it == conns_.end()) return;
-    if (!it->second->pending_keys.empty()) {
-      // client went away mid-write: reclaim uncommitted regions
-      std::lock_guard<std::mutex> g(mu_);
-      store_.abort_put(it->second->pending_keys);
+  void drop(Shard& sh, int fd) {
+    std::unique_ptr<Conn> c;
+    {
+      std::lock_guard<std::mutex> g(sh.conns_mu);
+      auto it = sh.conns.find(fd);
+      if (it == sh.conns.end()) return;
+      c = std::move(it->second);
+      sh.conns.erase(it);
     }
-    epoll_ctl(ep_fd_, EPOLL_CTL_DEL, fd, nullptr);
+    if (!c->pending_keys.empty() || !c->seg_descs.empty()) {
+      std::lock_guard<std::mutex> g(mu_);
+      // client went away mid-write: reclaim uncommitted regions
+      if (!c->pending_keys.empty()) store_.abort_put(c->pending_keys);
+      // release pins on zero-copy segments it never finished receiving
+      if (!c->seg_descs.empty()) store_.unpin(c->seg_descs);
+    }
+    epoll_ctl(sh.ep_fd, EPOLL_CTL_DEL, fd, nullptr);
     close(fd);
-    conns_.erase(it);
   }
 
   // returns false if the connection died
@@ -207,9 +271,19 @@ class StoreServer {
                       body.size()))
           return false;
       }
-      if (!c->out.empty() && !flush(c)) return false;
+      if (!c->outq.empty() && !flush(c)) return false;
     }
   }
+
+  // env-gated data-plane timing (ISTPU_TIMING=1): cumulative seconds spent
+  // in recv-into-pool vs everything else, printed per 256 MB streamed
+  struct Timing {
+    double recv_s = 0, total_bytes = 0;
+    std::chrono::steady_clock::time_point win_start =
+        std::chrono::steady_clock::now();
+  };
+  Timing timing_;
+  bool timing_on_ = getenv("ISTPU_TIMING") != nullptr;
 
   // stream PUT_INLINE_BATCH payload straight into pool regions
   bool stream_payload(Conn* c) {
@@ -235,7 +309,28 @@ class StoreServer {
         dst = store_.view(d.pool_idx, d.offset);
       }
       while (c->stream_off < d.size) {
+        auto t0 = timing_on_ ? std::chrono::steady_clock::now()
+                             : std::chrono::steady_clock::time_point();
         ssize_t r = recv(c->fd, dst + c->stream_off, d.size - c->stream_off, 0);
+        if (timing_on_ && r > 0) {
+          timing_.recv_s += std::chrono::duration<double>(
+                                std::chrono::steady_clock::now() - t0)
+                                .count();
+          timing_.total_bytes += r;
+          if (timing_.total_bytes >= (256 << 20)) {
+            double win = std::chrono::duration<double>(
+                             std::chrono::steady_clock::now() -
+                             timing_.win_start)
+                             .count();
+            fprintf(stderr,
+                    "[istpu-timing] %.0f MB window: recv %.3fs (%.2f GB/s "
+                    "inside recv), wall %.3fs (%.2f GB/s)\n",
+                    timing_.total_bytes / 1e6, timing_.recv_s,
+                    timing_.total_bytes / timing_.recv_s / 1e9, win,
+                    timing_.total_bytes / win / 1e9);
+            timing_ = Timing();
+          }
+        }
         if (r == 0) goto dead;
         if (r < 0) {
           if (errno == EAGAIN || errno == EWOULDBLOCK) return true;
@@ -287,44 +382,62 @@ class StoreServer {
     }
   }
 
+  void queue_bytes(Conn* c, std::string bytes) {
+    // coalesce consecutive byte items (headers of back-to-back small
+    // responses share one send)
+    if (!c->outq.empty() && c->outq.back().seg == nullptr &&
+        !(c->outq.size() == 1 && c->out_off > 0)) {
+      c->outq.back().bytes.append(bytes);
+      return;
+    }
+    Conn::OutItem item;
+    item.bytes = std::move(bytes);
+    c->outq.push_back(std::move(item));
+  }
+
+  void queue_seg(Conn* c, const uint8_t* p, uint64_t size) {
+    Conn::OutItem item;
+    item.seg = p;
+    item.size = size;
+    c->outq.push_back(std::move(item));
+  }
+
   void respond(Conn* c, int32_t status, const std::string& body) {
     RespHeader rh{status, static_cast<uint32_t>(body.size())};
-    c->out.append(reinterpret_cast<const char*>(&rh), sizeof(rh));
-    c->out.append(body);
+    std::string bytes(reinterpret_cast<const char*>(&rh), sizeof(rh));
+    bytes.append(body);
+    queue_bytes(c, std::move(bytes));
   }
 
   // returns false if the connection died; registers EPOLLOUT when blocked
   bool flush(Conn* c) {
-    while (c->out_off < c->out.size()) {
-      ssize_t r = send(c->fd, c->out.data() + c->out_off,
-                       c->out.size() - c->out_off, MSG_NOSIGNAL);
-      if (r < 0) {
-        if (errno == EAGAIN || errno == EWOULDBLOCK) return want_out(c);
-        return false;
+    while (!c->outq.empty()) {
+      Conn::OutItem& item = c->outq.front();
+      const uint8_t* base = item.seg
+                                ? item.seg
+                                : reinterpret_cast<const uint8_t*>(item.bytes.data());
+      uint64_t size = item.seg ? item.size : item.bytes.size();
+      while (c->out_off < size) {
+        ssize_t r = send(c->fd, base + c->out_off, size - c->out_off,
+                         MSG_NOSIGNAL);
+        if (r < 0) {
+          if (errno == EAGAIN || errno == EWOULDBLOCK) return want_out(c);
+          return false;
+        }
+        c->out_off += r;
       }
-      c->out_off += r;
+      c->out_off = 0;
+      c->outq.pop_front();
     }
-    while (c->seg_idx < c->out_segs.size()) {
-      auto [p, sz] = c->out_segs[c->seg_idx];
-      ssize_t r = send(c->fd, p + c->seg_off, sz - c->seg_off, MSG_NOSIGNAL);
-      if (r < 0) {
-        if (errno == EAGAIN || errno == EWOULDBLOCK) return want_out(c);
-        return false;
-      }
-      c->seg_off += r;
-      if (c->seg_off == sz) {
-        c->seg_idx++;
-        c->seg_off = 0;
-      }
+    if (!c->seg_descs.empty()) {
+      std::lock_guard<std::mutex> g(mu_);
+      store_.unpin(c->seg_descs);
+      c->seg_descs.clear();
     }
-    c->out.clear();
-    c->out_off = 0;
-    c->out_segs.clear();
-    c->seg_idx = 0;
     epoll_event ev{};
     ev.events = EPOLLIN;
     ev.data.fd = c->fd;
-    epoll_ctl(ep_fd_, EPOLL_CTL_MOD, c->fd, &ev);
+    epoll_ctl(c->ep_fd, EPOLL_CTL_MOD, c->fd, &ev);
     return true;
   }
 
@@ -332,7 +445,7 @@ class StoreServer {
     epoll_event ev{};
     ev.events = EPOLLIN | EPOLLOUT;
     ev.data.fd = c->fd;
-    epoll_ctl(ep_fd_, EPOLL_CTL_MOD, c->fd, &ev);
+    epoll_ctl(c->ep_fd, EPOLL_CTL_MOD, c->fd, &ev);
     return true;
   }
 
@@ -493,11 +606,14 @@ class StoreServer {
           sizes.append(reinterpret_cast<const char*>(&sz), 4);
         }
         RespHeader rh{FINISH, static_cast<uint32_t>(sizes.size() + total)};
-        c->out.append(reinterpret_cast<const char*>(&rh), sizeof(rh));
-        c->out.append(sizes);
+        std::string head(reinterpret_cast<const char*>(&rh), sizeof(rh));
+        head.append(sizes);
+        queue_bytes(c, std::move(head));
+        store_.pin(descs);  // pages stay alive until flush() finishes sending
         for (const auto& d : descs) {
-          c->out_segs.emplace_back(store_.view(d.pool_idx, d.offset), d.size);
+          queue_seg(c, store_.view(d.pool_idx, d.offset), d.size);
         }
+        c->seg_descs.insert(c->seg_descs.end(), descs.begin(), descs.end());
         return true;
       }
       default:
@@ -514,11 +630,9 @@ class StoreServer {
   std::mutex mu_;
   int port_;
   int listen_fd_ = -1;
-  int ep_fd_ = -1;
-  int wake_fd_ = -1;
   std::atomic<bool> running_{false};
-  std::thread thread_;
-  std::unordered_map<int, std::unique_ptr<Conn>> conns_;
+  std::atomic<size_t> next_shard_{0};
+  std::vector<std::unique_ptr<Shard>> shards_;
 };
 
 }  // namespace istpu

@@ -109,7 +109,7 @@ def server():
         [sys.executable, "-m", "infinistore_tpu.server",
          "--service-port", str(port), "--manage-port", str(mport),
          "--prealloc-size", "1", "--minimal-allocate-size", "16",
-         "--backend", "python"],
+         "--backend", os.environ.get("ISTPU_TEST_BACKEND", "native")],
         env={**os.environ, "JAX_PLATFORMS": "cpu"},
     )
     deadline = time.time() + 15

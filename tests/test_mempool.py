@@ -1,3 +1,5 @@
+import os
+
 import pytest
 
 from infinistore_tpu.mempool import MM, Pool
@@ -96,3 +98,44 @@ def test_mm_usage():
         assert mm.usage() == pytest.approx(0.5)
     finally:
         mm.close()
+
+
+def test_sweep_stale_segments(tmp_path):
+    import os
+
+    from infinistore_tpu.mempool import sweep_stale_segments
+
+    shm = str(tmp_path)
+    dead = os.path.join(shm, "istpu_999999999_deadbeef_p0")
+    open(dead, "wb").close()
+    live = os.path.join(shm, f"istpu_{os.getpid()}_cafe_p0")
+    open(live, "wb").close()
+    other = os.path.join(shm, "not_ours")
+    open(other, "wb").close()
+    removed = sweep_stale_segments(shm)
+    assert dead in removed and not os.path.exists(dead)
+    assert os.path.exists(live) and os.path.exists(other)
+    os.unlink(live)
+    os.unlink(other)
+
+
+def test_pool_creation_is_fast_and_prefaults_in_background():
+    """bind/listen must not wait on pre-fault: creating a 256 MB pool
+    returns quickly while pages populate on a background thread."""
+    import time
+
+    from infinistore_tpu.mempool import Pool
+
+    t0 = time.monotonic()
+    # pid in the name so sweep_stale_segments reclaims it if pytest dies
+    p = Pool(f"istpu_{os.getpid()}_testfast{time.monotonic_ns()}", 256 << 20, 64 << 10)
+    created_in = time.monotonic() - t0
+    try:
+        assert created_in < 2.0, created_in
+        assert p.prefault_done.wait(timeout=30.0)
+        # pool is usable while/after prefault
+        off = p.allocate(64 << 10)
+        p.buf[off : off + 4] = b"abcd"
+        assert bytes(p.buf[off : off + 4]) == b"abcd"
+    finally:
+        p.close()

@@ -29,12 +29,18 @@ def _free_port():
     return port
 
 
-SERVICE_PORT = _free_port()
-MANAGE_PORT = _free_port()
+SERVICE_PORT = 0  # set by the server fixture for the active backend
+MANAGE_PORT = 0
 
 
-@pytest.fixture(scope="module")
-def server():
+# The whole module runs twice: once against the asyncio server and once
+# against the C++ epoll server (the reference always tests the real native
+# server, infinistore/test_infinistore.py:99-571).
+@pytest.fixture(scope="module", params=["python", "native"])
+def server(request):
+    global SERVICE_PORT, MANAGE_PORT
+    SERVICE_PORT = _free_port()
+    MANAGE_PORT = _free_port()
     proc = subprocess.Popen(
         [
             sys.executable,
@@ -51,7 +57,7 @@ def server():
             "--log-level",
             "warning",
             "--backend",
-            "python",
+            request.param,
         ],
         env={**os.environ, "JAX_PLATFORMS": "cpu"},
     )
@@ -360,4 +366,244 @@ def test_concurrent_async_writers_one_connection(server):
         np.testing.assert_array_equal(dst[: 8 * 1024], src[: 8 * 1024])
 
     asyncio.run(run())
+    conn.close()
+
+
+@pytest.mark.parametrize("client_mode", ["python", "native"])
+def test_client_matrix_roundtrip(server, client_mode, monkeypatch):
+    """Both client implementations against both server backends."""
+    if client_mode == "native":
+        from infinistore_tpu import _native
+
+        if not _native.available():
+            pytest.skip("native client library not built")
+    monkeypatch.setenv("ISTPU_CLIENT", client_mode)
+    conn = make_conn()
+    key = rand_key()
+    src = np.random.randn(4096).astype(np.float32)
+    dst = np.zeros(4096, dtype=np.float32)
+    conn.register_mr(src)
+    conn.register_mr(dst)
+    asyncio.run(conn.write_cache_async([(key, 0)], 4096 * 4, src.ctypes.data))
+    asyncio.run(conn.read_cache_async([(key, 0)], 4096 * 4, dst.ctypes.data))
+    np.testing.assert_array_equal(src, dst)
+    conn.close()
+
+
+def test_bf16_roundtrip(server):
+    """bf16 is the serving dtype; raw bytes must round-trip unscathed."""
+    import ml_dtypes
+
+    conn = make_conn()
+    key = rand_key()
+    src = np.arange(4096).astype(ml_dtypes.bfloat16)
+    dst = np.zeros(4096, dtype=ml_dtypes.bfloat16)
+    conn.register_mr(src)
+    conn.register_mr(dst)
+    asyncio.run(conn.write_cache_async([(key, 0)], 4096 * 2, src.ctypes.data))
+    asyncio.run(conn.read_cache_async([(key, 0)], 4096 * 2, dst.ctypes.data))
+    np.testing.assert_array_equal(src.view(np.uint16), dst.view(np.uint16))
+    conn.close()
+
+
+def _alive_probe():
+    conn = make_conn()
+    key = rand_key()
+    src = np.ones(1024, dtype=np.float32)
+    conn.register_mr(src)
+    asyncio.run(conn.write_cache_async([(key, 0)], 1024 * 4, src.ctypes.data))
+    assert conn.check_exist(key)
+    conn.close()
+
+
+def test_malformed_frames_drop_connection_not_server(server):
+    """Garbage header and adversarial key counts cost the sender its
+    connection; the server must keep serving other clients."""
+    from infinistore_tpu import protocol as P
+
+    # 1. garbage bytes where a header belongs
+    s = socket.create_connection(("127.0.0.1", SERVICE_PORT), timeout=5)
+    s.sendall(b"\xde\xad\xbe\xef" * 16)
+    s.settimeout(5)
+    try:
+        assert s.recv(1) == b""  # orderly close...
+    except ConnectionResetError:
+        pass  # ...or RST; both mean the server dropped us
+    s.close()
+
+    # 2. valid header, adversarial key count (2^32-1 keys in a 4-byte body)
+    s = socket.create_connection(("127.0.0.1", SERVICE_PORT), timeout=5)
+    bomb = (0xFFFFFFFF).to_bytes(4, "little")
+    s.sendall(P.pack_header(P.OP_DELETE_KEYS, len(bomb)) + bomb)
+    s.settimeout(5)
+    try:
+        got = s.recv(P.RESP_SIZE)
+        # either an INVALID_REQ response or a drop is acceptable; a crash is not
+        if got:
+            status, _ = P.RESP.unpack(got)
+            assert status == P.INVALID_REQ
+    except ConnectionResetError:
+        pass
+    s.close()
+
+    _alive_probe()
+
+
+def test_client_death_mid_stream_reclaims_pending(server):
+    """A client killed midway through a PUT_INLINE_BATCH payload must not
+    leak pending regions (reference aborts uncommitted keys on disconnect)."""
+    import json
+    import urllib.request
+
+    from infinistore_tpu import protocol as P
+
+    block = 64 << 10
+    keys = [f"dead_{rand_key()}".encode() for _ in range(4)]
+    body = P.pack_put_inline_batch(keys, block)
+    s = socket.create_connection(("127.0.0.1", SERVICE_PORT), timeout=5)
+    s.sendall(P.pack_header(P.OP_PUT_INLINE_BATCH, len(body)) + body)
+    s.sendall(b"x" * (block + 100))  # a fraction of the 4-block payload
+    s.close()  # die mid-stream
+
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{MANAGE_PORT}/metrics", timeout=5
+        ) as r:
+            if json.load(r).get("pending", 1) == 0:
+                break
+        time.sleep(0.2)
+    else:
+        pytest.fail("pending regions were not reclaimed after client death")
+    conn = make_conn()
+    for k in keys:  # uncommitted keys must never have become visible
+        assert not conn.check_exist(k.decode())
+    conn.close()
+
+
+def _client_stress(port, worker_id):
+    config = ist.ClientConfig(
+        host_addr="127.0.0.1", service_port=port, connection_type=ist.TYPE_SHM
+    )
+    conn = ist.InfinityConnection(config)
+    conn.connect()
+    n_blocks, elems = 8, 1024
+    src = (np.arange(n_blocks * elems, dtype=np.float32) + worker_id).copy()
+    dst = np.zeros_like(src)
+    conn.register_mr(src)
+    conn.register_mr(dst)
+    for it in range(10):
+        blocks = [(f"st{worker_id}_{it}_{i}", i * elems * 4) for i in range(n_blocks)]
+        asyncio.run(conn.write_cache_async(blocks, elems * 4, src.ctypes.data))
+        asyncio.run(conn.read_cache_async(blocks, elems * 4, dst.ctypes.data))
+        np.testing.assert_array_equal(src, dst)
+    conn.close()
+
+
+def test_multiprocess_stress(server):
+    """4 concurrent writer/reader processes on one server (reference:
+    test_infinistore.py multi-client scenarios)."""
+    procs = [
+        Process(target=_client_stress, args=(SERVICE_PORT, w)) for w in range(4)
+    ]
+    for p in procs:
+        p.start()
+    for p in procs:
+        p.join(timeout=60)
+        assert p.exitcode == 0
+
+
+def test_slow_reader_survives_delete_and_reuse(server):
+    """Zero-copy GET segments queued behind a slow receiver must survive a
+    concurrent delete + block reuse (the server pins the regions)."""
+    from infinistore_tpu import protocol as P
+
+    n_keys, block = 512, 64 << 10  # 32 MB: far beyond kernel socket buffers
+    payload = np.random.randint(0, 256, n_keys * block, dtype=np.uint8)
+    conn = make_conn()
+    conn.register_mr(payload)
+    keys = [f"slow_{rand_key()}" for _ in range(n_keys)]
+    asyncio.run(
+        conn.write_cache_async(
+            [(keys[i], i * block) for i in range(n_keys)], block, payload.ctypes.data
+        )
+    )
+
+    # request everything over TCP inline-batch but do NOT read the response
+    # (modest receive buffer, set before connect so it bounds the window)
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 256 << 10)
+    s.settimeout(10)
+    s.connect(("127.0.0.1", SERVICE_PORT))
+    body = P.pack_get_inline_batch([k.encode() for k in keys], block)
+    s.sendall(P.pack_header(P.OP_GET_INLINE_BATCH, len(body)) + body)
+    time.sleep(0.5)  # let the server queue the zero-copy segments
+
+    # delete the keys and force the freed blocks to be reused
+    asyncio.run(conn.delete_keys_async(keys)) if hasattr(
+        conn, "delete_keys_async"
+    ) else conn.delete_keys(keys)
+    refill = np.zeros(block, dtype=np.uint8)
+    conn.register_mr(refill)
+    for j in range(min(n_keys, 32)):
+        asyncio.run(
+            conn.write_cache_async([(f"refill_{j}", 0)], block, refill.ctypes.data)
+        )
+
+    # now drain the response slowly and verify byte integrity
+    def read_exact(sock, n):
+        out = bytearray()
+        while len(out) < n:
+            chunk = sock.recv(min(1 << 16, n - len(out)))
+            if not chunk:
+                raise AssertionError("connection died mid-response")
+            out.extend(chunk)
+        return bytes(out)
+
+    s.settimeout(30)
+    status, body_len = P.RESP.unpack(read_exact(s, P.RESP_SIZE))
+    assert status == P.FINISH
+    sizes = read_exact(s, 4 * n_keys)
+    got = read_exact(s, body_len - 4 * n_keys)
+    assert got == payload.tobytes()
+    s.close()
+    conn.close()
+
+
+def test_pipelined_big_gets_preserve_wire_order(server, monkeypatch):
+    """Several large GET_INLINE_BATCH responses queued on ONE socket must
+    come back in order with intact payloads (regression: the native server
+    once interleaved response headers with zero-copy payload segments)."""
+    monkeypatch.setenv("ISTPU_CLIENT", "python")
+    config = ist.ClientConfig(
+        host_addr="127.0.0.1",
+        service_port=SERVICE_PORT,
+        connection_type=ist.TYPE_TCP,
+        num_streams=1,  # force every op onto one pipelined channel
+    )
+    conn = ist.InfinityConnection(config)
+    conn.connect()
+    nb, blk = 16, 256 << 10  # 4 MB per batch
+    srcs = []
+    for j in range(6):
+        src = np.random.randint(0, 256, nb * blk, dtype=np.uint8)
+        srcs.append(src)
+        conn.register_mr(src)
+        blocks = [(f"po{j}_{i}", i * blk) for i in range(nb)]
+        asyncio.run(conn.write_cache_async(blocks, blk, src.ctypes.data))
+
+    dsts = [np.zeros(nb * blk, dtype=np.uint8) for _ in range(6)]
+
+    async def flood_reads():
+        tasks = []
+        for j in range(6):
+            blocks = [(f"po{j}_{i}", i * blk) for i in range(nb)]
+            tasks.append(
+                conn.read_cache_async(blocks, blk, dsts[j].ctypes.data)
+            )
+        await asyncio.gather(*tasks)
+
+    asyncio.run(flood_reads())
+    for j in range(6):
+        np.testing.assert_array_equal(srcs[j], dsts[j])
     conn.close()

@@ -22,6 +22,7 @@ def make_store(prealloc_mb=1, block_kb=16, **kw):
     store.mm = MM(pool_size=prealloc_mb << 20, block_size=block_kb << 10)
     store.kv = OrderedDict()
     store.pending = {}
+    store._deferred = []
     store.stats = Stats()
     return store
 
@@ -156,3 +157,30 @@ def test_stats(store):
     d = store.stats_dict()
     assert d["puts"] == 1 and d["hits"] == 1 and d["misses"] == 1
     assert d["kvmap_len"] == 1
+
+
+def test_delete_leased_key_defers_free(store):
+    """Deleting a key mid shm-read (active lease) must hide the key at once
+    but keep the blocks until the lease lapses (a client may be memcpying)."""
+    assert store.put_inline(b"k", b"x" * (16 << 10)) == P.FINISH
+    st, _ = store.get_desc([b"k"])  # grants the 5 s read lease
+    assert st == P.FINISH
+    used_before = store.mm.usage()
+    assert store.delete_keys([b"k"]) == 1
+    assert not store.exist(b"k")  # key gone immediately
+    assert store.mm.usage() == used_before  # blocks still held
+    assert len(store._deferred) == 1
+    # force the lease to lapse, then any reaping op frees the region
+    store._deferred[0] = (0.0, store._deferred[0][1])
+    store.evict(0.0, 2.0)  # below max threshold: only reaps
+    assert store.mm.usage() < used_before
+    assert not store._deferred
+
+
+def test_purge_leased_key_defers_free(store):
+    assert store.put_inline(b"k", b"x" * (16 << 10)) == P.FINISH
+    st, _ = store.get_desc([b"k"])
+    assert st == P.FINISH
+    assert store.purge() == 1
+    assert store.kvmap_len() == 0
+    assert len(store._deferred) == 1
