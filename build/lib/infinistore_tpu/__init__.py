@@ -1,0 +1,59 @@
+"""infinistore_tpu -- a TPU-native KV-cache tier and serving substrate.
+
+Re-designed from scratch with the capability surface of InfiniStore
+(reference: /root/reference): a slab-pooled host-DRAM KV store with zero-copy
+local transport (POSIX shm instead of RDMA verbs), TCP for cross-host (DCN)
+clients, LRU eviction, prefix matching -- plus the JAX/TPU serving stack it
+exists to feed: paged HBM KV caches, Llama-family models, tp/sp/pp/dp
+sharding, ring attention, and prefill/decode disaggregation engines.
+
+Public API mirrors the reference package (infinistore/__init__.py).
+"""
+
+from .config import (
+    ClientConfig,
+    ServerConfig,
+    TYPE_SHM,
+    TYPE_TCP,
+    TYPE_RDMA,
+    LINK_ICI,
+    LINK_DCN,
+    LINK_ETHERNET,
+    LINK_IB,
+)
+from .lib import (
+    Connection,
+    InfinityConnection,
+    InfiniStoreException,
+    InfiniStoreKeyNotFound,
+)
+from .server import (
+    evict_cache,
+    get_kvmap_len,
+    purge_kv_map,
+    register_server,
+)
+from .utils.logging import Logger
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "InfinityConnection",
+    "Connection",
+    "register_server",
+    "ClientConfig",
+    "ServerConfig",
+    "TYPE_SHM",
+    "TYPE_TCP",
+    "TYPE_RDMA",
+    "Logger",
+    "LINK_ICI",
+    "LINK_DCN",
+    "LINK_ETHERNET",
+    "LINK_IB",
+    "purge_kv_map",
+    "get_kvmap_len",
+    "InfiniStoreException",
+    "InfiniStoreKeyNotFound",
+    "evict_cache",
+]

@@ -1,0 +1,360 @@
+"""ctypes bindings to the C++ native runtime (``libistpu.so``).
+
+The reference binds its C++ client/server with pybind11 (reference:
+src/pybind.cpp); pybind11 isn't in this image, so the native runtime exposes
+a C ABI (src/istpu_c.cpp, src/store_client.cpp) and we drive it with ctypes.
+ctypes releases the GIL around every foreign call, so batched transfers run
+native memcpy loops without holding the interpreter lock -- the same effect
+as the reference's CQ-polling thread doing IO off the Python thread.
+
+Build: ``make -C src`` (produces infinistore_tpu/libistpu.so).  Everything
+degrades gracefully to the pure-Python implementations when the library
+hasn't been built.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+import threading
+import time
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+_LIB_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "libistpu.so")
+_lib = None
+
+
+_build_attempted = False
+
+
+def _build():
+    """Build libistpu.so from src/ if a toolchain is present (once per
+    process; a failure is logged, not swallowed, so a broken toolchain is
+    diagnosable and doesn't re-block every later call)."""
+    global _build_attempted
+    if _build_attempted:
+        return
+    _build_attempted = True
+    src = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "src")
+    if not os.path.exists(os.path.join(src, "Makefile")):
+        return
+    import subprocess
+    import sys
+
+    try:
+        subprocess.run(
+            ["make", "-C", src], check=True, capture_output=True, timeout=300
+        )
+    except subprocess.CalledProcessError as e:
+        print(
+            f"[infinistore_tpu] native build failed (falling back to Python):\n"
+            f"{e.stderr.decode(errors='replace')[-2000:]}",
+            file=sys.stderr,
+        )
+    except (OSError, subprocess.SubprocessError) as e:
+        print(
+            f"[infinistore_tpu] native build unavailable: {e!r}", file=sys.stderr
+        )
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_LIB_PATH) and not os.environ.get("ISTPU_NO_BUILD"):
+        _build()
+    if not os.path.exists(_LIB_PATH):
+        return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        return None
+
+    lib.istpu_server_create.restype = ctypes.c_void_p
+    lib.istpu_server_create.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int, ctypes.c_int,
+    ]
+    lib.istpu_server_start.argtypes = [ctypes.c_void_p]
+    lib.istpu_server_stop.argtypes = [ctypes.c_void_p]
+    lib.istpu_server_destroy.argtypes = [ctypes.c_void_p]
+    lib.istpu_server_kvmap_len.restype = ctypes.c_uint64
+    lib.istpu_server_kvmap_len.argtypes = [ctypes.c_void_p]
+    lib.istpu_server_purge.argtypes = [ctypes.c_void_p]
+    lib.istpu_server_evict.restype = ctypes.c_longlong
+    lib.istpu_server_evict.argtypes = [ctypes.c_void_p, ctypes.c_double, ctypes.c_double]
+    lib.istpu_server_usage.restype = ctypes.c_double
+    lib.istpu_server_usage.argtypes = [ctypes.c_void_p]
+    lib.istpu_server_stats_json.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+    ]
+
+    lib.istpu_client_create.restype = ctypes.c_void_p
+    lib.istpu_client_connect.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int,
+    ]
+    lib.istpu_client_close.argtypes = [ctypes.c_void_p]
+    lib.istpu_client_destroy.argtypes = [ctypes.c_void_p]
+    KEYS = ctypes.POINTER(ctypes.c_char_p)
+    OFFS = ctypes.POINTER(ctypes.c_uint64)
+    lib.istpu_client_write_cache.argtypes = [
+        ctypes.c_void_p, KEYS, OFFS, ctypes.c_int, ctypes.c_uint64, ctypes.c_void_p,
+    ]
+    lib.istpu_client_read_cache.argtypes = [
+        ctypes.c_void_p, KEYS, OFFS, ctypes.c_int, ctypes.c_uint64, ctypes.c_void_p,
+    ]
+    lib.istpu_client_put_inline.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p, ctypes.c_uint64,
+    ]
+    lib.istpu_client_get_inline.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_uint64),
+    ]
+    lib.istpu_client_exist.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_int),
+    ]
+    lib.istpu_client_match_last_index.argtypes = [
+        ctypes.c_void_p, KEYS, ctypes.c_int, ctypes.POINTER(ctypes.c_int),
+    ]
+    lib.istpu_client_delete_keys.argtypes = [
+        ctypes.c_void_p, KEYS, ctypes.c_int, ctypes.POINTER(ctypes.c_int),
+    ]
+    lib.istpu_client_purge.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_int)]
+    lib.istpu_client_evict.argtypes = [ctypes.c_void_p, ctypes.c_float, ctypes.c_float]
+    lib.istpu_client_stats_json.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+    ]
+    _lib = lib
+    return lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _keys_array(keys: Sequence[bytes]):
+    arr = (ctypes.c_char_p * len(keys))()
+    arr[:] = list(keys)
+    return arr
+
+
+def _offsets_array(offsets: Sequence[int]):
+    arr = (ctypes.c_uint64 * len(offsets))()
+    arr[:] = [int(o) for o in offsets]
+    return arr
+
+
+class NativeStoreServer:
+    """In-process native data-plane server (epoll thread lives in C++)."""
+
+    def __init__(self, config):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("libistpu.so not built (make -C src)")
+        self._lib = lib
+        self.config = config
+        prefix = (getattr(config, "shm_prefix", "") or "").encode()
+        self._h = lib.istpu_server_create(
+            prefix,
+            int(config.prealloc_size) << 30,
+            int(config.minimal_allocate_size) << 10,
+            1 if config.auto_increase else 0,
+            int(config.service_port),
+        )
+        if not self._h:
+            raise RuntimeError("native server create failed")
+        self._stopped = threading.Event()
+
+    def start(self) -> None:
+        if self._lib.istpu_server_start(self._h) != 0:
+            raise RuntimeError("native server failed to bind/listen")
+
+    def wait(self) -> None:
+        try:
+            while not self._stopped.wait(1.0):
+                pass
+        except KeyboardInterrupt:
+            pass
+
+    def stop(self) -> None:
+        self._stopped.set()
+        if self._h:
+            self._lib.istpu_server_destroy(self._h)
+            self._h = None
+
+    # manage-plane surface (duck-typed like Store for server.py handlers)
+    @property
+    def store(self):
+        return self
+
+    def kvmap_len(self) -> int:
+        return int(self._lib.istpu_server_kvmap_len(self._h))
+
+    def purge(self) -> int:
+        return int(self._lib.istpu_server_purge(self._h))
+
+    def evict(self, mn: float, mx: float) -> int:
+        return int(self._lib.istpu_server_evict(self._h, mn, mx))
+
+    def usage(self) -> float:
+        return float(self._lib.istpu_server_usage(self._h))
+
+    def stats_dict(self) -> dict:
+        buf = ctypes.create_string_buffer(4096)
+        self._lib.istpu_server_stats_json(self._h, buf, len(buf))
+        return json.loads(buf.value.decode() or "{}")
+
+    def close(self) -> None:
+        self.stop()
+
+
+class NativeConnection:
+    """Drop-in replacement for lib.Connection backed by the C++ client."""
+
+    def __init__(self, config):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("libistpu.so not built (make -C src)")
+        self._lib = lib
+        self.config = config
+        self._h = None
+        self.shm_mode = False
+        self._registered = {}
+
+    # lazy import to avoid a cycle (lib.py imports this module)
+    def _errors(self):
+        from .lib import InfiniStoreException, InfiniStoreKeyNotFound
+        return InfiniStoreException, InfiniStoreKeyNotFound
+
+    def _check(self, status: int, what: str):
+        from . import protocol as P
+        if status in (P.FINISH, P.TASK_ACCEPTED):
+            return
+        Exc, KeyNotFound = self._errors()
+        if status == P.KEY_NOT_FOUND:
+            raise KeyNotFound(f"{what} failed, ret = {status}")
+        raise Exc(f"{what} failed, ret = {status}")
+
+    def connect(self) -> None:
+        from .config import TYPE_SHM
+        Exc, _ = self._errors()
+        if self._h is not None:
+            raise Exc("Already connected to remote instance")
+        self._h = self._lib.istpu_client_create()
+        use_shm = 1 if self.config.connection_type == TYPE_SHM else 0
+        ret = self._lib.istpu_client_connect(
+            self._h, self.config.host_addr.encode(),
+            int(self.config.service_port), use_shm,
+            int(getattr(self.config, "num_streams", 4)),
+        )
+        if ret != 0:
+            self._lib.istpu_client_destroy(self._h)
+            self._h = None
+            raise Exc(f"native connect failed (ret={ret})")
+        self.shm_mode = bool(use_shm)
+
+    def close(self) -> None:
+        if self._h is not None:
+            self._lib.istpu_client_close(self._h)
+            self._lib.istpu_client_destroy(self._h)
+            self._h = None
+
+    # ---- batched zero-copy ops ----
+
+    def write_cache(self, blocks: Sequence[Tuple[str, int]], block_size: int, ptr: int) -> int:
+        from . import protocol as P
+        keys = _keys_array([k.encode() if isinstance(k, str) else bytes(k) for k, _ in blocks])
+        offs = _offsets_array([off for _, off in blocks])
+        st = self._lib.istpu_client_write_cache(
+            self._h, keys, offs, len(blocks), block_size, ctypes.c_void_p(ptr)
+        )
+        self._check(st, "write_cache")
+        return P.FINISH
+
+    def read_cache(self, blocks: Sequence[Tuple[str, int]], block_size: int, ptr: int) -> int:
+        from . import protocol as P
+        keys = _keys_array([k.encode() if isinstance(k, str) else bytes(k) for k, _ in blocks])
+        offs = _offsets_array([off for _, off in blocks])
+        st = self._lib.istpu_client_read_cache(
+            self._h, keys, offs, len(blocks), block_size, ctypes.c_void_p(ptr)
+        )
+        self._check(st, "read_cache")
+        return P.FINISH
+
+    # ---- inline single-key ----
+
+    def w_tcp(self, key: str, ptr: int, size: int) -> int:
+        st = self._lib.istpu_client_put_inline(
+            self._h, key.encode(), ctypes.c_void_p(ptr), size
+        )
+        self._check(st, "tcp write")
+        return 0
+
+    def w_tcp_bytes(self, key: str, data: bytes) -> int:
+        st = self._lib.istpu_client_put_inline(self._h, key.encode(), data, len(data))
+        self._check(st, "tcp write")
+        return 0
+
+    def r_tcp(self, key: str) -> np.ndarray:
+        from . import protocol as P
+        cap = 1 << 20
+        for _ in range(2):
+            buf = np.empty(cap, dtype=np.uint8)
+            out_size = ctypes.c_uint64(0)
+            st = self._lib.istpu_client_get_inline(
+                self._h, key.encode(), ctypes.c_void_p(buf.ctypes.data), cap,
+                ctypes.byref(out_size),
+            )
+            if st == P.INVALID_REQ and out_size.value > cap:
+                cap = int(out_size.value)  # retry with the exact size
+                continue
+            self._check(st, "tcp read")
+            return buf[: out_size.value]
+        self._check(st, "tcp read")
+
+    # ---- metadata ----
+
+    def check_exist(self, key: str) -> int:
+        out = ctypes.c_int(1)
+        st = self._lib.istpu_client_exist(self._h, key.encode(), ctypes.byref(out))
+        self._check(st, "check_exist")
+        return int(out.value)
+
+    def get_match_last_index(self, keys: Sequence[str]) -> int:
+        arr = _keys_array([k.encode() if isinstance(k, str) else bytes(k) for k in keys])
+        out = ctypes.c_int(-1)
+        st = self._lib.istpu_client_match_last_index(
+            self._h, arr, len(keys), ctypes.byref(out)
+        )
+        self._check(st, "get_match_last_index")
+        return int(out.value)
+
+    def delete_keys(self, keys: Sequence[str]) -> int:
+        arr = _keys_array([k.encode() if isinstance(k, str) else bytes(k) for k in keys])
+        out = ctypes.c_int(0)
+        st = self._lib.istpu_client_delete_keys(self._h, arr, len(keys), ctypes.byref(out))
+        self._check(st, "delete_keys")
+        return int(out.value)
+
+    def purge(self) -> int:
+        out = ctypes.c_int(0)
+        st = self._lib.istpu_client_purge(self._h, ctypes.byref(out))
+        self._check(st, "purge")
+        return int(out.value)
+
+    def stats(self) -> dict:
+        buf = ctypes.create_string_buffer(4096)
+        st = self._lib.istpu_client_stats_json(self._h, buf, len(buf))
+        self._check(st, "stats")
+        return json.loads(buf.value.decode() or "{}")
+
+    def evict(self, min_threshold: float, max_threshold: float) -> None:
+        st = self._lib.istpu_client_evict(self._h, min_threshold, max_threshold)
+        self._check(st, "evict")
+
+    def register_mr(self, ptr: int, size: int) -> int:
+        self._registered[ptr] = size
+        return 0

@@ -1,0 +1,117 @@
+"""Client/server configuration (reference parity: infinistore/lib.py:38-153).
+
+Connection types: the reference's zero-copy transport is RDMA; ours is a
+same-host shared-memory map of the server pool (``TYPE_SHM``) with TCP for
+cross-host (DCN) clients.  ``TYPE_RDMA`` is kept as a drop-in alias of the
+zero-copy path so reference callers port without edits.  Link types ``ICI`` /
+``DCN`` replace the reference's ``IB`` / ``Ethernet`` and are accepted
+interchangeably (they only label topology; transport selection is automatic).
+"""
+
+from __future__ import annotations
+
+import os
+
+TYPE_SHM = "SHM"
+TYPE_TCP = "TCP"
+TYPE_RDMA = TYPE_SHM  # drop-in alias for reference callers
+
+LINK_ICI = "ICI"
+LINK_DCN = "DCN"
+LINK_ETHERNET = "Ethernet"  # accepted alias (reference: infinistore/lib.py:23)
+LINK_IB = "IB"  # accepted alias
+
+_LINKS = [LINK_ICI, LINK_DCN, LINK_ETHERNET, LINK_IB]
+_LOG_LEVELS = ["error", "debug", "info", "warning"]
+
+
+class ClientConfig:
+    """Reference parity: infinistore/lib.py:38-92."""
+
+    def __init__(self, **kwargs):
+        self.connection_type = kwargs.get("connection_type", None)
+        self.host_addr = kwargs.get("host_addr", None)
+        self.dev_name = kwargs.get("dev_name", "")  # unused; kept for parity
+        self.ib_port = kwargs.get("ib_port", 1)
+        self.link_type = kwargs.get("link_type", LINK_ICI)
+        self.service_port = kwargs.get("service_port", None)
+        self.log_level = os.environ.get(
+            "INFINISTORE_LOG_LEVEL", kwargs.get("log_level", "warning")
+        )
+        self.hint_gid_index = kwargs.get("hint_gid_index", -1)
+        # ours: TCP data sockets per connection.  Batched inline ops stripe
+        # their blocks across the streams (the role RDMA's multi-WR chains
+        # play in the reference); metadata ops ride stream 0.
+        self.num_streams = kwargs.get("num_streams", 4)
+
+    def __repr__(self):
+        return (
+            f"ClientConfig(service_port={self.service_port}, "
+            f"log_level='{self.log_level}', host_addr='{self.host_addr}', "
+            f"connection_type='{self.connection_type}', link_type='{self.link_type}')"
+        )
+
+    def verify(self):
+        if self.connection_type not in [TYPE_SHM, TYPE_TCP]:
+            raise Exception("Invalid connection type")
+        if not self.host_addr:
+            raise Exception("Host address is empty")
+        if not self.service_port:
+            raise Exception("Service port is 0")
+        if self.log_level not in _LOG_LEVELS:
+            raise Exception("log level should be error, debug, info or warning")
+        if self.ib_port < 1:
+            raise Exception("ib port of device should be greater than 0")
+        if self.connection_type == TYPE_SHM and self.link_type not in _LINKS:
+            raise Exception(f"link type should be one of {_LINKS}")
+        if not (1 <= int(self.num_streams) <= 64):
+            raise Exception("num_streams must be in [1, 64]")
+
+
+class ServerConfig:
+    """Reference parity: infinistore/lib.py:94-153."""
+
+    def __init__(self, **kwargs):
+        self.manage_port = kwargs.get("manage_port", 0)
+        self.service_port = kwargs.get("service_port", 0)
+        self.log_level = kwargs.get("log_level", "warning")
+        self.dev_name = kwargs.get("dev_name", "")
+        self.ib_port = kwargs.get("ib_port", 1)
+        self.link_type = kwargs.get("link_type", LINK_ICI)
+        self.prealloc_size = kwargs.get("prealloc_size", 16)  # GB
+        self.minimal_allocate_size = kwargs.get("minimal_allocate_size", 64)  # KB
+        self.auto_increase = kwargs.get("auto_increase", False)
+        self.evict_min_threshold = kwargs.get("evict_min_threshold", 0.6)
+        self.evict_max_threshold = kwargs.get("evict_max_threshold", 0.8)
+        self.evict_interval = kwargs.get("evict_interval", 5)
+        self.hint_gid_index = kwargs.get("hint_gid_index", -1)
+        # ours: shm segment name prefix; backend selects native C++ or python
+        self.shm_prefix = kwargs.get("shm_prefix", "")
+        self.backend = kwargs.get("backend", "auto")  # auto | native | python
+
+    def __repr__(self):
+        return (
+            f"ServerConfig(service_port={self.service_port}, manage_port={self.manage_port}, "
+            f"log_level='{self.log_level}', prealloc_size={self.prealloc_size}, "
+            f"minimal_allocate_size={self.minimal_allocate_size}, "
+            f"auto_increase={self.auto_increase}, "
+            f"evict_min_threshold={self.evict_min_threshold}, "
+            f"evict_max_threshold={self.evict_max_threshold}, "
+            f"evict_interval={self.evict_interval}, backend='{self.backend}')"
+        )
+
+    def verify(self):
+        if not self.service_port:
+            raise Exception("Service port is 0")
+        if not self.manage_port:
+            raise Exception("Manage port is 0")
+        if self.log_level not in _LOG_LEVELS:
+            raise Exception("log level should be error, debug, info or warning")
+        if self.ib_port < 1:
+            raise Exception("ib port of device should be greater than 0")
+        if self.link_type not in _LINKS:
+            raise Exception(f"link type should be one of {_LINKS}")
+        if self.minimal_allocate_size < 16:
+            raise Exception("minimal allocate size should be greater than 16")
+        if self.backend not in ("auto", "native", "python"):
+            raise Exception("backend should be auto, native or python")

@@ -1,0 +1,4 @@
+from .connector import StoreConnector
+from .engine import InferenceEngine, SequenceState
+
+__all__ = ["InferenceEngine", "SequenceState", "StoreConnector"]

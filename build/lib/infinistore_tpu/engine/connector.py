@@ -1,0 +1,69 @@
+"""KV connector: the integration surface for external inference engines.
+
+The reference integrates with vLLM through LMCache (reference README:
+"Integration with vLLM is done via LMCache"); this module is the equivalent
+surface for a vLLM-TPU-style engine: ``lookup`` / ``store_kv`` /
+``retrieve_kv`` over token ids, with the store handling chunking, prefix
+hashing, and transport.  An engine that manages its own paged HBM cache
+plugs in here; engines that want the whole serving path use
+``engine.InferenceEngine`` instead.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+
+from ..kv.cache import PagedCacheConfig
+from ..kv.hashing import chunk_keys, matched_token_count
+from ..kv.transfer import KVTransferEngine
+
+
+class StoreConnector:
+    """LMCache-style connector bound to one model + one store connection."""
+
+    def __init__(self, conn, pc: PagedCacheConfig, model_id: str):
+        self.transfer = KVTransferEngine(conn, pc)
+        self.pc = pc
+        self.model_id = model_id
+
+    def _keys(self, tokens: Sequence[int]) -> List[str]:
+        return chunk_keys(tokens, self.model_id, chunk_tokens=self.pc.block_tokens)
+
+    def lookup(self, tokens: Sequence[int]) -> int:
+        """How many leading tokens of ``tokens`` are store-resident."""
+        n_chunks = self.transfer.lookup_prefix(self._keys(tokens))
+        return matched_token_count(n_chunks - 1, self.pc.block_tokens)
+
+    def store_kv(
+        self, tokens: Sequence[int], cache: jax.Array, block_ids: Sequence[int]
+    ) -> int:
+        """Push the pages holding ``tokens``'s complete chunks.
+
+        ``block_ids[i]`` must hold chunk ``i`` of the sequence.  Returns
+        bytes written.
+        """
+        keys = self._keys(tokens)
+        n = min(len(keys), len(block_ids))
+        return self.transfer.save_pages(cache, list(block_ids[:n]), keys[:n])
+
+    def retrieve_kv(
+        self, tokens: Sequence[int], cache: jax.Array, block_ids: Sequence[int]
+    ) -> Tuple[jax.Array, int]:
+        """Pull the longest store-resident prefix into ``block_ids``.
+
+        Returns (updated cache, number of tokens retrieved).
+        """
+        keys = self._keys(tokens)
+        n_chunks = min(self.transfer.lookup_prefix(keys), len(block_ids))
+        if n_chunks == 0:
+            return cache, 0
+        cache = self.transfer.load_pages(cache, list(block_ids[:n_chunks]), keys[:n_chunks])
+        return cache, n_chunks * self.pc.block_tokens
+
+    def invalidate(self, tokens: Sequence[int]) -> int:
+        """Delete all of this sequence's chunks from the store."""
+        keys = self._keys(tokens)
+        page_keys = self.transfer._page_keys(keys)
+        return self.transfer.conn.delete_keys(page_keys)

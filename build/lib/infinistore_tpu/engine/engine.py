@@ -82,6 +82,7 @@ class InferenceEngine:
             partial(prefill_fn or prefill_forward, cfg=self.cfg)
         )
         self._decode_raw = partial(decode_fn or decode_forward, cfg=self.cfg)
+        self._decode_jit = jax.jit(self._decode_raw)
         # tokens per compiled decode dispatch; the scan length is static so
         # distinct chunk sizes compile once each
         self.decode_chunk = 32

@@ -1,0 +1,28 @@
+from .cache import (
+    BlockAllocator,
+    BlockTable,
+    PagedCacheConfig,
+    init_cache,
+    prefill_to_pages,
+    read_pages,
+    write_pages,
+    write_token_kv,
+)
+from .hashing import DEFAULT_CHUNK_TOKENS, chunk_keys, layer_key, matched_token_count
+from .transfer import KVTransferEngine
+
+__all__ = [
+    "BlockAllocator",
+    "BlockTable",
+    "PagedCacheConfig",
+    "init_cache",
+    "prefill_to_pages",
+    "read_pages",
+    "write_pages",
+    "write_token_kv",
+    "DEFAULT_CHUNK_TOKENS",
+    "chunk_keys",
+    "layer_key",
+    "matched_token_count",
+    "KVTransferEngine",
+]

@@ -1,0 +1,149 @@
+"""Paged KV cache in TPU HBM.
+
+The reference's client stores KV blocks from GPU memory (GPUDirect RDMA from
+``data_ptr()`` offsets); the TPU-native counterpart keeps the device cache as
+one fused ``jax.Array`` of pages and moves whole pages with gather/scatter
+under ``jit``:
+
+    kv : [n_layers, 2(K|V), n_kv_heads, n_blocks, block_tokens, head_dim]
+
+Heads sit OUTSIDE the block axis so a (head, page) tile [block_tokens,
+head_dim] = [16, 128] is contiguous -- exactly the bf16 min tile, which lets
+the Pallas decode kernel (ops/pallas_attention.py) stream pages HBM->VMEM by
+block-table lookup with no layout shuffle.
+
+A page is ``block_tokens`` consecutive tokens of one layer's K+V (all heads)
+-- the unit that maps 1:1 onto a store key (kv/hashing.chunk_keys x layer).
+With Llama-3-8B shapes (8 kv-heads x 128 dim, 16-token pages, bf16) a page
+is 64 KiB.
+
+Static shapes everywhere: gathers/scatters take fixed-width index vectors so
+XLA compiles one program per (n_pages,) width; the host-side ``BlockAllocator``
+is plain Python (never traced).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass(frozen=True)
+class PagedCacheConfig:
+    n_layers: int
+    n_kv_heads: int
+    head_dim: int
+    n_blocks: int
+    block_tokens: int = 16
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @property
+    def page_bytes(self) -> int:
+        """Bytes of one (layer, chunk) page: K+V, all heads."""
+        return 2 * self.block_tokens * self.n_kv_heads * self.head_dim * np.dtype(
+            jnp.dtype(self.dtype)
+        ).itemsize
+
+    @property
+    def page_shape(self) -> Tuple[int, ...]:
+        """Shape of one (layer, chunk) page as stored: [2, H_kv, T, D]."""
+        return (2, self.n_kv_heads, self.block_tokens, self.head_dim)
+
+
+def init_cache(cfg: PagedCacheConfig) -> jax.Array:
+    return jnp.zeros(
+        (cfg.n_layers, 2, cfg.n_kv_heads, cfg.n_blocks, cfg.block_tokens, cfg.head_dim),
+        dtype=cfg.dtype,
+    )
+
+
+def write_pages(cache: jax.Array, block_ids: jax.Array, pages: jax.Array) -> jax.Array:
+    """Scatter pages for all layers at once.
+
+    pages: [n_layers, 2, H_kv, n, T, D]; block_ids: [n] int32
+    """
+    return cache.at[:, :, :, block_ids].set(pages)
+
+
+def read_pages(cache: jax.Array, block_ids: jax.Array) -> jax.Array:
+    """Gather pages for all layers: -> [n_layers, 2, H_kv, n, T, D]."""
+    return cache[:, :, :, block_ids]
+
+
+def write_token_kv(
+    cache: jax.Array,
+    layer: int,
+    block_ids: jax.Array,
+    slot_ids: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+) -> jax.Array:
+    """Scatter one token per sequence into layer ``layer``.
+
+    block_ids/slot_ids: [B] page id and in-page slot for each sequence's
+    current position; k/v: [B, n_kv_heads, head_dim].
+    """
+    kv = jnp.stack([k, v], axis=1)  # [B, 2, H, D]
+    # advanced indices (layer, block_ids, slot_ids) are separated by slices,
+    # so the broadcast batch dim lands in FRONT: target shape [B, 2, H, D]
+    return cache.at[layer, :, :, block_ids, slot_ids].set(kv)
+
+
+def prefill_to_pages(kv: jax.Array, n_pages: int, block_tokens: int) -> jax.Array:
+    """Reshape prefill KV [L, 2, S, H, D] (S = n_pages*block_tokens) into
+    pages [L, 2, H, n_pages, T, D]."""
+    L, two, S, H, D = kv.shape
+    assert S == n_pages * block_tokens, (S, n_pages, block_tokens)
+    kv = kv.reshape(L, two, n_pages, block_tokens, H, D)
+    return jnp.transpose(kv, (0, 1, 4, 2, 3, 5))
+
+
+def pages_to_seq_kv(pages: jax.Array) -> jax.Array:
+    """[L, 2, H, n, T, D] -> [L, 2, 1, n*T, H, D] (batch-1 sequence KV)."""
+    L, two, H, n, T, D = pages.shape
+    return jnp.transpose(pages, (0, 1, 3, 4, 2, 5)).reshape(L, two, 1, n * T, H, D)
+
+
+class BlockAllocator:
+    """Host-side page allocator for the HBM cache (free-list; O(1))."""
+
+    def __init__(self, n_blocks: int):
+        self.n_blocks = n_blocks
+        self._free = list(range(n_blocks - 1, -1, -1))
+
+    def alloc(self, n: int) -> List[int]:
+        if n > len(self._free):
+            raise MemoryError(f"out of KV pages: want {n}, have {len(self._free)}")
+        return [self._free.pop() for _ in range(n)]
+
+    def free(self, ids: Sequence[int]) -> None:
+        self._free.extend(ids)
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+
+class BlockTable:
+    """Per-sequence page tables (host side), for paged attention."""
+
+    def __init__(self, max_seqs: int, max_blocks_per_seq: int):
+        self.max_seqs = max_seqs
+        self.max_blocks_per_seq = max_blocks_per_seq
+        self.table = np.zeros((max_seqs, max_blocks_per_seq), dtype=np.int32)
+        self.seq_lens = np.zeros((max_seqs,), dtype=np.int32)
+
+    def assign(self, seq_idx: int, block_ids: Sequence[int], seq_len: int) -> None:
+        n = len(block_ids)
+        if n > self.max_blocks_per_seq:
+            raise ValueError("sequence exceeds max_blocks_per_seq")
+        self.table[seq_idx, :n] = block_ids
+        self.table[seq_idx, n:] = 0
+        self.seq_lens[seq_idx] = seq_len
+
+    def device_arrays(self) -> Tuple[jax.Array, jax.Array]:
+        return jnp.asarray(self.table), jnp.asarray(self.seq_lens)

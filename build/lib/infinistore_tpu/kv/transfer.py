@@ -4,10 +4,9 @@ The reference moves KV between GPU memory and the store pool with GPUDirect
 RDMA against ``tensor.data_ptr()`` offsets (reference: infinistore/lib.py:425-
 542, benchmark.py:163-247).  On a TPU-VM the device side is a ``jax.Array``
 in HBM, so the path is: one fused gather on device -> a single device-to-host
-transfer -> zero-copy batched put straight from that host array into the
-store's shm pool (one host copy total; the mirror image for reads lands in a
-reusable staging buffer — the "registered MR": allocated once, registered
-with the connection, reused).
+transfer into a reusable staging buffer -> zero-copy batched put into the
+store's shm pool (and the mirror image for reads).  The staging buffer is the
+"registered MR": allocated once, registered with the connection, reused.
 
 Key layout: page (layer L, chunk c) of a sequence is stored under
 ``layer_key(chunk_keys(tokens)[c], L)`` so prefix reuse works per chunk while
@@ -70,6 +69,7 @@ class KVTransferEngine:
         host = np.ascontiguousarray(jax.device_get(pages))
         view = host.reshape(-1).view(np.uint8)
         pb = self.cfg.page_bytes
+        self.conn.register_mr(host.ctypes.data, view.nbytes)
         keys = self._page_keys(chunk_keys_)
         blocks = [(k, i * pb) for i, k in enumerate(keys)]
         self.conn.write_cache(blocks, pb, host.ctypes.data)

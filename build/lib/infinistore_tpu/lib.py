@@ -536,10 +536,6 @@ class InfinityConnection:
         await loop.run_in_executor(None, self.connect)
 
     def close(self) -> None:
-        pool = getattr(self, "_async_pool", None)
-        if pool is not None:
-            pool.shutdown(wait=False)
-            self._async_pool = None
         self.conn.close()
         self.rdma_connected = False
 
@@ -551,30 +547,13 @@ class InfinityConnection:
     def read_cache(self, blocks: Sequence[Tuple[str, int]], block_size: int, ptr: int) -> int:
         return self.conn.read_cache(blocks, block_size, ptr)
 
-    def _io_pool(self):
-        # One shared bounded executor per connection: asyncio's loop-default
-        # executor is created per event loop (tests/apps often spin up many
-        # short-lived loops), which churns threads and loses the pipelined
-        # channels' warm state.  The sync calls below already overlap on the
-        # wire via req_id pipelining + socket striping, so a handful of
-        # threads is enough to keep every channel busy.
-        pool = getattr(self, "_async_pool", None)
-        if pool is None:
-            from concurrent.futures import ThreadPoolExecutor
-
-            pool = ThreadPoolExecutor(
-                max_workers=8, thread_name_prefix="istpu-async"
-            )
-            self._async_pool = pool
-        return pool
-
     async def write_cache_async(
         self, blocks: Sequence[Tuple[str, int]], block_size: int, ptr: int
     ) -> int:
         async with self.semaphore:
             loop = asyncio.get_running_loop()
             return await loop.run_in_executor(
-                self._io_pool(), self.conn.write_cache, blocks, block_size, ptr
+                None, self.conn.write_cache, blocks, block_size, ptr
             )
 
     async def read_cache_async(
@@ -583,7 +562,7 @@ class InfinityConnection:
         async with self.semaphore:
             loop = asyncio.get_running_loop()
             return await loop.run_in_executor(
-                self._io_pool(), self.conn.read_cache, blocks, block_size, ptr
+                None, self.conn.read_cache, blocks, block_size, ptr
             )
 
     # drop-in aliases for reference callers

@@ -1,0 +1,277 @@
+"""Slab memory pools backed by POSIX shared memory.
+
+TPU-native counterpart of the reference's RDMA-registered pinned pool
+(reference: src/mempool.{h,cpp}).  The reference pre-registers host DRAM with
+``ibv_reg_mr`` and hands out fixed-size blocks via a bitmap allocator; on a
+TPU-VM there is no NIC registration step, but the pool must be reachable by
+local clients without copies through the server process.  We therefore back
+every pool with a POSIX shm segment (``/dev/shm``): local clients map the
+segment and read/write blocks directly (the "local gpu copy"/RDMA analog),
+while remote clients stream payloads over TCP.
+
+The allocator mirrors the reference design: fixed block size
+(``minimal_allocate_size``), a bitmap of used blocks, first-fit with a rover,
+multi-pool ``MM`` with 10 GB auto-extend (reference: src/mempool.h:12-13,
+src/infinistore.cpp:437-452).  The bitmap is a Python big-int: run-of-k free
+block search is done with shifted AND-chains, which executes in C at
+~word-per-64-blocks speed.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import mmap
+import os
+import re
+import secrets
+import threading
+from typing import List, Optional, Tuple
+
+EXTEND_POOL_SIZE = 10 << 30  # reference: src/mempool.h:12
+SHM_DIR = "/dev/shm"
+MADV_POPULATE_WRITE = 23  # linux >= 5.14; not in this Python's mmap module
+
+
+def _prefault(mm: mmap.mmap, size: int, write: bool = True) -> None:
+    """Pre-fault every page of ``mm`` so the data path never takes tmpfs
+    first-touch faults (the analog of the reference's ``ibv_reg_mr`` pinning,
+    src/mempool.cpp -- registration faults+pins the pool up front).  Measured
+    on this host: first-touch writes run at ~0.15 GB/s vs ~5 GB/s after.
+
+    ``write=False`` MUST be used for mappings of pools owned by someone else
+    (client mappings of the server pool): the write fallback zero-fills,
+    which would destroy live data there."""
+    if os.environ.get("ISTPU_NO_PREFAULT"):
+        return
+    addr = ctypes.addressof(ctypes.c_char.from_buffer(mm))
+    libc = ctypes.CDLL(None, use_errno=True)
+    if libc.madvise(ctypes.c_void_p(addr), ctypes.c_size_t(size), MADV_POPULATE_WRITE) == 0:
+        return
+    if write:
+        step = 1 << 24  # fallback: sequential zero-fill (fresh pools only)
+        zeros = bytes(step)
+        for off in range(0, size, step):
+            mm[off : off + min(step, size - off)] = zeros[: min(step, size - off)]
+    else:
+        # read-touch one byte per page; populates this process's page table
+        # without modifying shared contents
+        view = memoryview(mm)
+        acc = 0
+        for off in range(0, size, mmap.PAGESIZE):
+            acc |= view[off]
+        view.release()
+
+
+def _round_up(x: int, align: int) -> int:
+    return -(-x // align) * align
+
+
+_SEGMENT_RE = re.compile(r"^istpu_(\d+)_")
+
+
+def sweep_stale_segments(shm_dir: str = SHM_DIR) -> List[str]:
+    """Remove ``istpu_<pid>_*`` segments whose owning pid is dead.
+
+    A server killed with SIGKILL never reaches ``Pool.close``, so its
+    segments would permanently eat host RAM; every new server reclaims them
+    at startup (segment names embed the creator's pid).  Returns the paths
+    removed."""
+    removed = []
+    try:
+        names = os.listdir(shm_dir)
+    except OSError:
+        return removed
+    for name in names:
+        m = _SEGMENT_RE.match(name)
+        if not m:
+            continue
+        pid = int(m.group(1))
+        if pid == os.getpid():
+            continue
+        try:
+            os.kill(pid, 0)
+            continue  # owner alive
+        except ProcessLookupError:
+            pass
+        except PermissionError:
+            continue  # alive, different uid
+        try:
+            os.unlink(os.path.join(shm_dir, name))
+            removed.append(os.path.join(shm_dir, name))
+        except OSError:
+            pass
+    return removed
+
+
+class Pool:
+    """One shm-backed slab pool with a bitmap block allocator."""
+
+    def __init__(self, name: str, pool_size: int, block_size: int):
+        assert pool_size % block_size == 0
+        self.name = name
+        self.pool_size = pool_size
+        self.block_size = block_size
+        self.total_blocks = pool_size // block_size
+        self.allocated_blocks = 0
+        self._rover = 0
+        self._occ = 0  # bitmap: bit i set => block i in use
+        self._full_mask = (1 << self.total_blocks) - 1
+        self.path = os.path.join(SHM_DIR, name)
+        fd = os.open(self.path, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
+        try:
+            os.ftruncate(fd, pool_size)
+            self.mm = mmap.mmap(fd, pool_size)
+        finally:
+            os.close(fd)
+        self.buf = memoryview(self.mm)
+        # Pre-fault in the background so the server can bind/listen
+        # immediately (a 16 GiB pool takes minutes to fault in).  Only the
+        # madvise and read-touch strategies are concurrency-safe; the
+        # zero-fill fallback in _prefault would race live writes, so it is
+        # never used off-thread.
+        self.prefault_done = threading.Event()
+        self._closing = False
+        if os.environ.get("ISTPU_NO_PREFAULT"):
+            self.prefault_done.set()
+            self._prefault_thread = None
+        else:
+            self._prefault_thread = threading.Thread(
+                target=self._prefault_bg, args=(pool_size,), daemon=True
+            )
+            self._prefault_thread.start()
+
+    def _prefault_bg(self, size: int) -> None:
+        try:
+            addr = ctypes.addressof(ctypes.c_char.from_buffer(self.mm))
+            libc = ctypes.CDLL(None, use_errno=True)
+            step = 1 << 28  # 256 MB chunks so close() never waits long
+            for off in range(0, size, step):
+                if self._closing:
+                    return
+                n = min(step, size - off)
+                rc = libc.madvise(
+                    ctypes.c_void_p(addr + off),
+                    ctypes.c_size_t(n),
+                    MADV_POPULATE_WRITE,
+                )
+                if rc != 0:  # pre-5.14 kernel: read-touch (concurrency-safe)
+                    for o2 in range(off, off + n, mmap.PAGESIZE):
+                        if self._closing:
+                            return
+                        self.buf[o2]
+        except (ValueError, OSError, BufferError):
+            pass  # pool closed mid-prefault; remaining pages fault on first touch
+        finally:
+            self.prefault_done.set()
+
+    # -- allocation --
+
+    def _find_run(self, k: int) -> int:
+        """Return first block index of a free run of k blocks, or -1."""
+        free = ~self._occ & self._full_mask
+        if free == 0:
+            return -1
+        r = free
+        for i in range(1, k):
+            r &= free >> i
+            if r == 0:
+                return -1
+        # prefer positions at/after the rover to reduce fragmentation churn
+        hi = r >> self._rover
+        if hi:
+            return self._rover + (hi & -hi).bit_length() - 1
+        return (r & -r).bit_length() - 1
+
+    def allocate(self, size: int) -> Optional[int]:
+        """Allocate a contiguous region of ``size`` bytes (rounded up to
+        blocks).  Returns byte offset into the pool or None."""
+        k = _round_up(size, self.block_size) // self.block_size
+        if k == 0 or k > self.total_blocks - self.allocated_blocks:
+            return None
+        idx = self._find_run(k)
+        if idx < 0:
+            return None
+        run_mask = ((1 << k) - 1) << idx
+        self._occ |= run_mask
+        self.allocated_blocks += k
+        self._rover = (idx + k) % self.total_blocks
+        return idx * self.block_size
+
+    def deallocate(self, offset: int, size: int) -> None:
+        k = _round_up(size, self.block_size) // self.block_size
+        idx = offset // self.block_size
+        run_mask = ((1 << k) - 1) << idx
+        assert self._occ & run_mask == run_mask, "double free"
+        self._occ &= ~run_mask
+        self.allocated_blocks -= k
+
+    def close(self) -> None:
+        self._closing = True
+        if self._prefault_thread is not None:
+            self._prefault_thread.join(timeout=10.0)
+        self.buf.release()
+        self.mm.close()
+        try:
+            os.unlink(self.path)
+        except FileNotFoundError:
+            pass
+
+
+class MM:
+    """Multi-pool manager (reference: src/mempool.h:54-91)."""
+
+    def __init__(self, pool_size: int, block_size: int, name_prefix: str = None):
+        self.block_size = block_size
+        self.name_prefix = name_prefix or f"istpu_{os.getpid()}_{secrets.token_hex(4)}"
+        self.pools: List[Pool] = []
+        self.need_extend = False
+        sweep_stale_segments()  # reclaim segments of SIGKILL'd servers
+        self.add_mempool(pool_size, block_size)
+
+    def _next_name(self) -> str:
+        return f"{self.name_prefix}_p{len(self.pools)}"
+
+    def add_mempool(self, pool_size: int = EXTEND_POOL_SIZE, block_size: int = None) -> Pool:
+        block_size = block_size or self.block_size
+        pool = Pool(self._next_name(), _round_up(pool_size, block_size), block_size)
+        self.pools.append(pool)
+        return pool
+
+    def allocate(self, size: int, n: int) -> Optional[List[Tuple[int, int]]]:
+        """Allocate ``n`` regions of ``size`` bytes.  Returns a list of
+        (pool_idx, offset) or None (all-or-nothing, like the reference's
+        callback-per-region allocate, src/mempool.cpp MM::allocate)."""
+        out: List[Tuple[int, int]] = []
+        for _ in range(n):
+            placed = False
+            for pi, pool in enumerate(self.pools):
+                off = pool.allocate(size)
+                if off is not None:
+                    out.append((pi, off))
+                    placed = True
+                    break
+            if not placed:
+                self.need_extend = True
+                for pi, off in out:  # roll back
+                    self.pools[pi].deallocate(off, size)
+                return None
+        return out
+
+    def deallocate(self, pool_idx: int, offset: int, size: int) -> None:
+        self.pools[pool_idx].deallocate(offset, size)
+
+    def view(self, pool_idx: int, offset: int, size: int) -> memoryview:
+        return self.pools[pool_idx].buf[offset : offset + size]
+
+    def usage(self) -> float:
+        total = sum(p.total_blocks for p in self.pools)
+        used = sum(p.allocated_blocks for p in self.pools)
+        return used / total if total else 0.0
+
+    def pool_table(self) -> List[Tuple[str, int, int]]:
+        return [(p.name, p.pool_size, p.block_size) for p in self.pools]
+
+    def close(self) -> None:
+        for p in self.pools:
+            p.close()
+        self.pools.clear()

@@ -1,0 +1,137 @@
+"""Attention ops: causal prefill attention and paged decode attention.
+
+TPU-first design notes:
+* prefill attention is a plain fused SDPA in bf16 -- XLA tiles the matmuls
+  onto the MXU and fuses mask+softmax; a Pallas flash kernel can drop in
+  behind the same signature (``ops/pallas_attention.py``).
+* decode attention reads K/V straight from the paged HBM cache via a
+  static-shape page-table gather: [B, max_pages] int32 -> [B, S_max, H, D].
+  No dynamic shapes: padding slots are masked by sequence length.
+* GQA repeats KV heads with a reshape (broadcast), not a materialized tile.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def rope_freqs(head_dim: int, theta: float = 500000.0) -> jax.Array:
+    return 1.0 / (
+        theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+
+
+def apply_rope(x: jax.Array, positions: jax.Array, theta: float = 500000.0) -> jax.Array:
+    """x: [..., S, H, D]; positions: broadcastable to [..., S]."""
+    d = x.shape[-1]
+    freqs = rope_freqs(d, theta)  # [D/2]
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [..., S, D/2]
+    cos = jnp.cos(angles)[..., None, :]  # [..., S, 1, D/2]
+    sin = jnp.sin(angles)[..., None, :]
+    x1 = x[..., 0::2].astype(jnp.float32)
+    x2 = x[..., 1::2].astype(jnp.float32)
+    out1 = x1 * cos - x2 * sin
+    out2 = x2 * cos + x1 * sin
+    out = jnp.stack([out1, out2], axis=-1).reshape(x.shape)
+    return out.astype(x.dtype)
+
+
+def repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
+    """[..., S, H_kv, D] -> [..., S, H_kv*n_rep, D] (broadcast, no copy)."""
+    if n_rep == 1:
+        return x
+    shape = x.shape
+    x = x[..., :, :, None, :]
+    x = jnp.broadcast_to(x, shape[:-1] + (n_rep, shape[-1]))
+    return x.reshape(shape[:-2] + (shape[-2] * n_rep, shape[-1]))
+
+
+def causal_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, q_offset: jax.Array | int = 0
+) -> jax.Array:
+    """Causal SDPA.  q: [B, Sq, H, D]; k/v: [B, Sk, H_kv, D].
+
+    ``q_offset``: absolute position of q[0] minus that of k[0] (chunked
+    prefill attends to cached prefix + itself).
+    """
+    B, Sq, H, D = q.shape
+    Hkv = k.shape[2]
+    k = repeat_kv(k, H // Hkv)
+    v = repeat_kv(v, H // Hkv)
+    scale = 1.0 / np.sqrt(D)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    q_pos = jnp.arange(Sq) + q_offset
+    k_pos = jnp.arange(k.shape[1])
+    mask = k_pos[None, :] <= q_pos[:, None]  # [Sq, Sk]
+    logits = jnp.where(mask[None, None], logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+    return out
+
+
+def paged_decode_attention_xla(
+    q: jax.Array,
+    layer_cache: jax.Array,
+    block_table: jax.Array,
+    seq_lens: jax.Array,
+) -> jax.Array:
+    """One-token decode attention against the paged cache (XLA gather path).
+
+    q: [B, H, D] (current token, RoPE already applied)
+    layer_cache: [2, H_kv, n_blocks, T, D] (one layer's pages)
+    block_table: [B, max_pages] int32
+    seq_lens: [B] int32 -- number of valid tokens (including current)
+    """
+    B, H, D = q.shape
+    Hkv, _, T = layer_cache.shape[1:4]
+    max_pages = block_table.shape[1]
+    # gather pages: [Hkv, B, max_pages, T, D] -> [B, S_max, Hkv, D]
+    k = layer_cache[0][:, block_table]
+    v = layer_cache[1][:, block_table]
+    k = jnp.moveaxis(k, 0, 3).reshape(B, max_pages * T, Hkv, D)
+    v = jnp.moveaxis(v, 0, 3).reshape(B, max_pages * T, Hkv, D)
+    k = repeat_kv(k, H // Hkv)
+    v = repeat_kv(v, H // Hkv)
+    scale = 1.0 / np.sqrt(D)
+    logits = jnp.einsum("bhd,bkhd->bhk", q, k).astype(jnp.float32) * scale
+    pos = jnp.arange(max_pages * T)
+    mask = pos[None, :] < seq_lens[:, None]  # [B, S_max]
+    logits = jnp.where(mask[:, None, :], logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhk,bkhd->bhd", probs.astype(v.dtype), v)
+
+
+def paged_decode_attention(
+    q: jax.Array,
+    layer_cache: jax.Array,
+    block_table: jax.Array,
+    seq_lens: jax.Array,
+    allow_pallas: bool = True,
+) -> jax.Array:
+    """Paged decode attention; Pallas kernel on TPU, XLA gather elsewhere.
+
+    Same signature/layout as ``paged_decode_attention_xla`` -- the cache
+    layout [2, H_kv, n_blocks, T, D] IS the Pallas kernel layout, so the
+    kernel streams pages by block-table lookup with no shuffle.  Set
+    ``ISTPU_NO_PALLAS=1`` to force the XLA path.
+
+    ``allow_pallas=False`` MUST be passed when tracing under a
+    GSPMD-partitioned jit (parallel/sharding.py make_tp_decode): pallas_call
+    is an opaque custom call with no SPMD partitioning rule, so the
+    partitioner would replicate (all-gather) the sharded cache around it.
+    The sharded-kernel composition (shard_map around the kernel) is the
+    planned path for tensor-parallel Pallas decode.
+    """
+    import os
+
+    if (
+        allow_pallas
+        and jax.default_backend() == "tpu"
+        and not os.environ.get("ISTPU_NO_PALLAS")
+    ):
+        from ..ops.pallas_attention import paged_decode_attention_pallas
+
+        return paged_decode_attention_pallas(q, layer_cache, block_table, seq_lens)
+    return paged_decode_attention_xla(q, layer_cache, block_table, seq_lens)

@@ -1,0 +1,227 @@
+"""Llama-3 family in pure JAX (pytree params, bf16, GQA, RoPE, SwiGLU).
+
+The reference serves Llama via vLLM and only moves its KV; a TPU-native
+framework owns the model too.  Design: params are a plain pytree (dict) so
+``jax.sharding`` specs attach cleanly (parallel/sharding.py); all forwards
+are pure functions of (params, inputs) with the config closed over as a
+static argument -- one XLA program per shape, MXU-sized matmuls in bf16.
+
+Three entry points:
+* ``prefill_forward``  -- full-sequence causal forward; returns logits and
+  per-layer KV laid out for paging ([L, 2, B, S, Hkv, D]).
+* ``decode_forward``   -- single-token step against the paged HBM cache
+  (kv/cache.py), returning logits and the updated cache.
+* ``train_step_fn``    -- next-token cross-entropy + SGD update (used by the
+  multi-chip dry run; serving frameworks still need a tuning path).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .attention import apply_rope, causal_attention, paged_decode_attention
+
+Params = Dict[str, Any]
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128256
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    ffn_dim: int = 14336
+    norm_eps: float = 1e-5
+    rope_theta: float = 500000.0
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+
+# -- presets (Llama-3 shapes) --
+LLAMA3_8B = LlamaConfig()
+LLAMA3_70B = LlamaConfig(
+    dim=8192, n_layers=80, n_heads=64, n_kv_heads=8, ffn_dim=28672
+)
+LLAMA3_1B = LlamaConfig(  # Llama-3.2-1B shapes
+    dim=2048, n_layers=16, n_heads=32, n_kv_heads=8, ffn_dim=8192
+)
+TINY = LlamaConfig(
+    vocab_size=512, dim=128, n_layers=2, n_heads=4, n_kv_heads=2, ffn_dim=256
+)
+
+
+def scaled(cfg: LlamaConfig, **kw) -> LlamaConfig:
+    return replace(cfg, **kw)
+
+
+def init_params(cfg: LlamaConfig, key: jax.Array) -> Params:
+    def dense(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32) / np.sqrt(fan_in)).astype(
+            cfg.dtype
+        )
+
+    keys = jax.random.split(key, cfg.n_layers + 2)
+    hd = cfg.head_dim
+    layers = []
+    for li in range(cfg.n_layers):
+        k = jax.random.split(keys[li], 7)
+        layers.append(
+            {
+                "wq": dense(k[0], (cfg.dim, cfg.n_heads * hd), cfg.dim),
+                "wk": dense(k[1], (cfg.dim, cfg.n_kv_heads * hd), cfg.dim),
+                "wv": dense(k[2], (cfg.dim, cfg.n_kv_heads * hd), cfg.dim),
+                "wo": dense(k[3], (cfg.n_heads * hd, cfg.dim), cfg.n_heads * hd),
+                "w_gate": dense(k[4], (cfg.dim, cfg.ffn_dim), cfg.dim),
+                "w_up": dense(k[5], (cfg.dim, cfg.ffn_dim), cfg.dim),
+                "w_down": dense(k[6], (cfg.ffn_dim, cfg.dim), cfg.ffn_dim),
+                "ln_attn": jnp.ones((cfg.dim,), cfg.dtype),
+                "ln_mlp": jnp.ones((cfg.dim,), cfg.dtype),
+            }
+        )
+    # stack layers: every leaf gets a leading [n_layers] axis (scan-friendly,
+    # pp-shardable)
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *layers)
+    return {
+        "embed": dense(keys[-2], (cfg.vocab_size, cfg.dim), cfg.dim),
+        "layers": stacked,
+        "ln_out": jnp.ones((cfg.dim,), cfg.dtype),
+        "lm_head": dense(keys[-1], (cfg.dim, cfg.vocab_size), cfg.dim),
+    }
+
+
+def rmsnorm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    scale = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (x32 * scale).astype(x.dtype) * w
+
+
+def _attn_qkv(layer: Params, cfg: LlamaConfig, x: jax.Array, positions: jax.Array):
+    B, S, _ = x.shape
+    hd = cfg.head_dim
+    q = (x @ layer["wq"]).reshape(B, S, cfg.n_heads, hd)
+    k = (x @ layer["wk"]).reshape(B, S, cfg.n_kv_heads, hd)
+    v = (x @ layer["wv"]).reshape(B, S, cfg.n_kv_heads, hd)
+    q = apply_rope(q, positions, cfg.rope_theta)
+    k = apply_rope(k, positions, cfg.rope_theta)
+    return q, k, v
+
+
+def _mlp(layer: Params, x: jax.Array) -> jax.Array:
+    return (jax.nn.silu(x @ layer["w_gate"]) * (x @ layer["w_up"])) @ layer["w_down"]
+
+
+def _layer(ix: int):
+    def get(stacked: Params) -> Params:
+        return jax.tree.map(lambda x: x[ix], stacked)
+
+    return get
+
+
+def prefill_forward(
+    params: Params,
+    cfg: LlamaConfig,
+    tokens: jax.Array,
+    prefix_kv: jax.Array | None = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """tokens: [B, S] -> (logits [B, S, V], kv [L, 2, B, S, Hkv, D]).
+
+    ``prefix_kv`` ([L, 2, B, P, Hkv, D], RoPE already applied) enables
+    chunked prefill on top of a reused prefix: ``tokens`` are positions
+    P..P+S-1 and attend to the prefix KV plus themselves causally.  The
+    returned KV covers only the new tokens.
+    """
+    B, S = tokens.shape
+    P = 0 if prefix_kv is None else prefix_kv.shape[3]
+    positions = jnp.broadcast_to(jnp.arange(S) + P, (B, S))
+    x = params["embed"][tokens]
+    kvs = []
+    for li in range(cfg.n_layers):
+        layer = _layer(li)(params["layers"])
+        h = rmsnorm(x, layer["ln_attn"], cfg.norm_eps)
+        q, k, v = _attn_qkv(layer, cfg, h, positions)
+        kvs.append(jnp.stack([k, v], axis=0))  # [2, B, S, Hkv, D]
+        if prefix_kv is None:
+            attn = causal_attention(q, k, v)
+        else:
+            k_full = jnp.concatenate([prefix_kv[li, 0], k], axis=1)
+            v_full = jnp.concatenate([prefix_kv[li, 1], v], axis=1)
+            attn = causal_attention(q, k_full, v_full, q_offset=P)
+        x = x + attn.reshape(B, S, -1) @ layer["wo"]
+        h = rmsnorm(x, layer["ln_mlp"], cfg.norm_eps)
+        x = x + _mlp(layer, h)
+    x = rmsnorm(x, params["ln_out"], cfg.norm_eps)
+    logits = x @ params["lm_head"]
+    return logits, jnp.stack(kvs)
+
+
+def decode_forward(
+    params: Params,
+    cfg: LlamaConfig,
+    tokens: jax.Array,
+    positions: jax.Array,
+    cache: jax.Array,
+    block_table: jax.Array,
+    seq_lens: jax.Array,
+    slot_block_ids: jax.Array,
+    slot_ids: jax.Array,
+    use_pallas: bool = True,
+) -> Tuple[jax.Array, jax.Array]:
+    """Single-token paged decode.
+
+    ``use_pallas=False`` forces the XLA attention path; required when this
+    function is traced under a GSPMD-partitioned jit (see
+    models/attention.py:paged_decode_attention).
+
+    tokens/positions: [B]; cache: [L, 2, Hkv, n_blocks, T, D]
+    (kv/cache.py layout -- heads outside blocks so the Pallas decode kernel
+    streams [T, D] tiles); block_table: [B, max_pages]; seq_lens: [B]
+    (*including* this token); slot_block_ids/slot_ids: [B] where to scatter
+    this token's K/V.  Returns (logits [B, V], updated cache).
+    """
+    from ..kv.cache import write_token_kv
+
+    B = tokens.shape[0]
+    x = params["embed"][tokens][:, None, :]  # [B, 1, dim]
+    pos = positions[:, None]
+    for li in range(cfg.n_layers):
+        layer = _layer(li)(params["layers"])
+        h = rmsnorm(x, layer["ln_attn"], cfg.norm_eps)
+        q, k, v = _attn_qkv(layer, cfg, h, pos)
+        # scatter this token's kv into its page slot
+        cache = write_token_kv(cache, li, slot_block_ids, slot_ids, k[:, 0], v[:, 0])
+        attn = paged_decode_attention(
+            q[:, 0], cache[li], block_table, seq_lens, allow_pallas=use_pallas
+        )
+        x = x + (attn.reshape(B, -1) @ layer["wo"])[:, None, :]
+        h = rmsnorm(x, layer["ln_mlp"], cfg.norm_eps)
+        x = x + _mlp(layer, h)
+    x = rmsnorm(x, params["ln_out"], cfg.norm_eps)
+    logits = x[:, 0] @ params["lm_head"]
+    return logits, cache
+
+
+def loss_fn(params: Params, cfg: LlamaConfig, tokens: jax.Array) -> jax.Array:
+    """Next-token cross entropy over [B, S] tokens."""
+    logits, _ = prefill_forward(params, cfg, tokens)
+    logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
+    tgt = tokens[:, 1:]
+    nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+    return nll.mean()
+
+
+def train_step_fn(cfg: LlamaConfig, lr: float = 1e-3):
+    def step(params: Params, tokens: jax.Array):
+        loss, grads = jax.value_and_grad(lambda p: loss_fn(p, cfg, tokens))(params)
+        params = jax.tree.map(lambda p, g: p - lr * g.astype(p.dtype), params, grads)
+        return params, loss
+
+    return step

@@ -1,0 +1,193 @@
+"""Mixtral-style sparse-MoE Llama: shared attention, top-k routed experts.
+
+Second model family beyond dense Llama (the reference serves whatever vLLM
+loads; a standalone framework owns its model zoo).  Design mirrors
+models/llama.py: params are a plain pytree with a stacked [n_layers] leaf
+axis, forwards are pure functions, bf16 matmuls sized for the MXU.
+
+The expert FFN is computed DENSELY here -- every expert runs on every token
+and the top-k gate zeros the rest.  That keeps shapes static and the XLA
+program branch-free (no capacity overflow, no token dropping), and it is the
+exact math the expert-parallel path (parallel/moe.py) reproduces with each
+device computing only its local experts and one psum over the ``ep`` axis.
+Top-k sparsity as a FLOP saving (all_to_all dispatch with capacity) is a
+serving-scale optimization layered on the same layout later.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .attention import causal_attention
+from .llama import LlamaConfig, Params, rmsnorm, _attn_qkv, _layer
+
+
+@dataclass(frozen=True)
+class MoEConfig(LlamaConfig):
+    n_experts: int = 8
+    top_k: int = 2
+
+
+MIXTRAL_8X7B = MoEConfig(
+    vocab_size=32000, dim=4096, n_layers=32, n_heads=32, n_kv_heads=8,
+    ffn_dim=14336, n_experts=8, top_k=2, rope_theta=1e6,
+)
+TINY_MOE = MoEConfig(
+    vocab_size=512, dim=128, n_layers=2, n_heads=4, n_kv_heads=2,
+    ffn_dim=256, n_experts=4, top_k=2,
+)
+
+
+def scaled_moe(cfg: MoEConfig, **kw) -> MoEConfig:
+    return replace(cfg, **kw)
+
+
+def init_moe_params(cfg: MoEConfig, key: jax.Array) -> Params:
+    def dense(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32) / np.sqrt(fan_in)).astype(
+            cfg.dtype
+        )
+
+    keys = jax.random.split(key, cfg.n_layers + 2)
+    hd = cfg.head_dim
+    E = cfg.n_experts
+    layers = []
+    for li in range(cfg.n_layers):
+        k = jax.random.split(keys[li], 9)
+        layers.append(
+            {
+                "wq": dense(k[0], (cfg.dim, cfg.n_heads * hd), cfg.dim),
+                "wk": dense(k[1], (cfg.dim, cfg.n_kv_heads * hd), cfg.dim),
+                "wv": dense(k[2], (cfg.dim, cfg.n_kv_heads * hd), cfg.dim),
+                "wo": dense(k[3], (cfg.n_heads * hd, cfg.dim), cfg.n_heads * hd),
+                # router stays fp32: tiny, and gate ordering is precision-
+                # sensitive (top-k ties)
+                "router": jax.random.normal(k[4], (cfg.dim, E), jnp.float32)
+                / np.sqrt(cfg.dim),
+                "w_gate": dense(k[5], (E, cfg.dim, cfg.ffn_dim), cfg.dim),
+                "w_up": dense(k[6], (E, cfg.dim, cfg.ffn_dim), cfg.dim),
+                "w_down": dense(k[7], (E, cfg.ffn_dim, cfg.dim), cfg.ffn_dim),
+                "ln_attn": jnp.ones((cfg.dim,), cfg.dtype),
+                "ln_mlp": jnp.ones((cfg.dim,), cfg.dtype),
+            }
+        )
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *layers)
+    return {
+        "embed": dense(keys[-2], (cfg.vocab_size, cfg.dim), cfg.dim),
+        "layers": stacked,
+        "ln_out": jnp.ones((cfg.dim,), cfg.dtype),
+        "lm_head": dense(keys[-1], (cfg.dim, cfg.vocab_size), cfg.dim),
+    }
+
+
+def top_k_gates(router_logits: jax.Array, top_k: int) -> jax.Array:
+    """[..., E] logits -> [..., E] gate weights: softmax over the top-k
+    entries, exact zeros elsewhere (Mixtral gating)."""
+    E = router_logits.shape[-1]
+    vals, idx = jax.lax.top_k(router_logits, top_k)  # [..., k]
+    probs = jax.nn.softmax(vals, axis=-1)
+    onehot = jax.nn.one_hot(idx, E, dtype=probs.dtype)  # [..., k, E]
+    return jnp.einsum("...k,...ke->...e", probs, onehot)
+
+
+def moe_ffn(layer: Params, x: jax.Array, top_k: int) -> jax.Array:
+    """Dense-compute MoE FFN.  x: [B, S, dim] -> [B, S, dim]."""
+    gates = top_k_gates(
+        x.astype(jnp.float32) @ layer["router"], top_k
+    )  # [B, S, E] fp32
+    # all experts on all tokens: [B, S, E, ffn]
+    h = jax.nn.silu(jnp.einsum("bsd,edf->bsef", x, layer["w_gate"]))
+    h = h * jnp.einsum("bsd,edf->bsef", x, layer["w_up"])
+    out = jnp.einsum("bsef,efd->bsed", h, layer["w_down"])  # [B, S, E, dim]
+    return jnp.einsum("bsed,bse->bsd", out, gates.astype(x.dtype))
+
+
+def moe_prefill_forward(
+    params: Params,
+    cfg: MoEConfig,
+    tokens: jax.Array,
+    prefix_kv: jax.Array | None = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """tokens: [B, S] -> (logits [B, S, V], kv [L, 2, B, S, Hkv, D]).
+
+    Same contract as models.llama.prefill_forward (including chunked
+    prefill on a reused ``prefix_kv``), so the serving engines and KV
+    paging work unchanged for MoE models.
+    """
+    B, S = tokens.shape
+    Pfx = 0 if prefix_kv is None else prefix_kv.shape[3]
+    positions = jnp.broadcast_to(jnp.arange(S) + Pfx, (B, S))
+    x = params["embed"][tokens]
+    kvs = []
+    for li in range(cfg.n_layers):
+        layer = _layer(li)(params["layers"])
+        h = rmsnorm(x, layer["ln_attn"], cfg.norm_eps)
+        q, k, v = _attn_qkv(layer, cfg, h, positions)
+        kvs.append(jnp.stack([k, v], axis=0))
+        if prefix_kv is None:
+            attn = causal_attention(q, k, v)
+        else:
+            k_full = jnp.concatenate([prefix_kv[li, 0], k], axis=1)
+            v_full = jnp.concatenate([prefix_kv[li, 1], v], axis=1)
+            attn = causal_attention(q, k_full, v_full, q_offset=Pfx)
+        x = x + attn.reshape(B, S, -1) @ layer["wo"]
+        h = rmsnorm(x, layer["ln_mlp"], cfg.norm_eps)
+        x = x + moe_ffn(layer, h, cfg.top_k)
+    x = rmsnorm(x, params["ln_out"], cfg.norm_eps)
+    return x @ params["lm_head"], jnp.stack(kvs)
+
+
+def moe_decode_forward(
+    params: Params,
+    cfg: MoEConfig,
+    tokens: jax.Array,
+    positions: jax.Array,
+    cache: jax.Array,
+    block_table: jax.Array,
+    seq_lens: jax.Array,
+    slot_block_ids: jax.Array,
+    slot_ids: jax.Array,
+    use_pallas: bool = True,
+) -> Tuple[jax.Array, jax.Array]:
+    """Single-token paged MoE decode; contract of models.llama.decode_forward."""
+    from ..kv.cache import write_token_kv
+    from .attention import paged_decode_attention
+
+    B = tokens.shape[0]
+    x = params["embed"][tokens][:, None, :]
+    pos = positions[:, None]
+    for li in range(cfg.n_layers):
+        layer = _layer(li)(params["layers"])
+        h = rmsnorm(x, layer["ln_attn"], cfg.norm_eps)
+        q, k, v = _attn_qkv(layer, cfg, h, pos)
+        cache = write_token_kv(cache, li, slot_block_ids, slot_ids, k[:, 0], v[:, 0])
+        attn = paged_decode_attention(
+            q[:, 0], cache[li], block_table, seq_lens, allow_pallas=use_pallas
+        )
+        x = x + (attn.reshape(B, -1) @ layer["wo"])[:, None, :]
+        h = rmsnorm(x, layer["ln_mlp"], cfg.norm_eps)
+        x = x + moe_ffn(layer, h, cfg.top_k)
+    x = rmsnorm(x, params["ln_out"], cfg.norm_eps)
+    return x[:, 0] @ params["lm_head"], cache
+
+
+def moe_loss_fn(params: Params, cfg: MoEConfig, tokens: jax.Array) -> jax.Array:
+    logits, _ = moe_prefill_forward(params, cfg, tokens)
+    logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
+    tgt = tokens[:, 1:]
+    nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+    return nll.mean()
+
+
+def moe_train_step_fn(cfg: MoEConfig, lr: float = 1e-3):
+    def step(params: Params, tokens: jax.Array):
+        loss, grads = jax.value_and_grad(lambda p: moe_loss_fn(p, cfg, tokens))(params)
+        params = jax.tree.map(lambda p, g: p - lr * g.astype(p.dtype), params, grads)
+        return params, loss
+
+    return step

@@ -1,0 +1,9 @@
+"""Pallas TPU kernels for the hot ops.
+
+``pallas_attention`` provides the paged decode-attention kernel (the
+bandwidth-bound inner loop of serving).  XLA versions of the same math live
+in ``models/attention.py``; kernels here are drop-in replacements validated
+against them in tests/test_ops.py.
+"""
+
+from .pallas_attention import paged_decode_attention_pallas  # noqa: F401

@@ -1,0 +1,159 @@
+"""Pallas TPU kernel: paged decode attention.
+
+The decode hot loop reads every cached K/V page of every active sequence per
+token -- purely HBM-bandwidth-bound.  The XLA version
+(models/attention.py:paged_decode_attention) materializes the page gather
+([B, S_max, H, D]) before attending; this kernel instead streams pages
+HBM->VMEM by block-table lookup (PrefetchScalarGridSpec: the table is
+available to BlockSpec index_maps, so the pipeline's double-buffered DMAs
+chase the page table directly -- no gathered copy is ever written back).
+
+The reference's comparable hot path is the GPUDirect RDMA read of KV blocks
+into the GPU (reference: src/libinfinistore.cpp batched IBV_WR_RDMA_READ);
+on TPU the cache is already in HBM and the analog is the HBM->VMEM stream.
+
+Cache layout: [2(K|V), H_kv, n_blocks, T, D] -- a (head, page) tile
+[T=16, D=128] is contiguous and exactly the bf16 min tile (16, 128).  This
+IS the serving layout (kv/cache.py), so no shuffle happens on the decode
+path.
+
+Grid: (B, H_kv, max_pages); the page axis is innermost so the flash-style
+online-softmax accumulators (m/l/acc in VMEM scratch, fp32) carry across
+page steps and write out once on the last page.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _decode_kernel(
+    table_ref,  # scalar prefetch: [B, max_pages] int32
+    lens_ref,   # scalar prefetch: [B] int32
+    q_ref,      # [1, 1, R, D] current-token queries for this kv head group
+    k_ref,      # [1, 1, 1, T, D] one K page
+    v_ref,      # [1, 1, 1, T, D] one V page
+    o_ref,      # [1, 1, R, D]
+    m_scr,      # [R, 128] fp32 running max (col 0 used)
+    l_scr,      # [R, 128] fp32 running denominator (col 0 used)
+    acc_scr,    # [R, D] fp32 numerator
+    *,
+    scale: float,
+):
+    b = pl.program_id(0)
+    c = pl.program_id(2)
+    n_chunks = pl.num_programs(2)
+    T = k_ref.shape[3]
+
+    @pl.when(c == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    seq_len = lens_ref[b]
+
+    @pl.when(c * T < seq_len)
+    def _attend():
+        q = q_ref[0, 0].astype(jnp.float32)        # [R, D]
+        k = k_ref[0, 0, 0].astype(jnp.float32)     # [T, D]
+        v = v_ref[0, 0, 0].astype(jnp.float32)     # [T, D]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale                                   # [R, T]
+        pos = c * T + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(pos < seq_len, s, NEG_INF)
+        m_prev = m_scr[:, :1]                       # [R, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_scr[:, :1] = l_scr[:, :1] * corr + jnp.sum(p, axis=1, keepdims=True)
+        m_scr[:, :1] = m_new
+        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot(
+            p, v, preferred_element_type=jnp.float32
+        )
+
+    @pl.when(c == n_chunks - 1)
+    def _finish():
+        o_ref[0, 0] = (acc_scr[:] / l_scr[:, :1]).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def paged_decode_attention_pallas(
+    q: jax.Array,
+    cache_kl: jax.Array,
+    block_table: jax.Array,
+    seq_lens: jax.Array,
+    interpret: bool = False,
+) -> jax.Array:
+    """One-token decode attention straight off the paged HBM cache.
+
+    q: [B, H, D] (RoPE applied); cache_kl: [2, H_kv, n_blocks, T, D]
+    (the kv/cache.py serving layout, per layer); block_table: [B, max_pages]
+    int32; seq_lens: [B] int32 (valid tokens incl. current).
+    Returns [B, H, D].
+
+    Matches models/attention.py:paged_decode_attention_xla (tests/test_ops.py).
+    """
+    B, H, D = q.shape
+    _, Hkv, _, T, Dc = cache_kl.shape
+    assert Dc == D, (Dc, D)
+    n_rep = H // Hkv
+    # pad query groups to the dtype's native sublane tile: (8, 128) for
+    # fp32, (16, 128) for bf16 -- an 8-sublane bf16 block would be below
+    # the native tile and Mosaic may reject or mis-tile it
+    min_sublane = 8 if q.dtype == jnp.float32 else 16
+    R = max(n_rep, min_sublane)
+    max_pages = block_table.shape[1]
+    scale = 1.0 / np.sqrt(D)
+
+    # [B, H, D] -> [B, Hkv, R, D]
+    qg = q.reshape(B, Hkv, n_rep, D)
+    if R != n_rep:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, R - n_rep), (0, 0)))
+
+    grid = (B, Hkv, max_pages)
+
+    def q_map(b, h, c, table_ref, lens_ref):
+        return (b, h, 0, 0)
+
+    def k_map(b, h, c, table_ref, lens_ref):
+        return (0, h, table_ref[b, c], 0, 0)
+
+    def v_map(b, h, c, table_ref, lens_ref):
+        return (1, h, table_ref[b, c], 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, R, D), q_map),
+            pl.BlockSpec((1, 1, 1, T, D), k_map),
+            pl.BlockSpec((1, 1, 1, T, D), v_map),
+        ],
+        out_specs=pl.BlockSpec((1, 1, R, D), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((R, 128), jnp.float32),
+            pltpu.VMEM((R, 128), jnp.float32),
+            pltpu.VMEM((R, D), jnp.float32),
+        ],
+    )
+
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, scale=scale),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, R, D), q.dtype),
+        interpret=interpret,
+    )(block_table.astype(jnp.int32), seq_lens.astype(jnp.int32), qg,
+      cache_kl, cache_kl)
+
+    return out[:, :, :n_rep].reshape(B, H, D)

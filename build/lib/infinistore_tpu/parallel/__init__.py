@@ -1,0 +1,68 @@
+"""Multi-chip parallelism: mesh construction, sharding rules, ring attention
+(sequence parallel over ICI), Megatron-style tensor parallel, collective-
+permute pipeline parallel, and DCN-aware data parallel.
+
+The reference scales by running many store nodes and moving KV over
+RDMA/NCCL between GPU hosts; a TPU-native framework scales the *model* with
+``jax.sharding`` over a device mesh and lets XLA place collectives on
+ICI/DCN.  Everything here follows the scaling-book recipe: pick a mesh,
+annotate shardings (or go fully manual with ``shard_map`` where the
+schedule matters -- ring attention, pipelining), let XLA do the rest.
+"""
+
+from .distributed import (
+    dcn_aware_store_targets,
+    initialize,
+    make_hybrid_mesh,
+    process_local_batch,
+)
+from .mesh import MeshShape, factor_devices, make_mesh
+from .ring import make_ring_attention, ring_attention_local
+from .layers import tp_layer_forward
+from .moe import (
+    make_moe_forward,
+    make_moe_mesh,
+    make_moe_train_step,
+    moe_param_specs,
+    init_sharded_moe_params,
+)
+from .pipeline import spmd_pipeline
+from .sharding import (
+    llama_inference_specs,
+    shard_params,
+    shardings_for,
+    make_tp_prefill,
+    make_tp_decode,
+)
+from .train import (
+    init_sharded_params,
+    llama_param_specs,
+    make_train_step,
+)
+
+__all__ = [
+    "make_moe_mesh",
+    "make_moe_forward",
+    "make_moe_train_step",
+    "moe_param_specs",
+    "init_sharded_moe_params",
+    "initialize",
+    "make_hybrid_mesh",
+    "process_local_batch",
+    "dcn_aware_store_targets",
+    "MeshShape",
+    "factor_devices",
+    "make_mesh",
+    "make_ring_attention",
+    "ring_attention_local",
+    "tp_layer_forward",
+    "spmd_pipeline",
+    "llama_inference_specs",
+    "shard_params",
+    "shardings_for",
+    "make_tp_prefill",
+    "make_tp_decode",
+    "init_sharded_params",
+    "llama_param_specs",
+    "make_train_step",
+]

@@ -1,0 +1,154 @@
+"""Multi-host distributed runtime: process init + ICI/DCN-aware meshes.
+
+The reference scales across hosts with NCCL/MPI ranks and an RDMA fabric
+(reference: docs/source/design.rst transfer-engine; src/rdma.cpp); the
+TPU-native equivalent is the JAX distributed runtime + one global mesh whose
+axes are laid out so collective traffic matches link bandwidth:
+
+* axes that communicate per-layer (tp) or per-attention (sp) stay INSIDE a
+  slice (ICI);
+* the once-per-step axis (dp) spans slices/hosts (DCN).
+
+``initialize()`` wires up jax.distributed from explicit arguments or the
+standard cluster env vars; ``make_hybrid_mesh`` builds the (dp, pp, sp, tp)
+mesh with dp mapped across DCN via
+``jax.experimental.mesh_utils.create_hybrid_device_mesh``.
+
+On a single host both degrade gracefully (no-op init, plain mesh), so the
+same launcher script runs everywhere -- the moral equivalent of the
+reference server not caring whether a client is local or remote.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from .mesh import AXES, MeshShape, factor_devices
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Initialize the JAX distributed runtime (idempotent).
+
+    Arguments default to the standard env vars (JAX_COORDINATOR_ADDRESS /
+    NUM_PROCESSES / PROCESS_ID, or cloud-TPU metadata when none are set).
+    Single-process with no env configured is a no-op.
+    """
+    already = getattr(jax.distributed, "is_initialized", None)
+    if callable(already) and jax.distributed.is_initialized():
+        return
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS"
+    )
+    if num_processes is None and os.environ.get("JAX_NUM_PROCESSES"):
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is None and os.environ.get("JAX_PROCESS_ID"):
+        process_id = int(os.environ["JAX_PROCESS_ID"])
+    if coordinator_address is None and num_processes is None:
+        return  # single-process / TPU-VM auto-detection handles itself
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def make_hybrid_mesh(
+    shape: Optional[MeshShape] = None,
+    *,
+    dcn_dp: Optional[int] = None,
+    **axis_sizes: int,
+) -> Mesh:
+    """A (dp, pp, sp, tp) mesh that spans hosts/slices.
+
+    ``dcn_dp`` is the data-parallel degree mapped across DCN (defaults to
+    ``jax.process_count()`` when >1).  The per-slice remainder is factored
+    tp-first like ``make_mesh``.  Example on 2 hosts x 8 chips:
+
+        make_hybrid_mesh(tp=4)  ->  dp=4 (2 over DCN x 2 over ICI), tp=4
+    """
+    from jax.experimental import mesh_utils
+
+    n_procs = jax.process_count()
+    if dcn_dp is None:
+        dcn_dp = n_procs if n_procs > 1 else 1
+    n_total = len(jax.devices())
+    per_dcn = n_total // dcn_dp
+    if shape is None:
+        caps = dict(axis_sizes)
+        unknown = set(caps) - {"dp", "tp", "sp", "pp"}
+        if unknown:
+            raise TypeError(f"unknown mesh axes: {sorted(unknown)}")
+        if caps:
+            # pinned axes are honored exactly; unpinned ones default to 1
+            # and dp absorbs the remainder
+            pinned = {ax: caps.get(ax, 1) for ax in ("tp", "sp", "pp")}
+            denom = pinned["tp"] * pinned["sp"] * pinned["pp"]
+            if per_dcn % denom != 0:
+                raise ValueError(
+                    f"{per_dcn} devices per DCN group not divisible by "
+                    f"tp*sp*pp = {denom}"
+                )
+            dp = per_dcn // denom
+            if "dp" in caps and caps["dp"] != dp:
+                raise ValueError(
+                    f"dp={caps['dp']} inconsistent: {per_dcn} devices per DCN "
+                    f"group / (tp*sp*pp = {denom}) = {dp}"
+                )
+            shape = MeshShape(dp=dp, **pinned)
+        else:
+            shape = factor_devices(per_dcn)
+    if dcn_dp == 1:
+        devs = mesh_utils.create_device_mesh(shape.as_tuple())
+        return Mesh(devs, AXES)
+    per_slice = (shape.dp, shape.pp, shape.sp, shape.tp)
+    devs = mesh_utils.create_hybrid_device_mesh(
+        per_slice, (dcn_dp, 1, 1, 1)
+    )  # dp outermost over DCN
+    return Mesh(devs, AXES)
+
+
+def process_local_batch(global_batch: int) -> int:
+    """Per-process batch share (data loading happens per host)."""
+    n = jax.process_count()
+    assert global_batch % n == 0, (global_batch, n)
+    return global_batch // n
+
+
+def _local_addresses() -> set:
+    import socket
+
+    addrs = {"127.0.0.1", "localhost", "::1"}
+    try:
+        hostname = socket.gethostname()
+        addrs.add(hostname)
+        for info in socket.getaddrinfo(hostname, None):
+            addrs.add(info[4][0])
+    except OSError:
+        pass
+    return addrs
+
+
+def dcn_aware_store_targets(
+    hosts: Sequence[str], my_rank: Optional[int] = None
+) -> str:
+    """Pick the store endpoint for this process: a host in the list that is
+    THIS machine wins (the SHM zero-copy path), otherwise rank-affine round
+    robin over DCN -- mirrors how the reference routes clients to the
+    nearest instance."""
+    if not hosts:
+        raise ValueError("no store hosts")
+    local = _local_addresses()
+    for h in hosts:
+        if h in local:
+            return h
+    rank = jax.process_index() if my_rank is None else my_rank
+    return hosts[rank % len(hosts)]

@@ -1,0 +1,104 @@
+"""Manual-SPMD transformer layer: Megatron tensor parallel + ring-attention
+sequence parallel, written as the per-device body of a ``shard_map``.
+
+Sharding contract (what each device holds):
+  x          [B_loc, S_loc, dim]      batch over dp, sequence over sp,
+                                      features replicated over tp
+  wq/wk/wv   [dim, (H/tp)*hd]         column parallel (output sharded)
+  wo         [(H/tp)*hd, dim]         row parallel (input sharded) -> psum
+  w_gate/up  [dim, F/tp]              column parallel
+  w_down     [F/tp, dim]              row parallel -> psum
+  ln_*       [dim]                    replicated
+
+Per layer exactly two tp all-reduces (attention output + MLP output) --
+the Megatron schedule -- and one sp ring inside attention.  Everything else
+is local MXU work in bf16.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..models.attention import apply_rope
+from ..models.llama import LlamaConfig, rmsnorm
+from .ring import ring_attention_local
+
+
+def tp_layer_forward(
+    layer,
+    x: jax.Array,
+    positions: jax.Array,
+    cfg: LlamaConfig,
+    tp: int,
+    tp_axis: str = "tp",
+    sp_axis: str = "sp",
+) -> jax.Array:
+    """One decoder layer, tp/sp-manual.  x: [B, S_loc, dim] local."""
+    B, S, _ = x.shape
+    hd = cfg.head_dim
+    h_loc = cfg.n_heads // tp
+    hkv_loc = cfg.n_kv_heads // tp
+
+    h = rmsnorm(x, layer["ln_attn"], cfg.norm_eps)
+    q = (h @ layer["wq"]).reshape(B, S, h_loc, hd)
+    k = (h @ layer["wk"]).reshape(B, S, hkv_loc, hd)
+    v = (h @ layer["wv"]).reshape(B, S, hkv_loc, hd)
+    q = apply_rope(q, positions, cfg.rope_theta)
+    k = apply_rope(k, positions, cfg.rope_theta)
+    attn = ring_attention_local(q, k, v, sp_axis)  # [B, S, h_loc, hd]
+    attn_out = attn.reshape(B, S, h_loc * hd) @ layer["wo"]
+    x = x + lax.psum(attn_out, tp_axis)
+
+    h = rmsnorm(x, layer["ln_mlp"], cfg.norm_eps)
+    mlp = (jax.nn.silu(h @ layer["w_gate"]) * (h @ layer["w_up"])) @ layer["w_down"]
+    x = x + lax.psum(mlp, tp_axis)
+    return x
+
+
+@partial(jax.custom_jvp, nondiff_argnums=(1,))
+def _pmax_stopgrad(x, axis_name):
+    return lax.pmax(x, axis_name)
+
+
+@_pmax_stopgrad.defjvp
+def _pmax_stopgrad_jvp(axis_name, primals, tangents):
+    out = lax.pmax(primals[0], axis_name)
+    return out, out * 0.0
+
+
+def tp_cross_entropy(
+    x: jax.Array,
+    lm_head_loc: jax.Array,
+    targets: jax.Array,
+    valid: jax.Array,
+    tp: int,
+    tp_axis: str = "tp",
+) -> jax.Array:
+    """Sum of next-token NLL with the vocabulary sharded over ``tp_axis``.
+
+    x: [..., dim] final hidden states (replicated over tp);
+    lm_head_loc: [dim, V/tp] this device's vocab shard;
+    targets: [...] global token ids; valid: [...] bool mask.
+    Returns the *local* masked sum (caller psums over dp/sp as needed);
+    the value is already unvarying over tp.
+    """
+    v_loc = lm_head_loc.shape[1]
+    tpi = lax.axis_index(tp_axis)
+    lo = tpi * v_loc
+    logits = (x @ lm_head_loc).astype(jnp.float32)  # [..., V/tp]
+    # global max as a numerical stabilizer (logsumexp is shift-invariant, so
+    # zero gradient through it is exact; pmax has no autodiff rule, and its
+    # output must stay VMA-invariant over tp for the replicated loss)
+    m = _pmax_stopgrad(logits.max(-1), tp_axis)
+    z = lax.psum(jnp.exp(logits - m[..., None]).sum(-1), tp_axis)
+    logz = m + jnp.log(z)
+    t_loc = jnp.clip(targets - lo, 0, v_loc - 1)
+    t_logit = jnp.take_along_axis(logits, t_loc[..., None], axis=-1)[..., 0]
+    in_range = (targets >= lo) & (targets < lo + v_loc)
+    t_logit = lax.psum(jnp.where(in_range, t_logit, 0.0), tp_axis)
+    nll = logz - t_logit
+    return jnp.where(valid, nll, 0.0).sum()

@@ -1,0 +1,166 @@
+"""Expert parallelism: the MoE train/serve step on a (dp, ep) mesh.
+
+Experts shard over the ``ep`` axis (each device owns E/ep experts, the whole
+stacked [L, E, ...] leaves split on axis 1); tokens shard over ``dp``.
+Inside the shard_map every device runs attention on its token shard
+(replicated over ep), computes ONLY its local experts' FFN contributions
+weighted by the globally-computed top-k gates, and one ``psum`` over ep
+combines expert outputs -- the transpose gives the expert-grad exchange in
+backward automatically.
+
+This is the dense no-token-dropping formulation of expert parallelism: the
+collective cost is one psum per MoE layer (same shape as a tp allreduce)
+instead of a pair of all_to_alls, shapes stay static, and the math equals
+models/moe.py's single-device forward exactly (tests/test_moe.py).  A
+capacity-based all_to_all dispatch (FLOP-sparse top-k) drops into the same
+param layout later.
+
+The reference's multi-node scaling is NCCL ranks moving KV (reference:
+docs/source/design.rst); here scaling model *compute* across chips is XLA
+collectives over the same mesh the KV tier serves.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.llama import rmsnorm, _attn_qkv, _layer
+from ..models.attention import causal_attention
+from ..models.moe import MoEConfig, init_moe_params, top_k_gates
+from .sharding import shardings_for
+
+MOE_AXES = ("dp", "ep")
+
+
+def make_moe_mesh(dp: int = 1, ep: int = 1):
+    devs = jax.devices()
+    need = dp * ep
+    if len(devs) < need:
+        raise ValueError(f"moe mesh {dp}x{ep} needs {need} devices, have {len(devs)}")
+    arr = np.asarray(devs[:need]).reshape(dp, ep)
+    return Mesh(arr, MOE_AXES)
+
+
+def moe_param_specs(cfg: MoEConfig) -> dict:
+    """Experts shard over ep on the stacked leaves' axis 1 ([L, E, ...]);
+    attention, router, norms, embeddings stay replicated (their grads psum
+    over dp x ep via the shard_map transpose)."""
+    layer_specs = {
+        "wq": P(), "wk": P(), "wv": P(), "wo": P(),
+        "router": P(),
+        "w_gate": P(None, "ep", None, None),
+        "w_up": P(None, "ep", None, None),
+        "w_down": P(None, "ep", None, None),
+        "ln_attn": P(), "ln_mlp": P(),
+    }
+    return {"embed": P(), "layers": layer_specs, "ln_out": P(), "lm_head": P()}
+
+
+def init_sharded_moe_params(cfg: MoEConfig, mesh: Mesh, key: jax.Array):
+    shardings = shardings_for(mesh, moe_param_specs(cfg))
+    return jax.jit(partial(init_moe_params, cfg), out_shardings=shardings)(key)
+
+
+def _local_moe_ffn(layer, x, cfg: MoEConfig, ep: int):
+    """Local-expert FFN contribution + psum over ep (exact dense MoE)."""
+    E = cfg.n_experts
+    E_loc = E // ep
+    ei = lax.axis_index("ep")
+    # gates over ALL experts (router is replicated), then slice our window
+    gates = top_k_gates(x.astype(jnp.float32) @ layer["router"], cfg.top_k)
+    gates_loc = lax.dynamic_slice_in_dim(gates, ei * E_loc, E_loc, axis=-1)
+    h = jax.nn.silu(jnp.einsum("bsd,edf->bsef", x, layer["w_gate"]))
+    h = h * jnp.einsum("bsd,edf->bsef", x, layer["w_up"])
+    out = jnp.einsum("bsef,efd->bsed", h, layer["w_down"])
+    part = jnp.einsum("bsed,bse->bsd", out, gates_loc.astype(x.dtype))
+    return lax.psum(part, "ep")
+
+
+def make_moe_train_step(
+    cfg: MoEConfig,
+    mesh: Mesh,
+    lr: float = 1e-3,
+):
+    """Jitted ``step(params, tokens[B, S]) -> (params, loss)`` on (dp, ep).
+
+    tokens sharded P("dp", None); experts sharded over ep; attention runs
+    replicated across ep shards (its weights are replicated and its cost is
+    amortized over E/ep experts' worth of FFN work).
+    """
+    dp = mesh.shape["dp"]
+    ep = mesh.shape["ep"]
+    if cfg.n_experts % ep != 0:
+        raise ValueError(f"n_experts {cfg.n_experts} % ep {ep} != 0")
+
+    def local_loss(params, tokens):
+        B_loc, S = tokens.shape
+        positions = jnp.broadcast_to(jnp.arange(S), (B_loc, S))
+        x = params["embed"][tokens]
+        for li in range(cfg.n_layers):
+            layer = _layer(li)(params["layers"])
+            h = rmsnorm(x, layer["ln_attn"], cfg.norm_eps)
+            q, k, v = _attn_qkv(layer, cfg, h, positions)
+            attn = causal_attention(q, k, v)
+            x = x + attn.reshape(B_loc, S, -1) @ layer["wo"]
+            h = rmsnorm(x, layer["ln_mlp"], cfg.norm_eps)
+            x = x + _local_moe_ffn(layer, h, cfg, ep)
+        x = rmsnorm(x, params["ln_out"], cfg.norm_eps)
+        logits = x @ params["lm_head"]
+        logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
+        tgt = tokens[:, 1:]
+        nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+        loss_sum = lax.psum(nll.sum(), "dp")
+        n_tokens = B_loc * dp * (S - 1)
+        return loss_sum / n_tokens
+
+    sharded_loss = jax.shard_map(
+        local_loss,
+        mesh=mesh,
+        in_specs=(moe_param_specs(cfg), P("dp", None)),
+        out_specs=P(),
+        axis_names={"dp", "ep"},
+    )
+
+    @partial(jax.jit, donate_argnums=0)
+    def step(params, tokens):
+        loss, grads = jax.value_and_grad(sharded_loss)(params, tokens)
+        params = jax.tree.map(lambda p, g: p - lr * g.astype(p.dtype), params, grads)
+        return params, loss
+
+    return step
+
+
+def make_moe_forward(cfg: MoEConfig, mesh: Mesh):
+    """Jitted expert-parallel forward: (params, tokens[B, S]) -> logits."""
+    ep = mesh.shape["ep"]
+
+    def local_fwd(params, tokens):
+        B_loc, S = tokens.shape
+        positions = jnp.broadcast_to(jnp.arange(S), (B_loc, S))
+        x = params["embed"][tokens]
+        for li in range(cfg.n_layers):
+            layer = _layer(li)(params["layers"])
+            h = rmsnorm(x, layer["ln_attn"], cfg.norm_eps)
+            q, k, v = _attn_qkv(layer, cfg, h, positions)
+            attn = causal_attention(q, k, v)
+            x = x + attn.reshape(B_loc, S, -1) @ layer["wo"]
+            h = rmsnorm(x, layer["ln_mlp"], cfg.norm_eps)
+            x = x + _local_moe_ffn(layer, h, cfg, ep)
+        x = rmsnorm(x, params["ln_out"], cfg.norm_eps)
+        return x @ params["lm_head"]
+
+    fn = jax.shard_map(
+        local_fwd,
+        mesh=mesh,
+        in_specs=(moe_param_specs(cfg), P("dp", None)),
+        out_specs=P("dp", None, None),
+        axis_names={"dp", "ep"},
+    )
+    return jax.jit(fn)

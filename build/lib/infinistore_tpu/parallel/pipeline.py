@@ -1,0 +1,70 @@
+"""GPipe-style pipeline parallelism as the per-device body of a shard_map.
+
+The stacked layer axis of the params is sharded over the ``pp`` mesh axis,
+so each device (stage) holds ``n_layers/pp`` consecutive layers.
+Microbatches stream through the stages; activations hop stage->stage with a
+non-cyclic ``lax.ppermute`` each tick.  After ``M + pp - 1`` ticks every
+microbatch has flowed through every stage.  Bubble ticks compute on don't-
+care data and are masked out of the output (their gradients are exactly
+zero through the masking ``where``).
+
+The schedule is differentiable: scan + ppermute + where all have exact
+transposes, so the backward pass is the mirrored pipeline (cotangents hop
+backward through the transposed ppermute).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def spmd_pipeline(
+    stage_fn: Callable[[jax.Array], jax.Array],
+    x_mbs: jax.Array,
+    axis_name: str = "pp",
+) -> jax.Array:
+    """Run microbatches through the pipeline.
+
+    stage_fn: activation [mb, ...] -> [mb, ...] applying *this stage's*
+    layers (closure over the stage-local params).
+    x_mbs: [M, mb, ...] all microbatch inputs (available on every stage;
+    only stage 0 actually consumes them).
+    Returns [M, mb, ...] outputs -- valid on the LAST stage only; other
+    stages return zeros in their place.  Callers typically reduce with
+    ``lax.psum(out, axis_name)`` (cheap for a scalar loss) or mask by
+    ``lax.axis_index(axis_name) == pp - 1``.
+    """
+    pp = lax.psum(1, axis_name)
+    stage = lax.axis_index(axis_name)
+    M = x_mbs.shape[0]
+    mb_shape = x_mbs.shape[1:]
+
+    def tick(carry, t):
+        x_recv, outs = carry
+        mb_idx = t - stage  # which microbatch this stage works on this tick
+        x_in = jnp.where(stage == 0, x_mbs[jnp.clip(t, 0, M - 1)], x_recv)
+        y = stage_fn(x_in)
+        active = (mb_idx >= 0) & (mb_idx < M) & (stage == pp - 1)
+        w = jnp.clip(mb_idx, 0, M - 1)
+        outs = outs.at[w].set(jnp.where(active, y, outs[w]))
+        # shift forward one stage (stage pp-1 sends nowhere; stage 0
+        # receives zeros, which it ignores)
+        x_send = lax.ppermute(
+            y, axis_name, [(j, j + 1) for j in range(pp - 1)]
+        )
+        return (x_send, outs), None
+
+    def pvary(x):  # add axis_name to x's varying set (idempotent)
+        if axis_name in jax.typeof(x).vma:
+            return x
+        return lax.pcast(x, (axis_name,), to="varying")
+
+    x0 = pvary(x_mbs[0] * 0)
+    outs0 = pvary(jnp.zeros_like(x_mbs))
+    (_, outs), _ = lax.scan(tick, (x0, outs0), jnp.arange(M + pp - 1))
+    # zero out non-last stages so a psum broadcast is also correct
+    return jnp.where(stage == pp - 1, outs, 0.0)

@@ -1,0 +1,102 @@
+"""Auto-sharding path for serving: annotate params with NamedShardings and
+let XLA's SPMD partitioner insert the tp collectives.
+
+Where parallel/train.py is fully manual (the schedule matters there --
+pipeline and ring), inference prefill/decode use the compiler-driven path:
+shard the weights Megatron-style, give jit the input shardings, and XLA
+produces the same two-allreduce-per-layer program without any hand-written
+collectives.  This is the recommended serving setup on a single slice
+(tp over ICI, dp over hosts for replica parallelism).
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.llama import LlamaConfig, decode_forward, prefill_forward
+
+
+def llama_inference_specs() -> dict:
+    """Tensor-parallel specs for the stacked param pytree (no pp: the layer
+    axis stays replicated; serving pipelines span engines, not chips)."""
+    layer_specs = {
+        "wq": P(None, None, "tp"),
+        "wk": P(None, None, "tp"),
+        "wv": P(None, None, "tp"),
+        "wo": P(None, "tp", None),
+        "w_gate": P(None, None, "tp"),
+        "w_up": P(None, None, "tp"),
+        "w_down": P(None, "tp", None),
+        "ln_attn": P(None, None),
+        "ln_mlp": P(None, None),
+    }
+    return {
+        "embed": P(),
+        "layers": layer_specs,
+        "ln_out": P(),
+        "lm_head": P(None, "tp"),
+    }
+
+
+def shard_params(params, mesh: Mesh, specs=None):
+    if specs is None:
+        specs = llama_inference_specs()
+    return jax.device_put(params, shardings_for(mesh, specs))
+
+
+def shardings_for(mesh: Mesh, specs):
+    """PartitionSpec pytree -> NamedSharding pytree on ``mesh``."""
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s), specs, is_leaf=lambda x: isinstance(x, P)
+    )
+
+
+def make_tp_prefill(cfg: LlamaConfig, mesh: Mesh):
+    """Jitted tensor-parallel prefill: (params, tokens[B,S]) -> (logits, kv).
+
+    KV comes out sharded over tp on the head axis ([L, 2, B, S, Hkv, D]).
+    Paging it into the HBM cache (layout [L, 2, H_kv, n_blocks, T, D],
+    heads outside blocks) goes through kv/cache.py:prefill_to_pages, whose
+    transpose is tp-local -- the head axis stays sharded throughout.
+    """
+    data = NamedSharding(mesh, P("dp", None))
+    kv_sharding = NamedSharding(mesh, P(None, None, "dp", None, "tp", None))
+    logits_sharding = NamedSharding(mesh, P("dp", None, "tp"))
+
+    def fn(params, tokens):
+        return prefill_forward(params, cfg, tokens)
+
+    return jax.jit(
+        fn,
+        in_shardings=(shardings_for(mesh, llama_inference_specs()), data),
+        out_shardings=(logits_sharding, kv_sharding),
+    )
+
+
+def make_tp_decode(cfg: LlamaConfig, mesh: Mesh):
+    """Jitted tensor-parallel paged decode step (see models.llama.decode_forward)."""
+    repl = NamedSharding(mesh, P())
+    # cache [L, 2, H_kv, n_blocks, T, D]: shard the KV-head axis over tp so
+    # decode stays head-local (matches the head-sharded wk/wv)
+    cache_sharding = NamedSharding(mesh, P(None, None, "tp", None, None, None))
+
+    def fn(params, tokens, positions, cache, block_table, seq_lens,
+           slot_block_ids, slot_ids):
+        # use_pallas=False: this jit is GSPMD-partitioned and pallas_call has
+        # no SPMD partitioning rule (see models/attention.py)
+        return decode_forward(params, cfg, tokens, positions, cache,
+                              block_table, seq_lens, slot_block_ids, slot_ids,
+                              use_pallas=False)
+
+    # donate the cache: it dominates HBM, and the functional update must not
+    # allocate a second copy per token
+    return jax.jit(
+        fn,
+        in_shardings=(
+            shardings_for(mesh, llama_inference_specs()),
+            repl, repl, cache_sharding, repl, repl, repl, repl,
+        ),
+        out_shardings=(NamedSharding(mesh, P(None, "tp")), cache_sharding),
+        donate_argnums=3,
+    )

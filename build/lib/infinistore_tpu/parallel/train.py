@@ -1,0 +1,164 @@
+"""The full sharded training step: dp x pp x sp x tp on one 4-axis mesh.
+
+Composition (all inside ONE ``shard_map`` region, fully manual):
+  * dp -- batch sharded; gradients all-reduce via the transpose of the
+    scalar-loss psum (once per step; the axis that can span DCN).
+  * pp -- stacked layer axis sharded; GPipe microbatch schedule with
+    ppermute (parallel/pipeline.py).
+  * sp -- sequence sharded; ring attention (parallel/ring.py) plus a
+    one-token boundary exchange for next-token targets.
+  * tp -- Megatron column/row parallel with two psums per layer
+    (parallel/layers.py); vocabulary-sharded cross entropy.
+
+The gradient is ``jax.value_and_grad`` *through* the shard_map: every
+collective in the forward has an exact transpose (psum <-> broadcast,
+ppermute <-> inverse ppermute), so the backward pass is the mirrored
+schedule.  Verified against the single-device ``models.llama.loss_fn`` in
+tests/test_parallel.py.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..models.llama import LlamaConfig, init_params, rmsnorm
+from .layers import tp_cross_entropy, tp_layer_forward
+from .pipeline import spmd_pipeline
+from .sharding import shardings_for
+
+
+def llama_param_specs(cfg: LlamaConfig) -> dict:
+    """PartitionSpecs for the ``init_params`` pytree on a (dp,pp,sp,tp) mesh.
+
+    Layer stack [L, ...] shards over pp; matmul weights shard over tp
+    Megatron-style (column for in->hidden, row for hidden->out); norms and
+    the embedding stay replicated (their grads psum over the data axes via
+    the shard_map transpose).
+    """
+    layer_specs = {
+        "wq": P("pp", None, "tp"),
+        "wk": P("pp", None, "tp"),
+        "wv": P("pp", None, "tp"),
+        "wo": P("pp", "tp", None),
+        "w_gate": P("pp", None, "tp"),
+        "w_up": P("pp", None, "tp"),
+        "w_down": P("pp", "tp", None),
+        "ln_attn": P("pp", None),
+        "ln_mlp": P("pp", None),
+    }
+    return {
+        "embed": P(),
+        "layers": layer_specs,
+        "ln_out": P(),
+        "lm_head": P(None, "tp"),
+    }
+
+
+def init_sharded_params(cfg: LlamaConfig, mesh: Mesh, key: jax.Array):
+    """Initialize params directly into their mesh shardings (no host copy)."""
+    shardings = shardings_for(mesh, llama_param_specs(cfg))
+    return jax.jit(partial(init_params, cfg), out_shardings=shardings)(key)
+
+
+def _check_divisible(cfg: LlamaConfig, mesh: Mesh, batch: int, seq: int, n_mb: int):
+    ax = mesh.shape
+    checks = [
+        (cfg.n_layers % ax["pp"] == 0, "n_layers % pp"),
+        (cfg.n_heads % ax["tp"] == 0, "n_heads % tp"),
+        (cfg.n_kv_heads % ax["tp"] == 0, "n_kv_heads % tp"),
+        (cfg.vocab_size % ax["tp"] == 0, "vocab_size % tp"),
+        (cfg.ffn_dim % ax["tp"] == 0, "ffn_dim % tp"),
+        (seq % ax["sp"] == 0, "seq % sp"),
+        (batch % ax["dp"] == 0, "batch % dp"),
+        ((batch // ax["dp"]) % n_mb == 0, "local batch % n_microbatches"),
+    ]
+    for ok, what in checks:
+        if not ok:
+            raise ValueError(f"sharding constraint violated: {what} != 0 "
+                             f"(mesh {dict(ax)}, batch={batch}, seq={seq}, M={n_mb})")
+
+
+def make_train_step(
+    cfg: LlamaConfig,
+    mesh: Mesh,
+    lr: float = 1e-3,
+    n_microbatches: Optional[int] = None,
+):
+    """Returns jitted ``step(params, tokens) -> (params, loss)``.
+
+    ``tokens``: [B, S] int32, sharded P("dp", "sp").  The first call
+    validates divisibility constraints against the actual shapes.
+    """
+    pp = mesh.shape["pp"]
+    sp = mesh.shape["sp"]
+    tp = mesh.shape["tp"]
+    M = n_microbatches or pp
+
+    def local_loss(params, tokens):
+        # per-device: params are local shards, tokens [B_loc, S_loc]
+        stage = lax.axis_index("pp")
+        spi = lax.axis_index("sp")
+        B_loc, S_loc = tokens.shape
+        S_glob = S_loc * sp
+        mb = B_loc // M
+
+        x = params["embed"][tokens]  # [B_loc, S_loc, dim]
+        positions = spi * S_loc + jnp.arange(S_loc)  # global positions
+
+        def stage_fn(xm):
+            def body(xc, layer):
+                return tp_layer_forward(layer, xc, positions, cfg, tp=tp), None
+            xm, _ = lax.scan(body, xm, params["layers"])
+            return xm
+
+        x_mbs = x.reshape(M, mb, S_loc, -1)
+        x_mbs = lax.pcast(x_mbs, ("pp",), to="varying")
+        outs = spmd_pipeline(stage_fn, x_mbs, "pp")  # valid on last stage
+        hs = outs.reshape(B_loc, S_loc, -1)
+        hs = rmsnorm(hs, params["ln_out"], cfg.norm_eps)
+
+        # next-token targets; sequence chunk j needs chunk j+1's first token
+        first_next = lax.ppermute(
+            tokens[:, :1], "sp", [(j, j - 1) for j in range(1, sp)]
+        )
+        targets = jnp.concatenate([tokens[:, 1:], first_next], axis=1)
+        valid = jnp.broadcast_to(
+            (spi * S_loc + jnp.arange(S_loc)) < S_glob - 1, targets.shape
+        )
+        loss_sum = tp_cross_entropy(hs, params["lm_head"], targets, valid, tp=tp)
+        loss_sum = lax.psum(loss_sum, ("dp", "sp"))
+        # only the last pipeline stage computed real logits
+        loss_sum = lax.psum(jnp.where(stage == pp - 1, loss_sum, 0.0), "pp")
+        n_tokens = tokens.shape[0] * mesh.shape["dp"] * (S_glob - 1)
+        return loss_sum / n_tokens
+
+    param_specs = llama_param_specs(cfg)
+    sharded_loss = jax.shard_map(
+        local_loss,
+        mesh=mesh,
+        in_specs=(param_specs, P("dp", "sp")),
+        out_specs=P(),
+        axis_names={"dp", "pp", "sp", "tp"},
+    )
+
+    checked = [False]
+
+    @partial(jax.jit, donate_argnums=0)
+    def step(params, tokens):
+        loss, grads = jax.value_and_grad(sharded_loss)(params, tokens)
+        params = jax.tree.map(lambda p, g: p - lr * g.astype(p.dtype), params, grads)
+        return params, loss
+
+    def step_checked(params, tokens):
+        if not checked[0]:
+            _check_divisible(cfg, mesh, tokens.shape[0], tokens.shape[1], M)
+            checked[0] = True
+        return step(params, tokens)
+
+    return step_checked

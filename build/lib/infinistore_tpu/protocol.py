@@ -1,0 +1,245 @@
+"""Wire protocol for the infinistore-tpu data plane.
+
+Own binary framing (little-endian, hand-rolled) shared by the Python client,
+the pure-Python server and the C++ native runtime (``src/protocol.h`` mirrors
+these layouts).  The reference uses flatbuffers messages behind a packed
+``{magic, op, body_size}`` header (reference: src/protocol.h:35-72); we keep
+the same concept with a fixed header and flat structs, no flatbuffers
+dependency.
+
+Request frame:   header_t | body
+Response frame:  status:i32 | body_len:u32 | body
+
+Zero-copy ops (the TPU analog of the reference's RDMA READ/WRITE path,
+reference: src/infinistore.cpp:558-640):
+
+* ALLOC_PUT  -- server allocates pool regions for a batch of keys and returns
+               (pool_idx, offset) descriptors; the client memcpys payloads
+               straight into the shared-memory pool.
+* COMMIT_PUT -- marks the batch visible (the analog of the reference's
+               RDMA commit message, src/infinistore.cpp:405-418).
+* GET_DESC   -- returns descriptors of committed entries for direct
+               shared-memory reads (the RDMA-READ analog).
+
+Inline ops carry payloads through the socket for cross-host (DCN) clients,
+mirroring the reference's OP_TCP_PUT/OP_TCP_GET (src/infinistore.cpp:236-297).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import List, Sequence, Tuple
+
+MAGIC = 0x54504B56  # "VKPT"
+VERSION = 1
+
+# header_t: magic u32 | version u8 | op u8 | flags u16 | body_len u32 | req_id u32
+HEADER = struct.Struct("<IBBHII")
+HEADER_SIZE = HEADER.size  # 16
+
+# response: status i32 | body_len u32
+RESP = struct.Struct("<iI")
+RESP_SIZE = RESP.size  # 8
+
+# ---- ops ----
+OP_HELLO = 1
+OP_PUT_INLINE = 2
+OP_GET_INLINE = 3
+OP_ALLOC_PUT = 4
+OP_COMMIT_PUT = 5
+OP_GET_DESC = 6
+OP_EXIST = 7
+OP_MATCH_LAST_IDX = 8
+OP_DELETE_KEYS = 9
+OP_PURGE = 10
+OP_STATS = 11
+OP_EVICT = 12
+OP_PUT_INLINE_BATCH = 13
+OP_GET_INLINE_BATCH = 14
+OP_POOLS = 15
+
+_OP_NAMES = {
+    OP_HELLO: "HELLO",
+    OP_PUT_INLINE: "PUT_INLINE",
+    OP_GET_INLINE: "GET_INLINE",
+    OP_ALLOC_PUT: "ALLOC_PUT",
+    OP_COMMIT_PUT: "COMMIT_PUT",
+    OP_GET_DESC: "GET_DESC",
+    OP_EXIST: "EXIST",
+    OP_MATCH_LAST_IDX: "MATCH_LAST_IDX",
+    OP_DELETE_KEYS: "DELETE_KEYS",
+    OP_PURGE: "PURGE",
+    OP_STATS: "STATS",
+    OP_EVICT: "EVICT",
+    OP_PUT_INLINE_BATCH: "PUT_INLINE_BATCH",
+    OP_GET_INLINE_BATCH: "GET_INLINE_BATCH",
+    OP_POOLS: "POOLS",
+}
+
+
+def op_name(op: int) -> str:
+    """Reference parity: src/protocol.cpp op_name()."""
+    return _OP_NAMES.get(op, f"UNKNOWN({op})")
+
+
+# ---- status codes (same numbers as reference src/protocol.h:55-62) ----
+INVALID_REQ = 400
+FINISH = 200
+TASK_ACCEPTED = 202
+INTERNAL_ERROR = 500
+KEY_NOT_FOUND = 404
+RETRY = 408
+SYSTEM_ERROR = 503
+OUT_OF_MEMORY = 507
+
+
+def pack_header(op: int, body_len: int, req_id: int = 0, flags: int = 0) -> bytes:
+    return HEADER.pack(MAGIC, VERSION, op, flags, body_len, req_id)
+
+
+def unpack_header(buf: bytes) -> Tuple[int, int, int, int]:
+    """Returns (op, flags, body_len, req_id).  Raises ValueError on bad magic."""
+    magic, ver, op, flags, body_len, req_id = HEADER.unpack(buf)
+    if magic != MAGIC:
+        raise ValueError(f"bad magic {magic:#x}")
+    if ver != VERSION:
+        raise ValueError(f"bad version {ver}")
+    return op, flags, body_len, req_id
+
+
+def pack_resp(status: int, body: bytes = b"") -> bytes:
+    return RESP.pack(status, len(body)) + body
+
+
+# ---- body builders / parsers ----
+
+_U16 = struct.Struct("<H")
+_U32 = struct.Struct("<I")
+_I32 = struct.Struct("<i")
+_U64 = struct.Struct("<Q")
+_DESC = struct.Struct("<IQQ")  # pool_idx u32 | offset u64 | size u64
+_F32x2 = struct.Struct("<ff")
+
+DESC_SIZE = _DESC.size  # 20
+
+
+def pack_keys(keys: Sequence[bytes]) -> bytes:
+    parts = [_U32.pack(len(keys))]
+    for k in keys:
+        parts.append(_U16.pack(len(k)))
+        parts.append(k)
+    return b"".join(parts)
+
+
+def unpack_keys(buf: memoryview, off: int = 0) -> Tuple[List[bytes], int]:
+    (n,) = _U32.unpack_from(buf, off)
+    off += 4
+    # untrusted count: every key needs >= 2 bytes (its u16 length), so a
+    # count beyond remaining/2 is malformed -- reject up front instead of
+    # looping billions of times on an adversarial frame
+    if n > (len(buf) - off) // 2:
+        raise ValueError(f"key count {n} exceeds body size")
+    keys = []
+    for _ in range(n):
+        (klen,) = _U16.unpack_from(buf, off)
+        off += 2
+        keys.append(bytes(buf[off : off + klen]))
+        off += klen
+    return keys, off
+
+
+def encode_keys(keys: Sequence) -> List[bytes]:
+    return [k.encode() if isinstance(k, str) else bytes(k) for k in keys]
+
+
+# HELLO: req = pid u32 | flags u32 ; resp = pool table (see pack_pool_table)
+def pack_hello(pid: int, flags: int = 0) -> bytes:
+    return _U32.pack(pid) + _U32.pack(flags)
+
+
+# pool table: n u32 | n x { name_len u16 | name | pool_size u64 | block_size u64 }
+def pack_pool_table(pools: Sequence[Tuple[str, int, int]]) -> bytes:
+    parts = [_U32.pack(len(pools))]
+    for name, pool_size, block_size in pools:
+        nb = name.encode()
+        parts.append(_U16.pack(len(nb)))
+        parts.append(nb)
+        parts.append(_U64.pack(pool_size))
+        parts.append(_U64.pack(block_size))
+    return b"".join(parts)
+
+
+def unpack_pool_table(buf: memoryview) -> List[Tuple[str, int, int]]:
+    (n,) = _U32.unpack_from(buf, 0)
+    off = 4
+    pools = []
+    for _ in range(n):
+        (nlen,) = _U16.unpack_from(buf, off)
+        off += 2
+        name = bytes(buf[off : off + nlen]).decode()
+        off += nlen
+        (pool_size,) = _U64.unpack_from(buf, off)
+        off += 8
+        (block_size,) = _U64.unpack_from(buf, off)
+        off += 8
+        pools.append((name, pool_size, block_size))
+    return pools
+
+
+# ALLOC_PUT: req = block_size u64 | keys ; resp = n x desc
+def pack_alloc_put(keys: Sequence[bytes], block_size: int) -> bytes:
+    return _U64.pack(block_size) + pack_keys(keys)
+
+
+def unpack_alloc_put(buf: memoryview) -> Tuple[List[bytes], int]:
+    (block_size,) = _U64.unpack_from(buf, 0)
+    keys, _ = unpack_keys(buf, 8)
+    return keys, block_size
+
+
+def pack_descs(descs: Sequence[Tuple[int, int, int]]) -> bytes:
+    return b"".join(_DESC.pack(p, o, s) for (p, o, s) in descs)
+
+
+def unpack_descs(buf: memoryview) -> List[Tuple[int, int, int]]:
+    n = len(buf) // DESC_SIZE
+    return [_DESC.unpack_from(buf, i * DESC_SIZE) for i in range(n)]
+
+
+# PUT_INLINE: req = key_len u16 | key | value_len u64 | value
+def pack_put_inline(key: bytes, value_len: int) -> bytes:
+    return _U16.pack(len(key)) + key + _U64.pack(value_len)
+
+
+def unpack_put_inline_head(buf: memoryview) -> Tuple[bytes, int, int]:
+    """Returns (key, value_len, header_consumed)."""
+    (klen,) = _U16.unpack_from(buf, 0)
+    key = bytes(buf[2 : 2 + klen])
+    (vlen,) = _U64.unpack_from(buf, 2 + klen)
+    return key, vlen, 2 + klen + 8
+
+
+# PUT_INLINE_BATCH: req = block_size u64 | keys, then n*block_size raw payload
+# GET_INLINE_BATCH: req = block_size u64 | keys ;
+#   resp = n x size:u32 | payloads concatenated at their stored sizes
+pack_put_inline_batch = pack_alloc_put
+pack_get_inline_batch = pack_alloc_put
+
+# MATCH_LAST_IDX resp / EXIST resp / DELETE resp: i32
+pack_i32 = _I32.pack
+
+
+def unpack_i32(buf) -> int:
+    (v,) = _I32.unpack_from(buf, 0)
+    return v
+
+
+pack_u64 = _U64.pack
+
+
+def pack_evict(min_threshold: float, max_threshold: float) -> bytes:
+    return _F32x2.pack(min_threshold, max_threshold)
+
+
+def unpack_evict(buf: memoryview) -> Tuple[float, float]:
+    return _F32x2.unpack_from(buf, 0)

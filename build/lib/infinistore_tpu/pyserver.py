@@ -1,0 +1,214 @@
+"""Pure-Python asyncio data-plane server.
+
+Portable fallback for the C++ native runtime (``src/store_server.cpp``);
+speaks the same wire protocol (``protocol.py``).  Mirrors the reference's
+single-threaded event-loop server (reference: src/infinistore.cpp:887-1029 --
+libuv READ_HEADER/READ_BODY state machine); asyncio's ``readexactly`` plays
+the role of the state machine, and inline payloads are streamed directly
+into pool memory just as the reference streams TCP values into the slab
+(src/infinistore.cpp:942-960).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Optional
+
+from . import protocol as P
+from .store import Store
+from .utils.logging import Logger
+
+MAX_INLINE_BODY = 1 << 30
+
+
+class StoreServer:
+    def __init__(self, config, store: Optional[Store] = None):
+        self.config = config
+        self.store = store or Store(config)
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._evict_task = None
+
+    async def start(self, host: str = "0.0.0.0") -> None:
+        self._server = await asyncio.start_server(
+            self._handle_conn, host, self.config.service_port, reuse_address=True
+        )
+        Logger.info(f"pyserver listening on {host}:{self.config.service_port}")
+
+    async def serve_forever(self) -> None:
+        if self._server is None:
+            await self.start()
+        async with self._server:
+            await self._server.serve_forever()
+
+    def start_periodic_evict(self) -> None:
+        async def _loop():
+            while True:
+                self.store.evict(
+                    self.config.evict_min_threshold, self.config.evict_max_threshold
+                )
+                await asyncio.sleep(self.config.evict_interval)
+
+        self._evict_task = asyncio.get_running_loop().create_task(_loop())
+
+    async def close(self) -> None:
+        if self._evict_task:
+            self._evict_task.cancel()
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+        self.store.close()
+
+    # ---- connection handling ----
+
+    async def _handle_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        # keys this connection has allocated but not yet committed; reclaimed
+        # if the client disconnects mid-write
+        conn_pending: set = set()
+        try:
+            while True:
+                try:
+                    raw = await reader.readexactly(P.HEADER_SIZE)
+                except (asyncio.IncompleteReadError, ConnectionResetError):
+                    break
+                try:
+                    op, flags, body_len, req_id = P.unpack_header(raw)
+                except ValueError as e:
+                    Logger.error(f"bad header: {e}")
+                    break
+                if body_len > MAX_INLINE_BODY:
+                    Logger.error(f"body too large: {body_len}")
+                    break
+                body = memoryview(await reader.readexactly(body_len)) if body_len else memoryview(b"")
+                resp = await self._dispatch(op, body, reader, writer, conn_pending)
+                if resp is not None:  # streaming ops write directly
+                    writer.write(resp)
+                await writer.drain()
+        except (ConnectionResetError, BrokenPipeError):
+            pass
+        except Exception as e:  # noqa: BLE001 - keep server alive
+            Logger.error(f"connection error: {e!r}")
+        finally:
+            if conn_pending:
+                self.store.abort_put(list(conn_pending))
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+    async def _dispatch(
+        self,
+        op: int,
+        body: memoryview,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+        conn_pending: set,
+    ) -> bytes | None:
+        st = self.store
+        if op == P.OP_HELLO:
+            return P.pack_resp(P.FINISH, P.pack_pool_table(st.mm.pool_table()))
+        if op == P.OP_POOLS:
+            return P.pack_resp(P.FINISH, P.pack_pool_table(st.mm.pool_table()))
+        if op == P.OP_PUT_INLINE:
+            key, vlen, consumed = P.unpack_put_inline_head(body)
+            payload = body[consumed : consumed + vlen]
+            if len(payload) != vlen:
+                return P.pack_resp(P.INVALID_REQ)
+            return P.pack_resp(st.put_inline(key, payload))
+        if op == P.OP_GET_INLINE:
+            keys, _ = P.unpack_keys(body)
+            if not keys:
+                return P.pack_resp(P.INVALID_REQ)
+            view = st.get_inline(keys[0])
+            if view is None:
+                return P.pack_resp(P.KEY_NOT_FOUND)
+            return P.pack_resp(P.FINISH, bytes(view))
+        if op == P.OP_ALLOC_PUT:
+            keys, block_size = P.unpack_alloc_put(body)
+            status, descs = st.alloc_put(keys, block_size)
+            if status == P.FINISH:
+                conn_pending.update(keys)
+            return P.pack_resp(status, P.pack_descs(descs))
+        if op == P.OP_COMMIT_PUT:
+            keys, _ = P.unpack_keys(body)
+            status, count = st.commit_put(keys)
+            conn_pending.difference_update(keys)
+            return P.pack_resp(status, P.pack_i32(count))
+        if op == P.OP_GET_DESC:
+            keys, block_size = P.unpack_alloc_put(body)
+            status, descs = st.get_desc(keys, block_size)
+            return P.pack_resp(status, P.pack_descs(descs))
+        if op == P.OP_EXIST:
+            keys, _ = P.unpack_keys(body)
+            if not keys:
+                return P.pack_resp(P.INVALID_REQ)
+            return P.pack_resp(P.FINISH, P.pack_i32(0 if st.exist(keys[0]) else 1))
+        if op == P.OP_MATCH_LAST_IDX:
+            keys, _ = P.unpack_keys(body)
+            return P.pack_resp(P.FINISH, P.pack_i32(st.match_last_index(keys)))
+        if op == P.OP_DELETE_KEYS:
+            keys, _ = P.unpack_keys(body)
+            return P.pack_resp(P.FINISH, P.pack_i32(st.delete_keys(keys)))
+        if op == P.OP_PURGE:
+            return P.pack_resp(P.FINISH, P.pack_i32(st.purge()))
+        if op == P.OP_STATS:
+            return P.pack_resp(P.FINISH, json.dumps(st.stats_dict()).encode())
+        if op == P.OP_EVICT:
+            mn, mx = P.unpack_evict(body)
+            st.evict(mn, mx)
+            return P.pack_resp(P.FINISH)
+        if op == P.OP_PUT_INLINE_BATCH:
+            # body carries block_size+keys; n*block_size payload follows the frame
+            keys, block_size = P.unpack_alloc_put(body)
+            status, descs = st.alloc_put(keys, block_size)
+            if status != P.FINISH:
+                # drain the payload to keep the stream in sync
+                remaining = block_size * len(keys)
+                while remaining > 0:
+                    chunk = await reader.read(min(remaining, 1 << 20))
+                    if not chunk:
+                        break
+                    remaining -= len(chunk)
+                return P.pack_resp(status)
+            # mark busy: a concurrent purge/realloc must not free these
+            # regions while we await payload chunks; track in conn_pending so
+            # a mid-stream disconnect reclaims them
+            conn_pending.update(keys)
+            for key in keys:
+                st.pending[key].busy = True
+            try:
+                for (pool_idx, offset, size) in descs:
+                    dst = st.mm.view(pool_idx, offset, size)
+                    got = 0
+                    while got < size:
+                        chunk = await reader.read(min(size - got, 1 << 20))
+                        if not chunk:
+                            st.abort_put(keys)
+                            return P.pack_resp(P.INVALID_REQ)
+                        dst[got : got + len(chunk)] = chunk
+                        got += len(chunk)
+            finally:
+                for key in keys:
+                    e = st.pending.get(key)
+                    if e is not None:
+                        e.busy = False
+            status, count = st.commit_put(keys)
+            conn_pending.difference_update(keys)
+            return P.pack_resp(status, P.pack_i32(count))
+        if op == P.OP_GET_INLINE_BATCH:
+            keys, block_size = P.unpack_alloc_put(body)
+            status, descs = st.get_desc(keys, block_size)
+            if status != P.FINISH:
+                return P.pack_resp(status)
+            # resp body = n x size:u32 | payloads streamed straight from the
+            # shm pool (no batch-sized intermediate copies)
+            total = sum(size for (_, _, size) in descs)
+            sizes = b"".join(P._U32.pack(size) for (_, _, size) in descs)
+            writer.write(P.RESP.pack(P.FINISH, len(sizes) + total))
+            writer.write(sizes)
+            for (pool_idx, offset, size) in descs:
+                writer.write(bytes(st.mm.view(pool_idx, offset, size)))
+                await writer.drain()
+            return None
+        return P.pack_resp(P.INVALID_REQ)

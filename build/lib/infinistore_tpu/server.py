@@ -1,0 +1,210 @@
+"""Server entrypoint (reference parity: infinistore/server.py).
+
+``python -m infinistore_tpu.server --service-port ... --manage-port ...``
+
+Runs the data-plane server (native C++ runtime when built, asyncio fallback
+otherwise) plus an HTTP manage plane with ``/selftest``, ``/purge``,
+``/kvmap_len``, ``/usage``, ``/metrics`` (reference exposes ``/purge`` and
+``/kvmap_len`` via FastAPI; we use stdlib http.server to stay dependency-free
+on TPU-VM images).  Periodic eviction and the OOM-score guard mirror the
+reference (infinistore/server.py:151-189).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import atexit
+import json
+import signal
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import os
+
+from .config import ServerConfig
+from .pyserver import StoreServer
+from .utils.logging import Logger
+
+# in-process server handle for the parity management API
+_SERVER: StoreServer | None = None
+
+
+def register_server(loop, config: ServerConfig):
+    """Reference parity: infinistore/lib.py:203-229.  Creates the store and
+    schedules the data-plane server on ``loop``."""
+    global _SERVER
+    backend = getattr(config, "backend", "auto")
+    if backend in ("auto", "native"):
+        try:
+            from . import _native  # noqa: F401
+
+            if _native.available():
+                srv = _native.NativeStoreServer(config)
+                srv.start()
+                _SERVER = srv
+                return 0
+            if backend == "native":
+                raise RuntimeError("native runtime requested but not built")
+        except ImportError:
+            if backend == "native":
+                raise
+    pysrv = StoreServer(config)
+    _SERVER = pysrv
+
+    async def _start():
+        await pysrv.start()
+
+    loop.run_until_complete(_start())
+    return 0
+
+
+def get_kvmap_len() -> int:
+    """Reference parity: infinistore/lib.py:177-187."""
+    return _SERVER.store.kvmap_len() if _SERVER else 0
+
+
+def purge_kv_map() -> int:
+    """Reference parity: infinistore/lib.py:190-200."""
+    return _SERVER.store.purge() if _SERVER else 0
+
+
+def evict_cache(min_threshold: float, max_threshold: float):
+    """Reference parity: infinistore/lib.py:232-249."""
+    if min_threshold >= max_threshold:
+        raise Exception("min_threshold should be less than max_threshold")
+    if not (0 <= min_threshold <= 1) or not (0 <= max_threshold <= 1):
+        raise Exception("thresholds should be in (0, 1)")
+    if _SERVER:
+        return _SERVER.store.evict(min_threshold, max_threshold)
+    return 0
+
+
+def _manage_handler(server_ref):
+    class Handler(BaseHTTPRequestHandler):
+        def _json(self, obj, code=200):
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            store = server_ref().store if server_ref() else None
+            if self.path == "/selftest":
+                self._json({"status": "ok"})
+            elif self.path == "/kvmap_len":
+                self._json({"len": store.kvmap_len() if store else 0})
+            elif self.path == "/usage":
+                self._json({"usage": store.usage() if store else 0.0})
+            elif self.path == "/metrics":
+                self._json(store.stats_dict() if store else {})
+            else:
+                self._json({"error": "not found"}, 404)
+
+        def do_POST(self):
+            store = server_ref().store if server_ref() else None
+            if self.path == "/purge":
+                Logger.info("clear kvmap")
+                num = store.purge() if store else 0
+                self._json({"status": "ok", "num": num})
+            else:
+                self._json({"error": "not found"}, 404)
+
+        def log_message(self, fmt, *args):  # quiet
+            pass
+
+    return Handler
+
+
+def parse_args():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--auto-increase", required=False, action="store_true",
+                        help="increase allocated memory automatically, 10GB each time")
+    parser.add_argument("--host", required=False, default="0.0.0.0", type=str)
+    parser.add_argument("--manage-port", required=False, type=int, default=18080)
+    parser.add_argument("--service-port", required=False, type=int, default=22345)
+    parser.add_argument("--log-level", required=False, default="info", type=str)
+    parser.add_argument("--prealloc-size", required=False, type=int, default=16,
+                        help="prealloc mem pool size, unit: GB")
+    parser.add_argument("--dev-name", required=False, default="", type=str)
+    parser.add_argument("--ib-port", required=False, type=int, default=1)
+    parser.add_argument("--link-type", required=False, default="ICI", type=str)
+    parser.add_argument("--minimal-allocate-size", required=False, default=64, type=int,
+                        help="minimal allocate size, unit: KB")
+    parser.add_argument("--evict-interval", required=False, default=5, type=int)
+    parser.add_argument("--evict-min-threshold", required=False, default=0.6, type=float)
+    parser.add_argument("--evict-max-threshold", required=False, default=0.8, type=float)
+    parser.add_argument("--enable-periodic-evict", required=False, action="store_true",
+                        default=False)
+    parser.add_argument("--hint-gid-index", required=False, default=-1, type=int)
+    parser.add_argument("--backend", required=False, default="auto",
+                        choices=["auto", "native", "python"])
+    parser.add_argument("--shm-prefix", required=False, default="", type=str)
+    return parser.parse_args()
+
+
+def prevent_oom():
+    """Reference parity: infinistore/server.py:151-154."""
+    try:
+        with open(f"/proc/self/oom_score_adj", "w") as f:
+            f.write("-1000")
+    except (PermissionError, FileNotFoundError, OSError):
+        Logger.warn("could not set oom_score_adj")
+
+
+def main():
+    args = parse_args()
+    kwargs = {k: v for k, v in vars(args).items() if k not in ("host", "enable_periodic_evict")}
+    config = ServerConfig(**kwargs)
+    config.verify()
+
+    Logger.set_log_level(config.log_level)
+    Logger.info(config)
+
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+    register_server(loop, config)
+    prevent_oom()
+
+    # make sure the shm pools are unlinked on SIGTERM/exit, not just SIGINT
+    def _cleanup():
+        srv = _SERVER
+        if srv is not None and hasattr(srv, "store"):
+            try:
+                srv.store.close()
+            except Exception:
+                pass
+
+    atexit.register(_cleanup)
+    signal.signal(signal.SIGTERM, lambda *_: (_cleanup(), os._exit(0)))
+
+    if args.enable_periodic_evict and isinstance(_SERVER, StoreServer):
+        async def _enable():
+            _SERVER.start_periodic_evict()
+        loop.run_until_complete(_enable())
+
+    http_server = ThreadingHTTPServer(
+        (args.host, config.manage_port), _manage_handler(lambda: _SERVER)
+    )
+    threading.Thread(target=http_server.serve_forever, daemon=True).start()
+
+    Logger.warn("server started")
+    try:
+        if isinstance(_SERVER, StoreServer):
+            loop.run_until_complete(_SERVER.serve_forever())
+        else:
+            _SERVER.wait()  # native runtime runs its own epoll threads
+    except KeyboardInterrupt:
+        pass
+    finally:
+        http_server.shutdown()
+        if isinstance(_SERVER, StoreServer):
+            loop.run_until_complete(_SERVER.close())
+        else:
+            _SERVER.stop()
+
+
+if __name__ == "__main__":
+    main()

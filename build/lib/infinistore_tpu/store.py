@@ -1,0 +1,314 @@
+"""Transport-agnostic KV store core.
+
+Mirrors the reference server's state (kv_map + lru_queue + MM, reference:
+src/infinistore.cpp:26-53) and op semantics, independent of the event loop so
+both the asyncio server (``pyserver.py``) and tests can drive it directly.
+The C++ native runtime (``src/store_server.cpp``) implements the same logic.
+
+Semantics preserved from the reference:
+
+* entries become visible only at commit time (reference inserts into kv_map
+  after the RDMA transfer completes, src/infinistore.cpp:405-418);
+* reads touch the LRU (src/infinistore.cpp:629-634) and fail with
+  KEY_NOT_FOUND if *any* requested key is missing (src/infinistore.cpp:612-617);
+* stored size must fit the reader's block size (src/infinistore.cpp:620-624);
+* eviction pops from the LRU head until usage < min threshold
+  (src/infinistore.cpp:223-234), with the same on-demand thresholds before
+  allocation (0.8/0.95, src/infinistore.cpp:52-53);
+* ``get_match_last_index`` binary-searches for the last present key, which
+  assumes present keys form a prefix of the list -- exactly the reference's
+  algorithm (src/infinistore.cpp:786-802);
+* allocation failure sets ``need_extend`` for the 10 GB auto-extend path
+  (src/infinistore.cpp:437-452).
+
+One addition over the reference: descriptor reads hand out raw pool offsets
+to shm clients, so committed entries carry a short *lease* after a GET_DESC
+and the evictor skips leased entries.  The reference has the same window with
+in-flight RDMA reads and relies on LRU touch alone.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from . import protocol as P
+from .mempool import MM
+
+ON_DEMAND_MIN_THRESHOLD = 0.8  # reference: src/infinistore.cpp:52
+ON_DEMAND_MAX_THRESHOLD = 0.95  # reference: src/infinistore.cpp:53
+READ_LEASE_S = 5.0
+
+
+@dataclass
+class Entry:
+    pool_idx: int
+    offset: int
+    size: int
+    lease: float = 0.0
+    # busy: an op is actively streaming payload into this pending region;
+    # purge/realloc must not free the blocks out from under it
+    busy: bool = False
+
+
+@dataclass
+class Stats:
+    puts: int = 0
+    gets: int = 0
+    hits: int = 0
+    misses: int = 0
+    evicted: int = 0
+    bytes_in: int = 0
+    bytes_out: int = 0
+
+
+class Store:
+    def __init__(self, config):
+        self.config = config
+        self.mm = MM(
+            pool_size=config.prealloc_size << 30,
+            block_size=config.minimal_allocate_size << 10,
+            name_prefix=getattr(config, "shm_prefix", None) or None,
+        )
+        # committed entries; OrderedDict doubles as the LRU queue (head = LRU)
+        self.kv: "OrderedDict[bytes, Entry]" = OrderedDict()
+        # uncommitted allocations: key -> Entry (not visible to reads/exist)
+        self.pending: Dict[bytes, Entry] = {}
+        # regions deleted/purged while leased: the key disappears at once,
+        # the blocks are freed only after the lease expires (an shm client
+        # may still be memcpying from them)
+        self._deferred: List[Tuple[float, Entry]] = []
+        self.stats = Stats()
+
+    # ---- helpers ----
+
+    def _free(self, e: Entry) -> None:
+        self.mm.deallocate(e.pool_idx, e.offset, e.size)
+
+    def _free_or_defer(self, e: Entry, now: float) -> None:
+        if e.lease > now:
+            self._deferred.append((e.lease, e))
+        else:
+            self._free(e)
+
+    def _reap_deferred(self, now: float) -> None:
+        keep = []
+        for expiry, e in self._deferred:
+            if expiry <= now:
+                self._free(e)
+            else:
+                keep.append((expiry, e))
+        self._deferred = keep
+
+    def _touch(self, key: bytes) -> None:
+        self.kv.move_to_end(key)
+
+    def usage(self) -> float:
+        return self.mm.usage()
+
+    def kvmap_len(self) -> int:
+        return len(self.kv)
+
+    # ---- eviction / pool growth ----
+
+    def evict(self, min_threshold: float, max_threshold: float) -> int:
+        evicted = 0
+        self._reap_deferred(time.monotonic())
+        if self.mm.usage() >= max_threshold:
+            now = time.monotonic()
+            skipped = []
+            while self.mm.usage() >= min_threshold and self.kv:
+                key, e = next(iter(self.kv.items()))
+                if e.lease > now:
+                    # leased for an in-flight shm read; rotate past it
+                    self.kv.move_to_end(key)
+                    skipped.append(key)
+                    if len(skipped) >= len(self.kv):
+                        break
+                    continue
+                del self.kv[key]
+                self._free(e)
+                evicted += 1
+        self.stats.evicted += evicted
+        return evicted
+
+    def maybe_extend(self) -> bool:
+        if self.config.auto_increase and self.mm.need_extend:
+            self.mm.add_mempool()
+            self.mm.need_extend = False
+            return True
+        return False
+
+    def _allocate(self, size: int, n: int):
+        """On-demand-evict + allocate + auto-extend-retry."""
+        self.evict(ON_DEMAND_MIN_THRESHOLD, ON_DEMAND_MAX_THRESHOLD)
+        regions = self.mm.allocate(size, n)
+        if regions is None and self.maybe_extend():
+            regions = self.mm.allocate(size, n)
+        return regions
+
+    # ---- ops ----
+
+    def put_inline(self, key: bytes, data) -> int:
+        size = len(data)
+        regions = self._allocate(size, 1)
+        if regions is None:
+            return P.OUT_OF_MEMORY
+        pool_idx, offset = regions[0]
+        self.mm.view(pool_idx, offset, size)[:] = data
+        self._insert_committed(key, Entry(pool_idx, offset, size))
+        self.stats.puts += 1
+        self.stats.bytes_in += size
+        return P.FINISH
+
+    def alloc_inline_dst(self, key: bytes, size: int) -> Optional[Entry]:
+        """Allocate a region the server will stream an inline payload into."""
+        regions = self._allocate(size, 1)
+        if regions is None:
+            return None
+        pool_idx, offset = regions[0]
+        e = Entry(pool_idx, offset, size)
+        self.pending[key] = e
+        return e
+
+    def get_inline(self, key: bytes):
+        e = self.kv.get(key)
+        if e is None:
+            self.stats.misses += 1
+            return None
+        self._touch(key)
+        self.stats.gets += 1
+        self.stats.hits += 1
+        self.stats.bytes_out += e.size
+        return self.mm.view(e.pool_idx, e.offset, e.size)
+
+    def alloc_put(self, keys: Sequence[bytes], block_size: int):
+        """Batched allocate for zero-copy writes.  Returns (status, descs)."""
+        if len(set(keys)) != len(keys):
+            return P.INVALID_REQ, []
+        # another op is actively streaming into one of these keys: back off
+        # rather than stomp its pending region
+        if any((e := self.pending.get(k)) is not None and e.busy for k in keys):
+            return P.RETRY, []
+        regions = self._allocate(block_size, len(keys))
+        if regions is None:
+            return P.OUT_OF_MEMORY, []
+        descs = []
+        for key, (pool_idx, offset) in zip(keys, regions):
+            old = self.pending.pop(key, None)
+            if old is not None:
+                self._free(old)
+            self.pending[key] = Entry(pool_idx, offset, block_size)
+            descs.append((pool_idx, offset, block_size))
+        return P.FINISH, descs
+
+    def abort_put(self, keys: Sequence[bytes]) -> None:
+        """Reclaim pending regions whose writer went away uncommitted."""
+        for key in keys:
+            e = self.pending.pop(key, None)
+            if e is not None:
+                self._free(e)
+
+    def commit_put(self, keys: Sequence[bytes]) -> Tuple[int, int]:
+        committed = 0
+        for key in keys:
+            e = self.pending.pop(key, None)
+            if e is None:
+                continue
+            self._insert_committed(key, e)
+            committed += 1
+            self.stats.puts += 1
+            self.stats.bytes_in += e.size
+        status = P.FINISH if committed == len(keys) else P.INVALID_REQ
+        return status, committed
+
+    def _insert_committed(self, key: bytes, e: Entry) -> None:
+        old = self.kv.pop(key, None)
+        if old is not None:
+            # overwrite: an shm reader may hold a live lease on the old
+            # region; defer the free just like delete/purge do
+            self._free_or_defer(old, time.monotonic())
+        self.kv[key] = e  # appended at MRU end
+
+    def get_desc(self, keys: Sequence[bytes], block_size: int = 0):
+        """Batched descriptors for zero-copy reads.  404 if any key missing."""
+        descs = []
+        now = time.monotonic()
+        for key in keys:
+            e = self.kv.get(key)
+            if e is None:
+                self.stats.misses += 1
+                return P.KEY_NOT_FOUND, []
+            if block_size and e.size > block_size:
+                return P.INVALID_REQ, []
+            descs.append((e.pool_idx, e.offset, e.size))
+        for key in keys:
+            e = self.kv[key]
+            e.lease = now + READ_LEASE_S
+            self._touch(key)
+            self.stats.gets += 1
+            self.stats.hits += 1
+            self.stats.bytes_out += e.size
+        return P.FINISH, descs
+
+    def exist(self, key: bytes) -> bool:
+        return key in self.kv
+
+    def match_last_index(self, keys: Sequence[bytes]) -> int:
+        left, right = 0, len(keys)
+        while left < right:
+            mid = (left + right) // 2
+            if keys[mid] in self.kv:
+                left = mid + 1
+            else:
+                right = mid
+        return left - 1
+
+    def delete_keys(self, keys: Sequence[bytes]) -> int:
+        count = 0
+        now = time.monotonic()
+        self._reap_deferred(now)
+        for key in keys:
+            e = self.kv.pop(key, None)
+            if e is not None:
+                self._free_or_defer(e, now)
+                count += 1
+        return count
+
+    def purge(self) -> int:
+        n = len(self.kv)
+        now = time.monotonic()
+        self._reap_deferred(now)
+        for e in self.kv.values():
+            self._free_or_defer(e, now)
+        self.kv.clear()
+        # keep regions an op is actively streaming into (their op will
+        # commit or abort them); free the rest
+        keep = {k: e for k, e in self.pending.items() if e.busy}
+        for k, e in self.pending.items():
+            if not e.busy:
+                self._free(e)
+        self.pending = keep
+        return n
+
+    def stats_dict(self) -> dict:
+        s = self.stats
+        return {
+            "kvmap_len": len(self.kv),
+            "pending": len(self.pending),
+            "usage": self.mm.usage(),
+            "pools": len(self.mm.pools),
+            "block_size": self.mm.block_size,
+            "puts": s.puts,
+            "gets": s.gets,
+            "hits": s.hits,
+            "misses": s.misses,
+            "evicted": s.evicted,
+            "bytes_in": s.bytes_in,
+            "bytes_out": s.bytes_out,
+        }
+
+    def close(self) -> None:
+        self.mm.close()

@@ -1,0 +1,3 @@
+from .logging import Logger, log_msg, set_log_level
+
+__all__ = ["Logger", "log_msg", "set_log_level"]

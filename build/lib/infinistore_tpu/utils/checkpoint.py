@@ -1,0 +1,158 @@
+"""Checkpoint/resume for params, optimizer state, and engine metadata.
+
+The reference framework's durability story is the KV store itself (committed
+entries survive client restarts); the serving/training stack around it needs
+model-state durability too.  This wraps orbax-checkpoint with the two
+TPU-specific behaviors that matter:
+
+* **sharding-aware restore**: pass ``like`` (a pytree of jax.Arrays or
+  ShapeDtypeStructs with shardings) and every leaf is restored directly into
+  its mesh sharding -- no host-memory spike, no post-restore reshard.
+* **async save**: device-to-host happens at ``save()``; serialization runs in
+  the background so the train/serve loop keeps going.  ``wait()`` (or the
+  next save) joins it.
+
+Engine metadata (page tables, chunk keys, token history) is plain Python and
+rides along as JSON under the same step directory, so a decode engine can
+resume exactly where it stopped and re-attach to store-resident KV.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+
+class CheckpointManager:
+    """Thin orbax wrapper: numbered steps under one directory, keep-N."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.manager = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True
+            ),
+        )
+
+    # ---- save ----
+
+    def save(self, step: int, state: Any, metadata: Optional[dict] = None) -> None:
+        """Async-save a pytree of jax.Arrays; metadata is JSON-serializable."""
+        args = self._ocp.args.Composite(
+            state=self._ocp.args.StandardSave(state),
+            **(
+                {"metadata": self._ocp.args.JsonSave(metadata)}
+                if metadata is not None
+                else {}
+            ),
+        )
+        self.manager.save(step, args=args)
+
+    def wait(self) -> None:
+        self.manager.wait_until_finished()
+
+    # ---- restore ----
+
+    def latest_step(self) -> Optional[int]:
+        return self.manager.latest_step()
+
+    def restore(self, step: Optional[int] = None, like: Any = None) -> Any:
+        """Restore the state pytree.  ``like`` (arrays or ShapeDtypeStructs
+        with ``.sharding``) restores each leaf into that sharding."""
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {self.directory}")
+        if like is not None:
+            abstract = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(
+                    x.shape, x.dtype, sharding=getattr(x, "sharding", None)
+                ),
+                like,
+            )
+            args = self._ocp.args.Composite(
+                state=self._ocp.args.StandardRestore(abstract)
+            )
+        else:
+            args = self._ocp.args.Composite(state=self._ocp.args.StandardRestore())
+        out = self.manager.restore(step, args=args)
+        return out["state"]
+
+    def restore_metadata(self, step: Optional[int] = None) -> Optional[dict]:
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            return None
+        item_dir = os.path.join(self.directory, str(step), "metadata")
+        if not os.path.exists(item_dir):
+            return None  # this step was saved without metadata
+        # a present-but-unreadable blob is corruption: let it raise
+        out = self.manager.restore(
+            step,
+            args=self._ocp.args.Composite(metadata=self._ocp.args.JsonRestore()),
+        )
+        return out["metadata"]
+
+    def close(self) -> None:
+        self.manager.close()
+
+
+def save_engine_state(path: str, engine) -> None:
+    """Persist an InferenceEngine's host-side serving state (sequences,
+    page tables, chunk keys).  The HBM cache itself is NOT saved: committed
+    pages live in the store and are re-fetched on resume (the reference's
+    "DRAM tier outlives engine restarts" behavior)."""
+    seqs = {
+        str(sid): {
+            "tokens": [int(t) for t in s.tokens],
+            "block_ids": [int(b) for b in s.block_ids],
+            "chunk_keys": list(s.chunk_keys),
+            "reused_chunks": int(s.reused_chunks),
+        }
+        for sid, s in engine.seqs.items()
+    }
+    with open(path, "w") as f:
+        json.dump({"model_id": engine.model_id, "next_id": engine._next_id,
+                   "seqs": seqs}, f)
+
+
+def resume_engine_state(path: str, engine) -> int:
+    """Re-attach persisted sequences through ``engine.prefill``: store-
+    resident prefix pages are re-fetched into HBM and only the tail (plus
+    anything evicted from the store) is recomputed -- the exact decode-node
+    startup path, so resumed sequences have correct logits and can keep
+    decoding immediately.  Original sequence ids are preserved.  Returns the
+    number of sequences resumed."""
+    with open(path) as f:
+        blob = json.load(f)
+    if blob["model_id"] != engine.model_id:
+        raise ValueError(
+            f"checkpoint is for model {blob['model_id']!r}, engine has "
+            f"{engine.model_id!r}"
+        )
+    live = set(engine.seqs)
+    clash = live & {int(s) for s in blob["seqs"]}
+    if clash:
+        raise ValueError(
+            f"sequence ids {sorted(clash)} already live in this engine; "
+            "resume into a fresh engine or release them first"
+        )
+    resumed = 0
+    for sid, s in blob["seqs"].items():
+        state = engine.prefill(s["tokens"])
+        # restore the persisted identity
+        engine.seqs.pop(state.seq_id, None)
+        state.seq_id = int(sid)
+        engine.seqs[state.seq_id] = state
+        resumed += 1
+    engine._next_id = max(blob["next_id"], engine._next_id)
+    return resumed

@@ -1,0 +1,55 @@
+"""Leveled logger (reference parity: infinistore/lib.py:155-175, src/log.h)."""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+_logger = logging.getLogger("infinistore_tpu")
+if not _logger.handlers:
+    _h = logging.StreamHandler(sys.stderr)
+    _h.setFormatter(
+        logging.Formatter("[%(asctime)s] [%(levelname)s] %(message)s", "%H:%M:%S")
+    )
+    _logger.addHandler(_h)
+    _logger.setLevel(logging.WARNING)
+    _logger.propagate = False
+
+_LEVELS = {
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+}
+
+
+def log_msg(level: str, msg: str) -> None:
+    _logger.log(_LEVELS.get(level, logging.INFO), msg)
+
+
+def set_log_level(level: str) -> None:
+    _logger.setLevel(_LEVELS.get(level, logging.WARNING))
+
+
+class Logger:
+    """Reference parity: infinistore/lib.py:155-175."""
+
+    @staticmethod
+    def info(msg):
+        _logger.info(str(msg))
+
+    @staticmethod
+    def debug(msg):
+        _logger.debug(str(msg))
+
+    @staticmethod
+    def error(msg):
+        _logger.error(str(msg))
+
+    @staticmethod
+    def warn(msg):
+        _logger.warning(str(msg))
+
+    @staticmethod
+    def set_log_level(level):
+        set_log_level(level)

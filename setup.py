@@ -1,0 +1,29 @@
+"""Build hook: compile the native runtime (libistpu.so) into the package.
+
+The reference's setup.py drives CMake to build its pybind11 extension
+(reference: setup.py CMakeBuild); ours drives the plain Makefile in src/ and
+ships the resulting shared library as package data — the Python side loads
+it via ctypes (infinistore_tpu/_native.py) and falls back to the pure-Python
+runtime when no toolchain was available at install time.
+"""
+
+import os
+import shutil
+import subprocess
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+
+class BuildNativeThenPy(build_py):
+    def run(self):
+        root = os.path.dirname(os.path.abspath(__file__))
+        src = os.path.join(root, "src")
+        if shutil.which("make") and shutil.which(os.environ.get("CXX", "g++")):
+            subprocess.run(["make", "-C", src], check=True)
+        else:
+            print("[infinistore-tpu] no C++ toolchain; installing pure-Python runtime")
+        super().run()
+
+
+setup(cmdclass={"build_py": BuildNativeThenPy})
