@@ -1,4 +1,11 @@
 from .connector import StoreConnector
 from .engine import InferenceEngine, SequenceState
+from .scheduler import Request, Scheduler
 
-__all__ = ["InferenceEngine", "SequenceState", "StoreConnector"]
+__all__ = [
+    "InferenceEngine",
+    "Request",
+    "Scheduler",
+    "SequenceState",
+    "StoreConnector",
+]

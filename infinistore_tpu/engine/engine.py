@@ -21,7 +21,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 from functools import partial
-from typing import Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -62,10 +62,15 @@ class InferenceEngine:
         max_seqs: int = 8,
         prefill_fn=None,
         decode_fn=None,
+        prefill_chunk: Optional[int] = None,
     ):
         """``prefill_fn``/``decode_fn`` plug in other model families with the
         same contracts as models.llama.prefill_forward / decode_forward
-        (e.g. models.moe.moe_prefill_forward / moe_decode_forward)."""
+        (e.g. models.moe.moe_prefill_forward / moe_decode_forward).
+
+        ``prefill_chunk``: process prompts in chunks of this many tokens
+        (a multiple of ``pc.block_tokens``) instead of one full-sequence
+        forward — bounds prefill attention memory for long prompts."""
         assert pc.n_layers == cfg.n_layers
         self.params = params
         self.cfg = cfg
@@ -75,6 +80,11 @@ class InferenceEngine:
         self.alloc = BlockAllocator(pc.n_blocks)
         self.transfer = KVTransferEngine(conn, pc) if conn is not None else None
         self.max_seqs = max_seqs
+        if prefill_chunk is not None:
+            assert prefill_chunk % pc.block_tokens == 0, (
+                prefill_chunk, pc.block_tokens
+            )
+        self.prefill_chunk = prefill_chunk
         self.max_pages = pc.n_blocks
         self.seqs: Dict[int, SequenceState] = {}
         self._next_id = 0
@@ -85,7 +95,8 @@ class InferenceEngine:
         # tokens per compiled decode dispatch; the scan length is static so
         # distinct chunk sizes compile once each
         self.decode_chunk = 32
-        self._decode_many_cache: Dict[int, object] = {}
+        self._decode_many_cache: Dict[Any, object] = {}
+        self._rng = jax.random.PRNGKey(0)
 
     # ---- prefill ----
 
@@ -116,19 +127,41 @@ class InferenceEngine:
             pages = read_pages(self.cache, jnp.asarray(block_ids[:reused]))
             prefix_kv = pages_to_seq_kv(pages)  # [L, 2, 1, n*T, H, D]
 
-        # compute the tail; pad to a whole number of pages for paging
+        # compute the tail; pad to a whole number of pages for paging.
+        # ``prefill_chunk`` tokens per forward (chunked prefill): each chunk
+        # attends to the accumulated prefix KV + itself, so long prompts cost
+        # O(chunk * S) attention memory instead of O(S^2), and each chunk's
+        # pages land in the HBM cache as soon as they are computed.
         suffix = tokens[P:]
         S = len(suffix)
         pad = (-S) % T
-        suffix_arr = jnp.asarray(suffix + [0] * pad, dtype=jnp.int32)[None]
-        logits, kv = self._prefill_jit(
-            self.params, tokens=suffix_arr, prefix_kv=prefix_kv
+        padded = suffix + [0] * pad
+        C = self.prefill_chunk or len(padded)
+        assert C % T == 0 or C == len(padded), (
+            "prefill_chunk must be a multiple of block_tokens"
         )
-        n_suffix_pages = (S + pad) // T
-        pages_new = prefill_to_pages(kv[:, :, 0], n_suffix_pages, T)
-        self.cache = write_pages(
-            self.cache, jnp.asarray(block_ids[reused:]), pages_new
-        )
+        prefix = prefix_kv
+        done = reused
+        logits = None
+        off_last = 0
+        for off in range(0, len(padded), C):
+            chunk = padded[off : off + C]
+            arr = jnp.asarray(chunk, dtype=jnp.int32)[None]
+            logits, kv = self._prefill_jit(
+                self.params, tokens=arr, prefix_kv=prefix
+            )
+            if off + C < len(padded):  # another chunk still attends to this KV
+                prefix = kv if prefix is None else jnp.concatenate(
+                    [prefix, kv], axis=3
+                )
+            n_pg = len(chunk) // T
+            self.cache = write_pages(
+                self.cache,
+                jnp.asarray(block_ids[done : done + n_pg]),
+                prefill_to_pages(kv[:, :, 0], n_pg, T),
+            )
+            done += n_pg
+            off_last = off
 
         # push complete chunks to the store (prefill-node role)
         if self.transfer is not None:
@@ -143,7 +176,7 @@ class InferenceEngine:
             block_ids=block_ids,
             chunk_keys=keys,
             reused_chunks=reused,
-            last_logits=logits[0, S - 1],
+            last_logits=logits[0, (S - 1) - off_last],
         )
         self._next_id += 1
         self.seqs[state.seq_id] = state
@@ -151,29 +184,37 @@ class InferenceEngine:
 
     # ---- decode ----
 
-    def _table_for(self, state: SequenceState) -> jax.Array:
-        table = np.zeros((1, self.max_pages), dtype=np.int32)
-        table[0, : len(state.block_ids)] = state.block_ids
-        return jnp.asarray(table)
-
-    def _decode_many(self, n_steps: int):
-        """Compiled ``n_steps``-token greedy decode: a ``lax.scan`` whose body
+    def _decode_many(self, n_steps: int, sample: str, top_k: int):
+        """Compiled ``n_steps``-token decode: a ``lax.scan`` whose body
         samples on device (no per-token host sync) and derives the KV scatter
-        slot from the device-resident block table.  Cached per scan length.
+        slot from the device-resident block table.  Works for any batch of
+        sequences (jit re-specializes per batch shape).  Cached per
+        (scan length, sampling mode).
 
         The reference decodes through vLLM's CUDA-graph step loop; the TPU
         analog is one traced scan so XLA pipelines all ``n_steps`` steps
         without returning to Python (VERDICT round-1 weak #9)."""
-        fn = self._decode_many_cache.get(n_steps)
+        cache_key = (n_steps, sample, top_k)
+        fn = self._decode_many_cache.get(cache_key)
         if fn is not None:
             return fn
         T = self.pc.block_tokens
         decode_fn = self._decode_raw
 
-        def many(params, logits0, start_pos, cache, block_table):
+        def pick(logits, rng, temperature):
+            if sample == "greedy":
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            l = logits.astype(jnp.float32) / temperature
+            if top_k:
+                kth = jax.lax.top_k(l, top_k)[0][:, -1:]  # [B, 1]
+                l = jnp.where(l < kth, -jnp.inf, l)
+            return jax.random.categorical(rng, l).astype(jnp.int32)
+
+        def many(params, logits0, start_pos, cache, block_table, rng, temperature):
             def step(carry, i):
-                logits, cache = carry
-                tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B]
+                logits, cache, rng = carry
+                rng, sub = jax.random.split(rng)
+                tok = pick(logits, sub, temperature)  # [B]
                 pos = start_pos + i  # [B]
                 page_idx = pos // T
                 slot_blocks = jnp.take_along_axis(
@@ -189,50 +230,95 @@ class InferenceEngine:
                     slot_block_ids=slot_blocks,
                     slot_ids=pos % T,
                 )
-                return (logits2, cache), tok
+                return (logits2, cache, rng), tok
 
-            (logits, cache), toks = jax.lax.scan(
-                step, (logits0, cache), jnp.arange(n_steps)
+            (logits, cache, _), toks = jax.lax.scan(
+                step, (logits0, cache, rng), jnp.arange(n_steps)
             )
             return toks, logits, cache
 
         fn = jax.jit(many, donate_argnums=(3,))
-        self._decode_many_cache[n_steps] = fn
+        self._decode_many_cache[cache_key] = fn
         return fn
 
-    def decode(self, state: SequenceState, n_steps: int, sample: str = "greedy") -> List[int]:
-        """Greedy-decode ``n_steps`` tokens for one sequence.
+    def decode(
+        self,
+        state: SequenceState,
+        n_steps: int,
+        sample: str = "greedy",
+        temperature: float = 1.0,
+        top_k: int = 0,
+        rng: Optional[jax.Array] = None,
+    ) -> List[int]:
+        """Decode ``n_steps`` tokens for one sequence."""
+        return self.decode_batch(
+            [state], n_steps, sample=sample, temperature=temperature,
+            top_k=top_k, rng=rng,
+        )[0]
 
-        Pages for the whole run are allocated up front and the block table is
-        built once; the token loop itself runs on device in compiled chunks
-        (``decode_chunk`` tokens per dispatch), so the only host syncs are the
-        per-chunk token downloads."""
-        assert sample == "greedy", "device-side sampling is greedy-only for now"
+    def decode_batch(
+        self,
+        states: Sequence[SequenceState],
+        n_steps: int,
+        sample: str = "greedy",
+        temperature: float = 1.0,
+        top_k: int = 0,
+        rng: Optional[jax.Array] = None,
+    ) -> List[List[int]]:
+        """Decode ``n_steps`` tokens for a batch of sequences in lockstep
+        (vLLM-style batched decode; sequences may have different lengths —
+        positions, lengths, and scatter slots are per-row device values).
+
+        ``sample``: "greedy" (default) or "categorical" (softmax sampling at
+        ``temperature``, optionally truncated to the ``top_k`` most likely
+        tokens); sampling runs on device with a carried PRNG key.
+
+        Pages for the whole run are allocated up front and block tables are
+        built once; the token loop runs on device in compiled chunks
+        (``decode_chunk`` tokens per dispatch), so the only host syncs are
+        the per-chunk token downloads."""
+        assert sample in ("greedy", "categorical"), sample
+        B = len(states)
+        assert B >= 1
         T = self.pc.block_tokens
-        cur = len(state.tokens)
-        need_pages = -(-(cur + n_steps) // T)
-        if need_pages > len(state.block_ids):
-            state.block_ids.extend(self.alloc.alloc(need_pages - len(state.block_ids)))
-        block_table = self._table_for(state)
+        for st in states:
+            need = -(-(len(st.tokens) + n_steps) // T)
+            if need > len(st.block_ids):
+                st.block_ids.extend(self.alloc.alloc(need - len(st.block_ids)))
+        table = np.zeros((B, self.max_pages), dtype=np.int32)
+        for b, st in enumerate(states):
+            table[b, : len(st.block_ids)] = st.block_ids
+        block_table = jnp.asarray(table)
+        if rng is None:
+            # advance the engine's own stream: repeated sampling calls must
+            # not replay the same draws
+            self._rng, rng = jax.random.split(self._rng)
 
-        out: List[int] = []
-        logits = state.last_logits[None]  # [1, V]
-        pos = cur  # position of the next generated token
+        out: List[List[int]] = [[] for _ in range(B)]
+        logits = jnp.stack([st.last_logits for st in states])  # [B, V]
+        pos = np.asarray([len(st.tokens) for st in states], dtype=np.int32)
+        temp = jnp.asarray(max(temperature, 1e-6), dtype=jnp.float32)
         remaining = n_steps
         while remaining > 0:
             chunk = min(remaining, self.decode_chunk)
-            toks, logits, self.cache = self._decode_many(chunk)(
+            rng, sub = jax.random.split(rng)
+            toks, logits, self.cache = self._decode_many(chunk, sample, top_k)(
                 self.params,
                 logits,
-                jnp.asarray([pos], dtype=jnp.int32),
+                jnp.asarray(pos),
                 self.cache,
                 block_table,
+                sub,
+                temp,
             )
-            out.extend(int(t) for t in np.asarray(toks[:, 0]))  # one sync/chunk
+            host_toks = np.asarray(toks)  # [chunk, B]; one sync/chunk
+            for b in range(B):
+                out[b].extend(int(t) for t in host_toks[:, b])
             pos += chunk
             remaining -= chunk
-        state.tokens.extend(out)
-        state.last_logits = logits[0]
+        for b, st in enumerate(states):
+            st.tokens.extend(out[b])
+            st.last_logits = logits[b]
         return out
 
     def generate(self, tokens: Sequence[int], n_steps: int) -> List[int]:

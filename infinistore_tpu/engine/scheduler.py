@@ -1,0 +1,151 @@
+"""Continuous batching on top of the compiled batched decode loop.
+
+The reference leaves request scheduling to vLLM; a standalone serving stack
+needs one.  Model: requests are admitted and retired only at decode-chunk
+boundaries, and every in-flight request decodes in lockstep through
+``InferenceEngine.decode_batch``.  Chunk lengths are powers of two capped at
+``engine.decode_chunk`` and a batch only mixes requests with identical
+sampling params, so the jit cache stays bounded by ``max_batch`` batch
+shapes x log2(decode_chunk)+1 scan lengths — the TPU analog of vLLM's
+CUDA-graph batch-size buckets.  A request whose budget ends mid-chunk
+decodes to the boundary and is trimmed at retirement.
+
+Flow per ``step()``:
+1. admit pending requests up to ``max_batch`` (prefill runs immediately,
+   store-backed prefix reuse included);
+2. decode one chunk for the active batch;
+3. retire requests that hit ``max_new_tokens`` or emitted ``eos_id``
+   (checked host-side at the chunk boundary), freeing their KV pages.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import jax
+
+from .engine import InferenceEngine, SequenceState
+
+
+@dataclass
+class Request:
+    req_id: int
+    tokens: List[int]
+    max_new_tokens: int
+    eos_id: Optional[int] = None
+    sample: str = "greedy"
+    temperature: float = 1.0
+    top_k: int = 0
+    # filled by the scheduler
+    state: Optional[SequenceState] = None
+    output: List[int] = field(default_factory=list)
+    done: bool = False
+
+
+class Scheduler:
+    def __init__(self, engine: InferenceEngine, max_batch: int = 8,
+                 rng: Optional[jax.Array] = None):
+        self.engine = engine
+        self.max_batch = max_batch
+        self.pending: List[Request] = []
+        self.active: List[Request] = []
+        self.finished: Dict[int, Request] = {}
+        self._next_id = 0
+        self._rng = rng if rng is not None else jax.random.PRNGKey(0)
+
+    def submit(
+        self,
+        tokens: Sequence[int],
+        max_new_tokens: int,
+        eos_id: Optional[int] = None,
+        sample: str = "greedy",
+        temperature: float = 1.0,
+        top_k: int = 0,
+    ) -> int:
+        req = Request(
+            req_id=self._next_id, tokens=list(tokens),
+            max_new_tokens=max_new_tokens, eos_id=eos_id,
+            sample=sample, temperature=temperature, top_k=top_k,
+        )
+        self._next_id += 1
+        self.pending.append(req)
+        return req.req_id
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self.pending or self.active)
+
+    @staticmethod
+    def _group(req: Request):
+        # one lockstep dispatch shares a single compiled sampling program, so
+        # a batch only holds requests with identical sampling params
+        return (req.sample, req.temperature, req.top_k)
+
+    def _admit(self) -> None:
+        if not self.active and self.pending:
+            key = self._group(self.pending[0])
+        elif self.active:
+            key = self._group(self.active[0])
+        else:
+            return
+        i = 0
+        while i < len(self.pending) and len(self.active) < self.max_batch:
+            if self._group(self.pending[i]) == key:
+                req = self.pending.pop(i)
+                req.state = self.engine.prefill(req.tokens)
+                self.active.append(req)
+            else:
+                i += 1  # different sampling params: wait for this batch
+
+    def _retire(self) -> List[Request]:
+        done_now: List[Request] = []
+        still: List[Request] = []
+        for req in self.active:
+            out = req.output
+            hit_eos = req.eos_id is not None and req.eos_id in out
+            cut = out.index(req.eos_id) + 1 if hit_eos else len(out)
+            cut = min(cut, req.max_new_tokens)
+            if hit_eos or len(out) >= req.max_new_tokens:
+                del out[cut:]
+                req.done = True
+                self.engine.release(req.state)
+                self.finished[req.req_id] = req
+                done_now.append(req)
+            else:
+                still.append(req)
+        self.active = still
+        return done_now
+
+    def step(self) -> List[Request]:
+        """Admit, decode one chunk for the whole batch, retire.  Returns the
+        requests that finished this step."""
+        self._admit()
+        if not self.active:
+            return []
+        head = self.active[0]
+        # chunk lengths are powers of two capped at decode_chunk, so the jit
+        # cache holds at most log2(decode_chunk)+1 scan lengths per batch
+        # shape; a request whose budget lands mid-chunk decodes to the chunk
+        # boundary and _retire trims the overshoot
+        shortest = min(r.max_new_tokens - len(r.output) for r in self.active)
+        chunk = 1
+        while chunk < shortest and chunk < self.engine.decode_chunk:
+            chunk *= 2
+        chunk = min(chunk, self.engine.decode_chunk)
+        self._rng, sub = jax.random.split(self._rng)
+        outs = self.engine.decode_batch(
+            [r.state for r in self.active], chunk,
+            sample=head.sample, temperature=head.temperature,
+            top_k=head.top_k, rng=sub,
+        )
+        for req, toks in zip(self.active, outs):
+            req.output.extend(toks)
+        return self._retire()
+
+    def run(self) -> Dict[int, List[int]]:
+        """Drive until every submitted request finishes; returns
+        req_id -> generated tokens."""
+        while self.has_work:
+            self.step()
+        return {rid: r.output for rid, r in self.finished.items()}

@@ -1,0 +1,137 @@
+"""HuggingFace Llama checkpoint import.
+
+The reference rides vLLM, which loads HF checkpoints; a standalone framework
+needs its own loader.  ``params_from_hf`` maps a ``transformers``
+LlamaForCausalLM state dict onto our pytree (models/llama.py layout: stacked
+per-layer leaves, ``x @ W`` orientation), converting two representation
+differences:
+
+* weight orientation — HF stores ``[out, in]``; we compute ``x @ W`` so
+  every projection is transposed;
+* RoPE convention — HF rotates half-split features
+  (``rotate_half: [-x2, x1]`` over ``[:d/2] | [d/2:]``); our ``apply_rope``
+  rotates interleaved even/odd pairs.  The two are equivalent under a fixed
+  permutation of each head's feature rows, so we bake that permutation into
+  Wq/Wk once at import time and the runtime math never branches.
+
+No network access is needed: pass a ``transformers`` model object (e.g.
+``LlamaForCausalLM.from_pretrained(local_dir)``) or a raw state dict.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping
+
+import jax.numpy as jnp
+import numpy as np
+
+from .llama import LlamaConfig, Params
+
+
+def config_from_hf(hf_config: Any, dtype: Any = jnp.bfloat16) -> LlamaConfig:
+    """Map a ``transformers.LlamaConfig`` onto ours."""
+    return LlamaConfig(
+        vocab_size=hf_config.vocab_size,
+        dim=hf_config.hidden_size,
+        n_layers=hf_config.num_hidden_layers,
+        n_heads=hf_config.num_attention_heads,
+        n_kv_heads=getattr(
+            hf_config, "num_key_value_heads", hf_config.num_attention_heads
+        ),
+        ffn_dim=hf_config.intermediate_size,
+        norm_eps=hf_config.rms_norm_eps,
+        # configs old enough to lack the field predate the Llama-3 theta
+        # bump; transformers defaulted them to 10000
+        rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+        dtype=dtype,
+    )
+
+
+def _np(t: Any) -> np.ndarray:
+    """torch tensor / np array -> fp32 numpy (bf16 has no numpy dtype in
+    torch, so go through float32)."""
+    if hasattr(t, "detach"):
+        t = t.detach().to("cpu")
+        if hasattr(t, "float"):
+            t = t.float()
+        return t.numpy()
+    return np.asarray(t, dtype=np.float32)
+
+
+def _rope_perm(head_dim: int) -> np.ndarray:
+    """Row permutation taking HF's half-split feature order to our
+    interleaved order: ours[2i] = hf[i], ours[2i+1] = hf[d/2 + i]."""
+    half = head_dim // 2
+    perm = np.empty(head_dim, dtype=np.int64)
+    perm[0::2] = np.arange(half)
+    perm[1::2] = np.arange(half) + half
+    return perm
+
+
+def _proj_in_out(w: np.ndarray) -> np.ndarray:
+    return np.ascontiguousarray(w.T)  # HF [out, in] -> ours [in, out]
+
+
+def _qk(w: np.ndarray, n_heads: int, head_dim: int) -> np.ndarray:
+    """q/k projection: transpose + per-head RoPE-convention permutation of
+    the output features."""
+    perm = _rope_perm(head_dim)
+    w = w.reshape(n_heads, head_dim, -1)[:, perm]  # permute rows per head
+    return _proj_in_out(w.reshape(n_heads * head_dim, -1))
+
+
+def params_from_hf(
+    model_or_state: Any, cfg: LlamaConfig | None = None
+) -> Params:
+    """Convert an HF LlamaForCausalLM (or its state dict) to our params.
+
+    Returns the pytree models/llama.py forwards consume, in ``cfg.dtype``.
+    Tied-embedding checkpoints (no ``lm_head.weight``) reuse the embedding
+    matrix, matching transformers' ``tie_word_embeddings``.
+    """
+    if hasattr(model_or_state, "state_dict"):
+        if cfg is None:
+            cfg = config_from_hf(model_or_state.config)
+        state: Mapping[str, Any] = model_or_state.state_dict()
+    else:
+        state = model_or_state
+        if cfg is None:
+            raise ValueError("cfg is required when passing a raw state dict")
+
+    def get(name: str) -> np.ndarray:
+        return _np(state[name])
+
+    hd = cfg.head_dim
+    layers = []
+    for li in range(cfg.n_layers):
+        p = f"model.layers.{li}."
+        layers.append(
+            {
+                "wq": _qk(get(p + "self_attn.q_proj.weight"), cfg.n_heads, hd),
+                "wk": _qk(get(p + "self_attn.k_proj.weight"), cfg.n_kv_heads, hd),
+                "wv": _proj_in_out(get(p + "self_attn.v_proj.weight")),
+                "wo": _proj_in_out(get(p + "self_attn.o_proj.weight")),
+                "w_gate": _proj_in_out(get(p + "mlp.gate_proj.weight")),
+                "w_up": _proj_in_out(get(p + "mlp.up_proj.weight")),
+                "w_down": _proj_in_out(get(p + "mlp.down_proj.weight")),
+                "ln_attn": get(p + "input_layernorm.weight"),
+                "ln_mlp": get(p + "post_attention_layernorm.weight"),
+            }
+        )
+    stacked: Dict[str, Any] = {}
+    for k in layers[0]:
+        stacked[k] = jnp.asarray(
+            np.stack([layer[k] for layer in layers]), dtype=cfg.dtype
+        )
+    embed = _np(state["model.embed_tokens.weight"])
+    lm_head = (
+        _np(state["lm_head.weight"]).T
+        if "lm_head.weight" in state
+        else embed.T
+    )
+    return {
+        "embed": jnp.asarray(embed, dtype=cfg.dtype),
+        "layers": stacked,
+        "ln_out": jnp.asarray(_np(state["model.norm.weight"]), dtype=cfg.dtype),
+        "lm_head": jnp.asarray(np.ascontiguousarray(lm_head), dtype=cfg.dtype),
+    }

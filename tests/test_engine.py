@@ -112,6 +112,112 @@ def test_single_token_prompt():
     assert eng.generate([42], 4) == dense_greedy([42], 4)
 
 
+def test_chunked_prefill_matches_single_shot():
+    """Chunked prefill (bounded attention memory for long prompts) must be
+    bit-identical in greedy tokens to the one-shot prefill."""
+    prompt = [int(x) for x in np.random.RandomState(3).randint(1, 500, size=23)]
+    want = InferenceEngine(PARAMS, CFG, make_pc()).generate(prompt, 6)
+    eng = InferenceEngine(PARAMS, CFG, make_pc(), prefill_chunk=2 * T)
+    got = eng.generate(prompt, 6)
+    assert got == want
+    # prompt shorter than one chunk still works
+    assert InferenceEngine(PARAMS, CFG, make_pc(), prefill_chunk=2 * T).generate(
+        prompt[:3], 4
+    ) == dense_greedy(prompt[:3], 4)
+
+
+def test_batched_decode_matches_single():
+    """Lockstep batched decode over different-length sequences must produce
+    exactly what each sequence gets decoded alone (vLLM-style batching)."""
+    prompts = [PROMPT, PROMPT[:5], [42, 7, 9]]
+    solo = []
+    for p in prompts:
+        eng = InferenceEngine(PARAMS, CFG, make_pc())
+        solo.append(eng.generate(p, 6))
+    eng = InferenceEngine(PARAMS, CFG, make_pc())
+    states = [eng.prefill(p) for p in prompts]
+    batched = eng.decode_batch(states, 6)
+    assert batched == solo
+    for st, p, got in zip(states, prompts, batched):
+        assert st.tokens == list(p) + got
+
+
+def test_decode_chunk_boundary():
+    """n_steps spanning multiple compiled chunks stays exact."""
+    eng = InferenceEngine(PARAMS, CFG, make_pc())
+    eng.decode_chunk = 3
+    assert eng.generate(PROMPT, 8) == dense_greedy(PROMPT, 8)
+
+
+def test_categorical_sampling_device_side():
+    """Sampling mode: reproducible under a fixed key, near-greedy at tiny
+    temperature, and all tokens in-vocab."""
+    eng = InferenceEngine(PARAMS, CFG, make_pc())
+    st = eng.prefill(PROMPT)
+    a = eng.decode(st, 6, sample="categorical", temperature=0.8,
+                   top_k=8, rng=jax.random.PRNGKey(3))
+    eng2 = InferenceEngine(PARAMS, CFG, make_pc())
+    st2 = eng2.prefill(PROMPT)
+    b = eng2.decode(st2, 6, sample="categorical", temperature=0.8,
+                    top_k=8, rng=jax.random.PRNGKey(3))
+    assert a == b
+    assert all(0 <= t < CFG.vocab_size for t in a)
+    eng3 = InferenceEngine(PARAMS, CFG, make_pc())
+    st3 = eng3.prefill(PROMPT)
+    cold = eng3.decode(st3, 6, sample="categorical", temperature=1e-4,
+                       rng=jax.random.PRNGKey(0))
+    assert cold == dense_greedy(PROMPT, 6)
+
+
+def test_scheduler_continuous_batching():
+    """Requests submitted together and staggered must each match their solo
+    greedy decode; finished requests leave the batch and free their pages."""
+    from infinistore_tpu.engine import Scheduler
+
+    prompts = [PROMPT, PROMPT[:5], [42, 7, 9], [11, 13]]
+    budgets = [6, 9, 4, 7]
+    want = {i: dense_greedy(p, n) for i, (p, n) in enumerate(zip(prompts, budgets))}
+
+    eng = InferenceEngine(PARAMS, CFG, make_pc())
+    eng.decode_chunk = 3  # several admission/retire boundaries per request
+    sched = Scheduler(eng, max_batch=2)  # forces queueing -> staggered admission
+    ids = [sched.submit(p, n) for p, n in zip(prompts, budgets)]
+    got = sched.run()
+    assert {ids[i]: want[i] for i in range(len(prompts))} == got
+    assert not sched.active and not sched.pending
+    # all pages returned to the allocator
+    assert len(eng.alloc._free) == eng.pc.n_blocks
+
+
+def test_scheduler_separates_sampling_groups():
+    """Requests with different sampling params never share a lockstep batch;
+    each still finishes with its own mode."""
+    from infinistore_tpu.engine import Scheduler
+
+    eng = InferenceEngine(PARAMS, CFG, make_pc())
+    eng.decode_chunk = 4
+    sched = Scheduler(eng, max_batch=4)
+    g = sched.submit(PROMPT, 5)  # greedy
+    c = sched.submit(PROMPT[:5], 5, sample="categorical", temperature=0.9)
+    out = sched.run()
+    assert out[g] == dense_greedy(PROMPT, 5)
+    assert len(out[c]) == 5
+    assert all(0 <= t < CFG.vocab_size for t in out[c])
+
+
+def test_scheduler_eos_stops_early():
+    from infinistore_tpu.engine import Scheduler
+
+    eng = InferenceEngine(PARAMS, CFG, make_pc())
+    eng.decode_chunk = 4
+    full = dense_greedy(PROMPT, 8)
+    eos = full[2]  # a token greedy decode actually emits mid-stream
+    sched = Scheduler(eng, max_batch=2)
+    rid = sched.submit(PROMPT, 8, eos_id=eos)
+    out = sched.run()[rid]
+    assert out == full[: full.index(eos) + 1]
+
+
 def test_pd_disaggregation(server):
     """Prefill engine pushes KV to the store; a separate decode engine pulls
     it and must produce the same tokens as the dense reference."""

@@ -1,0 +1,65 @@
+"""HF checkpoint import: converted weights must reproduce transformers'
+Llama logits (validates weight orientation, GQA mapping, the RoPE-convention
+permutation, RMSNorm placement, and tied embeddings)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from infinistore_tpu.models.hf import config_from_hf, params_from_hf  # noqa: E402
+from infinistore_tpu.models.llama import prefill_forward  # noqa: E402
+
+
+def make_hf_model(tie: bool):
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=128,
+        rms_norm_eps=1e-5,
+        rope_theta=10000.0,
+        tie_word_embeddings=tie,
+        attention_bias=False,
+        mlp_bias=False,
+    )
+    torch.manual_seed(0)
+    with torch.no_grad():
+        model = transformers.LlamaForCausalLM(hf_cfg)
+        # random init is near-zero-logit; scale up so differences are visible
+        for p in model.parameters():
+            p.mul_(3.0)
+    model.eval()
+    return model
+
+
+@pytest.mark.parametrize("tie", [False, True])
+def test_logits_match_transformers(tie):
+    model = make_hf_model(tie)
+    cfg = config_from_hf(model.config, dtype=jnp.float32)
+    params = params_from_hf(model, cfg)
+
+    tokens = np.array([[5, 17, 99, 3, 42, 200, 7, 1]], dtype=np.int64)
+    with torch.no_grad():
+        want = model(torch.from_numpy(tokens)).logits.numpy()
+
+    got, _ = prefill_forward(params, cfg, jnp.asarray(tokens, dtype=jnp.int32))
+    got = np.asarray(got, dtype=np.float32)
+
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
+def test_state_dict_entry_point():
+    model = make_hf_model(tie=False)
+    cfg = config_from_hf(model.config, dtype=jnp.float32)
+    params = params_from_hf(model.state_dict(), cfg)
+    tokens = jnp.asarray([[1, 2, 3]], dtype=jnp.int32)
+    logits, kv = prefill_forward(params, cfg, tokens)
+    assert logits.shape == (1, 3, cfg.vocab_size)
+    assert kv.shape[0] == cfg.n_layers
