@@ -97,6 +97,13 @@ class InferenceEngine:
         self.decode_chunk = 32
         self._decode_many_cache: Dict[Any, object] = {}
         self._rng = jax.random.PRNGKey(0)
+        # in-place append into the bucketed chunked-prefill KV buffer
+        self._kv_append = jax.jit(
+            lambda buf, kv, off: jax.lax.dynamic_update_slice(
+                buf, kv, (0, 0, 0, off, 0, 0)
+            ),
+            donate_argnums=(0,),
+        )
 
     # ---- prefill ----
 
@@ -131,7 +138,11 @@ class InferenceEngine:
         # ``prefill_chunk`` tokens per forward (chunked prefill): each chunk
         # attends to the accumulated prefix KV + itself, so long prompts cost
         # O(chunk * S) attention memory instead of O(S^2), and each chunk's
-        # pages land in the HBM cache as soon as they are computed.
+        # pages land in the HBM cache as soon as they are computed.  The
+        # prefix lives in a buffer bucketed at power-of-two capacities with a
+        # traced valid length (prefix_len): the forward specializes on
+        # O(log(S/chunk)) buffer shapes instead of one per chunk index, and
+        # appends are in-place (donated dynamic_update_slice).
         suffix = tokens[P:]
         S = len(suffix)
         pad = (-S) % T
@@ -140,19 +151,41 @@ class InferenceEngine:
         assert C % T == 0 or C == len(padded), (
             "prefill_chunk must be a multiple of block_tokens"
         )
-        prefix = prefix_kv
+
+        def cap_for(n: int) -> int:
+            c = C
+            while c < n:
+                c *= 2
+            return c
+
+        single = C >= len(padded)
+        if single:
+            buf, plen = prefix_kv, P  # exact buffer: no masking, flash OK
+        elif prefix_kv is not None:
+            cap = cap_for(P)
+            buf = jnp.pad(
+                prefix_kv, ((0, 0),) * 3 + ((0, cap - P),) + ((0, 0),) * 2
+            )
+            plen = P
+        else:
+            buf, plen = None, 0
+
         done = reused
         logits = None
         off_last = 0
         for off in range(0, len(padded), C):
             chunk = padded[off : off + C]
             arr = jnp.asarray(chunk, dtype=jnp.int32)[None]
-            logits, kv = self._prefill_jit(
-                self.params, tokens=arr, prefix_kv=prefix
-            )
-            if off + C < len(padded):  # another chunk still attends to this KV
-                prefix = kv if prefix is None else jnp.concatenate(
-                    [prefix, kv], axis=3
+            if buf is None:
+                logits, kv = self._prefill_jit(self.params, tokens=arr)
+            elif single:
+                logits, kv = self._prefill_jit(
+                    self.params, tokens=arr, prefix_kv=buf
+                )
+            else:
+                logits, kv = self._prefill_jit(
+                    self.params, tokens=arr, prefix_kv=buf,
+                    prefix_len=jnp.asarray(plen, dtype=jnp.int32),
                 )
             n_pg = len(chunk) // T
             self.cache = write_pages(
@@ -162,6 +195,25 @@ class InferenceEngine:
             )
             done += n_pg
             off_last = off
+            if off + C < len(padded):  # another chunk still attends to this KV
+                need = plen + len(chunk)
+                ncap = cap_for(need)
+                if buf is None:
+                    buf = jnp.pad(
+                        kv, ((0, 0),) * 3 + ((0, ncap - len(chunk)),) + ((0, 0),) * 2
+                    )
+                else:
+                    if ncap > buf.shape[3]:
+                        buf = jnp.pad(
+                            buf,
+                            ((0, 0),) * 3
+                            + ((0, ncap - buf.shape[3]),)
+                            + ((0, 0),) * 2,
+                        )
+                    buf = self._kv_append(
+                        buf, kv, jnp.asarray(plen, dtype=jnp.int32)
+                    )
+                plen = need
 
         # push complete chunks to the store (prefill-node role)
         if self.transfer is not None:

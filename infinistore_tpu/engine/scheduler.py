@@ -50,7 +50,6 @@ class Scheduler:
         self.max_batch = max_batch
         self.pending: List[Request] = []
         self.active: List[Request] = []
-        self.finished: Dict[int, Request] = {}
         self._next_id = 0
         self._rng = rng if rng is not None else jax.random.PRNGKey(0)
 
@@ -110,7 +109,6 @@ class Scheduler:
                 del out[cut:]
                 req.done = True
                 self.engine.release(req.state)
-                self.finished[req.req_id] = req
                 done_now.append(req)
             else:
                 still.append(req)
@@ -145,7 +143,12 @@ class Scheduler:
 
     def run(self) -> Dict[int, List[int]]:
         """Drive until every submitted request finishes; returns
-        req_id -> generated tokens."""
+        req_id -> generated tokens.  (``step()`` hands each finished request
+        back exactly once and the scheduler keeps no reference — a
+        long-running server that drives ``step()`` itself owns the results
+        and the scheduler's memory stays bounded by the active batch.)"""
+        results: Dict[int, List[int]] = {}
         while self.has_work:
-            self.step()
-        return {rid: r.output for rid, r in self.finished.items()}
+            for req in self.step():
+                results[req.req_id] = req.output
+        return results

@@ -17,16 +17,48 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def rope_freqs(head_dim: int, theta: float = 500000.0) -> jax.Array:
-    return 1.0 / (
+def rope_freqs(
+    head_dim: int,
+    theta: float = 500000.0,
+    scaling: tuple | None = None,
+) -> jax.Array:
+    """Base RoPE frequencies, optionally remapped by Llama-3.1-style
+    context-extension scaling.
+
+    ``scaling``: ``(factor, low_freq_factor, high_freq_factor,
+    original_max_position_embeddings)`` — long wavelengths (relative to the
+    original context) are slowed by ``factor``, short ones are kept, and the
+    band between is interpolated.  Matches transformers'
+    ``rope_type="llama3"`` so imported 3.1/3.2 checkpoints reproduce HF
+    logits (tests/test_hf_import.py).
+    """
+    freqs = 1.0 / (
         theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
     )
+    if scaling is not None:
+        factor, low_f, high_f, orig_ctx = scaling
+        wavelen = 2.0 * np.pi / freqs
+        low_wl = orig_ctx / low_f
+        high_wl = orig_ctx / high_f
+        smooth = (orig_ctx / wavelen - low_f) / (high_f - low_f)
+        interp = (1.0 - smooth) * freqs / factor + smooth * freqs
+        freqs = jnp.where(
+            wavelen > low_wl,
+            freqs / factor,
+            jnp.where(wavelen < high_wl, freqs, interp),
+        )
+    return freqs
 
 
-def apply_rope(x: jax.Array, positions: jax.Array, theta: float = 500000.0) -> jax.Array:
+def apply_rope(
+    x: jax.Array,
+    positions: jax.Array,
+    theta: float = 500000.0,
+    scaling: tuple | None = None,
+) -> jax.Array:
     """x: [..., S, H, D]; positions: broadcastable to [..., S]."""
     d = x.shape[-1]
-    freqs = rope_freqs(d, theta)  # [D/2]
+    freqs = rope_freqs(d, theta, scaling)  # [D/2]
     angles = positions[..., None].astype(jnp.float32) * freqs  # [..., S, D/2]
     cos = jnp.cos(angles)[..., None, :]  # [..., S, 1, D/2]
     sin = jnp.sin(angles)[..., None, :]
@@ -49,22 +81,63 @@ def repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
 
 
 def causal_attention(
-    q: jax.Array, k: jax.Array, v: jax.Array, q_offset: jax.Array | int = 0
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    q_offset: jax.Array | int = 0,
+    allow_pallas: bool = False,
+    prefix_pad: int | None = None,
+    prefix_len: jax.Array | None = None,
 ) -> jax.Array:
     """Causal SDPA.  q: [B, Sq, H, D]; k/v: [B, Sk, H_kv, D].
 
     ``q_offset``: absolute position of q[0] minus that of k[0] (chunked
     prefill attends to cached prefix + itself).
+
+    Padded-prefix mode (``prefix_pad``/``prefix_len`` both given): the first
+    ``prefix_pad`` K/V rows are a prefix buffer of which only the first
+    ``prefix_len`` (a traced scalar) are valid, and the remaining rows are
+    the queries' own KV.  Bucketing the prefix buffer to a few static
+    capacities keeps chunked prefill's compile count logarithmic
+    (engine/engine.py) while this mask hides the slack.
+
+    ``allow_pallas=True`` routes to the flash kernel
+    (ops/pallas_attention.py) on TPU when the head dim is lane-aligned; it
+    must stay False under a GSPMD-partitioned jit (same rule as
+    ``paged_decode_attention`` below) — which is why the sharded callers in
+    parallel/ use the default.  ``ISTPU_NO_PALLAS=1`` forces the XLA path.
     """
+    import os
+
     B, Sq, H, D = q.shape
+    if (
+        allow_pallas
+        and prefix_len is None
+        and isinstance(q_offset, int)
+        and D % 128 == 0
+        and jax.default_backend() == "tpu"
+        and not os.environ.get("ISTPU_NO_PALLAS")
+    ):
+        from ..ops.pallas_attention import flash_causal_attention_pallas
+
+        return flash_causal_attention_pallas(q, k, v, q_offset=q_offset)
     Hkv = k.shape[2]
     k = repeat_kv(k, H // Hkv)
     v = repeat_kv(v, H // Hkv)
     scale = 1.0 / np.sqrt(D)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
-    q_pos = jnp.arange(Sq) + q_offset
     k_pos = jnp.arange(k.shape[1])
-    mask = k_pos[None, :] <= q_pos[:, None]  # [Sq, Sk]
+    if prefix_len is not None:
+        assert prefix_pad is not None
+        i = jnp.arange(Sq)[:, None]  # query row within the chunk
+        in_prefix = k_pos[None, :] < prefix_len  # valid prefix rows
+        in_self = (k_pos[None, :] >= prefix_pad) & (
+            k_pos[None, :] - prefix_pad <= i
+        )
+        mask = in_prefix | in_self  # [Sq, Sk]
+    else:
+        q_pos = jnp.arange(Sq) + q_offset
+        mask = k_pos[None, :] <= q_pos[:, None]  # [Sq, Sk]
     logits = jnp.where(mask[None, None], logits, -jnp.inf)
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
@@ -128,6 +201,7 @@ def paged_decode_attention(
 
     if (
         allow_pallas
+        and q.shape[-1] % 128 == 0  # head dim must fill whole lanes
         and jax.default_backend() == "tpu"
         and not os.environ.get("ISTPU_NO_PALLAS")
     ):

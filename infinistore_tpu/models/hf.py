@@ -29,7 +29,31 @@ from .llama import LlamaConfig, Params
 
 
 def config_from_hf(hf_config: Any, dtype: Any = jnp.bfloat16) -> LlamaConfig:
-    """Map a ``transformers.LlamaConfig`` onto ours."""
+    """Map a ``transformers.LlamaConfig`` onto ours.
+
+    Raises on configurations this model family cannot represent (a custom
+    ``head_dim`` or an unknown ``rope_scaling`` type) rather than importing
+    weights that would silently produce wrong logits.
+    """
+    derived_hd = hf_config.hidden_size // hf_config.num_attention_heads
+    explicit_hd = getattr(hf_config, "head_dim", None)
+    if explicit_hd is not None and explicit_hd != derived_hd:
+        raise ValueError(
+            f"unsupported head_dim {explicit_hd} != hidden/heads {derived_hd}"
+        )
+    rs = getattr(hf_config, "rope_scaling", None)
+    scaling = None
+    if rs:
+        rtype = rs.get("rope_type", rs.get("type", "default"))
+        if rtype == "llama3":
+            scaling = (
+                float(rs["factor"]),
+                float(rs["low_freq_factor"]),
+                float(rs["high_freq_factor"]),
+                int(rs["original_max_position_embeddings"]),
+            )
+        elif rtype != "default":
+            raise ValueError(f"unsupported rope_scaling type {rtype!r}")
     return LlamaConfig(
         vocab_size=hf_config.vocab_size,
         dim=hf_config.hidden_size,
@@ -43,6 +67,7 @@ def config_from_hf(hf_config: Any, dtype: Any = jnp.bfloat16) -> LlamaConfig:
         # configs old enough to lack the field predate the Llama-3 theta
         # bump; transformers defaulted them to 10000
         rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+        rope_scaling=scaling,
         dtype=dtype,
     )
 

@@ -39,6 +39,10 @@ class LlamaConfig:
     ffn_dim: int = 14336
     norm_eps: float = 1e-5
     rope_theta: float = 500000.0
+    # Llama-3.1-style context-extension RoPE remap: (factor, low_freq_factor,
+    # high_freq_factor, original_max_position_embeddings); a tuple, not a
+    # dict, so the frozen config stays hashable (attention.rope_freqs)
+    rope_scaling: Tuple[float, float, float, int] | None = None
     dtype: Any = jnp.bfloat16
 
     @property
@@ -110,8 +114,8 @@ def _attn_qkv(layer: Params, cfg: LlamaConfig, x: jax.Array, positions: jax.Arra
     q = (x @ layer["wq"]).reshape(B, S, cfg.n_heads, hd)
     k = (x @ layer["wk"]).reshape(B, S, cfg.n_kv_heads, hd)
     v = (x @ layer["wv"]).reshape(B, S, cfg.n_kv_heads, hd)
-    q = apply_rope(q, positions, cfg.rope_theta)
-    k = apply_rope(k, positions, cfg.rope_theta)
+    q = apply_rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
+    k = apply_rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
     return q, k, v
 
 
@@ -131,6 +135,8 @@ def prefill_forward(
     cfg: LlamaConfig,
     tokens: jax.Array,
     prefix_kv: jax.Array | None = None,
+    use_pallas: bool = True,
+    prefix_len: jax.Array | None = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """tokens: [B, S] -> (logits [B, S, V], kv [L, 2, B, S, Hkv, D]).
 
@@ -138,10 +144,21 @@ def prefill_forward(
     chunked prefill on top of a reused prefix: ``tokens`` are positions
     P..P+S-1 and attend to the prefix KV plus themselves causally.  The
     returned KV covers only the new tokens.
+
+    ``prefix_len`` (traced int32 scalar): when ``prefix_kv`` is a padded
+    buffer, only its first ``prefix_len`` rows are valid — the token
+    positions start there and the slack is masked out of attention.  Keeping
+    the buffer at a few bucketed capacities bounds chunked prefill's
+    compile count (engine/engine.py).
+
+    ``use_pallas=False`` forces the XLA attention path; required when this
+    function is traced under a GSPMD-partitioned jit (see loss_fn and
+    parallel/sharding.py — same rule as decode_forward).
     """
     B, S = tokens.shape
     P = 0 if prefix_kv is None else prefix_kv.shape[3]
-    positions = jnp.broadcast_to(jnp.arange(S) + P, (B, S))
+    start = P if prefix_len is None else prefix_len
+    positions = jnp.broadcast_to(jnp.arange(S) + start, (B, S))
     x = params["embed"][tokens]
     kvs = []
     for li in range(cfg.n_layers):
@@ -150,11 +167,15 @@ def prefill_forward(
         q, k, v = _attn_qkv(layer, cfg, h, positions)
         kvs.append(jnp.stack([k, v], axis=0))  # [2, B, S, Hkv, D]
         if prefix_kv is None:
-            attn = causal_attention(q, k, v)
+            attn = causal_attention(q, k, v, allow_pallas=use_pallas)
         else:
             k_full = jnp.concatenate([prefix_kv[li, 0], k], axis=1)
             v_full = jnp.concatenate([prefix_kv[li, 1], v], axis=1)
-            attn = causal_attention(q, k_full, v_full, q_offset=P)
+            attn = causal_attention(
+                q, k_full, v_full, q_offset=P, allow_pallas=use_pallas,
+                prefix_pad=P if prefix_len is not None else None,
+                prefix_len=prefix_len,
+            )
         x = x + attn.reshape(B, S, -1) @ layer["wo"]
         h = rmsnorm(x, layer["ln_mlp"], cfg.norm_eps)
         x = x + _mlp(layer, h)
@@ -211,7 +232,8 @@ def decode_forward(
 
 def loss_fn(params: Params, cfg: LlamaConfig, tokens: jax.Array) -> jax.Array:
     """Next-token cross entropy over [B, S] tokens."""
-    logits, _ = prefill_forward(params, cfg, tokens)
+    # XLA path: the train step runs under GSPMD-partitioned jit
+    logits, _ = prefill_forward(params, cfg, tokens, use_pallas=False)
     logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
     tgt = tokens[:, 1:]
     nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]

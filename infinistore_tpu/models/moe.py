@@ -112,16 +112,20 @@ def moe_prefill_forward(
     cfg: MoEConfig,
     tokens: jax.Array,
     prefix_kv: jax.Array | None = None,
+    use_pallas: bool = True,
+    prefix_len: jax.Array | None = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """tokens: [B, S] -> (logits [B, S, V], kv [L, 2, B, S, Hkv, D]).
 
     Same contract as models.llama.prefill_forward (including chunked
-    prefill on a reused ``prefix_kv``), so the serving engines and KV
-    paging work unchanged for MoE models.
+    prefill on a padded/bucketed ``prefix_kv`` with traced ``prefix_len``
+    and the ``use_pallas=False`` requirement under GSPMD), so the serving
+    engines and KV paging work unchanged for MoE models.
     """
     B, S = tokens.shape
     Pfx = 0 if prefix_kv is None else prefix_kv.shape[3]
-    positions = jnp.broadcast_to(jnp.arange(S) + Pfx, (B, S))
+    start = Pfx if prefix_len is None else prefix_len
+    positions = jnp.broadcast_to(jnp.arange(S) + start, (B, S))
     x = params["embed"][tokens]
     kvs = []
     for li in range(cfg.n_layers):
@@ -130,11 +134,15 @@ def moe_prefill_forward(
         q, k, v = _attn_qkv(layer, cfg, h, positions)
         kvs.append(jnp.stack([k, v], axis=0))
         if prefix_kv is None:
-            attn = causal_attention(q, k, v)
+            attn = causal_attention(q, k, v, allow_pallas=use_pallas)
         else:
             k_full = jnp.concatenate([prefix_kv[li, 0], k], axis=1)
             v_full = jnp.concatenate([prefix_kv[li, 1], v], axis=1)
-            attn = causal_attention(q, k_full, v_full, q_offset=Pfx)
+            attn = causal_attention(
+                q, k_full, v_full, q_offset=Pfx, allow_pallas=use_pallas,
+                prefix_pad=Pfx if prefix_len is not None else None,
+                prefix_len=prefix_len,
+            )
         x = x + attn.reshape(B, S, -1) @ layer["wo"]
         h = rmsnorm(x, layer["ln_mlp"], cfg.norm_eps)
         x = x + moe_ffn(layer, h, cfg.top_k)
@@ -177,7 +185,8 @@ def moe_decode_forward(
 
 
 def moe_loss_fn(params: Params, cfg: MoEConfig, tokens: jax.Array) -> jax.Array:
-    logits, _ = moe_prefill_forward(params, cfg, tokens)
+    # XLA path: the train step runs under GSPMD-partitioned jit
+    logits, _ = moe_prefill_forward(params, cfg, tokens, use_pallas=False)
     logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
     tgt = tokens[:, 1:]
     nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]

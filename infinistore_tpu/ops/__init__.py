@@ -6,4 +6,7 @@ in ``models/attention.py``; kernels here are drop-in replacements validated
 against them in tests/test_ops.py.
 """
 
-from .pallas_attention import paged_decode_attention_pallas  # noqa: F401
+from .pallas_attention import (  # noqa: F401
+    flash_causal_attention_pallas,
+    paged_decode_attention_pallas,
+)

@@ -87,6 +87,134 @@ def _decode_kernel(
         o_ref[0, 0] = (acc_scr[:] / l_scr[:, :1]).astype(o_ref.dtype)
 
 
+def _flash_kernel(
+    q_ref,    # [1, 1, Bq, D]
+    k_ref,    # [1, 1, Bk, D]
+    v_ref,    # [1, 1, Bk, D]
+    o_ref,    # [1, 1, Bq, D]
+    m_scr,    # [Bq, 128] fp32 running max (col 0 used)
+    l_scr,    # [Bq, 128] fp32 running denominator (col 0 used)
+    acc_scr,  # [Bq, D] fp32 numerator
+    *,
+    scale: float,
+    q_offset: int,
+    block_q: int,
+    block_k: int,
+):
+    iq = pl.program_id(2)
+    ik = pl.program_id(3)
+    n_k = pl.num_programs(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # absolute positions of this tile's rows/cols; the causal test also
+    # masks tail padding (padded K rows sit past every real Q position)
+    q_pos = q_offset + iq * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+    k_pos = ik * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+
+    @pl.when(ik * block_k <= q_offset + (iq + 1) * block_q - 1)
+    def _attend():  # block intersects the causal triangle
+        q = q_ref[0, 0].astype(jnp.float32)  # [Bq, D]
+        k = k_ref[0, 0].astype(jnp.float32)  # [Bk, D]
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [Bq, Bk]
+        s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+        m_prev = m_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_scr[:, :1] = l_scr[:, :1] * corr + jnp.sum(p, axis=1, keepdims=True)
+        m_scr[:, :1] = m_new
+        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot(
+            p, v, preferred_element_type=jnp.float32
+        )
+
+    @pl.when(ik == n_k - 1)
+    def _finish():
+        o_ref[0, 0] = (acc_scr[:] / l_scr[:, :1]).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("q_offset", "interpret", "block_q", "block_k")
+)
+def flash_causal_attention_pallas(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    q_offset: int = 0,
+    interpret: bool = False,
+    block_q: int = 128,
+    block_k: int = 128,
+) -> jax.Array:
+    """Flash-style causal prefill attention (online softmax, GQA).
+
+    q: [B, Sq, H, D]; k/v: [B, Sk, H_kv, D]; ``q_offset`` = absolute
+    position of q[0] minus that of k[0] (chunked prefill attends to the
+    cached prefix plus itself).  Returns [B, Sq, H, D].
+
+    The O(S^2) score matrix never exists in HBM: K/V stream HBM->VMEM in
+    [block_k, D] tiles and the m/l/acc accumulators carry across the
+    innermost k-block grid axis (same structure as the paged decode kernel
+    above).  This is the role flash attention plays in the reference's GPU
+    serving stack; matches models/attention.py:causal_attention
+    (tests/test_ops.py).
+    """
+    B, Sq, H, D = q.shape
+    Sk, Hkv = k.shape[1], k.shape[2]
+    n_rep = H // Hkv
+    scale = 1.0 / np.sqrt(D)
+
+    pad_q = (-Sq) % block_q
+    pad_k = (-Sk) % block_k
+    # [B, S, H, D] -> [B, H, S, D] tiles; padded K rows are causally masked
+    # for every real Q row, padded Q rows are dropped on return
+    qt = jnp.pad(jnp.transpose(q, (0, 2, 1, 3)), ((0, 0), (0, 0), (0, pad_q), (0, 0)))
+    kt = jnp.pad(jnp.transpose(k, (0, 2, 1, 3)), ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+    vt = jnp.pad(jnp.transpose(v, (0, 2, 1, 3)), ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+
+    grid = (B, H, (Sq + pad_q) // block_q, (Sk + pad_k) // block_k)
+
+    def q_map(b, h, iq, ik):
+        return (b, h, iq, 0)
+
+    def kv_map(b, h, iq, ik):
+        return (b, h // n_rep, ik, 0)
+
+    out = pl.pallas_call(
+        functools.partial(
+            _flash_kernel, scale=scale, q_offset=q_offset,
+            block_q=block_q, block_k=block_k,
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D), q_map),
+            pl.BlockSpec((1, 1, block_k, D), kv_map),
+            pl.BlockSpec((1, 1, block_k, D), kv_map),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, D), q_map),
+        out_shape=jax.ShapeDtypeStruct((B, H, Sq + pad_q, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt)
+
+    return jnp.transpose(out[:, :, :Sq], (0, 2, 1, 3))
+
+
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def paged_decode_attention_pallas(
     q: jax.Array,

@@ -47,8 +47,8 @@ def tp_layer_forward(
     q = (h @ layer["wq"]).reshape(B, S, h_loc, hd)
     k = (h @ layer["wk"]).reshape(B, S, hkv_loc, hd)
     v = (h @ layer["wv"]).reshape(B, S, hkv_loc, hd)
-    q = apply_rope(q, positions, cfg.rope_theta)
-    k = apply_rope(k, positions, cfg.rope_theta)
+    q = apply_rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
+    k = apply_rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
     attn = ring_attention_local(q, k, v, sp_axis)  # [B, S, h_loc, hd]
     attn_out = attn.reshape(B, S, h_loc * hd) @ layer["wo"]
     x = x + lax.psum(attn_out, tp_axis)

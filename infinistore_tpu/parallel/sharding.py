@@ -65,7 +65,8 @@ def make_tp_prefill(cfg: LlamaConfig, mesh: Mesh):
     logits_sharding = NamedSharding(mesh, P("dp", None, "tp"))
 
     def fn(params, tokens):
-        return prefill_forward(params, cfg, tokens)
+        # XLA attention path: this jit is GSPMD-partitioned
+        return prefill_forward(params, cfg, tokens, use_pallas=False)
 
     return jax.jit(
         fn,

@@ -114,8 +114,10 @@ def test_single_token_prompt():
 
 def test_chunked_prefill_matches_single_shot():
     """Chunked prefill (bounded attention memory for long prompts) must be
-    bit-identical in greedy tokens to the one-shot prefill."""
-    prompt = [int(x) for x in np.random.RandomState(3).randint(1, 500, size=23)]
+    bit-identical in greedy tokens to the one-shot prefill.  The 30-token
+    prompt forces the bucketed prefix buffer through a growth step AND a
+    slack state (prefix_len 24 < capacity 32), exercising the traced mask."""
+    prompt = [int(x) for x in np.random.RandomState(3).randint(1, 500, size=30)]
     want = InferenceEngine(PARAMS, CFG, make_pc()).generate(prompt, 6)
     eng = InferenceEngine(PARAMS, CFG, make_pc(), prefill_chunk=2 * T)
     got = eng.generate(prompt, 6)

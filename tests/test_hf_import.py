@@ -55,6 +55,53 @@ def test_logits_match_transformers(tie):
     np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
 
 
+def test_llama31_rope_scaling_matches_transformers():
+    """Llama-3.1/3.2 checkpoints ship rope_scaling rope_type='llama3'; the
+    imported model must reproduce HF logits with the scaled frequencies."""
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256, rms_norm_eps=1e-5, rope_theta=500000.0,
+        tie_word_embeddings=False, attention_bias=False, mlp_bias=False,
+        rope_scaling={
+            "rope_type": "llama3", "factor": 8.0, "low_freq_factor": 1.0,
+            "high_freq_factor": 4.0, "original_max_position_embeddings": 32,
+        },
+    )
+    torch.manual_seed(1)
+    with torch.no_grad():
+        model = transformers.LlamaForCausalLM(hf_cfg)
+        for p in model.parameters():
+            p.mul_(3.0)
+    model.eval()
+    cfg = config_from_hf(model.config, dtype=jnp.float32)
+    assert cfg.rope_scaling == (8.0, 1.0, 4.0, 32)
+    params = params_from_hf(model, cfg)
+
+    # positions past original_max_position_embeddings exercise the remap
+    tokens = np.arange(1, 49, dtype=np.int64)[None] % 256
+    with torch.no_grad():
+        want = model(torch.from_numpy(tokens)).logits.numpy()
+    got, _ = prefill_forward(params, cfg, jnp.asarray(tokens, dtype=jnp.int32))
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), want, rtol=2e-3, atol=2e-3
+    )
+
+
+def test_rejects_unrepresentable_configs():
+    base = dict(
+        vocab_size=64, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=1, num_attention_heads=4, num_key_value_heads=2,
+    )
+    with pytest.raises(ValueError, match="head_dim"):
+        config_from_hf(transformers.LlamaConfig(**base, head_dim=32))
+    with pytest.raises(ValueError, match="rope_scaling"):
+        config_from_hf(transformers.LlamaConfig(
+            **base,
+            rope_scaling={"rope_type": "yarn", "factor": 2.0},
+        ))
+
+
 def test_state_dict_entry_point():
     model = make_hf_model(tie=False)
     cfg = config_from_hf(model.config, dtype=jnp.float32)

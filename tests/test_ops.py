@@ -54,3 +54,55 @@ def test_paged_decode_kernel_single_token():
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(want), rtol=5e-6, atol=5e-6
     )
+
+
+# ---- flash causal prefill kernel ----
+
+from infinistore_tpu.models.attention import causal_attention  # noqa: E402
+from infinistore_tpu.ops import flash_causal_attention_pallas  # noqa: E402
+
+
+def _flash_setup(B, Sq, Sk, H, Hkv, D, seed=0, dtype=jnp.float32):
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.standard_normal((B, Sq, H, D)), dtype)
+    k = jnp.asarray(rng.standard_normal((B, Sk, Hkv, D)), dtype)
+    v = jnp.asarray(rng.standard_normal((B, Sk, Hkv, D)), dtype)
+    return q, k, v
+
+
+@pytest.mark.parametrize("n_rep", [1, 4])
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_flash_prefill_matches_xla(n_rep, dtype):
+    B, S, Hkv, D = 2, 48, 2, 128  # S straddles block boundaries after padding
+    q, k, v = _flash_setup(B, S, S, Hkv * n_rep, Hkv, D, dtype=dtype)
+    want = causal_attention(q, k, v)
+    got = flash_causal_attention_pallas(
+        q, k, v, interpret=True, block_q=16, block_k=16
+    )
+    tol = 5e-6 if dtype == jnp.float32 else 2e-2
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32),
+        rtol=tol, atol=tol,
+    )
+
+
+def test_flash_prefill_chunked_offset():
+    """Chunked prefill: queries at positions P..P+Sq-1 over prefix+self KV."""
+    B, P, Sq, Hkv, D = 1, 24, 18, 2, 128
+    q, k, v = _flash_setup(B, Sq, P + Sq, 4, Hkv, D, seed=3)
+    want = causal_attention(q, k, v, q_offset=P)
+    got = flash_causal_attention_pallas(
+        q, k, v, q_offset=P, interpret=True, block_q=16, block_k=16
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_flash_prefill_single_row():
+    q, k, v = _flash_setup(1, 1, 1, 4, 2, 128, seed=5)
+    want = causal_attention(q, k, v)
+    got = flash_causal_attention_pallas(q, k, v, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+    )
