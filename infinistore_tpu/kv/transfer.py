@@ -29,10 +29,15 @@ from .hashing import layer_key
 class KVTransferEngine:
     """Moves pages between a paged HBM cache and an infinistore-tpu server."""
 
-    def __init__(self, conn, cfg: PagedCacheConfig):
+    def __init__(self, conn, cfg: PagedCacheConfig, pipeline_groups: int = 4):
         # accept the public InfinityConnection or the raw wire Connection
         self.conn = getattr(conn, "conn", conn)
         self.cfg = cfg
+        # save_pages splits the D2H transfer into this many layer bands and
+        # overlaps each band's pool write with the next band's transfer
+        # (the role the reference's async RDMA WR chains play on the GPU
+        # side); 1 = fully serial
+        self.pipeline_groups = pipeline_groups
         self._staging: Optional[np.ndarray] = None
 
     def _ensure_staging(self, nbytes: int) -> np.ndarray:
@@ -41,11 +46,23 @@ class KVTransferEngine:
             self.conn.register_mr(self._staging.ctypes.data, self._staging.nbytes)
         return self._staging
 
+    def _page_blocks(
+        self, chunk_keys_: Sequence[str], l0: int, l1: int
+    ) -> List[Tuple[str, int]]:
+        """The store layout, defined once for both directions: layer-major,
+        chunk-minor ``(key, offset)`` pairs for layers [l0, l1), offsets
+        relative to a buffer that starts at layer ``l0``."""
+        pb = self.cfg.page_bytes
+        n = len(chunk_keys_)
+        return [
+            (layer_key(ck, layer), ((layer - l0) * n + i) * pb)
+            for layer in range(l0, l1)
+            for i, ck in enumerate(chunk_keys_)
+        ]
+
     def _page_keys(self, chunk_keys_: Sequence[str]) -> List[str]:
         return [
-            layer_key(ck, layer)
-            for layer in range(self.cfg.n_layers)
-            for ck in chunk_keys_
+            k for k, _ in self._page_blocks(chunk_keys_, 0, self.cfg.n_layers)
         ]
 
     def save_pages(
@@ -64,16 +81,26 @@ class KVTransferEngine:
         gathered = read_pages(cache, ids)  # [L, 2, H, n, T, D]
         # -> [L, n, 2, H, T, D] so each (layer, chunk) page is contiguous
         pages = jnp.transpose(gathered, (0, 3, 1, 2, 4, 5))
-        # One D2H transfer lands in a fresh C-contiguous host array; hand its
-        # pointer straight to the put so the only host-side copy is the
-        # client->pool write (the RDMA-WRITE analog).  No staging memcpy.
-        host = np.ascontiguousarray(jax.device_get(pages))
-        view = host.reshape(-1).view(np.uint8)
+        # Split into layer bands, start every band's D2H up front
+        # (copy_to_host_async), then write band i into the pool while bands
+        # i+1.. are still streaming device->host.  Each band's host array
+        # pointer goes straight to the put, so the only synchronous host
+        # copy is the client->pool write (the RDMA-WRITE analog).
+        L = self.cfg.n_layers
         pb = self.cfg.page_bytes
-        keys = self._page_keys(chunk_keys_)
-        blocks = [(k, i * pb) for i, k in enumerate(keys)]
-        self.conn.write_cache(blocks, pb, host.ctypes.data)
-        return view.nbytes
+        G = max(1, min(self.pipeline_groups, L))
+        Lg = -(-L // G)
+        parts = [pages[l0 : l0 + Lg] for l0 in range(0, L, Lg)]
+        for p in parts:
+            p.copy_to_host_async()
+        total = 0
+        for gi, p in enumerate(parts):
+            host = np.ascontiguousarray(np.asarray(p))  # waits for this band
+            l0 = gi * Lg
+            blocks = self._page_blocks(chunk_keys_, l0, l0 + p.shape[0])
+            self.conn.write_cache(blocks, pb, host.ctypes.data)
+            total += host.nbytes
+        return total
 
     def load_pages(
         self, cache: jax.Array, block_ids: Sequence[int], chunk_keys_: Sequence[str]
@@ -88,10 +115,9 @@ class KVTransferEngine:
         if n == 0:
             return cache
         pb = self.cfg.page_bytes
-        keys = self._page_keys(chunk_keys_)
-        nbytes = len(keys) * pb
+        blocks = self._page_blocks(chunk_keys_, 0, self.cfg.n_layers)
+        nbytes = len(blocks) * pb
         staging = self._ensure_staging(nbytes)
-        blocks = [(k, i * pb) for i, k in enumerate(keys)]
         self.conn.read_cache(blocks, pb, staging.ctypes.data)
         L = self.cfg.n_layers
         host = (

@@ -188,8 +188,13 @@ def flash_causal_attention_pallas(
     def q_map(b, h, iq, ik):
         return (b, h, iq, 0)
 
+    # causal frontier: the last k block that q block iq can see.  Clamping
+    # the index map there makes every fully-masked step re-request the same
+    # block, and the pipeline skips the duplicate fetch — no dead K/V DMA
+    # above the diagonal (HBM bandwidth is the kernel's bottleneck).
     def kv_map(b, h, iq, ik):
-        return (b, h // n_rep, ik, 0)
+        frontier = (q_offset + (iq + 1) * block_q - 1) // block_k
+        return (b, h // n_rep, jnp.minimum(ik, frontier), 0)
 
     out = pl.pallas_call(
         functools.partial(

@@ -82,10 +82,10 @@ def server():
         proc.kill()
 
 
-def _conn(port):
+def _conn(port, conn_type=None):
     c = ist.InfinityConnection(
         ist.ClientConfig(host_addr="127.0.0.1", service_port=port,
-                         connection_type=ist.TYPE_SHM)
+                         connection_type=conn_type or ist.TYPE_SHM)
     )
     c.connect()
     return c
@@ -240,6 +240,27 @@ def test_pd_disaggregation(server):
     assert st2.reused_chunks == len(PROMPT) // T  # all complete chunks reused
     got = decode_eng.decode(st2, 8)
     assert got == dense_greedy(PROMPT, 8)
+    prefill_conn.close()
+    decode_conn.close()
+
+
+def test_pd_disaggregation_over_tcp(server):
+    """Same PD flow with both engines on the TCP transport — the DCN
+    cross-host path (reference BASELINE config 4: 2-host PD transfer).
+    Chunked prefill on the decode side exercises reuse + chunking + TCP."""
+    prefill_conn = _conn(server, ist.TYPE_TCP)
+    decode_conn = _conn(server, ist.TYPE_TCP)
+    prefill_eng = InferenceEngine(
+        PARAMS, CFG, make_pc(), conn=prefill_conn, model_id="pd-tcp"
+    )
+    decode_eng = InferenceEngine(
+        PARAMS, CFG, make_pc(), conn=decode_conn, model_id="pd-tcp",
+        prefill_chunk=2 * T,
+    )
+    prefill_eng.prefill(PROMPT)
+    st = decode_eng.prefill(PROMPT)
+    assert st.reused_chunks == len(PROMPT) // T
+    assert decode_eng.decode(st, 8) == dense_greedy(PROMPT, 8)
     prefill_conn.close()
     decode_conn.close()
 
