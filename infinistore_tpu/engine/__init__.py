@@ -1,11 +1,13 @@
 from .connector import StoreConnector
 from .engine import InferenceEngine, SequenceState
 from .scheduler import Request, Scheduler
+from .speculative import SpeculativeDecoder
 
 __all__ = [
     "InferenceEngine",
     "Request",
     "Scheduler",
     "SequenceState",
+    "SpeculativeDecoder",
     "StoreConnector",
 ]

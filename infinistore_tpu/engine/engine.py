@@ -38,7 +38,12 @@ from ..kv.cache import (
 )
 from ..kv.hashing import chunk_keys
 from ..kv.transfer import KVTransferEngine
-from ..models.llama import LlamaConfig, decode_forward, prefill_forward
+from ..models.llama import (
+    LlamaConfig,
+    decode_forward,
+    prefill_forward,
+    verify_forward,
+)
 
 
 @dataclass
@@ -62,6 +67,7 @@ class InferenceEngine:
         max_seqs: int = 8,
         prefill_fn=None,
         decode_fn=None,
+        verify_fn=None,
         prefill_chunk: Optional[int] = None,
     ):
         """``prefill_fn``/``decode_fn`` plug in other model families with the
@@ -92,6 +98,16 @@ class InferenceEngine:
             partial(prefill_fn or prefill_forward, cfg=self.cfg)
         )
         self._decode_raw = partial(decode_fn or decode_forward, cfg=self.cfg)
+        # a custom model family must bring its own verify step: silently
+        # binding llama's verify_forward to foreign params would die deep in
+        # jit tracing instead of at the call site
+        self._has_verify = verify_fn is not None or (
+            decode_fn is None and prefill_fn is None
+        )
+        self._verify_jit = jax.jit(
+            partial(verify_fn or verify_forward, cfg=self.cfg),
+            donate_argnames=("cache",),
+        )
         # tokens per compiled decode dispatch; the scan length is static so
         # distinct chunk sizes compile once each
         self.decode_chunk = 32
@@ -337,10 +353,7 @@ class InferenceEngine:
             need = -(-(len(st.tokens) + n_steps) // T)
             if need > len(st.block_ids):
                 st.block_ids.extend(self.alloc.alloc(need - len(st.block_ids)))
-        table = np.zeros((B, self.max_pages), dtype=np.int32)
-        for b, st in enumerate(states):
-            table[b, : len(st.block_ids)] = st.block_ids
-        block_table = jnp.asarray(table)
+        block_table = self._block_table(states)
         if rng is None:
             # advance the engine's own stream: repeated sampling calls must
             # not replay the same draws
@@ -372,6 +385,52 @@ class InferenceEngine:
             st.tokens.extend(out[b])
             st.last_logits = logits[b]
         return out
+
+    def verify(
+        self, state: SequenceState, run_tokens: Sequence[int], start_pos: int
+    ) -> jax.Array:
+        """Process ``run_tokens`` at positions ``start_pos..`` in ONE paged
+        forward (the speculative-decode verify step): their K/V are written
+        into the cache and the logits after each token come back [S, V].
+
+        Does NOT update ``state.tokens`` — the caller decides which tokens
+        are accepted.  K/V written for later-rejected tokens is harmless:
+        attention masks by absolute position, and a future token at the same
+        position overwrites the same page slot.
+        """
+        if not self._has_verify:
+            raise ValueError(
+                "this engine uses a custom model family (prefill_fn/decode_fn)"
+                " without a verify_fn; pass verify_fn= with the same contract"
+                " as models.llama.verify_forward to use verify()/speculative"
+                " decoding"
+            )
+        S = len(run_tokens)
+        assert S >= 1
+        T = self.pc.block_tokens
+        need_pages = -(-(start_pos + S) // T)
+        if need_pages > len(state.block_ids):
+            state.block_ids.extend(self.alloc.alloc(need_pages - len(state.block_ids)))
+        poss = np.arange(start_pos, start_pos + S, dtype=np.int32)
+        slot_blocks = np.asarray(
+            [state.block_ids[p // T] for p in poss], dtype=np.int32
+        )
+        logits, self.cache = self._verify_jit(
+            self.params,
+            tokens=jnp.asarray([list(run_tokens)], dtype=jnp.int32),
+            positions=jnp.asarray(poss[None]),
+            cache=self.cache,
+            block_table=self._block_table([state]),
+            slot_block_ids=jnp.asarray(slot_blocks[None]),
+            slot_ids=jnp.asarray((poss % T)[None]),
+        )
+        return logits[0]
+
+    def _block_table(self, states: Sequence[SequenceState]) -> jax.Array:
+        table = np.zeros((len(states), self.max_pages), dtype=np.int32)
+        for b, st in enumerate(states):
+            table[b, : len(st.block_ids)] = st.block_ids
+        return jnp.asarray(table)
 
     def generate(self, tokens: Sequence[int], n_steps: int) -> List[int]:
         state = self.prefill(tokens)

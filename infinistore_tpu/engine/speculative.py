@@ -1,0 +1,122 @@
+"""Greedy speculative decoding: a draft model proposes, the target verifies.
+
+The reference serves through vLLM, whose speculative mode is a headline
+throughput feature; ours is rebuilt on the paged TPU engine.  Per round:
+
+1. the DRAFT engine scan-decodes ``k`` proposal tokens (cheap model, its own
+   paged cache);
+2. the TARGET engine scores ``[last_accepted_token, p_1..p_k]`` in ONE
+   multi-token paged forward (``InferenceEngine.verify``) — one dispatch
+   instead of ``k``;
+3. proposals are accepted while they match the target's greedy choice, then
+   the target's own next token is appended (so every round emits between 1
+   and k+1 tokens);
+4. the draft is resynced by verifying the accepted tail against its own
+   cache (rewrites of already-correct slots are harmless — position-masked
+   attention and slot overwrite semantics, see ``verify``'s docstring).
+
+Output is the target's greedy decode — speculation changes the dispatch
+count, not the decision rule (property-tested in tests/test_speculative.py).
+Exactness holds to the extent the verify forward's numerics match the scan
+decode's: in bf16 the batched einsum's reduction order can flip an argmax
+between near-tied logits, so low-precision serving should treat the
+guarantee as statistical rather than bitwise.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from .engine import InferenceEngine, SequenceState
+
+
+class SpeculativeDecoder:
+    def __init__(
+        self,
+        target: InferenceEngine,
+        draft: InferenceEngine,
+        k: int = 4,
+    ):
+        assert target.pc.block_tokens == draft.pc.block_tokens, (
+            "target and draft must page with the same chunk size"
+        )
+        self.target = target
+        self.draft = draft
+        self.k = k
+        # round accounting for reporting acceptance rates
+        self.rounds = 0
+        self.accepted = 0
+        self.proposed = 0
+
+    def prefill(self, tokens: Sequence[int]) -> Tuple[SequenceState, SequenceState]:
+        return self.target.prefill(tokens), self.draft.prefill(tokens)
+
+    def _resync_draft(self, st_d: SequenceState, accepted: List[int]) -> None:
+        """Bring the draft's cache and logits in line with the accepted
+        sequence.  The draft speculated past the rejection point, so its
+        tokens are rewound and the accepted tail is re-verified; feeding a
+        fixed-length window ending at the last accepted token keeps the
+        compile count at one shape."""
+        st_d.tokens = list(accepted)
+        w = min(len(accepted), self.k + 1)
+        run = accepted[-w:]
+        logits = self.draft.verify(st_d, run, len(accepted) - w)
+        st_d.last_logits = logits[-1]
+
+    def decode(
+        self,
+        st_t: SequenceState,
+        st_d: SequenceState,
+        n_steps: int,
+    ) -> List[int]:
+        """Emit exactly ``n_steps`` tokens (greedy-equivalent to
+        ``target.decode(st_t, n_steps)``)."""
+        out: List[int] = []
+        while len(out) < n_steps:
+            k = self.k
+            # 1. draft proposes k tokens (advances st_d by k)
+            proposals = self.draft.decode(st_d, k)
+
+            # 2. target scores [prev_token, p_1..p_k] in one dispatch; row j
+            #    gives the target's choice AFTER consuming that row's token
+            prev = st_t.tokens[-1]
+            run = [prev] + proposals
+            logits = self.target.verify(st_t, run, len(st_t.tokens) - 1)
+            choices = np.asarray(jnp.argmax(logits, axis=-1))  # [k+1]
+
+            # 3. accept while the draft agreed, then take the target's token
+            m = 0
+            while m < k and proposals[m] == int(choices[m]):
+                m += 1
+            emitted = proposals[:m] + [int(choices[m])]
+            self.rounds += 1
+            self.proposed += k
+            self.accepted += m
+            st_t.tokens.extend(emitted)
+            out.extend(emitted)
+
+            # 4. resync the draft onto the accepted sequence
+            self._resync_draft(st_d, list(st_t.tokens))
+
+        excess = len(out) - n_steps
+        if excess:
+            del out[n_steps:]
+            del st_t.tokens[-excess:]
+            self._resync_draft(st_d, list(st_t.tokens))
+        # verify rounds do not carry logits for the bonus token, so refresh
+        # last_logits to leave the target state decode()-ready
+        st_t.last_logits = self.target.verify(
+            st_t, [st_t.tokens[-1]], len(st_t.tokens) - 1
+        )[-1]
+        return out
+
+    def generate(self, tokens: Sequence[int], n_steps: int) -> List[int]:
+        st_t, st_d = self.prefill(tokens)
+        return self.decode(st_t, st_d, n_steps)
+
+    @property
+    def acceptance_rate(self) -> float:
+        return self.accepted / self.proposed if self.proposed else 0.0

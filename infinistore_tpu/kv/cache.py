@@ -93,6 +93,31 @@ def write_token_kv(
     return cache.at[layer, :, :, block_ids, slot_ids].set(kv)
 
 
+def write_tokens_kv(
+    cache: jax.Array,
+    layer: int,
+    block_ids: jax.Array,
+    slot_ids: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+) -> jax.Array:
+    """Scatter a run of tokens per sequence into layer ``layer`` (the
+    multi-token sibling of write_token_kv; used by the speculative-decode
+    verify step).
+
+    block_ids/slot_ids: [B, S]; k/v: [B, S, n_kv_heads, head_dim].
+    Distinct (page, slot) targets per token, so the flat scatter is exact.
+    """
+    B, S = block_ids.shape
+    return write_token_kv(
+        cache, layer,
+        block_ids.reshape(B * S),
+        slot_ids.reshape(B * S),
+        k.reshape((B * S,) + k.shape[2:]),
+        v.reshape((B * S,) + v.shape[2:]),
+    )
+
+
 def prefill_to_pages(kv: jax.Array, n_pages: int, block_tokens: int) -> jax.Array:
     """Reshape prefill KV [L, 2, S, H, D] (S = n_pages*block_tokens) into
     pages [L, 2, H, n_pages, T, D]."""

@@ -176,6 +176,41 @@ def paged_decode_attention_xla(
     return jnp.einsum("bhk,bkhd->bhd", probs.astype(v.dtype), v)
 
 
+def paged_multitoken_attention_xla(
+    q: jax.Array,
+    layer_cache: jax.Array,
+    block_table: jax.Array,
+    positions: jax.Array,
+) -> jax.Array:
+    """Attention for a short run of new tokens against the paged cache
+    (the speculative-decode verify step: S proposal tokens attend to the
+    whole paged history plus themselves, causally by absolute position).
+
+    q: [B, S, H, D] (RoPE applied); layer_cache: [2, H_kv, n_blocks, T, D]
+    — the new tokens' K/V must already be scattered into the pages;
+    block_table: [B, max_pages] int32; positions: [B, S] int32 absolute
+    positions of the new tokens.  Masking is purely positional: a key in a
+    gathered page is visible iff its absolute position <= the query's, which
+    also hides stale slots past the sequence end.  Returns [B, S, H, D].
+    """
+    B, S, H, D = q.shape
+    Hkv, _, T = layer_cache.shape[1:4]
+    max_pages = block_table.shape[1]
+    k = layer_cache[0][:, block_table]
+    v = layer_cache[1][:, block_table]
+    k = jnp.moveaxis(k, 0, 3).reshape(B, max_pages * T, Hkv, D)
+    v = jnp.moveaxis(v, 0, 3).reshape(B, max_pages * T, Hkv, D)
+    k = repeat_kv(k, H // Hkv)
+    v = repeat_kv(v, H // Hkv)
+    scale = 1.0 / np.sqrt(D)
+    logits = jnp.einsum("bshd,bkhd->bhsk", q, k).astype(jnp.float32) * scale
+    k_pos = jnp.arange(max_pages * T)
+    mask = k_pos[None, None, :] <= positions[:, :, None]  # [B, S, S_max]
+    logits = jnp.where(mask[:, None], logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhsk,bkhd->bshd", probs.astype(v.dtype), v)
+
+
 def paged_decode_attention(
     q: jax.Array,
     layer_cache: jax.Array,

@@ -24,7 +24,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .attention import apply_rope, causal_attention, paged_decode_attention
+from .attention import (
+    apply_rope,
+    causal_attention,
+    paged_decode_attention,
+    paged_multitoken_attention_xla,
+)
 
 Params = Dict[str, Any]
 
@@ -228,6 +233,44 @@ def decode_forward(
     x = rmsnorm(x, params["ln_out"], cfg.norm_eps)
     logits = x[:, 0] @ params["lm_head"]
     return logits, cache
+
+
+def verify_forward(
+    params: Params,
+    cfg: LlamaConfig,
+    tokens: jax.Array,
+    positions: jax.Array,
+    cache: jax.Array,
+    block_table: jax.Array,
+    slot_block_ids: jax.Array,
+    slot_ids: jax.Array,
+) -> Tuple[jax.Array, jax.Array]:
+    """Multi-token paged step: process a short run of tokens against the
+    paged cache in ONE forward (the speculative-decode verify step — the
+    target model scores all draft proposals at once instead of one
+    dispatch per token).
+
+    tokens/positions/slot_block_ids/slot_ids: [B, S]; cache:
+    [L, 2, Hkv, n_blocks, T, D]; block_table: [B, max_pages].  The tokens'
+    K/V are scattered into their page slots first, then each token attends
+    to the paged history plus the run causally by absolute position.
+    Returns (logits [B, S, V], updated cache).
+    """
+    from ..kv.cache import write_tokens_kv
+
+    B, S = tokens.shape
+    x = params["embed"][tokens]  # [B, S, dim]
+    for li in range(cfg.n_layers):
+        layer = _layer(li)(params["layers"])
+        h = rmsnorm(x, layer["ln_attn"], cfg.norm_eps)
+        q, k, v = _attn_qkv(layer, cfg, h, positions)
+        cache = write_tokens_kv(cache, li, slot_block_ids, slot_ids, k, v)
+        attn = paged_multitoken_attention_xla(q, cache[li], block_table, positions)
+        x = x + attn.reshape(B, S, -1) @ layer["wo"]
+        h = rmsnorm(x, layer["ln_mlp"], cfg.norm_eps)
+        x = x + _mlp(layer, h)
+    x = rmsnorm(x, params["ln_out"], cfg.norm_eps)
+    return x @ params["lm_head"], cache
 
 
 def loss_fn(params: Params, cfg: LlamaConfig, tokens: jax.Array) -> jax.Array:

@@ -184,6 +184,36 @@ def moe_decode_forward(
     return x[:, 0] @ params["lm_head"], cache
 
 
+def moe_verify_forward(
+    params: Params,
+    cfg: MoEConfig,
+    tokens: jax.Array,
+    positions: jax.Array,
+    cache: jax.Array,
+    block_table: jax.Array,
+    slot_block_ids: jax.Array,
+    slot_ids: jax.Array,
+) -> Tuple[jax.Array, jax.Array]:
+    """Multi-token paged MoE step; contract of models.llama.verify_forward
+    (the speculative-decode verify step for MoE engines)."""
+    from ..kv.cache import write_tokens_kv
+    from .attention import paged_multitoken_attention_xla
+
+    B, S = tokens.shape
+    x = params["embed"][tokens]
+    for li in range(cfg.n_layers):
+        layer = _layer(li)(params["layers"])
+        h = rmsnorm(x, layer["ln_attn"], cfg.norm_eps)
+        q, k, v = _attn_qkv(layer, cfg, h, positions)
+        cache = write_tokens_kv(cache, li, slot_block_ids, slot_ids, k, v)
+        attn = paged_multitoken_attention_xla(q, cache[li], block_table, positions)
+        x = x + attn.reshape(B, S, -1) @ layer["wo"]
+        h = rmsnorm(x, layer["ln_mlp"], cfg.norm_eps)
+        x = x + moe_ffn(layer, h, cfg.top_k)
+    x = rmsnorm(x, params["ln_out"], cfg.norm_eps)
+    return x @ params["lm_head"], cache
+
+
 def moe_loss_fn(params: Params, cfg: MoEConfig, tokens: jax.Array) -> jax.Array:
     # XLA path: the train step runs under GSPMD-partitioned jit
     logits, _ = moe_prefill_forward(params, cfg, tokens, use_pallas=False)
