@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import asyncio
 import ctypes
+import functools
 import json
 import mmap
 import os
@@ -44,6 +45,23 @@ from .config import (  # noqa: F401 - re-exported for parity
 )
 from .mempool import SHM_DIR, _prefault
 from .utils.logging import Logger
+from .utils.profiling import LatencyStats
+
+
+def _timed_op(name: str):
+    """Record the wrapped data-path method in the connection's client-side
+    latency counters (the client half of observability; server half is
+    /metrics)."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(self, *args, **kwargs):
+            with self.latency.timed(name):
+                return fn(self, *args, **kwargs)
+
+        return wrapper
+
+    return deco
 
 
 class InfiniStoreException(Exception):
@@ -233,6 +251,11 @@ class Connection:
         self._registered: Dict[int, int] = {}  # base ptr -> size
         self._pool_lock = threading.Lock()
         self._stripe_pool: Optional[ThreadPoolExecutor] = None
+        self.latency = LatencyStats()
+
+    def latency_stats(self) -> Dict[str, Dict[str, float]]:
+        """Client-side per-op latency counters (count/avg/max ms)."""
+        return self.latency.snapshot()
 
     @property
     def sock(self):  # backwards-compat probe: "is connected"
@@ -321,6 +344,7 @@ class Connection:
             if blocks[i * per : (i + 1) * per]
         ]
 
+    @_timed_op("write_cache")
     def write_cache(self, blocks: Sequence[Tuple[str, int]], block_size: int, ptr: int) -> int:
         """Batched put: key i's payload is ``block_size`` bytes at
         ``ptr + offset_i`` (reference: lib.py:425-481)."""
@@ -365,6 +389,7 @@ class Connection:
                 _raise_for_status(st, "put_inline_batch")
         return P.FINISH
 
+    @_timed_op("read_cache")
     def read_cache(self, blocks: Sequence[Tuple[str, int]], block_size: int, ptr: int) -> int:
         """Batched get into ``ptr + offset_i`` (reference: lib.py:483-542)."""
         offsets = [off for _, off in blocks]
@@ -416,6 +441,7 @@ class Connection:
 
     # -- inline single-key ops (reference: w_tcp/r_tcp) --
 
+    @_timed_op("w_tcp")
     def w_tcp(self, key: str, ptr: int, size: int) -> int:
         payload = _ptr_view(ptr, size)
         body = P.pack_put_inline(key.encode(), size)
@@ -423,12 +449,14 @@ class Connection:
         _raise_for_status(status, "tcp write")
         return 0
 
+    @_timed_op("w_tcp")
     def w_tcp_bytes(self, key: str, data: bytes) -> int:
         body = P.pack_put_inline(key.encode(), len(data))
         status, _ = self._request(P.OP_PUT_INLINE, body + data)
         _raise_for_status(status, "tcp write")
         return 0
 
+    @_timed_op("r_tcp")
     def r_tcp(self, key: str) -> np.ndarray:
         status, body = self._request(P.OP_GET_INLINE, P.pack_keys([key.encode()]))
         _raise_for_status(status, "tcp read")
@@ -542,6 +570,12 @@ class InfinityConnection:
             self._async_pool = None
         self.conn.close()
         self.rdma_connected = False
+
+    def latency_stats(self) -> dict:
+        """Client-side per-op latency counters (count/avg/max ms); empty for
+        the native client, whose timings live in the C runtime."""
+        fn = getattr(self.conn, "latency_stats", None)
+        return fn() if fn is not None else {}
 
     # -- zero-copy batched API --
 

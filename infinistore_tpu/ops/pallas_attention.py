@@ -259,11 +259,20 @@ def paged_decode_attention_pallas(
     def q_map(b, h, c, table_ref, lens_ref):
         return (b, h, 0, 0)
 
+    # clamp the page index at each sequence's last valid page: grid steps
+    # past the sequence end re-request the same page and the pipeline skips
+    # the duplicate fetch, so a short sequence in a long-max_pages batch
+    # costs its own length in HBM traffic, not max_pages (compute for those
+    # steps is already gated by the c*T < seq_len guard in the kernel)
+    def _page(b, c, lens_ref):
+        last = jnp.maximum(lens_ref[b] - 1, 0) // T
+        return jnp.minimum(c, last)
+
     def k_map(b, h, c, table_ref, lens_ref):
-        return (0, h, table_ref[b, c], 0, 0)
+        return (0, h, table_ref[b, _page(b, c, lens_ref)], 0, 0)
 
     def v_map(b, h, c, table_ref, lens_ref):
-        return (1, h, table_ref[b, c], 0, 0)
+        return (1, h, table_ref[b, _page(b, c, lens_ref)], 0, 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,

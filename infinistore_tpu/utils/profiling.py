@@ -1,0 +1,62 @@
+"""Lightweight observability helpers.
+
+``LatencyStats`` backs the client-side per-op latency counters
+(lib.py Connection.latency_stats — the client's side of the story next to
+the server's ``/metrics``), and ``device_trace`` wraps ``jax.profiler`` so a
+serving run can capture a TPU trace (HBM/MXU utilization, per-op timings)
+for TensorBoard/xprof without importing profiler plumbing at call sites.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Dict
+
+
+class LatencyStats:
+    """Per-op latency accumulator: count / total / max (thread-safe, cheap
+    enough for the data path — two perf_counter calls and a dict update)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._ops: Dict[str, list] = {}  # name -> [count, total_s, max_s]
+
+    @contextlib.contextmanager
+    def timed(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            with self._lock:
+                rec = self._ops.setdefault(name, [0, 0.0, 0.0])
+                rec[0] += 1
+                rec[1] += dt
+                rec[2] = max(rec[2], dt)
+
+    def snapshot(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            return {
+                name: {
+                    "count": c,
+                    "total_ms": round(total * 1e3, 3),
+                    "avg_ms": round(total / c * 1e3, 3) if c else 0.0,
+                    "max_ms": round(mx * 1e3, 3),
+                }
+                for name, (c, total, mx) in self._ops.items()
+            }
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: str):
+    """Capture a jax.profiler trace of the enclosed block into ``log_dir``
+    (view with TensorBoard's profile plugin / xprof)."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

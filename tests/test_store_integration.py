@@ -289,6 +289,12 @@ def test_simple_tcp_read_write(server):
     conn.tcp_write_cache(key, src.ctypes.data, size)
     dst = conn.tcp_read_cache(key)
     np.testing.assert_array_equal(np.asarray(dst), src)
+    # client-side observability: the data-path ops were timed
+    stats = conn.latency_stats()
+    if stats:  # python client only; native keeps timings in the C runtime
+        assert stats["w_tcp"]["count"] == 1
+        assert stats["r_tcp"]["count"] == 1
+        assert stats["w_tcp"]["avg_ms"] > 0
     conn.close()
 
 
