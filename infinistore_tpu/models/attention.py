@@ -112,15 +112,24 @@ def causal_attention(
     B, Sq, H, D = q.shape
     if (
         allow_pallas
-        and prefix_len is None
-        and isinstance(q_offset, int)
         and D % 128 == 0
         and jax.default_backend() == "tpu"
         and not os.environ.get("ISTPU_NO_PALLAS")
     ):
-        from ..ops.pallas_attention import flash_causal_attention_pallas
+        if prefix_len is None and isinstance(q_offset, int):
+            from ..ops.pallas_attention import flash_causal_attention_pallas
 
-        return flash_causal_attention_pallas(q, k, v, q_offset=q_offset)
+            return flash_causal_attention_pallas(q, k, v, q_offset=q_offset)
+        if (
+            prefix_len is not None
+            and prefix_pad is not None
+            and prefix_pad % 128 == 0
+        ):
+            from ..ops.pallas_attention import flash_prefix_attention_pallas
+
+            return flash_prefix_attention_pallas(
+                q, k, v, prefix_pad=prefix_pad, prefix_len=prefix_len
+            )
     Hkv = k.shape[2]
     k = repeat_kv(k, H // Hkv)
     v = repeat_kv(v, H // Hkv)

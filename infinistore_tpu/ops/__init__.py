@@ -8,5 +8,6 @@ against them in tests/test_ops.py.
 
 from .pallas_attention import (  # noqa: F401
     flash_causal_attention_pallas,
+    flash_prefix_attention_pallas,
     paged_decode_attention_pallas,
 )

@@ -35,6 +35,22 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
+def _online_softmax_step(s, v, m_scr, l_scr, acc_scr):
+    """One flash-attention accumulator update: fold the masked score tile
+    ``s`` [R, Tk] and value tile ``v`` [Tk, D] into the running max /
+    denominator / numerator scratch.  Shared by all three kernels below so
+    the numerics can never diverge between them."""
+    m_prev = m_scr[:, :1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    corr = jnp.exp(m_prev - m_new)
+    l_scr[:, :1] = l_scr[:, :1] * corr + jnp.sum(p, axis=1, keepdims=True)
+    m_scr[:, :1] = m_new
+    acc_scr[:] = acc_scr[:] * corr + jax.lax.dot(
+        p, v, preferred_element_type=jnp.float32
+    )
+
+
 def _decode_kernel(
     table_ref,  # scalar prefetch: [B, max_pages] int32
     lens_ref,   # scalar prefetch: [B] int32
@@ -72,15 +88,7 @@ def _decode_kernel(
         ) * scale                                   # [R, T]
         pos = c * T + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         s = jnp.where(pos < seq_len, s, NEG_INF)
-        m_prev = m_scr[:, :1]                       # [R, 1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        corr = jnp.exp(m_prev - m_new)
-        l_scr[:, :1] = l_scr[:, :1] * corr + jnp.sum(p, axis=1, keepdims=True)
-        m_scr[:, :1] = m_new
-        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot(
-            p, v, preferred_element_type=jnp.float32
-        )
+        _online_softmax_step(s, v, m_scr, l_scr, acc_scr)
 
     @pl.when(c == n_chunks - 1)
     def _finish():
@@ -130,19 +138,163 @@ def _flash_kernel(
             preferred_element_type=jnp.float32,
         ) * scale  # [Bq, Bk]
         s = jnp.where(k_pos <= q_pos, s, NEG_INF)
-        m_prev = m_scr[:, :1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        corr = jnp.exp(m_prev - m_new)
-        l_scr[:, :1] = l_scr[:, :1] * corr + jnp.sum(p, axis=1, keepdims=True)
-        m_scr[:, :1] = m_new
-        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot(
-            p, v, preferred_element_type=jnp.float32
-        )
+        _online_softmax_step(s, v, m_scr, l_scr, acc_scr)
 
     @pl.when(ik == n_k - 1)
     def _finish():
         o_ref[0, 0] = (acc_scr[:] / l_scr[:, :1]).astype(o_ref.dtype)
+
+
+def _flash_prefix_kernel(
+    plen_ref,  # scalar prefetch: [1] int32 valid prefix length
+    q_ref,     # [1, 1, Bq, D]
+    k_ref,     # [1, 1, Bk, D]
+    v_ref,     # [1, 1, Bk, D]
+    o_ref,     # [1, 1, Bq, D]
+    m_scr,
+    l_scr,
+    acc_scr,
+    *,
+    scale: float,
+    prefix_pad: int,
+    block_q: int,
+    block_k: int,
+):
+    """Flash attention over ``[bucketed prefix | self]`` K/V: the first
+    ``prefix_pad`` rows are a prefix buffer of which only ``plen`` are
+    valid; the rest are the queries' own KV, causal by chunk-local index.
+    ``prefix_pad`` is block-aligned, so each k block is entirely prefix or
+    entirely self."""
+    iq = pl.program_id(2)
+    ik = pl.program_id(3)
+    n_k = pl.num_programs(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    plen = plen_ref[0]
+    q_idx = iq * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+    k_pos = ik * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+
+    in_prefix = ik * block_k < prefix_pad
+    live = jnp.where(
+        in_prefix,
+        ik * block_k < plen,
+        ik * block_k - prefix_pad <= iq * block_q + block_q - 1,
+    )
+
+    @pl.when(live)
+    def _attend():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        valid = jnp.where(
+            k_pos < prefix_pad, k_pos < plen, (k_pos - prefix_pad) <= q_idx
+        )
+        s = jnp.where(valid, s, NEG_INF)
+        _online_softmax_step(s, v, m_scr, l_scr, acc_scr)
+
+    @pl.when(ik == n_k - 1)
+    def _finish():
+        o_ref[0, 0] = (acc_scr[:] / l_scr[:, :1]).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("prefix_pad", "interpret", "block_q", "block_k"),
+)
+def flash_prefix_attention_pallas(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    prefix_pad: int,
+    prefix_len: jax.Array,
+    interpret: bool = False,
+    block_q: int = 128,
+    block_k: int = 128,
+) -> jax.Array:
+    """Flash attention for bucketed chunked prefill (engine/engine.py).
+
+    q: [B, Sq, H, D]; k/v: [B, prefix_pad + Sq, H_kv, D] where rows
+    [0, prefix_len) are the valid prefix, [prefix_len, prefix_pad) are
+    bucket slack, and [prefix_pad, ...) are the queries' own KV.
+    ``prefix_len`` is a traced int32 scalar delivered to the kernel and its
+    index maps via scalar prefetch, so every bucket capacity compiles once;
+    slack and causal-dead K/V blocks are clamp-deduped out of the DMA
+    stream just like the dense-causal kernel's frontier.
+    Matches models/attention.py:causal_attention's padded-prefix mode
+    (tests/test_ops.py).
+    """
+    B, Sq, H, D = q.shape
+    Sk, Hkv = k.shape[1], k.shape[2]
+    n_rep = H // Hkv
+    scale = 1.0 / np.sqrt(D)
+    assert prefix_pad % block_k == 0, (prefix_pad, block_k)
+    assert Sk == prefix_pad + Sq, (Sk, prefix_pad, Sq)
+
+    pad_q = (-Sq) % block_q
+    pad_k = (-Sk) % block_k
+    qt = jnp.pad(jnp.transpose(q, (0, 2, 1, 3)), ((0, 0), (0, 0), (0, pad_q), (0, 0)))
+    kt = jnp.pad(jnp.transpose(k, (0, 2, 1, 3)), ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+    vt = jnp.pad(jnp.transpose(v, (0, 2, 1, 3)), ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+
+    grid = (B, H, (Sq + pad_q) // block_q, (Sk + pad_k) // block_k)
+    n_prefix_blocks = prefix_pad // block_k
+
+    def q_map(b, h, iq, ik, plen_ref):
+        return (b, h, iq, 0)
+
+    def kv_map(b, h, iq, ik, plen_ref):
+        # prefix region: clamp at the last valid prefix block (slack blocks
+        # re-request it; duplicate fetches are skipped).  self region: clamp
+        # at the causal frontier, as in the dense kernel.
+        last_prefix = jnp.maximum(plen_ref[0] - 1, 0) // block_k
+        frontier = (prefix_pad + (iq + 1) * block_q - 1) // block_k
+        ikc = jnp.where(
+            ik < n_prefix_blocks,
+            jnp.minimum(ik, last_prefix),
+            jnp.minimum(ik, frontier),
+        )
+        return (b, h // n_rep, ikc, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D), q_map),
+            pl.BlockSpec((1, 1, block_k, D), kv_map),
+            pl.BlockSpec((1, 1, block_k, D), kv_map),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, D), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, D), jnp.float32),
+        ],
+    )
+
+    out = pl.pallas_call(
+        functools.partial(
+            _flash_prefix_kernel, scale=scale, prefix_pad=prefix_pad,
+            block_q=block_q, block_k=block_k,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, Sq + pad_q, D), q.dtype),
+        interpret=interpret,
+    )(jnp.asarray(prefix_len, dtype=jnp.int32).reshape(1), qt, kt, vt)
+
+    return jnp.transpose(out[:, :, :Sq], (0, 2, 1, 3))
 
 
 @functools.partial(

@@ -622,7 +622,6 @@ class _LatencyProxy:
     throughput cap)."""
 
     def __init__(self, upstream_port: int, delay_s: float):
-        import collections
         import threading
 
         self.upstream_port = upstream_port
@@ -632,10 +631,7 @@ class _LatencyProxy:
         self.listener.listen(8)
         self.port = self.listener.getsockname()[1]
         self.alive = True
-        self._threads = []
-        t = threading.Thread(target=self._accept_loop, daemon=True)
-        t.start()
-        self._threads.append(t)
+        threading.Thread(target=self._accept_loop, daemon=True).start()
 
     def _accept_loop(self):
         import threading
@@ -647,11 +643,9 @@ class _LatencyProxy:
                 return
             up = socket.create_connection(("127.0.0.1", self.upstream_port))
             for src, dst, delayed in ((cli, up, True), (up, cli, False)):
-                t = threading.Thread(
+                threading.Thread(
                     target=self._pump, args=(src, dst, delayed), daemon=True
-                )
-                t.start()
-                self._threads.append(t)
+                ).start()
 
     def _pump(self, src, dst, delayed):
         if not delayed:
@@ -683,9 +677,7 @@ class _LatencyProxy:
             except OSError:
                 pass
 
-        st = threading.Thread(target=sender, daemon=True)
-        st.start()
-        self._threads.append(st)
+        threading.Thread(target=sender, daemon=True).start()
         try:
             while True:
                 data = src.recv(65536)
@@ -723,8 +715,10 @@ def test_pipelining_hides_rtt(server):
     pay the latency N times; an async flood on one connection pays it ~once.
     This holds regardless of host core count (the round-1 async-vs-sync
     throughput test could not distinguish overlap from CPU contention)."""
-    delay = 0.02
-    N = 12
+    # delay >> scheduling noise: the assertion compares ~N round-trips
+    # against ~1, so the margin must survive a loaded single-core host
+    delay = 0.05
+    N = 8
     proxy = _LatencyProxy(SERVICE_PORT, delay)
     try:
         cfg = ist.ClientConfig(
