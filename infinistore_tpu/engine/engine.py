@@ -46,6 +46,16 @@ from ..models.llama import (
 )
 
 
+def _round_up_pow2(n: int, base: int) -> int:
+    """Smallest ``base * 2**k`` >= n — the shape-bucketing rule shared by
+    chunked prefill, batched prefill, and the batch dimension, so jit-cache
+    growth policy lives in one place."""
+    b = base
+    while b < n:
+        b *= 2
+    return b
+
+
 @dataclass
 class SequenceState:
     seq_id: int
@@ -169,10 +179,7 @@ class InferenceEngine:
         )
 
         def cap_for(n: int) -> int:
-            c = C
-            while c < n:
-                c *= 2
-            return c
+            return _round_up_pow2(n, C)
 
         single = C >= len(padded)
         if single:
@@ -249,6 +256,95 @@ class InferenceEngine:
         self._next_id += 1
         self.seqs[state.seq_id] = state
         return state
+
+    def prefill_batch(self, prompts: Sequence[Sequence[int]]) -> List[SequenceState]:
+        """Prefill several prompts (vLLM-style batched prefill for the
+        scheduler's admission path).
+
+        Prompts are grouped by their power-of-two length bucket and each
+        group runs as ONE padded forward (batch dim also bucketed), so the
+        jit cache grows log x log and a stray long prompt never inflates the
+        short ones' padding.  Per-sequence fallback when a store is attached
+        (each sequence's reusable prefix differs), for singleton groups, and
+        when a group's total padded tokens would exceed ``prefill_chunk``
+        (the configured prefill memory bound).
+
+        On page exhaustion mid-batch, states created so far are released
+        before the MemoryError propagates — the engine is left unchanged."""
+        prompts = [list(p) for p in prompts]
+        assert prompts and all(len(p) >= 1 for p in prompts)
+        T = self.pc.block_tokens
+
+        out: List[Optional[SequenceState]] = [None] * len(prompts)
+        created: List[SequenceState] = []
+        try:
+            if self.transfer is not None:
+                for i, p in enumerate(prompts):
+                    st = self.prefill(p)
+                    created.append(st)
+                    out[i] = st
+                return out  # type: ignore[return-value]
+
+            groups: Dict[int, List[int]] = {}
+            for i, p in enumerate(prompts):
+                groups.setdefault(_round_up_pow2(len(p), T), []).append(i)
+
+            for bucket, idxs in groups.items():
+                group = [prompts[i] for i in idxs]
+                if len(group) == 1 or (
+                    self.prefill_chunk is not None
+                    and len(group) * bucket > self.prefill_chunk
+                ):
+                    states = []
+                    for p in group:
+                        st = self.prefill(p)
+                        created.append(st)
+                        states.append(st)
+                else:
+                    states = self._prefill_group(group, bucket)
+                    created.extend(states)
+                for i, st in zip(idxs, states):
+                    out[i] = st
+        except MemoryError:
+            for st in created:
+                self.release(st)
+            raise
+        return out  # type: ignore[return-value]
+
+    def _prefill_group(self, group: List[List[int]], bucket: int) -> List[SequenceState]:
+        """One padded forward + one cache scatter for a same-bucket group."""
+        T = self.pc.block_tokens
+        B = len(group)
+        Bp = _round_up_pow2(B, 1)  # batch-dim bucket: bounded compile count
+        n_pages_each = [-(-len(p) // T) for p in group]
+        ids_all = self.alloc.alloc(sum(n_pages_each))  # atomic: before any mutation
+        tokens = np.zeros((Bp, bucket), dtype=np.int32)
+        for b, p in enumerate(group):
+            tokens[b, : len(p)] = p
+        logits, kv = self._prefill_jit(self.params, tokens=jnp.asarray(tokens))
+        parts = [
+            prefill_to_pages(kv[:, :, b], bucket // T, T)[:, :, :, :n_pg]
+            for b, n_pg in enumerate(n_pages_each)
+        ]
+        self.cache = write_pages(
+            self.cache, jnp.asarray(ids_all), jnp.concatenate(parts, axis=3)
+        )
+        states = []
+        off = 0
+        for b, p in enumerate(group):
+            n_pg = n_pages_each[b]
+            st = SequenceState(
+                seq_id=self._next_id,
+                tokens=list(p),
+                block_ids=list(ids_all[off : off + n_pg]),
+                chunk_keys=chunk_keys(p, self.model_id, chunk_tokens=T),
+                last_logits=logits[b, len(p) - 1],
+            )
+            self._next_id += 1
+            self.seqs[st.seq_id] = st
+            states.append(st)
+            off += n_pg
+        return states
 
     # ---- decode ----
 

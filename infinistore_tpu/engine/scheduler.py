@@ -52,6 +52,10 @@ class Scheduler:
         self.active: List[Request] = []
         self._next_id = 0
         self._rng = rng if rng is not None else jax.random.PRNGKey(0)
+        # set when decode sheds a request for lack of KV pages: admission
+        # pauses until something retires, otherwise the shed request would
+        # re-admit into the same full allocator and be shed again (livelock)
+        self._admission_hold = False
 
     def submit(
         self,
@@ -88,14 +92,49 @@ class Scheduler:
             key = self._group(self.active[0])
         else:
             return
+        admit: List[Request] = []
         i = 0
-        while i < len(self.pending) and len(self.active) < self.max_batch:
+        while i < len(self.pending) and len(self.active) + len(admit) < self.max_batch:
             if self._group(self.pending[i]) == key:
-                req = self.pending.pop(i)
-                req.state = self.engine.prefill(req.tokens)
-                self.active.append(req)
+                admit.append(self.pending.pop(i))
             else:
                 i += 1  # different sampling params: wait for this batch
+        # one padded forward per length bucket for the admission wave (falls
+        # back to per-sequence prefill when store reuse applies).  The wave
+        # is first sized against the allocator host-side (no wasted device
+        # forwards), then page exhaustion mid-prefill sheds the newest
+        # request and retries; a single unrunnable request with nothing in
+        # flight is surfaced (it can never run), otherwise admission holds
+        # until the running batch frees pages (backpressure).
+        T = self.engine.pc.block_tokens
+
+        def wave_pages(reqs):
+            return sum(
+                -(-(len(r.tokens) + len(r.output)) // T) for r in reqs
+            )
+
+        while len(admit) > 1 and wave_pages(admit) > self.engine.alloc.n_free:
+            self.pending.insert(0, admit.pop())
+        while admit:
+            try:
+                # prompt + output-so-far: a request shed mid-decode resumes
+                # where it left off (its generated tokens re-prefill)
+                states = self.engine.prefill_batch(
+                    [r.tokens + r.output for r in admit]
+                )
+            except MemoryError:
+                if len(admit) > 1:
+                    self.pending.insert(0, admit.pop())
+                    continue
+                if not self.active:
+                    raise
+                self.pending[0:0] = admit
+                self._admission_hold = True  # retry after a retire frees pages
+                return
+            for req, st in zip(admit, states):
+                req.state = st
+                self.active.append(req)
+            return
 
     def _retire(self) -> List[Request]:
         done_now: List[Request] = []
@@ -113,12 +152,15 @@ class Scheduler:
             else:
                 still.append(req)
         self.active = still
+        if done_now:
+            self._admission_hold = False  # pages freed; admission may resume
         return done_now
 
     def step(self) -> List[Request]:
         """Admit, decode one chunk for the whole batch, retire.  Returns the
         requests that finished this step."""
-        self._admit()
+        if not (self._admission_hold and self.active):
+            self._admit()
         if not self.active:
             return []
         head = self.active[0]
@@ -132,11 +174,24 @@ class Scheduler:
             chunk *= 2
         chunk = min(chunk, self.engine.decode_chunk)
         self._rng, sub = jax.random.split(self._rng)
-        outs = self.engine.decode_batch(
-            [r.state for r in self.active], chunk,
-            sample=head.sample, temperature=head.temperature,
-            top_k=head.top_k, rng=sub,
-        )
+        try:
+            outs = self.engine.decode_batch(
+                [r.state for r in self.active], chunk,
+                sample=head.sample, temperature=head.temperature,
+                top_k=head.top_k, rng=sub,
+            )
+        except MemoryError:
+            # decode-time page exhaustion: shed the newest request back to
+            # pending (its pages free now; its prompt + output re-prefill on
+            # re-admission) and let the remaining batch make progress
+            if len(self.active) <= 1:
+                raise
+            victim = self.active.pop()
+            self.engine.release(victim.state)
+            victim.state = None
+            self.pending.insert(0, victim)
+            self._admission_hold = True
+            return []
         for req, toks in zip(self.active, outs):
             req.output.extend(toks)
         return self._retire()

@@ -171,6 +171,42 @@ def test_categorical_sampling_device_side():
     assert cold == dense_greedy(PROMPT, 6)
 
 
+def test_prefill_batch_matches_solo():
+    """Bucketed batched prefill must leave every sequence in the same state
+    as solo prefill.  PROMPT (11 tok) and PROMPT[:9] share the 16-token
+    bucket (one true batched forward); [42, 7, 9] is a singleton group."""
+    prompts = [PROMPT, PROMPT[:9], [42, 7, 9]]
+    solo = []
+    for p in prompts:
+        eng = InferenceEngine(PARAMS, CFG, make_pc())
+        st = eng.prefill(p)
+        solo.append(eng.decode(st, 6))
+    eng = InferenceEngine(PARAMS, CFG, make_pc())
+    states = eng.prefill_batch(prompts)
+    assert [s.tokens for s in states] == [list(p) for p in prompts]
+    got = [eng.decode(st, 6) for st in states]
+    assert got == solo
+
+
+def test_scheduler_backpressure_on_page_exhaustion():
+    """When the allocator cannot fit the whole admission wave, the newest
+    requests wait in pending and run after the first batch retires."""
+    from infinistore_tpu.engine import Scheduler
+
+    # 6 pages: both prompts prefill (3+3) but the first decode chunk needs
+    # a 4th page per sequence -> decode-time MemoryError -> the newest
+    # request is shed and resumes after the first retires
+    eng = InferenceEngine(PARAMS, CFG, make_pc(n_blocks=6))
+    eng.decode_chunk = 4
+    sched = Scheduler(eng, max_batch=4)
+    a = sched.submit(PROMPT, 5)
+    b = sched.submit(PROMPT[:9], 5)
+    out = sched.run()
+    assert out[a] == dense_greedy(PROMPT, 5)
+    assert out[b] == dense_greedy(PROMPT[:9], 5)
+    assert len(eng.alloc._free) == 6  # everything released
+
+
 def test_scheduler_continuous_batching():
     """Requests submitted together and staggered must each match their solo
     greedy decode; finished requests leave the batch and free their pages."""

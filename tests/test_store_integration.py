@@ -455,6 +455,36 @@ def test_malformed_frames_drop_connection_not_server(server):
     _alive_probe()
 
 
+def test_protocol_fuzz_random_bodies(server):
+    """Valid headers with random/truncated bodies across every op id: any
+    response or drop is fine, a server crash is not (the reference's
+    bad-frame handling; guards the untrusted-count paths in
+    src/protocol.h::Reader)."""
+    import random
+
+    from infinistore_tpu import protocol as P
+
+    rng = random.Random(0xC0FFEE)
+    for op in list(range(0, 20)):
+        for body_len in (0, 1, 4, 37, 256):
+            body = bytes(rng.randrange(256) for _ in range(body_len))
+            s = socket.create_connection(("127.0.0.1", SERVICE_PORT), timeout=5)
+            s.settimeout(5)
+            try:
+                s.sendall(P.pack_header(op, len(body)) + body)
+                s.recv(P.RESP_SIZE)  # response, close, or reset: all fine
+            except OSError:
+                pass
+            finally:
+                s.close()
+    # header claims a bigger body than it sends, then disconnects
+    s = socket.create_connection(("127.0.0.1", SERVICE_PORT), timeout=5)
+    s.sendall(P.pack_header(P.OP_PUT_INLINE, 1 << 20) + b"short")
+    s.close()
+
+    _alive_probe()
+
+
 def test_client_death_mid_stream_reclaims_pending(server):
     """A client killed midway through a PUT_INLINE_BATCH payload must not
     leak pending regions (reference aborts uncommitted keys on disconnect)."""
