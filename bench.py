@@ -145,7 +145,17 @@ def bench_tpu_leg(timeout_s: int = 600) -> dict:
         import signal
 
         os.killpg(leg.pid, signal.SIGKILL)
-        leg.wait()
+        stdout, _ = leg.communicate()
+        # salvage the legs that DID finish: bench_tpu prints a cumulative
+        # JSON snapshot after every leg
+        for line in reversed(stdout.decode(errors="replace").strip().splitlines()):
+            try:
+                partial = json.loads(line)
+            except ValueError:
+                continue
+            print("# tpu leg: timed out; using partial results", file=sys.stderr)
+            partial["leg_timed_out"] = 1
+            return partial
         print("# tpu leg: timed out mid-run", file=sys.stderr)
         return {}
     if r.returncode != 0:

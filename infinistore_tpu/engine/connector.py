@@ -21,10 +21,16 @@ from ..kv.transfer import KVTransferEngine
 
 
 class StoreConnector:
-    """LMCache-style connector bound to one model + one store connection."""
+    """LMCache-style connector bound to one model + one store connection.
 
-    def __init__(self, conn, pc: PagedCacheConfig, model_id: str):
-        self.transfer = KVTransferEngine(conn, pc)
+    ``quant="int8"`` stores pages quantized (kv/quant.py): half the bytes on
+    every store/retrieve hop, with per-head scales embedded in the payload.
+    """
+
+    def __init__(
+        self, conn, pc: PagedCacheConfig, model_id: str, quant: Optional[str] = None
+    ):
+        self.transfer = KVTransferEngine(conn, pc, quant=quant)
         self.pc = pc
         self.model_id = model_id
 

@@ -79,6 +79,7 @@ class InferenceEngine:
         decode_fn=None,
         verify_fn=None,
         prefill_chunk: Optional[int] = None,
+        kv_quant: Optional[str] = None,
     ):
         """``prefill_fn``/``decode_fn`` plug in other model families with the
         same contracts as models.llama.prefill_forward / decode_forward
@@ -86,7 +87,10 @@ class InferenceEngine:
 
         ``prefill_chunk``: process prompts in chunks of this many tokens
         (a multiple of ``pc.block_tokens``) instead of one full-sequence
-        forward — bounds prefill attention memory for long prompts."""
+        forward — bounds prefill attention memory for long prompts.
+
+        ``kv_quant="int8"``: store/retrieve KV pages quantized (kv/quant.py)
+        — half the bytes per hop; HBM pages stay full precision."""
         assert pc.n_layers == cfg.n_layers
         self.params = params
         self.cfg = cfg
@@ -94,7 +98,9 @@ class InferenceEngine:
         self.model_id = model_id
         self.cache = init_cache(pc)
         self.alloc = BlockAllocator(pc.n_blocks)
-        self.transfer = KVTransferEngine(conn, pc) if conn is not None else None
+        self.transfer = (
+            KVTransferEngine(conn, pc, quant=kv_quant) if conn is not None else None
+        )
         self.max_seqs = max_seqs
         if prefill_chunk is not None:
             assert prefill_chunk % pc.block_tokens == 0, (

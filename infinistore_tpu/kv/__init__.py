@@ -9,6 +9,7 @@ from .cache import (
     write_token_kv,
 )
 from .hashing import DEFAULT_CHUNK_TOKENS, chunk_keys, layer_key, matched_token_count
+from .quant import dequantize_pages_jit, page_quant_bytes, quantize_pages
 from .transfer import KVTransferEngine
 
 __all__ = [
@@ -25,4 +26,7 @@ __all__ = [
     "layer_key",
     "matched_token_count",
     "KVTransferEngine",
+    "quantize_pages",
+    "dequantize_pages_jit",
+    "page_quant_bytes",
 ]

@@ -24,12 +24,25 @@ import numpy as np
 
 from .cache import PagedCacheConfig, read_pages, write_pages
 from .hashing import layer_key
+from .quant import dequantize_pages_jit, page_quant_bytes, quantize_pages
 
 
 class KVTransferEngine:
-    """Moves pages between a paged HBM cache and an infinistore-tpu server."""
+    """Moves pages between a paged HBM cache and an infinistore-tpu server.
 
-    def __init__(self, conn, cfg: PagedCacheConfig, pipeline_groups: int = 4):
+    ``quant="int8"`` quantizes pages on device before the D2H hop (and
+    dequantizes after H2D on load), halving every byte the store, shm pool,
+    and DCN link touch; quantized pages live under a distinct key namespace
+    (``...#L{i}:q8``) so they can never be misread as bf16 pages.
+    """
+
+    def __init__(
+        self,
+        conn,
+        cfg: PagedCacheConfig,
+        pipeline_groups: int = 4,
+        quant: Optional[str] = None,
+    ):
         # accept the public InfinityConnection or the raw wire Connection
         self.conn = getattr(conn, "conn", conn)
         self.cfg = cfg
@@ -38,6 +51,12 @@ class KVTransferEngine:
         # (the role the reference's async RDMA WR chains play on the GPU
         # side); 1 = fully serial
         self.pipeline_groups = pipeline_groups
+        if quant not in (None, "int8"):
+            raise ValueError(f"unsupported quant mode: {quant!r}")
+        self.quant = quant
+        # bytes of one page as it crosses the wire / sits in the pool
+        self.wire_page_bytes = page_quant_bytes(cfg) if quant else cfg.page_bytes
+        self._key_suffix = ":q8" if quant else ""
         self._staging: Optional[np.ndarray] = None
 
     def _ensure_staging(self, nbytes: int) -> np.ndarray:
@@ -52,10 +71,10 @@ class KVTransferEngine:
         """The store layout, defined once for both directions: layer-major,
         chunk-minor ``(key, offset)`` pairs for layers [l0, l1), offsets
         relative to a buffer that starts at layer ``l0``."""
-        pb = self.cfg.page_bytes
+        pb = self.wire_page_bytes
         n = len(chunk_keys_)
         return [
-            (layer_key(ck, layer), ((layer - l0) * n + i) * pb)
+            (layer_key(ck, layer) + self._key_suffix, ((layer - l0) * n + i) * pb)
             for layer in range(l0, l1)
             for i, ck in enumerate(chunk_keys_)
         ]
@@ -81,13 +100,17 @@ class KVTransferEngine:
         gathered = read_pages(cache, ids)  # [L, 2, H, n, T, D]
         # -> [L, n, 2, H, T, D] so each (layer, chunk) page is contiguous
         pages = jnp.transpose(gathered, (0, 3, 1, 2, 4, 5))
+        if self.quant:
+            # fuse quantize+pack on device; the D2H below then moves half
+            # the bytes (the packed rows ARE the wire pages)
+            pages = quantize_pages(pages)  # [L, n, wire_page_bytes] uint8
         # Split into layer bands, start every band's D2H up front
         # (copy_to_host_async), then write band i into the pool while bands
         # i+1.. are still streaming device->host.  Each band's host array
         # pointer goes straight to the put, so the only synchronous host
         # copy is the client->pool write (the RDMA-WRITE analog).
         L = self.cfg.n_layers
-        pb = self.cfg.page_bytes
+        pb = self.wire_page_bytes
         G = max(1, min(self.pipeline_groups, L))
         Lg = -(-L // G)
         parts = [pages[l0 : l0 + Lg] for l0 in range(0, L, Lg)]
@@ -114,18 +137,23 @@ class KVTransferEngine:
         n = len(block_ids)
         if n == 0:
             return cache
-        pb = self.cfg.page_bytes
+        pb = self.wire_page_bytes
         blocks = self._page_blocks(chunk_keys_, 0, self.cfg.n_layers)
         nbytes = len(blocks) * pb
         staging = self._ensure_staging(nbytes)
         self.conn.read_cache(blocks, pb, staging.ctypes.data)
         L = self.cfg.n_layers
-        host = (
-            staging[:nbytes]
-            .view(jnp.dtype(self.cfg.dtype))
-            .reshape((L, n) + self.cfg.page_shape)  # [L, n, 2, H, T, D]
-        )
-        pages = jnp.transpose(jnp.asarray(host), (0, 2, 3, 1, 4, 5))  # [L,2,H,n,T,D]
+        if self.quant:
+            packed = jnp.asarray(staging[:nbytes].reshape(L, n, pb))
+            unpacked = dequantize_pages_jit(packed, self.cfg)  # [L, n, 2, H, T, D]
+            pages = jnp.transpose(unpacked, (0, 2, 3, 1, 4, 5))
+        else:
+            host = (
+                staging[:nbytes]
+                .view(jnp.dtype(self.cfg.dtype))
+                .reshape((L, n) + self.cfg.page_shape)  # [L, n, 2, H, T, D]
+            )
+            pages = jnp.transpose(jnp.asarray(host), (0, 2, 3, 1, 4, 5))  # [L,2,H,n,T,D]
         ids = jnp.asarray(np.asarray(block_ids, dtype=np.int32))
         return write_pages(cache, ids, pages)
 
@@ -135,10 +163,11 @@ class KVTransferEngine:
         written first, so verify the last layer before trusting a hit)."""
         if not chunk_keys_:
             return 0
-        probe = [layer_key(ck, 0) for ck in chunk_keys_]
+        sfx = self._key_suffix
+        probe = [layer_key(ck, 0) + sfx for ck in chunk_keys_]
         idx = self.conn.get_match_last_index(probe)
         while idx >= 0:
-            last = layer_key(chunk_keys_[idx], self.cfg.n_layers - 1)
+            last = layer_key(chunk_keys_[idx], self.cfg.n_layers - 1) + sfx
             if self.conn.check_exist(last) == 0:  # 0 => exists (wire semantics)
                 break
             idx -= 1

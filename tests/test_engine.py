@@ -301,6 +301,26 @@ def test_pd_disaggregation_over_tcp(server):
     decode_conn.close()
 
 
+def test_pd_disaggregation_quantized(server):
+    """PD flow with int8-quantized store pages: half the transfer bytes must
+    still reproduce the dense greedy tokens (kv/quant.py error bound)."""
+    prefill_conn, decode_conn = _conn(server), _conn(server)
+    prefill_eng = InferenceEngine(
+        PARAMS, CFG, make_pc(), conn=prefill_conn, model_id="pd-q8",
+        kv_quant="int8",
+    )
+    decode_eng = InferenceEngine(
+        PARAMS, CFG, make_pc(), conn=decode_conn, model_id="pd-q8",
+        kv_quant="int8",
+    )
+    prefill_eng.prefill(PROMPT)
+    st = decode_eng.prefill(PROMPT)
+    assert st.reused_chunks == len(PROMPT) // T
+    assert decode_eng.decode(st, 8) == dense_greedy(PROMPT, 8)
+    prefill_conn.close()
+    decode_conn.close()
+
+
 def test_cross_request_prefix_reuse(server):
     """Second request sharing a long prefix reuses stored chunks."""
     conn = _conn(server)

@@ -180,6 +180,74 @@ def test_lookup_prefix(conn):
     assert eng.lookup_prefix(keys[:2]) == 2
 
 
+def test_quantize_roundtrip_error():
+    from infinistore_tpu.kv.quant import quantization_error
+
+    pc = PagedCacheConfig(
+        n_layers=2, n_kv_heads=2, head_dim=16, n_blocks=4, block_tokens=8, dtype=jnp.bfloat16
+    )
+    pages = jax.random.normal(jax.random.PRNGKey(3), (2, 3, 2, 2, 8, 16), jnp.bfloat16)
+    abs_err, rel_err = quantization_error(pages, pc)
+    # symmetric int8 vs per-head amax: worst case ~ (0.5/127 quantization
+    # step) + bf16 round-off of the dequantized product
+    assert rel_err < 0.02, (abs_err, rel_err)
+
+
+def test_quantized_page_bytes():
+    from infinistore_tpu.kv import page_quant_bytes
+
+    pc = PagedCacheConfig(n_layers=32, n_kv_heads=8, head_dim=128, n_blocks=1, block_tokens=16)
+    # 16 f32 scales + 32768 int8 values vs 65536 bf16 bytes: 2x minus epsilon
+    assert page_quant_bytes(pc) == 2 * 8 * 4 + 2 * 8 * 16 * 128
+    assert page_quant_bytes(pc) < pc.page_bytes // 2 + 256
+
+
+def test_quantized_save_load_pages(conn):
+    from infinistore_tpu.kv import dequantize_pages_jit, page_quant_bytes, quantize_pages
+
+    pc = PagedCacheConfig(
+        n_layers=2, n_kv_heads=2, head_dim=16, n_blocks=8, block_tokens=16, dtype=jnp.float32
+    )
+    eng = KVTransferEngine(conn, pc, quant="int8")
+    cache = init_cache(pc)
+    pages = jax.random.normal(jax.random.PRNGKey(4), (2, 2, 2, 2, 16, 16), jnp.float32)
+    cache = write_pages(cache, jnp.asarray([0, 1]), pages)
+
+    keys = chunk_keys(list(range(32)), "m-quant")
+    nbytes = eng.save_pages(cache, [0, 1], keys)
+    assert nbytes == 2 * 2 * page_quant_bytes(pc)  # half the bf16 bytes
+
+    cache2 = init_cache(pc)
+    cache2 = eng.load_pages(cache2, [4, 5], keys)
+    out = read_pages(cache2, jnp.asarray([4, 5]))
+    # the store hop must be exactly the local quantize round-trip...
+    local = jnp.transpose(
+        dequantize_pages_jit(
+            quantize_pages(jnp.transpose(pages, (0, 3, 1, 2, 4, 5))), pc
+        ),
+        (0, 2, 3, 1, 4, 5),
+    )
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(local))
+    # ...and close to the original values (per-head int8 error bound)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(pages), atol=0.05)
+
+
+def test_quantized_namespace_isolation(conn):
+    """int8 pages live under :q8 keys; a bf16 engine must never see them."""
+    pc = PagedCacheConfig(
+        n_layers=2, n_kv_heads=2, head_dim=16, n_blocks=8, block_tokens=16, dtype=jnp.float32
+    )
+    qeng = KVTransferEngine(conn, pc, quant="int8")
+    feng = KVTransferEngine(conn, pc)
+    cache = init_cache(pc)
+    pages = jax.random.normal(jax.random.PRNGKey(5), (2, 2, 2, 1, 16, 16), jnp.float32)
+    cache = write_pages(cache, jnp.asarray([0]), pages)
+    keys = chunk_keys(list(range(16)), "m-qns")
+    qeng.save_pages(cache, [0], keys)
+    assert qeng.lookup_prefix(keys) == 1
+    assert feng.lookup_prefix(keys) == 0
+
+
 def test_lookup_prefix_requires_all_layers(conn):
     """A chunk whose last layer is missing must not count as a hit."""
     pc = PagedCacheConfig(
