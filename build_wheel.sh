@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# Build a distributable wheel with the native runtime compiled in
+# (reference parity: build_manylinux_wheels.sh drives docker+cmake; a TPU-VM
+# fleet shares one image, so a plain host build is the equivalent).
+#
+# The wheel bundles infinistore_tpu/libistpu.so (built by setup.py's
+# build_py hook from src/); installs fall back to the pure-Python runtime
+# when the target host lacks the library.
+set -euo pipefail
+cd "$(dirname "$0")"
+
+rm -rf build dist infinistore_tpu.egg-info
+# --no-isolation/--no-build-isolation: build against the host env (TPU-VM
+# images are airgapped; setuptools is baked in)
+if python -c "import build" 2>/dev/null; then
+    python -m build --wheel --no-isolation
+else
+    python -m pip wheel . -w dist/ --no-deps --no-build-isolation
+fi
+ls -l dist/*.whl
+echo "smoke-testing the wheel in a scratch prefix..."
+tmp=$(mktemp -d)
+trap 'rm -rf "$tmp"' EXIT
+python -m pip install --quiet --target "$tmp" dist/*.whl --no-deps
+PYTHONPATH="$tmp" python - <<'EOF'
+import infinistore_tpu as ist
+from infinistore_tpu import _native
+print("wheel import ok; native runtime available:", _native.available())
+EOF

@@ -80,6 +80,8 @@ class InferenceEngine:
         verify_fn=None,
         prefill_chunk: Optional[int] = None,
         kv_quant: Optional[str] = None,
+        mesh=None,
+        param_specs=None,
     ):
         """``prefill_fn``/``decode_fn`` plug in other model families with the
         same contracts as models.llama.prefill_forward / decode_forward
@@ -90,13 +92,40 @@ class InferenceEngine:
         forward — bounds prefill attention memory for long prompts.
 
         ``kv_quant="int8"``: store/retrieve KV pages quantized (kv/quant.py)
-        — half the bytes per hop; HBM pages stay full precision."""
+        — half the bytes per hop; HBM pages stay full precision.
+
+        ``mesh``: a ``jax.sharding.Mesh`` with a ``tp`` axis turns this into
+        a tensor-parallel serving engine: params are sharded Megatron-style
+        (``param_specs`` overrides the default Llama specs), the paged cache
+        is sharded over the KV-head axis, and every jitted step is
+        GSPMD-partitioned — XLA inserts the two allreduces per layer
+        (parallel/sharding.py rationale).  Page bookkeeping, the store
+        protocol, and the scheduler are unchanged: they never see the mesh."""
         assert pc.n_layers == cfg.n_layers
-        self.params = params
+        self.mesh = mesh
         self.cfg = cfg
         self.pc = pc
         self.model_id = model_id
-        self.cache = init_cache(pc)
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            from ..parallel.sharding import llama_inference_specs, shard_params
+
+            tp = mesh.shape["tp"]
+            assert pc.n_kv_heads % tp == 0, (
+                f"n_kv_heads={pc.n_kv_heads} must divide over tp={tp}"
+            )
+            self.params = shard_params(
+                params, mesh, param_specs or llama_inference_specs()
+            )
+            # cache [L, 2, H_kv, n_blocks, T, D]: KV-head axis over tp,
+            # matching the head-sharded wk/wv so decode stays head-local
+            self.cache = jax.device_put(
+                init_cache(pc),
+                NamedSharding(mesh, PartitionSpec(None, None, "tp")),
+            )
+        else:
+            self.params = params
+            self.cache = init_cache(pc)
         self.alloc = BlockAllocator(pc.n_blocks)
         self.transfer = (
             KVTransferEngine(conn, pc, quant=kv_quant) if conn is not None else None
@@ -110,18 +139,33 @@ class InferenceEngine:
         self.max_pages = pc.n_blocks
         self.seqs: Dict[int, SequenceState] = {}
         self._next_id = 0
+        # under a mesh every step is GSPMD-partitioned: the Pallas kernels
+        # are opaque custom calls with no partitioning rule, so force the
+        # XLA attention path (models/attention.py rationale); prefill/decode
+        # of every family take use_pallas for this reason
+        pallas_kw = {"use_pallas": False} if mesh is not None else {}
         self._prefill_jit = jax.jit(
-            partial(prefill_fn or prefill_forward, cfg=self.cfg)
+            partial(prefill_fn or prefill_forward, cfg=self.cfg, **pallas_kw)
         )
-        self._decode_raw = partial(decode_fn or decode_forward, cfg=self.cfg)
+        self._decode_raw = partial(
+            decode_fn or decode_forward, cfg=self.cfg, **pallas_kw
+        )
         # a custom model family must bring its own verify step: silently
         # binding llama's verify_forward to foreign params would die deep in
         # jit tracing instead of at the call site
         self._has_verify = verify_fn is not None or (
             decode_fn is None and prefill_fn is None
         )
+        # same GSPMD rule for a custom verify step; the built-in
+        # verify_forward is XLA-only and takes no use_pallas
+        verify_kw = {}
+        if mesh is not None and verify_fn is not None:
+            import inspect
+
+            if "use_pallas" in inspect.signature(verify_fn).parameters:
+                verify_kw = {"use_pallas": False}
         self._verify_jit = jax.jit(
-            partial(verify_fn or verify_forward, cfg=self.cfg),
+            partial(verify_fn or verify_forward, cfg=self.cfg, **verify_kw),
             donate_argnames=("cache",),
         )
         # tokens per compiled decode dispatch; the scan length is static so

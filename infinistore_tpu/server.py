@@ -100,6 +100,25 @@ def _manage_handler(server_ref):
                 self._json({"usage": store.usage() if store else 0.0})
             elif self.path == "/metrics":
                 self._json(store.stats_dict() if store else {})
+            elif self.path == "/metrics.prom":
+                # Prometheus text exposition of the same counters, for
+                # scrape-based monitoring of serving clusters
+                from .store import Store
+
+                stats = store.stats_dict() if store else {}
+                lines = []
+                for k, v in stats.items():
+                    if isinstance(v, bool) or not isinstance(v, (int, float)):
+                        continue
+                    kind = "gauge" if k in Store.STATS_GAUGES else "counter"
+                    lines.append(f"# TYPE infinistore_tpu_{k} {kind}")
+                    lines.append(f"infinistore_tpu_{k} {v}")
+                body = ("\n".join(lines) + "\n").encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
             else:
                 self._json({"error": "not found"}, 404)
 

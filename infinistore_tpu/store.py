@@ -293,6 +293,11 @@ class Store:
         self.pending = keep
         return n
 
+    # point-in-time values in stats_dict(); everything else is monotonic.
+    # Lives next to the schema so /metrics.prom's TYPE lines can't drift
+    # from what stats_dict() actually returns.
+    STATS_GAUGES = frozenset({"kvmap_len", "pending", "usage", "pools", "block_size"})
+
     def stats_dict(self) -> dict:
         s = self.stats
         return {

@@ -317,18 +317,26 @@ def test_manage_plane(server):
     import urllib.request
 
     with urllib.request.urlopen(
-        f"http://127.0.0.1:{MANAGE_PORT}/selftest", timeout=5
+        f"http://127.0.0.1:{MANAGE_PORT}/selftest", timeout=30
     ) as r:
         assert json.load(r)["status"] == "ok"
     with urllib.request.urlopen(
-        f"http://127.0.0.1:{MANAGE_PORT}/kvmap_len", timeout=5
+        f"http://127.0.0.1:{MANAGE_PORT}/kvmap_len", timeout=30
     ) as r:
         assert json.load(r)["len"] >= 0
     with urllib.request.urlopen(
-        f"http://127.0.0.1:{MANAGE_PORT}/metrics", timeout=5
+        f"http://127.0.0.1:{MANAGE_PORT}/metrics", timeout=30
     ) as r:
         m = json.load(r)
     assert "usage" in m and "puts" in m
+    # Prometheus exposition of the same counters
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{MANAGE_PORT}/metrics.prom", timeout=30
+    ) as r:
+        assert r.headers["Content-Type"].startswith("text/plain")
+        text = r.read().decode()
+    assert "# TYPE infinistore_tpu_usage gauge" in text
+    assert "infinistore_tpu_puts" in text
 
 
 def test_purge_via_manage_plane(server):
