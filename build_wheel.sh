@@ -22,8 +22,12 @@ echo "smoke-testing the wheel in a scratch prefix..."
 tmp=$(mktemp -d)
 trap 'rm -rf "$tmp"' EXIT
 python -m pip install --quiet --target "$tmp" dist/*.whl --no-deps
-PYTHONPATH="$tmp" python - <<'EOF'
+# PYTHONSAFEPATH keeps cwd/'' off sys.path so this provably imports the
+# INSTALLED wheel, not the repo source tree we are standing in (-I would
+# also discard the PYTHONPATH pointing at the wheel)
+PYTHONPATH="$tmp" PYTHONSAFEPATH=1 python - <<'EOF'
 import infinistore_tpu as ist
 from infinistore_tpu import _native
+assert ist.__file__.startswith(__import__("os").environ["PYTHONPATH"]), ist.__file__
 print("wheel import ok; native runtime available:", _native.available())
 EOF

@@ -30,6 +30,7 @@ import numpy as np
 from ..kv.cache import (
     BlockAllocator,
     PagedCacheConfig,
+    PrefixPageCache,
     init_cache,
     pages_to_seq_kv,
     prefill_to_pages,
@@ -82,6 +83,7 @@ class InferenceEngine:
         kv_quant: Optional[str] = None,
         mesh=None,
         param_specs=None,
+        pallas_tp: bool = False,
     ):
         """``prefill_fn``/``decode_fn`` plug in other model families with the
         same contracts as models.llama.prefill_forward / decode_forward
@@ -127,6 +129,10 @@ class InferenceEngine:
             self.params = params
             self.cache = init_cache(pc)
         self.alloc = BlockAllocator(pc.n_blocks)
+        # automatic prefix caching: complete-chunk pages are content-
+        # addressed by their prefix-commitment key and shared across
+        # sequences (kv/cache.py PrefixPageCache)
+        self.pages = PrefixPageCache(self.alloc)
         self.transfer = (
             KVTransferEngine(conn, pc, quant=kv_quant) if conn is not None else None
         )
@@ -147,8 +153,19 @@ class InferenceEngine:
         self._prefill_jit = jax.jit(
             partial(prefill_fn or prefill_forward, cfg=self.cfg, **pallas_kw)
         )
+        # pallas_tp: decode attention runs the Pallas kernel head-locally
+        # inside a shard_map over tp instead of the partitioned XLA gather
+        # (models/attention.py paged_decode_attention_tp); default-family
+        # only — a custom decode_fn brings its own sharded kernels
+        decode_kw = dict(pallas_kw)
+        if mesh is not None and pallas_tp:
+            assert decode_fn is None, (
+                "pallas_tp composes the built-in decode kernel; custom"
+                " decode_fn must handle its own tp kernel dispatch"
+            )
+            decode_kw["tp_mesh"] = mesh
         self._decode_raw = partial(
-            decode_fn or decode_forward, cfg=self.cfg, **pallas_kw
+            decode_fn or decode_forward, cfg=self.cfg, **decode_kw
         )
         # a custom model family must bring its own verify step: silently
         # binding llama's verify_forward to foreign params would die deep in
@@ -190,23 +207,34 @@ class InferenceEngine:
         assert S_total >= 1
         keys = chunk_keys(tokens, self.model_id, chunk_tokens=T)
 
-        # longest reusable store prefix, capped so >=1 token is computed
-        # locally (we need last-token logits to start decoding)
-        reused = 0
-        if self.transfer is not None and keys:
-            reused = self.transfer.lookup_prefix(keys)
-            reused = min(reused, (S_total - 1) // T)
+        # longest reusable prefix, capped so >=1 token is computed locally
+        # (we need last-token logits to start decoding).  Cheapest first:
+        # locally-resident pages (automatic prefix caching — zero compute,
+        # zero transfer), then the store (zero compute, one load).
+        max_reuse = (S_total - 1) // T
+        local_ids = self.pages.match_prefix(keys[:max_reuse])  # pins hits
+        reused = len(local_ids)
+        if self.transfer is not None and keys and reused < max_reuse:
+            reused = max(reused, min(self.transfer.lookup_prefix(keys), max_reuse))
         P = reused * T
 
-        # pages for the whole sequence (incl. a partial tail page)
+        # pages for the rest of the sequence (incl. a partial tail page)
         n_pages_total = -(-S_total // T)
-        block_ids = self.alloc.alloc(n_pages_total)
+        try:
+            fresh_ids = self.pages.acquire(n_pages_total - len(local_ids))
+        except MemoryError:
+            self.pages.unpin(local_ids)
+            raise
+        block_ids = local_ids + fresh_ids
 
         prefix_kv = None
         if reused:
-            self.cache = self.transfer.load_pages(
-                self.cache, block_ids[:reused], keys[:reused]
-            )
+            if reused > len(local_ids):  # store hop for the non-local part
+                self.cache = self.transfer.load_pages(
+                    self.cache,
+                    block_ids[len(local_ids):reused],
+                    keys[len(local_ids):reused],
+                )
             pages = read_pages(self.cache, jnp.asarray(block_ids[:reused]))
             prefix_kv = pages_to_seq_kv(pages)  # [L, 2, 1, n*T, H, D]
 
@@ -289,11 +317,14 @@ class InferenceEngine:
                 plen = need
 
         # push complete chunks to the store (prefill-node role)
-        if self.transfer is not None:
-            n_complete = S_total // T
-            if n_complete > reused:
-                ids = block_ids[reused:n_complete]
-                self.transfer.save_pages(self.cache, ids, keys[reused:n_complete])
+        n_complete = S_total // T
+        if self.transfer is not None and n_complete > reused:
+            ids = block_ids[reused:n_complete]
+            self.transfer.save_pages(self.cache, ids, keys[reused:n_complete])
+
+        # name this sequence's complete-chunk pages so later prefills can
+        # share them in place (no-op for keys already resident)
+        self.pages.register(keys[:n_complete], block_ids[:n_complete])
 
         state = SequenceState(
             seq_id=self._next_id,
@@ -367,7 +398,7 @@ class InferenceEngine:
         B = len(group)
         Bp = _round_up_pow2(B, 1)  # batch-dim bucket: bounded compile count
         n_pages_each = [-(-len(p) // T) for p in group]
-        ids_all = self.alloc.alloc(sum(n_pages_each))  # atomic: before any mutation
+        ids_all = self.pages.acquire(sum(n_pages_each))  # atomic: before any mutation
         tokens = np.zeros((Bp, bucket), dtype=np.int32)
         for b, p in enumerate(group):
             tokens[b, : len(p)] = p
@@ -390,6 +421,7 @@ class InferenceEngine:
                 chunk_keys=chunk_keys(p, self.model_id, chunk_tokens=T),
                 last_logits=logits[b, len(p) - 1],
             )
+            self.pages.register(st.chunk_keys, st.block_ids[: len(p) // T])
             self._next_id += 1
             self.seqs[st.seq_id] = st
             states.append(st)
@@ -498,7 +530,7 @@ class InferenceEngine:
         for st in states:
             need = -(-(len(st.tokens) + n_steps) // T)
             if need > len(st.block_ids):
-                st.block_ids.extend(self.alloc.alloc(need - len(st.block_ids)))
+                st.block_ids.extend(self.pages.acquire(need - len(st.block_ids)))
         block_table = self._block_table(states)
         if rng is None:
             # advance the engine's own stream: repeated sampling calls must
@@ -556,7 +588,7 @@ class InferenceEngine:
         T = self.pc.block_tokens
         need_pages = -(-(start_pos + S) // T)
         if need_pages > len(state.block_ids):
-            state.block_ids.extend(self.alloc.alloc(need_pages - len(state.block_ids)))
+            state.block_ids.extend(self.pages.acquire(need_pages - len(state.block_ids)))
         poss = np.arange(start_pos, start_pos + S, dtype=np.int32)
         slot_blocks = np.asarray(
             [state.block_ids[p // T] for p in poss], dtype=np.int32
@@ -582,7 +614,14 @@ class InferenceEngine:
         state = self.prefill(tokens)
         return self.decode(state, n_steps)
 
+    @property
+    def free_pages(self) -> int:
+        """Pages a new sequence can obtain (fresh + reclaimable cached)."""
+        return self.pages.available
+
     def release(self, state: SequenceState) -> None:
-        self.alloc.free(state.block_ids)
+        # shared pages just lose a ref; this sequence's registered pages
+        # stay resident (reclaimable LRU) for future prefix hits
+        self.pages.unpin(state.block_ids)
         state.block_ids = []
         self.seqs.pop(state.seq_id, None)

@@ -113,7 +113,7 @@ class Scheduler:
                 -(-(len(r.tokens) + len(r.output)) // T) for r in reqs
             )
 
-        while len(admit) > 1 and wave_pages(admit) > self.engine.alloc.n_free:
+        while len(admit) > 1 and wave_pages(admit) > self.engine.free_pages:
             self.pending.insert(0, admit.pop())
         while admit:
             try:

@@ -2,6 +2,7 @@ from .cache import (
     BlockAllocator,
     BlockTable,
     PagedCacheConfig,
+    PrefixPageCache,
     init_cache,
     prefill_to_pages,
     read_pages,
@@ -16,6 +17,7 @@ __all__ = [
     "BlockAllocator",
     "BlockTable",
     "PagedCacheConfig",
+    "PrefixPageCache",
     "init_cache",
     "prefill_to_pages",
     "read_pages",

@@ -153,6 +153,95 @@ class BlockAllocator:
         return len(self._free)
 
 
+class PrefixPageCache:
+    """Refcounted, content-addressed page residency — automatic prefix
+    caching for the HBM cache (the role vLLM's APC plays in the reference's
+    serving stack; the *store* handles cross-host reuse, this handles
+    same-engine reuse without recompute OR store traffic).
+
+    Chunk keys (kv/hashing.py) commit to the whole token prefix, so
+    ``key match == identical page content`` and pages become content-
+    addressable for free.  Complete-chunk pages are registered under their
+    key; sequences sharing a prefix pin the same block ids (a ref each).
+    Shared pages are only ever *read* — decode/verify append into pages past
+    the registered prefix, never into a registered one (slot = pos // T
+    lands beyond every complete chunk).  On release, refs drop; pages at
+    ref 0 with a key are RETAINED on an LRU of reclaimable pages (a later
+    prefill can still hit them) and only handed back to the allocator when
+    ``acquire`` runs out of fresh pages.
+    """
+
+    def __init__(self, alloc: BlockAllocator):
+        self.alloc = alloc
+        self._key_to_block: dict = {}
+        self._block_key: dict = {}
+        self._refs: dict = {}  # block_id -> live-sequence count
+        from collections import OrderedDict
+
+        self._cached: "OrderedDict[int, None]" = OrderedDict()  # ref==0, reclaimable
+
+    @property
+    def available(self) -> int:
+        """Pages obtainable by ``acquire``: fresh + reclaimable."""
+        return self.alloc.n_free + len(self._cached)
+
+    def acquire(self, n: int) -> List[int]:
+        """All-or-nothing allocation, reclaiming LRU cached pages on demand."""
+        if n > self.available:
+            raise MemoryError(
+                f"out of KV pages: want {n}, have {self.available}"
+            )
+        fresh = min(n, self.alloc.n_free)
+        ids = self.alloc.alloc(fresh) if fresh else []
+        while len(ids) < n:
+            bid, _ = self._cached.popitem(last=False)  # oldest first
+            key = self._block_key.pop(bid)
+            del self._key_to_block[key]
+            ids.append(bid)
+        for bid in ids:
+            self._refs[bid] = 1
+        return ids
+
+    def match_prefix(self, keys: Sequence[str]) -> List[int]:
+        """Longest resident run of ``keys``; pins every hit (+1 ref)."""
+        ids: List[int] = []
+        for k in keys:
+            bid = self._key_to_block.get(k)
+            if bid is None:
+                break
+            self._pin(bid)
+            ids.append(bid)
+        return ids
+
+    def _pin(self, bid: int) -> None:
+        self._refs[bid] = self._refs.get(bid, 0) + 1
+        self._cached.pop(bid, None)
+
+    def unpin(self, block_ids: Sequence[int]) -> None:
+        """Drop one ref per page; ref-0 pages go to the reclaim LRU (if
+        registered) or straight back to the allocator."""
+        for bid in block_ids:
+            r = self._refs[bid] - 1
+            if r > 0:
+                self._refs[bid] = r
+                continue
+            del self._refs[bid]
+            if bid in self._block_key:
+                self._cached[bid] = None
+                self._cached.move_to_end(bid)
+            else:
+                self.alloc.free([bid])
+
+    def register(self, keys: Sequence[str], block_ids: Sequence[int]) -> None:
+        """Name complete-chunk pages so later prefills can hit them.  First
+        registration wins: a key already resident keeps its page (the new
+        page simply stays private to its sequence)."""
+        for k, bid in zip(keys, block_ids):
+            if k in self._key_to_block or bid in self._block_key:
+                continue
+            self._key_to_block[k] = bid
+            self._block_key[bid] = k
+
 class BlockTable:
     """Per-sequence page tables (host side), for paged attention."""
 

@@ -220,12 +220,65 @@ def paged_multitoken_attention_xla(
     return jnp.einsum("bhsk,bkhd->bshd", probs.astype(v.dtype), v)
 
 
+def paged_decode_attention_tp(
+    q: jax.Array,
+    layer_cache: jax.Array,
+    block_table: jax.Array,
+    seq_lens: jax.Array,
+    mesh,
+    interpret: bool = False,
+) -> jax.Array:
+    """Tensor-parallel Pallas decode attention: the kernel inside a
+    ``shard_map`` over the mesh's ``tp`` axis.
+
+    Paged attention is head-local (each q-head group reads only its own KV
+    head's pages), so splitting q over H and the cache over H_kv needs NO
+    collectives — each shard streams its local pages with the same kernel
+    the single-chip path uses, and GSPMD stitches the head axis back.  This
+    is the composition models/attention.py's GSPMD caveat calls the planned
+    path: the opaque pallas_call never meets the partitioner because
+    shard_map hands it already-local shards.
+
+    Requires tp | H_kv (same grouping rule as the weights: tp shards whole
+    GQA groups).  q: [B, H, D]; layer_cache: [2, H_kv, n_blocks, T, D].
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from ..ops.pallas_attention import paged_decode_attention_pallas
+
+    tp = mesh.shape["tp"]
+    Hkv = layer_cache.shape[1]
+    assert Hkv % tp == 0 and q.shape[1] % tp == 0, (q.shape, Hkv, tp)
+
+    def local(q, cache, table, lens):
+        return paged_decode_attention_pallas(
+            q, cache, table, lens, interpret=interpret
+        )
+
+    return jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(
+            P(None, "tp", None),
+            P(None, "tp", None, None, None),
+            P(None, None),
+            P(None),
+        ),
+        out_specs=P(None, "tp", None),
+        axis_names={"tp"},
+        # pallas_call declares no varying-mesh-axes metadata; the specs
+        # above are the full contract
+        check_vma=False,
+    )(q, layer_cache, block_table, seq_lens)
+
+
 def paged_decode_attention(
     q: jax.Array,
     layer_cache: jax.Array,
     block_table: jax.Array,
     seq_lens: jax.Array,
     allow_pallas: bool = True,
+    tp_mesh=None,
 ) -> jax.Array:
     """Paged decode attention; Pallas kernel on TPU, XLA gather elsewhere.
 
@@ -238,11 +291,26 @@ def paged_decode_attention(
     GSPMD-partitioned jit (parallel/sharding.py make_tp_decode): pallas_call
     is an opaque custom call with no SPMD partitioning rule, so the
     partitioner would replicate (all-gather) the sharded cache around it.
-    The sharded-kernel composition (shard_map around the kernel) is the
-    planned path for tensor-parallel Pallas decode.
+    ``tp_mesh`` is the sharded-kernel composition that lifts this limit:
+    ``paged_decode_attention_tp`` wraps the kernel in a shard_map over tp
+    (on TPU; set ISTPU_PALLAS_INTERPRET=1 to exercise it in interpret mode
+    on the CPU mesh).
     """
     import os
 
+    if tp_mesh is not None:
+        interp = bool(os.environ.get("ISTPU_PALLAS_INTERPRET"))
+        on_tpu = (
+            q.shape[-1] % 128 == 0
+            and jax.default_backend() == "tpu"
+            and not os.environ.get("ISTPU_NO_PALLAS")
+        )
+        if on_tpu or interp:
+            return paged_decode_attention_tp(
+                q, layer_cache, block_table, seq_lens, tp_mesh,
+                interpret=interp,
+            )
+        return paged_decode_attention_xla(q, layer_cache, block_table, seq_lens)
     if (
         allow_pallas
         and q.shape[-1] % 128 == 0  # head dim must fill whole lanes

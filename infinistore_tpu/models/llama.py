@@ -200,12 +200,16 @@ def decode_forward(
     slot_block_ids: jax.Array,
     slot_ids: jax.Array,
     use_pallas: bool = True,
+    tp_mesh=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Single-token paged decode.
 
     ``use_pallas=False`` forces the XLA attention path; required when this
     function is traced under a GSPMD-partitioned jit (see
-    models/attention.py:paged_decode_attention).
+    models/attention.py:paged_decode_attention).  ``tp_mesh`` instead runs
+    the Pallas kernel head-locally inside a shard_map over the mesh's tp
+    axis (paged_decode_attention_tp) — the tensor-parallel serving fast
+    path.
 
     tokens/positions: [B]; cache: [L, 2, Hkv, n_blocks, T, D]
     (kv/cache.py layout -- heads outside blocks so the Pallas decode kernel
@@ -225,7 +229,8 @@ def decode_forward(
         # scatter this token's kv into its page slot
         cache = write_token_kv(cache, li, slot_block_ids, slot_ids, k[:, 0], v[:, 0])
         attn = paged_decode_attention(
-            q[:, 0], cache[li], block_table, seq_lens, allow_pallas=use_pallas
+            q[:, 0], cache[li], block_table, seq_lens, allow_pallas=use_pallas,
+            tp_mesh=tp_mesh,
         )
         x = x + (attn.reshape(B, -1) @ layer["wo"])[:, None, :]
         h = rmsnorm(x, layer["ln_mlp"], cfg.norm_eps)

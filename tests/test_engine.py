@@ -204,7 +204,8 @@ def test_scheduler_backpressure_on_page_exhaustion():
     out = sched.run()
     assert out[a] == dense_greedy(PROMPT, 5)
     assert out[b] == dense_greedy(PROMPT[:9], 5)
-    assert len(eng.alloc._free) == 6  # everything released
+    # everything released: fresh + APC-cached pages add back up to capacity
+    assert eng.free_pages == 6
 
 
 def test_scheduler_continuous_batching():
@@ -223,8 +224,8 @@ def test_scheduler_continuous_batching():
     got = sched.run()
     assert {ids[i]: want[i] for i in range(len(prompts))} == got
     assert not sched.active and not sched.pending
-    # all pages returned to the allocator
-    assert len(eng.alloc._free) == eng.pc.n_blocks
+    # all pages reclaimable again (fresh + APC-retained)
+    assert eng.free_pages == eng.pc.n_blocks
 
 
 def test_scheduler_separates_sampling_groups():
@@ -361,3 +362,91 @@ def test_connector_roundtrip(server):
     assert connector.invalidate(tokens) == 4 * CFG.n_layers
     assert connector.lookup(tokens) == 0
     conn.close()
+
+
+# ---- automatic prefix caching (HBM page dedup) ----
+
+def test_apc_shares_pages_across_sequences():
+    """Two live sequences with a common prefix must share the complete-chunk
+    pages in HBM (no recompute, no duplicate pages) and still decode the
+    dense-reference tokens."""
+    eng = InferenceEngine(PARAMS, CFG, make_pc())
+    a = eng.prefill(PROMPT)
+    free_before = eng.free_pages
+    b = eng.prefill(PROMPT)  # identical prompt
+    # shared: both complete chunks; private: the tail page only
+    assert b.reused_chunks == len(PROMPT) // T
+    assert b.block_ids[: b.reused_chunks] == a.block_ids[: b.reused_chunks]
+    assert free_before - eng.free_pages == 1  # one private tail page
+    assert eng.decode(b, 8) == dense_greedy(PROMPT, 8)
+    # the survivor keeps decoding correctly after the sharer releases
+    eng.release(b)
+    assert eng.decode(a, 8) == dense_greedy(PROMPT, 8)
+    eng.release(a)
+
+
+def test_apc_partial_prefix_and_divergence():
+    eng = InferenceEngine(PARAMS, CFG, make_pc())
+    base = [9, 8, 7, 6, 5, 4, 3, 2, 1, 10, 11, 12]  # 3 full chunks
+    a = eng.prefill(base)
+    fork = base[:8] + [100, 101, 102, 103]  # shares 2 chunks, diverges after
+    b = eng.prefill(fork)
+    assert b.reused_chunks == 2
+    assert b.block_ids[:2] == a.block_ids[:2]
+    assert b.block_ids[2] != a.block_ids[2]  # divergent chunk is private
+    assert eng.decode(b, 6) == dense_greedy(fork, 6)
+
+
+def test_apc_retains_pages_after_release():
+    """Released pages stay resident (reclaimable LRU): a later identical
+    prefill reuses them with zero recompute."""
+    eng = InferenceEngine(PARAMS, CFG, make_pc())
+    st = eng.prefill(PROMPT)
+    eng.release(st)
+    st2 = eng.prefill(PROMPT)
+    assert st2.reused_chunks == len(PROMPT) // T
+    assert eng.decode(st2, 8) == dense_greedy(PROMPT, 8)
+
+
+def test_apc_reclaims_cached_pages_under_pressure():
+    """Cached (ref-0) pages are handed back when fresh pages run out, oldest
+    first; live sequences' pages are never reclaimed."""
+    pc = make_pc(n_blocks=8)
+    eng = InferenceEngine(PARAMS, CFG, pc)
+    a = eng.prefill([1, 2, 3, 4, 5, 6, 7, 8])  # 2 pages, registered
+    eng.release(a)
+    assert eng.free_pages == 8  # 6 fresh + 2 cached
+    b = eng.prefill([11, 12, 13, 14] * 7)  # 7 pages: reclaims the oldest cached
+    assert eng.free_pages == 1  # the one surviving cached page
+    # reclaim happened oldest-first: chunk 0 of the released prompt is gone,
+    # so re-prefilling it cannot hit; it reclaims the last cached page
+    c = eng.prefill([1, 2, 3, 4])
+    assert c.reused_chunks == 0
+    assert eng.free_pages == 0
+    eng.release(b)
+    eng.release(c)
+
+
+def test_apc_never_writes_shared_pages():
+    """Decode/verify append must land in private pages: grow two sharers
+    past several page boundaries and check both still match the dense
+    reference (a write into a shared page would corrupt the sibling)."""
+    eng = InferenceEngine(PARAMS, CFG, make_pc())
+    a = eng.prefill(PROMPT)
+    b = eng.prefill(PROMPT)
+    out_a = eng.decode(a, 10)
+    out_b = eng.decode(b, 10)
+    want = dense_greedy(PROMPT, 10)
+    assert out_a == want and out_b == want
+
+
+def test_apc_pressure_error_unpins_local_hits():
+    """A MemoryError mid-prefill must not leak refs on matched pages."""
+    pc = make_pc(n_blocks=4)
+    eng = InferenceEngine(PARAMS, CFG, pc)
+    a = eng.prefill([1, 2, 3, 4, 5, 6, 7, 8])  # 2 pages
+    with pytest.raises(MemoryError):
+        eng.prefill([1, 2, 3, 4, 5, 6, 7, 8] + list(range(100, 112)))  # needs 5
+    # the failed prefill pinned pages 0-1; ensure refs were returned:
+    eng.release(a)
+    assert eng.free_pages == 4  # everything reclaimable again
