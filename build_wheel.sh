@@ -22,12 +22,13 @@ echo "smoke-testing the wheel in a scratch prefix..."
 tmp=$(mktemp -d)
 trap 'rm -rf "$tmp"' EXIT
 python -m pip install --quiet --target "$tmp" dist/*.whl --no-deps
-# PYTHONSAFEPATH keeps cwd/'' off sys.path so this provably imports the
-# INSTALLED wheel, not the repo source tree we are standing in (-I would
-# also discard the PYTHONPATH pointing at the wheel)
-PYTHONPATH="$tmp" PYTHONSAFEPATH=1 python - <<'EOF'
+# run from the scratch prefix so cwd-relative import resolution (and any
+# Python >= 3.10) provably picks the INSTALLED wheel, never the repo tree
+( cd "$tmp" && ISTPU_WHEEL_DIR="$tmp" python - <<'EOF'
+import os
 import infinistore_tpu as ist
 from infinistore_tpu import _native
-assert ist.__file__.startswith(__import__("os").environ["PYTHONPATH"]), ist.__file__
+assert ist.__file__.startswith(os.environ["ISTPU_WHEEL_DIR"]), ist.__file__
 print("wheel import ok; native runtime available:", _native.available())
 EOF
+)

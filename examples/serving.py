@@ -20,6 +20,11 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."
 
 import jax
 
+# honor JAX_PLATFORMS even where a platform plugin pinned the backend at
+# interpreter start (same workaround as tests/conftest.py)
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
 import infinistore_tpu as ist
 from infinistore_tpu.engine import InferenceEngine, Scheduler
 from infinistore_tpu.kv import PagedCacheConfig
@@ -59,6 +64,11 @@ def main():
         ids[name] = sched.submit(p, 32)
     ids["sampled"] = sched.submit(
         prompts["a"], 32, sample="categorical", temperature=0.8, top_k=40)
+    # streamed request: tokens arrive at every decode-chunk boundary
+    streamed: list = []
+    ids["streamed"] = sched.submit(
+        prompts["b"], 16,
+        on_token=lambda toks, done: streamed.append((len(toks), done)))
 
     t0 = time.time()
     out = sched.run()
@@ -68,6 +78,7 @@ def main():
           f"({n_tok / dt:.1f} tok/s aggregate)")
     for name, rid in ids.items():
         print(f"  {name:8s} -> {out[rid][:8]}...")
+    print(f"  streamed deliveries (n_tokens, done): {streamed}")
 
     if conn is not None:
         eng2 = InferenceEngine(params, cfg, pc, conn=conn)

@@ -366,8 +366,24 @@ class InferenceEngine:
                     out[i] = st
                 return out  # type: ignore[return-value]
 
+            # Prompts with a locally-cached prefix — or sharing a prefix
+            # with an earlier prompt in this same wave — skip the grouped
+            # forward (which computes everything it is given) and run the
+            # per-sequence reuse path AFTER the groups, once the wave's own
+            # pages are registered.
             groups: Dict[int, List[int]] = {}
+            deferred: List[int] = []
+            wave_chunk0: set = set()
             for i, p in enumerate(prompts):
+                ks = chunk_keys(p, self.model_id, chunk_tokens=T)
+                cap = (len(p) - 1) // T
+                if self.pages.peek_prefix(ks[:cap]) > 0 or (
+                    cap > 0 and ks[0] in wave_chunk0
+                ):
+                    deferred.append(i)
+                    continue
+                if cap > 0:
+                    wave_chunk0.add(ks[0])
                 groups.setdefault(_round_up_pow2(len(p), T), []).append(i)
 
             for bucket, idxs in groups.items():
@@ -386,6 +402,11 @@ class InferenceEngine:
                     created.extend(states)
                 for i, st in zip(idxs, states):
                     out[i] = st
+
+            for i in deferred:  # now the wave's pages are registered
+                st = self.prefill(prompts[i])
+                created.append(st)
+                out[i] = st
         except MemoryError:
             for st in created:
                 self.release(st)

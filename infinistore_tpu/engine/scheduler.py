@@ -21,7 +21,7 @@ Flow per ``step()``:
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence
 
 import jax
 
@@ -37,10 +37,16 @@ class Request:
     sample: str = "greedy"
     temperature: float = 1.0
     top_k: int = 0
+    # streaming: called at every chunk boundary with the newly visible
+    # tokens (already eos/budget-trimmed), then once with ([], True) at
+    # retirement — the vLLM streaming-generator analog at chunk granularity
+    on_token: Optional[Callable[[List[int], bool], None]] = None
     # filled by the scheduler
     state: Optional[SequenceState] = None
     output: List[int] = field(default_factory=list)
     done: bool = False
+    cancelled: bool = False
+    _sent: int = 0
 
 
 class Scheduler:
@@ -65,15 +71,53 @@ class Scheduler:
         sample: str = "greedy",
         temperature: float = 1.0,
         top_k: int = 0,
+        on_token: Optional[Callable[[List[int], bool], None]] = None,
     ) -> int:
         req = Request(
             req_id=self._next_id, tokens=list(tokens),
             max_new_tokens=max_new_tokens, eos_id=eos_id,
             sample=sample, temperature=temperature, top_k=top_k,
+            on_token=on_token,
         )
         self._next_id += 1
         self.pending.append(req)
         return req.req_id
+
+    def cancel(self, req_id: int) -> bool:
+        """Abort a request.  Pending: removed immediately.  Active: retired
+        at the next chunk boundary (pages freed, partial output kept).
+        Returns False for ids that are unknown or already finished."""
+        for i, req in enumerate(self.pending):
+            if req.req_id == req_id:
+                req.cancelled = req.done = True
+                self.pending.pop(i)
+                if req.on_token is not None:
+                    req.on_token([], True)
+                return True
+        for req in self.active:
+            if req.req_id == req_id and not req.cancelled:
+                req.cancelled = True
+                return True
+        return False
+
+    @staticmethod
+    def _visible_len(req: Request) -> int:
+        """Tokens of ``req.output`` that will survive retirement trimming
+        (stop at eos, cap at budget) — the streaming horizon."""
+        out = req.output
+        if req.eos_id is not None and req.eos_id in out:
+            return min(out.index(req.eos_id) + 1, req.max_new_tokens)
+        return min(len(out), req.max_new_tokens)
+
+    def _stream(self, req: Request, done: bool) -> None:
+        if req.on_token is None:
+            return
+        vis = self._visible_len(req)
+        if vis > req._sent:
+            req.on_token(req.output[req._sent:vis], False)
+            req._sent = vis
+        if done:
+            req.on_token([], True)
 
     @property
     def has_work(self) -> bool:
@@ -142,14 +186,14 @@ class Scheduler:
         for req in self.active:
             out = req.output
             hit_eos = req.eos_id is not None and req.eos_id in out
-            cut = out.index(req.eos_id) + 1 if hit_eos else len(out)
-            cut = min(cut, req.max_new_tokens)
-            if hit_eos or len(out) >= req.max_new_tokens:
-                del out[cut:]
+            if req.cancelled or hit_eos or len(out) >= req.max_new_tokens:
+                del out[self._visible_len(req):]
                 req.done = True
+                self._stream(req, done=True)
                 self.engine.release(req.state)
                 done_now.append(req)
             else:
+                self._stream(req, done=False)
                 still.append(req)
         self.active = still
         if done_now:
@@ -163,6 +207,9 @@ class Scheduler:
             self._admit()
         if not self.active:
             return []
+        if any(r.cancelled for r in self.active):
+            # retire cancellations before burning a decode chunk on them
+            return self._retire()
         head = self.active[0]
         # chunk lengths are powers of two capped at decode_chunk, so the jit
         # cache holds at most log2(decode_chunk)+1 scan lengths per batch
@@ -201,7 +248,9 @@ class Scheduler:
         req_id -> generated tokens.  (``step()`` hands each finished request
         back exactly once and the scheduler keeps no reference — a
         long-running server that drives ``step()`` itself owns the results
-        and the scheduler's memory stays bounded by the active batch.)"""
+        and the scheduler's memory stays bounded by the active batch.)
+        Requests cancelled while active appear with their partial output;
+        requests cancelled while pending never appear."""
         results: Dict[int, List[int]] = {}
         while self.has_work:
             for req in self.step():

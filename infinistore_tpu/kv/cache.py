@@ -202,6 +202,17 @@ class PrefixPageCache:
             self._refs[bid] = 1
         return ids
 
+    def peek_prefix(self, keys: Sequence[str]) -> int:
+        """Length of the resident prefix run WITHOUT pinning — the routing
+        probe for batched admission (engine.prefill_batch sends hits down
+        the per-sequence reuse path)."""
+        n = 0
+        for k in keys:
+            if k not in self._key_to_block:
+                break
+            n += 1
+        return n
+
     def match_prefix(self, keys: Sequence[str]) -> List[int]:
         """Longest resident run of ``keys``; pins every hit (+1 ref)."""
         ids: List[int] = []

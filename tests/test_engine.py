@@ -450,3 +450,116 @@ def test_apc_pressure_error_unpins_local_hits():
     # the failed prefill pinned pages 0-1; ensure refs were returned:
     eng.release(a)
     assert eng.free_pages == 4  # everything reclaimable again
+
+
+# ---- streaming and cancellation ----
+
+def test_scheduler_streaming_matches_final():
+    """Chunk-boundary streaming must deliver exactly the final output, in
+    order, and exactly one terminal ([], True) signal."""
+    from infinistore_tpu.engine import Scheduler
+
+    eng = InferenceEngine(PARAMS, CFG, make_pc())
+    eng.decode_chunk = 4
+    sched = Scheduler(eng, max_batch=2)
+    got: dict = {}
+
+    def cb_for(rid):
+        got[rid] = {"toks": [], "done": 0}
+
+        def cb(toks, done):
+            if done:
+                got[rid]["done"] += 1
+            else:
+                assert toks, "empty non-terminal stream delivery"
+                got[rid]["toks"].extend(toks)
+        return cb
+
+    r1 = sched.submit(PROMPT, 9)
+    sched.pending[-1].on_token = cb_for(r1)
+    r2 = sched.submit(PROMPT[:5], 6)
+    sched.pending[-1].on_token = cb_for(r2)
+    res = sched.run()
+    assert got[r1]["toks"] == res[r1] == dense_greedy(PROMPT, 9)
+    assert got[r2]["toks"] == res[r2] == dense_greedy(PROMPT[:5], 6)
+    assert got[r1]["done"] == got[r2]["done"] == 1
+
+
+def test_scheduler_streaming_stops_at_eos():
+    from infinistore_tpu.engine import Scheduler
+
+    eng = InferenceEngine(PARAMS, CFG, make_pc())
+    eng.decode_chunk = 4
+    full = dense_greedy(PROMPT, 12)
+    eos = full[2]  # force an early eos
+    sched = Scheduler(eng, max_batch=1)
+    seen: list = []
+    rid = sched.submit(PROMPT, 12, eos_id=eos)
+    sched.pending[-1].on_token = lambda t, d: seen.extend(t)
+    res = sched.run()
+    assert res[rid] == full[: full.index(eos) + 1]
+    assert seen == res[rid]  # nothing streamed past eos
+
+
+def test_scheduler_cancel_pending_and_active():
+    from infinistore_tpu.engine import Scheduler
+
+    eng = InferenceEngine(PARAMS, CFG, make_pc())
+    eng.decode_chunk = 2
+    sched = Scheduler(eng, max_batch=1)  # b waits in pending while a runs
+    a = sched.submit(PROMPT, 8)
+    b = sched.submit(PROMPT[:5], 8)
+    assert sched.cancel(b) is True  # pending: removed outright
+    assert sched.cancel(999) is False
+
+    # run a for one chunk, then cancel it mid-flight
+    done = sched.step()
+    assert not done and len(sched.active) == 1
+    assert sched.cancel(a) is True
+    done = sched.step()
+    assert [r.req_id for r in done] == [a]
+    assert done[0].output == dense_greedy(PROMPT, 2)  # partial kept
+    assert not sched.has_work
+    assert eng.free_pages == eng.pc.n_blocks  # everything released
+
+
+def test_scheduler_cancel_leaves_batchmates_correct():
+    from infinistore_tpu.engine import Scheduler
+
+    eng = InferenceEngine(PARAMS, CFG, make_pc())
+    eng.decode_chunk = 2
+    sched = Scheduler(eng, max_batch=2)
+    a = sched.submit(PROMPT, 8)
+    b = sched.submit(PROMPT[:5], 8)
+    sched.step()
+    sched.cancel(a)
+    res = {}
+    while sched.has_work:
+        for r in sched.step():
+            res[r.req_id] = r.output
+    assert res[b] == dense_greedy(PROMPT[:5], 8)  # unaffected by the cancel
+    assert len(res[a]) == 2
+
+
+def test_apc_batched_admission_dedups():
+    """prefill_batch must reuse resident pages (per-sequence path) instead
+    of recomputing in the grouped forward — including identical prompts
+    inside one admission wave."""
+    eng = InferenceEngine(PARAMS, CFG, make_pc())
+    warm = eng.prefill(PROMPT)  # registers PROMPT's 2 complete chunks
+    free0 = eng.free_pages
+    states = eng.prefill_batch([PROMPT, list(PROMPT)])  # same-wave duplicates
+    for st in states:
+        assert st.reused_chunks == len(PROMPT) // T
+        assert st.block_ids[:2] == warm.block_ids[:2]
+    assert free0 - eng.free_pages == 2  # one private tail page each
+    got = [eng.decode(st, 5) for st in states]
+    assert got == [dense_greedy(PROMPT, 5)] * 2
+
+    # cold same-wave duplicates (nothing resident beforehand): the first
+    # computes+registers via the deferral rule, the second hits it
+    eng2 = InferenceEngine(PARAMS, CFG, make_pc())
+    p = [5, 6, 7, 8, 9, 10, 11, 12, 13]
+    sts = eng2.prefill_batch([p, list(p)])
+    assert sts[1].block_ids[:2] == sts[0].block_ids[:2]
+    assert [eng2.decode(s, 4) for s in sts] == [dense_greedy(p, 4)] * 2
