@@ -68,20 +68,23 @@ def start_server():
 def bench_conn(conn_type: str, port: int, rounds: int, tag: str,
                force_python: bool = False):
     cfg = ClientConfig(host_addr="127.0.0.1", service_port=port,
-                       connection_type=conn_type, log_level="warning")
+                       connection_type=conn_type, log_level="warning",
+                       # the baseline proxy is the reference's single TCP
+                       # stream; the measured path uses the striped default
+                       num_streams=1 if force_python else 4)
     if force_python:
         # the baseline leg is a stable proxy for the reference's single-stream
         # loopback TCP (BASELINE.md); pin it to the Python client so it does
         # not drift with native-client optimizations
-        from infinistore_tpu.lib import Connection
-
-        conn = InfinityConnection.__new__(InfinityConnection)
-        conn.config = cfg
-        conn.conn = Connection(cfg)
-        conn.rdma_connected = False
-        import asyncio
-
-        conn.semaphore = asyncio.BoundedSemaphore(128)
+        prev = os.environ.get("ISTPU_CLIENT")
+        os.environ["ISTPU_CLIENT"] = "python"
+        try:
+            conn = InfinityConnection(cfg)
+        finally:
+            if prev is None:
+                os.environ.pop("ISTPU_CLIENT", None)
+            else:
+                os.environ["ISTPU_CLIENT"] = prev
     else:
         conn = InfinityConnection(cfg)
     conn.connect()

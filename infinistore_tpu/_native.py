@@ -236,7 +236,23 @@ class NativeConnection:
         Exc, KeyNotFound = self._errors()
         if status == P.KEY_NOT_FOUND:
             raise KeyNotFound(f"{what} failed, ret = {status}")
+        if status == P.SYSTEM_ERROR:
+            # only the C client produces this status, and only for a dead
+            # socket/channel — the class lib.py's auto-reconnect retries
+            from .lib import InfiniStoreConnectionError
+
+            raise InfiniStoreConnectionError(f"{what} failed, ret = {status}")
         raise Exc(f"{what} failed, ret = {status}")
+
+    def _handle(self):
+        # a closed/never-connected handle must surface as a transport error
+        # (retryable by lib.py's auto-reconnect), never as a NULL pointer
+        # handed to the C runtime
+        if self._h is None:
+            from .lib import InfiniStoreConnectionError
+
+            raise InfiniStoreConnectionError("not connected")
+        return self._h
 
     def connect(self) -> None:
         from .config import TYPE_SHM
@@ -269,7 +285,7 @@ class NativeConnection:
         keys = _keys_array([k.encode() if isinstance(k, str) else bytes(k) for k, _ in blocks])
         offs = _offsets_array([off for _, off in blocks])
         st = self._lib.istpu_client_write_cache(
-            self._h, keys, offs, len(blocks), block_size, ctypes.c_void_p(ptr)
+            self._handle(), keys, offs, len(blocks), block_size, ctypes.c_void_p(ptr)
         )
         self._check(st, "write_cache")
         return P.FINISH
@@ -279,7 +295,7 @@ class NativeConnection:
         keys = _keys_array([k.encode() if isinstance(k, str) else bytes(k) for k, _ in blocks])
         offs = _offsets_array([off for _, off in blocks])
         st = self._lib.istpu_client_read_cache(
-            self._h, keys, offs, len(blocks), block_size, ctypes.c_void_p(ptr)
+            self._handle(), keys, offs, len(blocks), block_size, ctypes.c_void_p(ptr)
         )
         self._check(st, "read_cache")
         return P.FINISH
@@ -288,13 +304,13 @@ class NativeConnection:
 
     def w_tcp(self, key: str, ptr: int, size: int) -> int:
         st = self._lib.istpu_client_put_inline(
-            self._h, key.encode(), ctypes.c_void_p(ptr), size
+            self._handle(), key.encode(), ctypes.c_void_p(ptr), size
         )
         self._check(st, "tcp write")
         return 0
 
     def w_tcp_bytes(self, key: str, data: bytes) -> int:
-        st = self._lib.istpu_client_put_inline(self._h, key.encode(), data, len(data))
+        st = self._lib.istpu_client_put_inline(self._handle(), key.encode(), data, len(data))
         self._check(st, "tcp write")
         return 0
 
@@ -305,7 +321,7 @@ class NativeConnection:
             buf = np.empty(cap, dtype=np.uint8)
             out_size = ctypes.c_uint64(0)
             st = self._lib.istpu_client_get_inline(
-                self._h, key.encode(), ctypes.c_void_p(buf.ctypes.data), cap,
+                self._handle(), key.encode(), ctypes.c_void_p(buf.ctypes.data), cap,
                 ctypes.byref(out_size),
             )
             if st == P.INVALID_REQ and out_size.value > cap:
@@ -319,7 +335,7 @@ class NativeConnection:
 
     def check_exist(self, key: str) -> int:
         out = ctypes.c_int(1)
-        st = self._lib.istpu_client_exist(self._h, key.encode(), ctypes.byref(out))
+        st = self._lib.istpu_client_exist(self._handle(), key.encode(), ctypes.byref(out))
         self._check(st, "check_exist")
         return int(out.value)
 
@@ -327,7 +343,7 @@ class NativeConnection:
         arr = _keys_array([k.encode() if isinstance(k, str) else bytes(k) for k in keys])
         out = ctypes.c_int(-1)
         st = self._lib.istpu_client_match_last_index(
-            self._h, arr, len(keys), ctypes.byref(out)
+            self._handle(), arr, len(keys), ctypes.byref(out)
         )
         self._check(st, "get_match_last_index")
         return int(out.value)
@@ -335,24 +351,24 @@ class NativeConnection:
     def delete_keys(self, keys: Sequence[str]) -> int:
         arr = _keys_array([k.encode() if isinstance(k, str) else bytes(k) for k in keys])
         out = ctypes.c_int(0)
-        st = self._lib.istpu_client_delete_keys(self._h, arr, len(keys), ctypes.byref(out))
+        st = self._lib.istpu_client_delete_keys(self._handle(), arr, len(keys), ctypes.byref(out))
         self._check(st, "delete_keys")
         return int(out.value)
 
     def purge(self) -> int:
         out = ctypes.c_int(0)
-        st = self._lib.istpu_client_purge(self._h, ctypes.byref(out))
+        st = self._lib.istpu_client_purge(self._handle(), ctypes.byref(out))
         self._check(st, "purge")
         return int(out.value)
 
     def stats(self) -> dict:
         buf = ctypes.create_string_buffer(4096)
-        st = self._lib.istpu_client_stats_json(self._h, buf, len(buf))
+        st = self._lib.istpu_client_stats_json(self._handle(), buf, len(buf))
         self._check(st, "stats")
         return json.loads(buf.value.decode() or "{}")
 
     def evict(self, min_threshold: float, max_threshold: float) -> None:
-        st = self._lib.istpu_client_evict(self._h, min_threshold, max_threshold)
+        st = self._lib.istpu_client_evict(self._handle(), min_threshold, max_threshold)
         self._check(st, "evict")
 
     def register_mr(self, ptr: int, size: int) -> int:

@@ -43,6 +43,10 @@ class ClientConfig:
         # their blocks across the streams (the role RDMA's multi-WR chains
         # play in the reference); metadata ops ride stream 0.
         self.num_streams = kwargs.get("num_streams", 4)
+        # ours: on a transport-level failure, tear the connection down,
+        # re-establish it (remapping pools / re-registering MRs) and retry
+        # the op once — the client side of SURVEY §5's failure handling
+        self.auto_reconnect = kwargs.get("auto_reconnect", True)
 
     def __repr__(self):
         return (

@@ -91,8 +91,7 @@ class Scheduler:
             if req.req_id == req_id:
                 req.cancelled = req.done = True
                 self.pending.pop(i)
-                if req.on_token is not None:
-                    req.on_token([], True)
+                self._stream(req, done=True)
                 return True
         for req in self.active:
             if req.req_id == req_id and not req.cancelled:
@@ -110,14 +109,27 @@ class Scheduler:
         return min(len(out), req.max_new_tokens)
 
     def _stream(self, req: Request, done: bool) -> None:
+        """Deliver newly visible tokens.  A raising callback must never
+        corrupt the scheduler (leak pages, leave a done request active), so
+        it is disarmed after the first failure and the request continues as
+        a non-streaming one."""
         if req.on_token is None:
             return
-        vis = self._visible_len(req)
-        if vis > req._sent:
-            req.on_token(req.output[req._sent:vis], False)
-            req._sent = vis
-        if done:
-            req.on_token([], True)
+        try:
+            vis = self._visible_len(req)
+            if vis > req._sent:
+                req.on_token(req.output[req._sent:vis], False)
+                req._sent = vis
+            if done:
+                req.on_token([], True)
+        except Exception as e:  # noqa: BLE001 — user callback, not our state
+            req.on_token = None
+            import logging
+
+            logging.getLogger("infinistore_tpu").warning(
+                "on_token callback for request %d raised %r; streaming "
+                "disabled for this request", req.req_id, e,
+            )
 
     @property
     def has_work(self) -> bool:

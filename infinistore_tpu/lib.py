@@ -72,8 +72,16 @@ class InfiniStoreKeyNotFound(InfiniStoreException):
     pass
 
 
+class InfiniStoreConnectionError(InfiniStoreException):
+    """The transport itself failed (socket died, channel torn down, server
+    unreachable) — the only class of error worth a reconnect."""
+
+
 _STATUS_EXC = {
     P.KEY_NOT_FOUND: InfiniStoreKeyNotFound,
+    # the server never answers SYSTEM_ERROR over the wire; this status
+    # surfaces client-side when a channel is dead
+    P.SYSTEM_ERROR: InfiniStoreConnectionError,
 }
 
 
@@ -173,7 +181,7 @@ class _Channel:
         slot = _Slot(consumer)
         with self._send_lock:
             if self._err is not None:
-                raise InfiniStoreException(f"connection dead: {self._err!r}")
+                raise InfiniStoreConnectionError(f"connection dead: {self._err!r}")
             with self._pending_lock:
                 self._pending.append(slot)
             # sendall per buffer: sendmsg can partially send under
@@ -183,7 +191,7 @@ class _Channel:
                 self.sock.sendall(view)
         slot.ev.wait()
         if slot.error is not None:
-            raise InfiniStoreException(f"request failed: {slot.error!r}")
+            raise InfiniStoreConnectionError(f"request failed: {slot.error!r}")
         return slot.status, slot.result
 
     def _read_loop(self) -> None:
@@ -218,7 +226,7 @@ class _Channel:
         while got < size:
             n = self.sock.recv_into(view[got:], size - got)
             if n == 0:
-                raise InfiniStoreException("connection closed by server")
+                raise InfiniStoreConnectionError("connection closed by server")
             got += n
 
     def close(self) -> None:
@@ -535,6 +543,11 @@ class InfinityConnection:
         self.config = config
         self.rdma_connected = False  # parity name: true when zero-copy path is up
         self.semaphore = asyncio.BoundedSemaphore(128)
+        self._connected = False
+        self._mrs: list = []  # (ptr, size) replayed on reconnect
+        self._gen = 0  # bumps on every successful reconnect
+        self._needs_reconnect = False  # a reconnect attempt failed; retry next op
+        self._reconnect_lock = threading.Lock()
         Logger.set_log_level(config.log_level)
 
     @staticmethod
@@ -552,12 +565,74 @@ class InfinityConnection:
             raise InfiniStoreException(f"Failed to resolve hostname '{hostname}': {e}")
 
     def connect(self) -> None:
-        if self.rdma_connected:
+        if self._connected:
             raise InfiniStoreException("Already connected to remote instance")
         self.config.host_addr = self.resolve_hostname(self.config.host_addr)
         self.conn.connect()
+        self._connected = True
         if self.config.connection_type == TYPE_SHM:
             self.rdma_connected = True
+
+    def reconnect(self) -> None:
+        """Tear down and re-establish the transport: fresh sockets, freshly
+        mapped pools (a restarted server publishes new shm segments), and
+        every registered MR replayed.  Reference analog: the client-side
+        retry half of SURVEY §5 failure handling."""
+        with self._reconnect_lock:
+            self._reconnect_locked()
+
+    def _reconnect_locked(self) -> None:
+        # Build the replacement connection FULLY before swapping it in: a
+        # failed attempt (server still down) must leave self.conn a dead-but-
+        # recognizable transport whose ops keep raising connection errors, so
+        # a later op can retry the reconnect once the server is back.
+        self._needs_reconnect = True
+        try:
+            self.conn.close()
+        except Exception:
+            pass
+        # rebuild the SAME implementation chosen at construction time —
+        # re-reading ISTPU_CLIENT here could silently swap python<->native
+        # mid-session (e.g. under a scoped env pin)
+        conn = type(self.conn)(self.config)
+        conn.connect()
+        for ptr, size in self._mrs:
+            conn.register_mr(ptr, size)
+        self.conn = conn
+        self._gen += 1
+        self._needs_reconnect = False
+        self._connected = True
+        if self.config.connection_type == TYPE_SHM:
+            self.rdma_connected = True
+
+    def _try_reconnect(self, gen: int, why) -> None:
+        with self._reconnect_lock:
+            if not self._connected:
+                # close() won the race — a closed connection must not revive
+                raise InfiniStoreConnectionError("connection closed")
+            if self._gen == gen or self._needs_reconnect:
+                # first thread in does the work; losers ride the fresh conn
+                Logger.warn(f"transport failure ({why}); reconnecting")
+                self._reconnect_locked()
+
+    def _call(self, name: str, *args):
+        """Run a connection op; on a TRANSPORT failure (socket/channel dead
+        — never a server-answered status like OOM or KEY_NOT_FOUND),
+        reconnect once and retry.  Threads coordinate via a generation
+        counter: whoever loses the race rides the winner's fresh
+        connection."""
+        if self._needs_reconnect and self.config.auto_reconnect and self._connected:
+            # an earlier reconnect attempt failed mid-outage; try again
+            # before the op instead of poking the known-dead transport
+            self._try_reconnect(self._gen, "previous reconnect failed")
+        gen = self._gen
+        try:
+            return getattr(self.conn, name)(*args)
+        except (OSError, InfiniStoreConnectionError) as e:
+            if not (self.config.auto_reconnect and self._connected):
+                raise
+            self._try_reconnect(gen, e)
+            return getattr(self.conn, name)(*args)
 
     async def connect_async(self) -> None:
         loop = asyncio.get_running_loop()
@@ -568,8 +643,12 @@ class InfinityConnection:
         if pool is not None:
             pool.shutdown(wait=False)
             self._async_pool = None
-        self.conn.close()
-        self.rdma_connected = False
+        # under the reconnect lock so an in-flight op's failure handler
+        # cannot revive the transport we are tearing down
+        with self._reconnect_lock:
+            self.conn.close()
+            self.rdma_connected = False
+            self._connected = False  # a closed connection must not auto-revive
 
     def latency_stats(self) -> dict:
         """Client-side per-op latency counters (count/avg/max ms); empty for
@@ -580,10 +659,13 @@ class InfinityConnection:
     # -- zero-copy batched API --
 
     def write_cache(self, blocks: Sequence[Tuple[str, int]], block_size: int, ptr: int) -> int:
-        return self.conn.write_cache(blocks, block_size, ptr)
+        # safe to retry across a reconnect: committed keys may be
+        # overwritten (reference semantics) and a server that died
+        # mid-write aborted the pending entries on disconnect
+        return self._call("write_cache", blocks, block_size, ptr)
 
     def read_cache(self, blocks: Sequence[Tuple[str, int]], block_size: int, ptr: int) -> int:
-        return self.conn.read_cache(blocks, block_size, ptr)
+        return self._call("read_cache", blocks, block_size, ptr)
 
     def _io_pool(self):
         # One shared bounded executor per connection: asyncio's loop-default
@@ -608,7 +690,7 @@ class InfinityConnection:
         async with self.semaphore:
             loop = asyncio.get_running_loop()
             return await loop.run_in_executor(
-                self._io_pool(), self.conn.write_cache, blocks, block_size, ptr
+                self._io_pool(), self.write_cache, blocks, block_size, ptr
             )
 
     async def read_cache_async(
@@ -617,7 +699,7 @@ class InfinityConnection:
         async with self.semaphore:
             loop = asyncio.get_running_loop()
             return await loop.run_in_executor(
-                self._io_pool(), self.conn.read_cache, blocks, block_size, ptr
+                self._io_pool(), self.read_cache, blocks, block_size, ptr
             )
 
     # drop-in aliases for reference callers
@@ -639,24 +721,24 @@ class InfinityConnection:
             raise InfiniStoreException("size is 0")
         if ptr == 0:
             raise InfiniStoreException("ptr is 0")
-        self.conn.w_tcp(key, ptr, size)
+        self._call("w_tcp", key, ptr, size)
 
     def tcp_read_cache(self, key: str, **kwargs) -> np.ndarray:
-        return self.conn.r_tcp(key)
+        return self._call("r_tcp", key)
 
     # -- metadata --
 
     def check_exist(self, key: str) -> bool:
-        return self.conn.check_exist(key) == 0
+        return self._call("check_exist", key) == 0
 
     def get_match_last_index(self, keys: Sequence[str]) -> int:
-        ret = self.conn.get_match_last_index(keys)
+        ret = self._call("get_match_last_index", keys)
         if ret < 0:
             raise InfiniStoreException("can't find a match")
         return ret
 
     def delete_keys(self, keys: Sequence[str]) -> int:
-        ret = self.conn.delete_keys(keys)
+        ret = self._call("delete_keys", keys)
         if ret < 0:
             raise InfiniStoreException(
                 "somethings are wrong, not all the specified keys were deleted"
@@ -671,9 +753,17 @@ class InfinityConnection:
                 )
             if size is None:
                 raise InfiniStoreException("size is required")
-            return self.conn.register_mr(int(arg), size)
+            return self._register_mr(int(arg), size)
         if isinstance(arg, np.ndarray):
-            return self.conn.register_mr(
-                arg.ctypes.data, arg.size * arg.itemsize
-            )
+            return self._register_mr(arg.ctypes.data, arg.size * arg.itemsize)
         raise NotImplementedError(f"not supported: {type(arg)}")
+
+    def _register_mr(self, ptr: int, size: int) -> int:
+        # under the reconnect lock: a registration racing a reconnect must
+        # land on the connection that survives, and the replay list must not
+        # collect duplicates from re-registration loops
+        with self._reconnect_lock:
+            ret = self.conn.register_mr(ptr, size)
+            if (ptr, size) not in self._mrs:
+                self._mrs.append((ptr, size))
+            return ret

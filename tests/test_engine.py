@@ -563,3 +563,24 @@ def test_apc_batched_admission_dedups():
     sts = eng2.prefill_batch([p, list(p)])
     assert sts[1].block_ids[:2] == sts[0].block_ids[:2]
     assert [eng2.decode(s, 4) for s in sts] == [dense_greedy(p, 4)] * 2
+
+
+def test_scheduler_survives_raising_callback():
+    """A user on_token callback that raises must not leak pages or corrupt
+    the batch — streaming is disarmed, the request still completes."""
+    from infinistore_tpu.engine import Scheduler
+
+    eng = InferenceEngine(PARAMS, CFG, make_pc())
+    eng.decode_chunk = 4
+    sched = Scheduler(eng, max_batch=2)
+    a = sched.submit(PROMPT, 8)
+
+    def bomb(toks, done):
+        raise RuntimeError("client went away")
+
+    sched.pending[-1].on_token = bomb
+    b = sched.submit(PROMPT[:5], 8)
+    res = sched.run()
+    assert res[a] == dense_greedy(PROMPT, 8)
+    assert res[b] == dense_greedy(PROMPT[:5], 8)
+    assert eng.free_pages == eng.pc.n_blocks

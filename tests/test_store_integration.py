@@ -791,3 +791,73 @@ def test_pipelining_hides_rtt(server):
         assert t_async < t_sync / 2, (t_sync, t_async)
     finally:
         proxy.close()
+
+
+@pytest.mark.parametrize("client_mode", ["python", "native"])
+def test_client_reconnects_after_server_restart(client_mode, monkeypatch):
+    """A transport failure mid-session must be survivable: the client tears
+    down, reconnects (remapping the restarted server's fresh shm pools,
+    replaying MR registrations) and retries the op once — SURVEY §5 failure
+    handling, client half."""
+    if client_mode == "native":
+        from infinistore_tpu import _native
+
+        if not _native.available():
+            pytest.skip("native client library not built")
+    monkeypatch.setenv("ISTPU_CLIENT", client_mode)
+    port, mport = _free_port(), _free_port()
+
+    def boot():
+        p = subprocess.Popen(
+            [sys.executable, "-m", "infinistore_tpu.server",
+             "--service-port", str(port), "--manage-port", str(mport),
+             "--prealloc-size", "1", "--minimal-allocate-size", "16",
+             "--log-level", "warning", "--backend", "python"],
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            try:
+                socket.create_connection(("127.0.0.1", port), timeout=0.5).close()
+                return p
+            except OSError:
+                time.sleep(0.1)
+        p.kill()
+        raise RuntimeError("server did not start")
+
+    srv = boot()
+    try:
+        conn = ist.InfinityConnection(ist.ClientConfig(
+            host_addr="127.0.0.1", service_port=port,
+            connection_type=ist.TYPE_SHM))
+        conn.connect()
+        src = np.arange(1024, dtype=np.float32)
+        dst = np.zeros_like(src)
+        conn.register_mr(src)
+        conn.register_mr(dst)
+        conn.write_cache([("rc-key", 0)], 4096, src.ctypes.data)
+
+        # hard-kill the server (no graceful teardown), then restart it
+        srv.kill()
+        srv.wait(timeout=10)
+
+        # an op during the outage fails (the reconnect attempt also finds
+        # the server down) — but must NOT brick the client: once the server
+        # is back, the next op retries the reconnect and succeeds
+        with pytest.raises(Exception):
+            conn.write_cache([("rc-dead", 0)], 4096, src.ctypes.data)
+        srv = boot()
+
+        # the same client object must transparently recover; the restarted
+        # store is empty, so the write lands fresh and reads back intact
+        conn.write_cache([("rc-key2", 0)], 4096, src.ctypes.data)
+        conn.read_cache([("rc-key2", 0)], 4096, dst.ctypes.data)
+        np.testing.assert_array_equal(src, dst)
+        assert conn.check_exist("rc-key2")
+        conn.close()
+    finally:
+        srv.send_signal(signal.SIGINT)
+        try:
+            srv.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            srv.kill()
