@@ -110,15 +110,13 @@ class InferenceEngine:
         self.model_id = model_id
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
-            from ..parallel.sharding import llama_inference_specs, shard_params
+            from ..parallel.sharding import shard_params
 
             tp = mesh.shape["tp"]
             assert pc.n_kv_heads % tp == 0, (
                 f"n_kv_heads={pc.n_kv_heads} must divide over tp={tp}"
             )
-            self.params = shard_params(
-                params, mesh, param_specs or llama_inference_specs()
-            )
+            self.params = shard_params(params, mesh, param_specs)
             # cache [L, 2, H_kv, n_blocks, T, D]: KV-head axis over tp,
             # matching the head-sharded wk/wv so decode stays head-local
             self.cache = jax.device_put(

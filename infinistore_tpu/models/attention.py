@@ -88,6 +88,7 @@ def causal_attention(
     allow_pallas: bool = False,
     prefix_pad: int | None = None,
     prefix_len: jax.Array | None = None,
+    window: int | None = None,
 ) -> jax.Array:
     """Causal SDPA.  q: [B, Sq, H, D]; k/v: [B, Sk, H_kv, D].
 
@@ -106,12 +107,17 @@ def causal_attention(
     must stay False under a GSPMD-partitioned jit (same rule as
     ``paged_decode_attention`` below) — which is why the sharded callers in
     parallel/ use the default.  ``ISTPU_NO_PALLAS=1`` forces the XLA path.
+
+    ``window``: sliding-window attention (Mistral) — a key is visible iff
+    ``q_pos - window < k_pos <= q_pos`` (HF convention).  Forces the XLA
+    path: the flash kernels carry no window mask.
     """
     import os
 
     B, Sq, H, D = q.shape
     if (
         allow_pallas
+        and window is None
         and D % 128 == 0
         and jax.default_backend() == "tpu"
         and not os.environ.get("ISTPU_NO_PALLAS")
@@ -144,9 +150,18 @@ def causal_attention(
             k_pos[None, :] - prefix_pad <= i
         )
         mask = in_prefix | in_self  # [Sq, Sk]
+        if window is not None:
+            # absolute positions: prefix row j sits at j; self row at
+            # prefix_len + (row - prefix_pad); query i at prefix_len + i
+            k_abs = jnp.where(
+                k_pos < prefix_pad, k_pos, prefix_len + k_pos - prefix_pad
+            )
+            mask &= k_abs[None, :] > prefix_len + i - window
     else:
         q_pos = jnp.arange(Sq) + q_offset
         mask = k_pos[None, :] <= q_pos[:, None]  # [Sq, Sk]
+        if window is not None:
+            mask &= k_pos[None, :] > q_pos[:, None] - window
     logits = jnp.where(mask[None, None], logits, -jnp.inf)
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
@@ -158,6 +173,7 @@ def paged_decode_attention_xla(
     layer_cache: jax.Array,
     block_table: jax.Array,
     seq_lens: jax.Array,
+    window: int | None = None,
 ) -> jax.Array:
     """One-token decode attention against the paged cache (XLA gather path).
 
@@ -180,6 +196,9 @@ def paged_decode_attention_xla(
     logits = jnp.einsum("bhd,bkhd->bhk", q, k).astype(jnp.float32) * scale
     pos = jnp.arange(max_pages * T)
     mask = pos[None, :] < seq_lens[:, None]  # [B, S_max]
+    if window is not None:
+        # current token sits at seq_lens-1; window covers (q - W, q]
+        mask &= pos[None, :] >= seq_lens[:, None] - window
     logits = jnp.where(mask[:, None, :], logits, -jnp.inf)
     probs = jax.nn.softmax(logits, axis=-1)
     return jnp.einsum("bhk,bkhd->bhd", probs.astype(v.dtype), v)
@@ -190,6 +209,7 @@ def paged_multitoken_attention_xla(
     layer_cache: jax.Array,
     block_table: jax.Array,
     positions: jax.Array,
+    window: int | None = None,
 ) -> jax.Array:
     """Attention for a short run of new tokens against the paged cache
     (the speculative-decode verify step: S proposal tokens attend to the
@@ -215,6 +235,8 @@ def paged_multitoken_attention_xla(
     logits = jnp.einsum("bshd,bkhd->bhsk", q, k).astype(jnp.float32) * scale
     k_pos = jnp.arange(max_pages * T)
     mask = k_pos[None, None, :] <= positions[:, :, None]  # [B, S, S_max]
+    if window is not None:
+        mask &= k_pos[None, None, :] > positions[:, :, None] - window
     logits = jnp.where(mask[:, None], logits, -jnp.inf)
     probs = jax.nn.softmax(logits, axis=-1)
     return jnp.einsum("bhsk,bkhd->bshd", probs.astype(v.dtype), v)
@@ -279,6 +301,7 @@ def paged_decode_attention(
     seq_lens: jax.Array,
     allow_pallas: bool = True,
     tp_mesh=None,
+    window: int | None = None,
 ) -> jax.Array:
     """Paged decode attention; Pallas kernel on TPU, XLA gather elsewhere.
 
@@ -298,6 +321,12 @@ def paged_decode_attention(
     """
     import os
 
+    if window is not None:
+        # the Pallas kernels carry no sliding-window mask; the XLA path
+        # partitions fine under GSPMD, so windowed models always take it
+        return paged_decode_attention_xla(
+            q, layer_cache, block_table, seq_lens, window=window
+        )
     if tp_mesh is not None:
         interp = bool(os.environ.get("ISTPU_PALLAS_INTERPRET"))
         on_tpu = (

@@ -1,8 +1,9 @@
-"""HuggingFace Llama checkpoint import.
+"""HuggingFace checkpoint import: Llama, Mistral, Qwen2/2.5, Qwen3.
 
 The reference rides vLLM, which loads HF checkpoints; a standalone framework
-needs its own loader.  ``params_from_hf`` maps a ``transformers``
-LlamaForCausalLM state dict onto our pytree (models/llama.py layout: stacked
+needs its own loader.  ``params_from_hf`` maps a ``transformers`` dense
+decoder state dict (LlamaForCausalLM, MistralForCausalLM, Qwen2ForCausalLM,
+Qwen3ForCausalLM) onto our pytree (models/llama.py layout: stacked
 per-layer leaves, ``x @ W`` orientation), converting two representation
 differences:
 
@@ -28,19 +29,31 @@ import numpy as np
 from .llama import LlamaConfig, Params
 
 
-def config_from_hf(hf_config: Any, dtype: Any = jnp.bfloat16) -> LlamaConfig:
-    """Map a ``transformers.LlamaConfig`` onto ours.
+# model_type -> (attn_bias default, qk_norm).  Qwen2/2.5 always bias QKV
+# (their HF config carries no attention_bias field); Qwen3 replaces the
+# biases with per-head Q/K RMSNorm.
+_FAMILIES = {
+    "llama": (False, False),
+    "mistral": (False, False),
+    "qwen2": (True, False),
+    "qwen3": (False, True),
+}
 
-    Raises on configurations this model family cannot represent (a custom
-    ``head_dim`` or an unknown ``rope_scaling`` type) rather than importing
-    weights that would silently produce wrong logits.
+
+def config_from_hf(hf_config: Any, dtype: Any = jnp.bfloat16) -> LlamaConfig:
+    """Map a ``transformers`` dense-decoder config (Llama / Mistral / Qwen2 /
+    Qwen3) onto ours.
+
+    Raises on configurations this architecture cannot represent (an unknown
+    ``model_type`` or ``rope_scaling`` type) rather than importing weights
+    that would silently produce wrong logits.
     """
+    family = getattr(hf_config, "model_type", "llama")
+    if family not in _FAMILIES:
+        raise ValueError(f"unsupported model_type {family!r}")
+    bias_default, qk_norm = _FAMILIES[family]
     derived_hd = hf_config.hidden_size // hf_config.num_attention_heads
     explicit_hd = getattr(hf_config, "head_dim", None)
-    if explicit_hd is not None and explicit_hd != derived_hd:
-        raise ValueError(
-            f"unsupported head_dim {explicit_hd} != hidden/heads {derived_hd}"
-        )
     rs = getattr(hf_config, "rope_scaling", None)
     scaling = None
     if rs:
@@ -54,6 +67,25 @@ def config_from_hf(hf_config: Any, dtype: Any = jnp.bfloat16) -> LlamaConfig:
             )
         elif rtype != "default":
             raise ValueError(f"unsupported rope_scaling type {rtype!r}")
+    window = getattr(hf_config, "sliding_window", None)
+    if window is not None and not getattr(hf_config, "use_sliding_window", True):
+        window = None  # Qwen2/3 ship the field but default it off
+    if window is not None:
+        # HF semantics: the first max_window_layers layers run FULL
+        # attention, layers >= mwl are windowed.  mwl >= n_layers ⇒ no
+        # layer is windowed; mwl == 0 ⇒ uniformly windowed; anything
+        # between mixes per layer, which this architecture doesn't
+        # represent.
+        mwl = getattr(hf_config, "max_window_layers", None)
+        if mwl is not None:
+            if mwl >= hf_config.num_hidden_layers:
+                window = None
+            elif mwl > 0:
+                raise ValueError(
+                    f"unsupported per-layer sliding window "
+                    f"(0 < max_window_layers={mwl} < num_hidden_layers="
+                    f"{hf_config.num_hidden_layers})"
+                )
     return LlamaConfig(
         vocab_size=hf_config.vocab_size,
         dim=hf_config.hidden_size,
@@ -68,6 +100,14 @@ def config_from_hf(hf_config: Any, dtype: Any = jnp.bfloat16) -> LlamaConfig:
         # bump; transformers defaulted them to 10000
         rope_theta=getattr(hf_config, "rope_theta", 10000.0),
         rope_scaling=scaling,
+        attn_bias=getattr(hf_config, "attention_bias", bias_default),
+        qk_norm=qk_norm,
+        sliding_window=window,
+        head_dim_override=(
+            explicit_hd
+            if explicit_hd is not None and explicit_hd != derived_hd
+            else None
+        ),
         dtype=dtype,
     )
 
@@ -105,6 +145,13 @@ def _qk(w: np.ndarray, n_heads: int, head_dim: int) -> np.ndarray:
     return _proj_in_out(w.reshape(n_heads * head_dim, -1))
 
 
+def _qk_bias(b: np.ndarray, n_heads: int, head_dim: int) -> np.ndarray:
+    """q/k bias: the bias adds to the projection output before RoPE, so it
+    gets the same per-head feature permutation as the weight rows."""
+    perm = _rope_perm(head_dim)
+    return b.reshape(n_heads, head_dim)[:, perm].reshape(-1)
+
+
 def params_from_hf(
     model_or_state: Any, cfg: LlamaConfig | None = None
 ) -> Params:
@@ -130,19 +177,32 @@ def params_from_hf(
     layers = []
     for li in range(cfg.n_layers):
         p = f"model.layers.{li}."
-        layers.append(
-            {
-                "wq": _qk(get(p + "self_attn.q_proj.weight"), cfg.n_heads, hd),
-                "wk": _qk(get(p + "self_attn.k_proj.weight"), cfg.n_kv_heads, hd),
-                "wv": _proj_in_out(get(p + "self_attn.v_proj.weight")),
-                "wo": _proj_in_out(get(p + "self_attn.o_proj.weight")),
-                "w_gate": _proj_in_out(get(p + "mlp.gate_proj.weight")),
-                "w_up": _proj_in_out(get(p + "mlp.up_proj.weight")),
-                "w_down": _proj_in_out(get(p + "mlp.down_proj.weight")),
-                "ln_attn": get(p + "input_layernorm.weight"),
-                "ln_mlp": get(p + "post_attention_layernorm.weight"),
-            }
-        )
+        layer = {
+            "wq": _qk(get(p + "self_attn.q_proj.weight"), cfg.n_heads, hd),
+            "wk": _qk(get(p + "self_attn.k_proj.weight"), cfg.n_kv_heads, hd),
+            "wv": _proj_in_out(get(p + "self_attn.v_proj.weight")),
+            "wo": _proj_in_out(get(p + "self_attn.o_proj.weight")),
+            "w_gate": _proj_in_out(get(p + "mlp.gate_proj.weight")),
+            "w_up": _proj_in_out(get(p + "mlp.up_proj.weight")),
+            "w_down": _proj_in_out(get(p + "mlp.down_proj.weight")),
+            "ln_attn": get(p + "input_layernorm.weight"),
+            "ln_mlp": get(p + "post_attention_layernorm.weight"),
+        }
+        if cfg.attn_bias:
+            layer["bq"] = _qk_bias(
+                get(p + "self_attn.q_proj.bias"), cfg.n_heads, hd
+            )
+            layer["bk"] = _qk_bias(
+                get(p + "self_attn.k_proj.bias"), cfg.n_kv_heads, hd
+            )
+            layer["bv"] = get(p + "self_attn.v_proj.bias")
+        if cfg.qk_norm:
+            # the norm weight multiplies head features before RoPE, so it
+            # rides the same permutation as the q/k weight rows
+            perm = _rope_perm(hd)
+            layer["q_norm"] = get(p + "self_attn.q_norm.weight")[perm]
+            layer["k_norm"] = get(p + "self_attn.k_norm.weight")[perm]
+        layers.append(layer)
     stacked: Dict[str, Any] = {}
     for k in layers[0]:
         stacked[k] = jnp.asarray(

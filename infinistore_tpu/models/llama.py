@@ -36,6 +36,14 @@ Params = Dict[str, Any]
 
 @dataclass(frozen=True)
 class LlamaConfig:
+    """One dense-decoder config covering the Llama/Mistral/Qwen families.
+
+    The reference serves all of these through vLLM's model zoo; here one
+    parametric architecture covers them: ``attn_bias`` (Qwen2/2.5 QKV
+    biases), ``qk_norm`` (Qwen3 per-head RMSNorm on Q/K before RoPE),
+    ``sliding_window`` (Mistral-style windowed causal attention), and
+    ``head_dim_override`` (Qwen3 decouples head_dim from dim/n_heads)."""
+
     vocab_size: int = 128256
     dim: int = 4096
     n_layers: int = 32
@@ -48,11 +56,18 @@ class LlamaConfig:
     # high_freq_factor, original_max_position_embeddings); a tuple, not a
     # dict, so the frozen config stays hashable (attention.rope_freqs)
     rope_scaling: Tuple[float, float, float, int] | None = None
+    attn_bias: bool = False  # QKV projection biases (Qwen2/2.5)
+    qk_norm: bool = False  # per-head RMSNorm on Q/K before RoPE (Qwen3)
+    # attend only to the last N positions (Mistral SWA).  Pages beyond the
+    # window stay allocated (the paged cache is append-only); the mask makes
+    # them invisible.
+    sliding_window: int | None = None
+    head_dim_override: int | None = None
     dtype: Any = jnp.bfloat16
 
     @property
     def head_dim(self) -> int:
-        return self.dim // self.n_heads
+        return self.head_dim_override or self.dim // self.n_heads
 
 
 # -- presets (Llama-3 shapes) --
@@ -65,6 +80,21 @@ LLAMA3_1B = LlamaConfig(  # Llama-3.2-1B shapes
 )
 TINY = LlamaConfig(
     vocab_size=512, dim=128, n_layers=2, n_heads=4, n_kv_heads=2, ffn_dim=256
+)
+
+# -- sibling dense families (same machinery, different knobs) --
+MISTRAL_7B = LlamaConfig(  # v0.1 shapes: windowed attention, theta 1e4
+    vocab_size=32000, dim=4096, n_layers=32, n_heads=32, n_kv_heads=8,
+    ffn_dim=14336, rope_theta=10000.0, sliding_window=4096,
+)
+QWEN25_7B = LlamaConfig(  # QKV biases
+    vocab_size=152064, dim=3584, n_layers=28, n_heads=28, n_kv_heads=4,
+    ffn_dim=18944, rope_theta=1000000.0, norm_eps=1e-6, attn_bias=True,
+)
+QWEN3_8B = LlamaConfig(  # Q/K norm, decoupled head_dim
+    vocab_size=151936, dim=4096, n_layers=36, n_heads=32, n_kv_heads=8,
+    ffn_dim=12288, rope_theta=1000000.0, norm_eps=1e-6, qk_norm=True,
+    head_dim_override=128,
 )
 
 
@@ -82,20 +112,26 @@ def init_params(cfg: LlamaConfig, key: jax.Array) -> Params:
     hd = cfg.head_dim
     layers = []
     for li in range(cfg.n_layers):
-        k = jax.random.split(keys[li], 7)
-        layers.append(
-            {
-                "wq": dense(k[0], (cfg.dim, cfg.n_heads * hd), cfg.dim),
-                "wk": dense(k[1], (cfg.dim, cfg.n_kv_heads * hd), cfg.dim),
-                "wv": dense(k[2], (cfg.dim, cfg.n_kv_heads * hd), cfg.dim),
-                "wo": dense(k[3], (cfg.n_heads * hd, cfg.dim), cfg.n_heads * hd),
-                "w_gate": dense(k[4], (cfg.dim, cfg.ffn_dim), cfg.dim),
-                "w_up": dense(k[5], (cfg.dim, cfg.ffn_dim), cfg.dim),
-                "w_down": dense(k[6], (cfg.ffn_dim, cfg.dim), cfg.ffn_dim),
-                "ln_attn": jnp.ones((cfg.dim,), cfg.dtype),
-                "ln_mlp": jnp.ones((cfg.dim,), cfg.dtype),
-            }
-        )
+        k = jax.random.split(keys[li], 10)
+        layer = {
+            "wq": dense(k[0], (cfg.dim, cfg.n_heads * hd), cfg.dim),
+            "wk": dense(k[1], (cfg.dim, cfg.n_kv_heads * hd), cfg.dim),
+            "wv": dense(k[2], (cfg.dim, cfg.n_kv_heads * hd), cfg.dim),
+            "wo": dense(k[3], (cfg.n_heads * hd, cfg.dim), cfg.n_heads * hd),
+            "w_gate": dense(k[4], (cfg.dim, cfg.ffn_dim), cfg.dim),
+            "w_up": dense(k[5], (cfg.dim, cfg.ffn_dim), cfg.dim),
+            "w_down": dense(k[6], (cfg.ffn_dim, cfg.dim), cfg.ffn_dim),
+            "ln_attn": jnp.ones((cfg.dim,), cfg.dtype),
+            "ln_mlp": jnp.ones((cfg.dim,), cfg.dtype),
+        }
+        if cfg.attn_bias:
+            layer["bq"] = dense(k[7], (cfg.n_heads * hd,), cfg.dim)
+            layer["bk"] = dense(k[8], (cfg.n_kv_heads * hd,), cfg.dim)
+            layer["bv"] = dense(k[9], (cfg.n_kv_heads * hd,), cfg.dim)
+        if cfg.qk_norm:
+            layer["q_norm"] = jnp.ones((hd,), cfg.dtype)
+            layer["k_norm"] = jnp.ones((hd,), cfg.dtype)
+        layers.append(layer)
     # stack layers: every leaf gets a leading [n_layers] axis (scan-friendly,
     # pp-shardable)
     stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *layers)
@@ -116,9 +152,15 @@ def rmsnorm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
 def _attn_qkv(layer: Params, cfg: LlamaConfig, x: jax.Array, positions: jax.Array):
     B, S, _ = x.shape
     hd = cfg.head_dim
-    q = (x @ layer["wq"]).reshape(B, S, cfg.n_heads, hd)
-    k = (x @ layer["wk"]).reshape(B, S, cfg.n_kv_heads, hd)
-    v = (x @ layer["wv"]).reshape(B, S, cfg.n_kv_heads, hd)
+    q, k, v = x @ layer["wq"], x @ layer["wk"], x @ layer["wv"]
+    if cfg.attn_bias:
+        q, k, v = q + layer["bq"], k + layer["bk"], v + layer["bv"]
+    q = q.reshape(B, S, cfg.n_heads, hd)
+    k = k.reshape(B, S, cfg.n_kv_heads, hd)
+    v = v.reshape(B, S, cfg.n_kv_heads, hd)
+    if cfg.qk_norm:  # per-head RMSNorm before RoPE (Qwen3)
+        q = rmsnorm(q, layer["q_norm"], cfg.norm_eps)
+        k = rmsnorm(k, layer["k_norm"], cfg.norm_eps)
     q = apply_rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
     k = apply_rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
     return q, k, v
@@ -172,14 +214,16 @@ def prefill_forward(
         q, k, v = _attn_qkv(layer, cfg, h, positions)
         kvs.append(jnp.stack([k, v], axis=0))  # [2, B, S, Hkv, D]
         if prefix_kv is None:
-            attn = causal_attention(q, k, v, allow_pallas=use_pallas)
+            attn = causal_attention(
+                q, k, v, allow_pallas=use_pallas, window=cfg.sliding_window
+            )
         else:
             k_full = jnp.concatenate([prefix_kv[li, 0], k], axis=1)
             v_full = jnp.concatenate([prefix_kv[li, 1], v], axis=1)
             attn = causal_attention(
                 q, k_full, v_full, q_offset=P, allow_pallas=use_pallas,
                 prefix_pad=P if prefix_len is not None else None,
-                prefix_len=prefix_len,
+                prefix_len=prefix_len, window=cfg.sliding_window,
             )
         x = x + attn.reshape(B, S, -1) @ layer["wo"]
         h = rmsnorm(x, layer["ln_mlp"], cfg.norm_eps)
@@ -230,7 +274,7 @@ def decode_forward(
         cache = write_token_kv(cache, li, slot_block_ids, slot_ids, k[:, 0], v[:, 0])
         attn = paged_decode_attention(
             q[:, 0], cache[li], block_table, seq_lens, allow_pallas=use_pallas,
-            tp_mesh=tp_mesh,
+            tp_mesh=tp_mesh, window=cfg.sliding_window,
         )
         x = x + (attn.reshape(B, -1) @ layer["wo"])[:, None, :]
         h = rmsnorm(x, layer["ln_mlp"], cfg.norm_eps)
@@ -270,7 +314,9 @@ def verify_forward(
         h = rmsnorm(x, layer["ln_attn"], cfg.norm_eps)
         q, k, v = _attn_qkv(layer, cfg, h, positions)
         cache = write_tokens_kv(cache, li, slot_block_ids, slot_ids, k, v)
-        attn = paged_multitoken_attention_xla(q, cache[li], block_table, positions)
+        attn = paged_multitoken_attention_xla(
+            q, cache[li], block_table, positions, window=cfg.sliding_window
+        )
         x = x + attn.reshape(B, S, -1) @ layer["wo"]
         h = rmsnorm(x, layer["ln_mlp"], cfg.norm_eps)
         x = x + _mlp(layer, h)

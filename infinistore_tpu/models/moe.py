@@ -134,14 +134,16 @@ def moe_prefill_forward(
         q, k, v = _attn_qkv(layer, cfg, h, positions)
         kvs.append(jnp.stack([k, v], axis=0))
         if prefix_kv is None:
-            attn = causal_attention(q, k, v, allow_pallas=use_pallas)
+            attn = causal_attention(
+                q, k, v, allow_pallas=use_pallas, window=cfg.sliding_window
+            )
         else:
             k_full = jnp.concatenate([prefix_kv[li, 0], k], axis=1)
             v_full = jnp.concatenate([prefix_kv[li, 1], v], axis=1)
             attn = causal_attention(
                 q, k_full, v_full, q_offset=Pfx, allow_pallas=use_pallas,
                 prefix_pad=Pfx if prefix_len is not None else None,
-                prefix_len=prefix_len,
+                prefix_len=prefix_len, window=cfg.sliding_window,
             )
         x = x + attn.reshape(B, S, -1) @ layer["wo"]
         h = rmsnorm(x, layer["ln_mlp"], cfg.norm_eps)
@@ -175,7 +177,8 @@ def moe_decode_forward(
         q, k, v = _attn_qkv(layer, cfg, h, pos)
         cache = write_token_kv(cache, li, slot_block_ids, slot_ids, k[:, 0], v[:, 0])
         attn = paged_decode_attention(
-            q[:, 0], cache[li], block_table, seq_lens, allow_pallas=use_pallas
+            q[:, 0], cache[li], block_table, seq_lens, allow_pallas=use_pallas,
+            window=cfg.sliding_window,
         )
         x = x + (attn.reshape(B, -1) @ layer["wo"])[:, None, :]
         h = rmsnorm(x, layer["ln_mlp"], cfg.norm_eps)
@@ -206,7 +209,9 @@ def moe_verify_forward(
         h = rmsnorm(x, layer["ln_attn"], cfg.norm_eps)
         q, k, v = _attn_qkv(layer, cfg, h, positions)
         cache = write_tokens_kv(cache, li, slot_block_ids, slot_ids, k, v)
-        attn = paged_multitoken_attention_xla(q, cache[li], block_table, positions)
+        attn = paged_multitoken_attention_xla(
+            q, cache[li], block_table, positions, window=cfg.sliding_window
+        )
         x = x + attn.reshape(B, S, -1) @ layer["wo"]
         h = rmsnorm(x, layer["ln_mlp"], cfg.norm_eps)
         x = x + moe_ffn(layer, h, cfg.top_k)

@@ -44,9 +44,17 @@ def tp_layer_forward(
     hkv_loc = cfg.n_kv_heads // tp
 
     h = rmsnorm(x, layer["ln_attn"], cfg.norm_eps)
-    q = (h @ layer["wq"]).reshape(B, S, h_loc, hd)
-    k = (h @ layer["wk"]).reshape(B, S, hkv_loc, hd)
-    v = (h @ layer["wv"]).reshape(B, S, hkv_loc, hd)
+    q, k, v = h @ layer["wq"], h @ layer["wk"], h @ layer["wv"]
+    if cfg.attn_bias:
+        # bias shards column-parallel with its projection: layer["bq"] is
+        # this device's [(H/tp)*hd] slice
+        q, k, v = q + layer["bq"], k + layer["bk"], v + layer["bv"]
+    q = q.reshape(B, S, h_loc, hd)
+    k = k.reshape(B, S, hkv_loc, hd)
+    v = v.reshape(B, S, hkv_loc, hd)
+    if cfg.qk_norm:  # per-head-feature weights are replicated
+        q = rmsnorm(q, layer["q_norm"], cfg.norm_eps)
+        k = rmsnorm(k, layer["k_norm"], cfg.norm_eps)
     q = apply_rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
     k = apply_rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
     attn = ring_attention_local(q, k, v, sp_axis)  # [B, S, h_loc, hd]

@@ -107,7 +107,7 @@ def make_moe_train_step(
             layer = _layer(li)(params["layers"])
             h = rmsnorm(x, layer["ln_attn"], cfg.norm_eps)
             q, k, v = _attn_qkv(layer, cfg, h, positions)
-            attn = causal_attention(q, k, v)
+            attn = causal_attention(q, k, v, window=cfg.sliding_window)
             x = x + attn.reshape(B_loc, S, -1) @ layer["wo"]
             h = rmsnorm(x, layer["ln_mlp"], cfg.norm_eps)
             x = x + _local_moe_ffn(layer, h, cfg, ep)
@@ -149,7 +149,7 @@ def make_moe_forward(cfg: MoEConfig, mesh: Mesh):
             layer = _layer(li)(params["layers"])
             h = rmsnorm(x, layer["ln_attn"], cfg.norm_eps)
             q, k, v = _attn_qkv(layer, cfg, h, positions)
-            attn = causal_attention(q, k, v)
+            attn = causal_attention(q, k, v, window=cfg.sliding_window)
             x = x + attn.reshape(B_loc, S, -1) @ layer["wo"]
             h = rmsnorm(x, layer["ln_mlp"], cfg.norm_eps)
             x = x + _local_moe_ffn(layer, h, cfg, ep)

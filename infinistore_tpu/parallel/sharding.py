@@ -17,9 +17,14 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..models.llama import LlamaConfig, decode_forward, prefill_forward
 
 
-def llama_inference_specs() -> dict:
+def llama_inference_specs(params=None, cfg: LlamaConfig | None = None) -> dict:
     """Tensor-parallel specs for the stacked param pytree (no pp: the layer
-    axis stays replicated; serving pipelines span engines, not chips)."""
+    axis stays replicated; serving pipelines span engines, not chips).
+
+    ``params`` (or ``cfg``): when given, the specs cover exactly the optional
+    leaves the pytree carries (QKV biases for Qwen2-style checkpoints shard
+    with their head-partitioned projections; Q/K norm weights are
+    per-head-feature and replicate)."""
     layer_specs = {
         "wq": P(None, None, "tp"),
         "wk": P(None, None, "tp"),
@@ -31,6 +36,21 @@ def llama_inference_specs() -> dict:
         "ln_attn": P(None, None),
         "ln_mlp": P(None, None),
     }
+    optional = {
+        "bq": P(None, "tp"),
+        "bk": P(None, "tp"),
+        "bv": P(None, "tp"),
+        "q_norm": P(None, None),
+        "k_norm": P(None, None),
+    }
+    present = set(params["layers"]) if params is not None else set()
+    if cfg is not None:
+        if cfg.attn_bias:
+            present |= {"bq", "bk", "bv"}
+        if cfg.qk_norm:
+            present |= {"q_norm", "k_norm"}
+    for key in present & set(optional):
+        layer_specs[key] = optional[key]
     return {
         "embed": P(),
         "layers": layer_specs,
@@ -41,7 +61,7 @@ def llama_inference_specs() -> dict:
 
 def shard_params(params, mesh: Mesh, specs=None):
     if specs is None:
-        specs = llama_inference_specs()
+        specs = llama_inference_specs(params)
     return jax.device_put(params, shardings_for(mesh, specs))
 
 
@@ -70,7 +90,7 @@ def make_tp_prefill(cfg: LlamaConfig, mesh: Mesh):
 
     return jax.jit(
         fn,
-        in_shardings=(shardings_for(mesh, llama_inference_specs()), data),
+        in_shardings=(shardings_for(mesh, llama_inference_specs(cfg=cfg)), data),
         out_shardings=(logits_sharding, kv_sharding),
     )
 
@@ -95,7 +115,7 @@ def make_tp_decode(cfg: LlamaConfig, mesh: Mesh):
     return jax.jit(
         fn,
         in_shardings=(
-            shardings_for(mesh, llama_inference_specs()),
+            shardings_for(mesh, llama_inference_specs(cfg=cfg)),
             repl, repl, cache_sharding, repl, repl, repl, repl,
         ),
         out_shardings=(NamedSharding(mesh, P(None, "tp")), cache_sharding),

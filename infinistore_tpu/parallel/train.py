@@ -52,6 +52,12 @@ def llama_param_specs(cfg: LlamaConfig) -> dict:
         "ln_attn": P("pp", None),
         "ln_mlp": P("pp", None),
     }
+    if cfg.attn_bias:
+        layer_specs |= {
+            "bq": P("pp", "tp"), "bk": P("pp", "tp"), "bv": P("pp", "tp"),
+        }
+    if cfg.qk_norm:
+        layer_specs |= {"q_norm": P("pp", None), "k_norm": P("pp", None)}
     return {
         "embed": P(),
         "layers": layer_specs,
@@ -95,6 +101,10 @@ def make_train_step(
     ``tokens``: [B, S] int32, sharded P("dp", "sp").  The first call
     validates divisibility constraints against the actual shapes.
     """
+    assert cfg.sliding_window is None, (
+        "the manual sp/pp train path (ring attention) carries no "
+        "sliding-window mask; train windowed models via loss_fn/GSPMD"
+    )
     pp = mesh.shape["pp"]
     sp = mesh.shape["sp"]
     tp = mesh.shape["tp"]

@@ -584,3 +584,45 @@ def test_scheduler_survives_raising_callback():
     assert res[a] == dense_greedy(PROMPT, 8)
     assert res[b] == dense_greedy(PROMPT[:5], 8)
     assert eng.free_pages == eng.pc.n_blocks
+
+
+def _family_engine_roundtrip(cfg, n_steps=6, prompt=(3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5)):
+    """Full serving loop (chunked prefill + paged decode) for a family
+    variant must match its own dense-forward greedy reference."""
+    params = init_params(cfg, jax.random.PRNGKey(11))
+
+    def dense(tokens, n):
+        toks = list(tokens)
+        out = []
+        for _ in range(n):
+            logits, _ = prefill_forward(
+                params, cfg, jnp.asarray(toks, dtype=jnp.int32)[None]
+            )
+            nxt = int(jnp.argmax(logits[0, -1]))
+            out.append(nxt)
+            toks.append(nxt)
+        return out
+
+    pc = PagedCacheConfig(
+        n_layers=cfg.n_layers, n_kv_heads=cfg.n_kv_heads,
+        head_dim=cfg.head_dim, n_blocks=64, block_tokens=T, dtype=cfg.dtype,
+    )
+    eng = InferenceEngine(params, cfg, pc, prefill_chunk=2 * T)
+    eng.decode_chunk = 4
+    assert eng.generate(list(prompt), n_steps) == dense(prompt, n_steps)
+
+
+def test_engine_serves_qwen2_style_bias_model():
+    _family_engine_roundtrip(scaled(TINY, dtype=jnp.float32, attn_bias=True))
+
+
+def test_engine_serves_qwen3_style_qk_norm_model():
+    _family_engine_roundtrip(
+        scaled(TINY, dtype=jnp.float32, qk_norm=True, head_dim_override=16)
+    )
+
+
+def test_engine_serves_windowed_mistral_style_model():
+    # window < prompt length: chunked prefill's prefix-buffer mask and the
+    # paged decode mask both genuinely drop early keys
+    _family_engine_roundtrip(scaled(TINY, dtype=jnp.float32, sliding_window=6))

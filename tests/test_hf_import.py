@@ -10,6 +10,8 @@ import pytest
 torch = pytest.importorskip("torch")
 transformers = pytest.importorskip("transformers")
 
+from dataclasses import replace as dataclasses_replace  # noqa: E402
+
 from infinistore_tpu.models.hf import config_from_hf, params_from_hf  # noqa: E402
 from infinistore_tpu.models.llama import prefill_forward  # noqa: E402
 
@@ -93,13 +95,128 @@ def test_rejects_unrepresentable_configs():
         vocab_size=64, hidden_size=64, intermediate_size=128,
         num_hidden_layers=1, num_attention_heads=4, num_key_value_heads=2,
     )
-    with pytest.raises(ValueError, match="head_dim"):
-        config_from_hf(transformers.LlamaConfig(**base, head_dim=32))
     with pytest.raises(ValueError, match="rope_scaling"):
         config_from_hf(transformers.LlamaConfig(
             **base,
             rope_scaling={"rope_type": "yarn", "factor": 2.0},
         ))
+    with pytest.raises(ValueError, match="model_type"):
+        config_from_hf(transformers.GemmaConfig(**base))
+    with pytest.raises(ValueError, match="max_window_layers"):
+        config_from_hf(transformers.Qwen2Config(
+            **{**base, "num_hidden_layers": 4}, use_sliding_window=True,
+            sliding_window=8, max_window_layers=2,
+        ))
+    # HF windows layers >= max_window_layers: mwl >= n_layers means NO
+    # layer is windowed; mwl == 0 means uniformly windowed
+    cfg_full = config_from_hf(transformers.Qwen2Config(
+        **{**base, "num_hidden_layers": 4}, use_sliding_window=True,
+        sliding_window=8, max_window_layers=4,
+    ))
+    assert cfg_full.sliding_window is None
+    cfg_win = config_from_hf(transformers.Qwen2Config(
+        **{**base, "num_hidden_layers": 4}, use_sliding_window=True,
+        sliding_window=8, max_window_layers=0,
+    ))
+    assert cfg_win.sliding_window == 8
+    # a decoupled head_dim is supported, not rejected
+    cfg = config_from_hf(transformers.LlamaConfig(**base, head_dim=32))
+    assert cfg.head_dim == 32
+
+
+def test_mistral_sliding_window_logits_match():
+    """Mistral = Llama machinery + sliding-window attention.  A tiny window
+    (5) over a longer sequence (14) makes the windowed and full-causal
+    outputs diverge, so this fails if the mask is wrong in either
+    direction."""
+    hf_cfg = transformers.MistralConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, rms_norm_eps=1e-5, rope_theta=10000.0,
+        sliding_window=5, tie_word_embeddings=False,
+    )
+    torch.manual_seed(2)
+    with torch.no_grad():
+        model = transformers.MistralForCausalLM(hf_cfg)
+        for p in model.parameters():
+            p.mul_(3.0)
+    model.eval()
+    cfg = config_from_hf(model.config, dtype=jnp.float32)
+    assert cfg.sliding_window == 5
+    params = params_from_hf(model, cfg)
+
+    tokens = np.arange(3, 45, 3, dtype=np.int64)[None] % 256  # len 14 > window
+    with torch.no_grad():
+        want = model(torch.from_numpy(tokens)).logits.numpy()
+    got, _ = prefill_forward(params, cfg, jnp.asarray(tokens, dtype=jnp.int32))
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), want, rtol=2e-3, atol=2e-3
+    )
+    # sanity: the window actually bites (full-causal differs at the tail)
+    full, _ = prefill_forward(
+        params, dataclasses_replace(cfg, sliding_window=None),
+        jnp.asarray(tokens, dtype=jnp.int32),
+    )
+    assert not np.allclose(np.asarray(full, np.float32)[0, -1], want[0, -1],
+                           rtol=2e-3, atol=2e-3)
+
+
+def test_qwen2_bias_logits_match():
+    """Qwen2/2.5 = Llama machinery + QKV biases (with the RoPE permutation
+    applied to the q/k bias rows)."""
+    hf_cfg = transformers.Qwen2Config(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, rms_norm_eps=1e-6, rope_theta=1e6,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(3)
+    with torch.no_grad():
+        model = transformers.Qwen2ForCausalLM(hf_cfg)
+        for p in model.parameters():
+            p.mul_(2.0)
+    model.eval()
+    cfg = config_from_hf(model.config, dtype=jnp.float32)
+    assert cfg.attn_bias and cfg.sliding_window is None
+    params = params_from_hf(model, cfg)
+    assert "bq" in params["layers"]
+
+    tokens = np.array([[7, 3, 99, 250, 12, 1, 88, 41, 5]], dtype=np.int64)
+    with torch.no_grad():
+        want = model(torch.from_numpy(tokens)).logits.numpy()
+    got, _ = prefill_forward(params, cfg, jnp.asarray(tokens, dtype=jnp.int32))
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), want, rtol=2e-3, atol=2e-3
+    )
+
+
+def test_qwen3_qk_norm_logits_match():
+    """Qwen3 = Llama machinery + per-head Q/K RMSNorm and a head_dim
+    decoupled from hidden/heads (8 != 64/4)."""
+    hf_cfg = transformers.Qwen3Config(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=8, max_position_embeddings=128, rms_norm_eps=1e-6,
+        rope_theta=1e6, tie_word_embeddings=False,
+    )
+    torch.manual_seed(4)
+    with torch.no_grad():
+        model = transformers.Qwen3ForCausalLM(hf_cfg)
+        for p in model.parameters():
+            p.mul_(2.0)
+    model.eval()
+    cfg = config_from_hf(model.config, dtype=jnp.float32)
+    assert cfg.qk_norm and cfg.head_dim == 8
+    params = params_from_hf(model, cfg)
+    assert "q_norm" in params["layers"]
+
+    tokens = np.array([[5, 100, 2, 43, 17, 200, 9]], dtype=np.int64)
+    with torch.no_grad():
+        want = model(torch.from_numpy(tokens)).logits.numpy()
+    got, _ = prefill_forward(params, cfg, jnp.asarray(tokens, dtype=jnp.int32))
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), want, rtol=2e-3, atol=2e-3
+    )
 
 
 def test_state_dict_entry_point():

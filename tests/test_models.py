@@ -111,6 +111,52 @@ def test_paged_decode_matches_prefill(tiny_setup):
         )
 
 
+def test_windowed_paged_decode_matches_prefill():
+    """Sliding-window config: the paged decode mask must agree with the
+    prefill mask.  Window (5) < prefilled length (8) so decode positions
+    genuinely drop early keys, and a full-causal decode would diverge."""
+    cfg = scaled(TINY, dtype=jnp.float32, sliding_window=5)
+    params = init_params(cfg, jax.random.PRNGKey(7))
+    T = 4
+    S_prefill, S_total = 8, 12
+    tokens = jax.random.randint(jax.random.PRNGKey(8), (1, S_total), 0, cfg.vocab_size)
+
+    ref_logits, _ = prefill_forward(params, cfg, tokens)
+    full_cfg = scaled(cfg, sliding_window=None)
+    full_logits, _ = prefill_forward(params, full_cfg, tokens)
+    assert not np.allclose(  # the window must actually bite
+        np.asarray(ref_logits[0, -1]), np.asarray(full_logits[0, -1]),
+        rtol=2e-4, atol=2e-4,
+    )
+
+    pc = PagedCacheConfig(
+        n_layers=cfg.n_layers, n_kv_heads=cfg.n_kv_heads,
+        head_dim=cfg.head_dim, n_blocks=16, block_tokens=T, dtype=cfg.dtype,
+    )
+    cache = init_cache(pc)
+    alloc = BlockAllocator(pc.n_blocks)
+    _, kv = prefill_forward(params, cfg, tokens[:, :S_prefill])
+    n_pages = S_prefill // T
+    block_ids = alloc.alloc(n_pages + 1)
+    cache = write_pages(
+        cache, jnp.asarray(block_ids[:n_pages]),
+        prefill_to_pages(kv[:, :, 0], n_pages, T),
+    )
+    table = np.zeros((1, 4), dtype=np.int32)
+    table[0, : n_pages + 1] = block_ids
+    for pos in range(S_prefill, S_total):
+        logits, cache = decode_forward(
+            params, cfg, tokens[:, pos], jnp.asarray([pos]), cache,
+            jnp.asarray(table), jnp.asarray([pos + 1], dtype=jnp.int32),
+            jnp.asarray([block_ids[pos // T]], dtype=jnp.int32),
+            jnp.asarray([pos % T], dtype=jnp.int32),
+        )
+        np.testing.assert_allclose(
+            np.asarray(logits[0]), np.asarray(ref_logits[0, pos]),
+            rtol=2e-4, atol=2e-4,
+        )
+
+
 def test_train_step_reduces_loss(tiny_setup):
     cfg, params = tiny_setup
     tokens = jax.random.randint(jax.random.PRNGKey(4), (4, 16), 0, cfg.vocab_size)

@@ -112,3 +112,42 @@ def test_moe_serving_engine_paged_decode():
         )
         toks.append(int(jnp.argmax(logits[0, -1])))
     assert out == toks[len(prompt):]
+
+
+def test_moe_windowed_paged_decode_matches_dense():
+    """sliding_window on MoEConfig must behave like the dense family: the
+    paged decode mask agrees with the prefill mask (Mixtral v0.1 ships
+    sliding_window=4096)."""
+    from infinistore_tpu.engine.engine import InferenceEngine
+    from infinistore_tpu.kv import PagedCacheConfig
+    from infinistore_tpu.models.moe import moe_decode_forward
+
+    wcfg = scaled_moe(TINY_MOE, dtype=jnp.float32, sliding_window=6)
+    params = init_moe_params(wcfg, jax.random.PRNGKey(3))
+    pc = PagedCacheConfig(
+        n_layers=wcfg.n_layers, n_kv_heads=wcfg.n_kv_heads,
+        head_dim=wcfg.head_dim, n_blocks=16, block_tokens=4, dtype=wcfg.dtype,
+    )
+    eng = InferenceEngine(
+        params, wcfg, pc, conn=None, model_id="moe-w",
+        prefill_fn=moe_prefill_forward, decode_fn=moe_decode_forward,
+    )
+    prompt = list(np.random.default_rng(7).integers(0, wcfg.vocab_size, 10))
+    out = eng.generate(prompt, 5)
+
+    toks = list(prompt)
+    for _ in range(5):
+        logits, _ = moe_prefill_forward(
+            params, wcfg, jnp.asarray(toks, jnp.int32)[None]
+        )
+        toks.append(int(jnp.argmax(logits[0, -1])))
+    assert out == toks[len(prompt):]
+
+    # and the window must actually change the model vs full causal
+    fl, _ = moe_prefill_forward(
+        params, scaled_moe(wcfg, sliding_window=None),
+        jnp.asarray(prompt, jnp.int32)[None],
+    )
+    wl, _ = moe_prefill_forward(params, wcfg, jnp.asarray(prompt, jnp.int32)[None])
+    assert not np.allclose(np.asarray(fl[0, -1]), np.asarray(wl[0, -1]),
+                           rtol=1e-4, atol=1e-4)

@@ -20,6 +20,7 @@ from infinistore_tpu.models.llama import (
     init_params,
     loss_fn,
     prefill_forward,
+    scaled,
 )
 from infinistore_tpu.parallel import (
     MeshShape,
@@ -311,3 +312,30 @@ def test_sharded_engine_pallas_tp_decode(monkeypatch):
         eng.decode_chunk = 3
         got = eng.decode(eng.prefill(prompt), 6)
     assert got == want
+
+
+def test_sharded_engine_serves_biased_family():
+    """A Qwen2-style pytree (QKV biases) under mesh=: shard_params must pick
+    up the bias specs (head-partitioned) and the GSPMD loop must match the
+    single-device engine."""
+    from infinistore_tpu.engine.engine import InferenceEngine
+    from infinistore_tpu.kv.cache import PagedCacheConfig
+
+    cfg = scaled(CFG, attn_bias=True, qk_norm=True)
+    params = init_params(cfg, jax.random.PRNGKey(13))
+    pc = PagedCacheConfig(
+        n_layers=cfg.n_layers, n_kv_heads=cfg.n_kv_heads,
+        head_dim=cfg.head_dim, n_blocks=32, block_tokens=4, dtype=jnp.float32)
+    prompt = [int(t) for t in
+              np.random.RandomState(5).randint(1, cfg.vocab_size, 9)]
+
+    ref = InferenceEngine(params, cfg, pc)
+    ref_toks = ref.decode(ref.prefill(prompt), 10)
+
+    mesh = make_mesh(tp=2)
+    with jax.set_mesh(mesh):
+        eng = InferenceEngine(params, cfg, pc, mesh=mesh)
+        sharded = eng.params["layers"]["bq"].sharding
+        assert "tp" in (sharded.spec[1],), sharded.spec  # bias head-sharded
+        toks = eng.decode(eng.prefill(prompt), 10)
+    assert toks == ref_toks

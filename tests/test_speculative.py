@@ -128,3 +128,17 @@ def test_speculative_continues_after_decode():
     more = spec.target.decode(st_t, 5)
     want = make_engine(TARGET_PARAMS, CFG).generate(PROMPT, 12)
     assert first + more == want
+
+
+def test_speculative_windowed_family():
+    """Sliding-window target: the multi-token verify mask must agree with
+    the scan decode mask, so speculation still reproduces greedy exactly."""
+    wcfg = scaled(TINY, dtype=jnp.float32, sliding_window=6)
+    wparams = init_params(wcfg, jax.random.PRNGKey(21))
+    want = make_engine(wparams, wcfg).generate(PROMPT, 16)
+    spec = SpeculativeDecoder(
+        make_engine(wparams, wcfg),
+        make_engine(DRAFT_PARAMS, DRAFT_CFG),
+        k=4,
+    )
+    assert spec.generate(PROMPT, 16) == want
