@@ -1,0 +1,58 @@
+"""Drive the HTTP serving front-end (infinistore_tpu.serve).
+
+Start a server first:
+    python -m infinistore_tpu.serve --model tiny --port 8000
+
+Then:
+    python examples/serve_client.py --port 8000
+"""
+
+import argparse
+import http.client
+import json
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8000)
+    args = ap.parse_args()
+
+    conn = http.client.HTTPConnection(args.host, args.port, timeout=300)
+
+    # model card
+    conn.request("GET", "/v1/models")
+    print("models:", json.loads(conn.getresponse().read()))
+
+    # one-shot completion (token ids in, token ids out; temperature 0 =
+    # greedy — pair with your tokenizer of choice outside the engine)
+    prompt = [11, 42, 7, 99, 5, 3, 17, 28]
+    conn.request("POST", "/v1/completions", json.dumps({
+        "prompt": prompt, "max_tokens": 16, "temperature": 0,
+    }), {"Content-Type": "application/json"})
+    print("completion:", json.loads(conn.getresponse().read()))
+
+    # streaming (SSE): tokens arrive at decode-chunk granularity
+    conn.request("POST", "/v1/completions", json.dumps({
+        "prompt": prompt, "max_tokens": 16, "temperature": 0.8,
+        "top_p": 0.95, "stream": True,
+    }), {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    buf = b""
+    while True:
+        chunk = resp.read1(65536)
+        if not chunk:
+            break
+        buf += chunk
+        while b"\n\n" in buf:
+            event, buf = buf.split(b"\n\n", 1)
+            payload = event[len(b"data: "):]
+            if payload == b"[DONE]":
+                print("stream: [DONE]")
+                conn.close()
+                return
+            print("stream:", json.loads(payload)["choices"][0]["token_ids"])
+
+
+if __name__ == "__main__":
+    main()

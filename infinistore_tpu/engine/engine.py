@@ -449,7 +449,8 @@ class InferenceEngine:
 
     # ---- decode ----
 
-    def _decode_many(self, n_steps: int, sample: str, top_k: int):
+    def _decode_many(self, n_steps: int, sample: str, top_k: int,
+                     top_p: float = 1.0):
         """Compiled ``n_steps``-token decode: a ``lax.scan`` whose body
         samples on device (no per-token host sync) and derives the KV scatter
         slot from the device-resident block table.  Works for any batch of
@@ -459,27 +460,42 @@ class InferenceEngine:
         The reference decodes through vLLM's CUDA-graph step loop; the TPU
         analog is one traced scan so XLA pipelines all ``n_steps`` steps
         without returning to Python (VERDICT round-1 weak #9)."""
-        cache_key = (n_steps, sample, top_k)
+        # top_p enters the compiled program as a TRACED scalar (like
+        # temperature): client-supplied values must not fragment the jit
+        # cache — only whether nucleus filtering runs at all is static
+        use_top_p = top_p < 1.0
+        cache_key = (n_steps, sample, top_k, use_top_p)
         fn = self._decode_many_cache.get(cache_key)
         if fn is not None:
             return fn
         T = self.pc.block_tokens
         decode_fn = self._decode_raw
 
-        def pick(logits, rng, temperature):
+        def pick(logits, rng, temperature, p):
             if sample == "greedy":
                 return jnp.argmax(logits, axis=-1).astype(jnp.int32)
             l = logits.astype(jnp.float32) / temperature
             if top_k:
                 kth = jax.lax.top_k(l, top_k)[0][:, -1:]  # [B, 1]
                 l = jnp.where(l < kth, -jnp.inf, l)
+            if use_top_p:
+                # nucleus: keep the smallest prefix of the descending-prob
+                # ordering whose mass reaches p (the crossing token
+                # included — HF/vLLM convention: exclusive cumsum < p)
+                sl = jnp.sort(l, axis=-1)[:, ::-1]  # descending logits
+                probs = jax.nn.softmax(sl, axis=-1)
+                excl = jnp.cumsum(probs, axis=-1) - probs
+                kept = jnp.where(excl < p, sl, jnp.inf)
+                thresh = jnp.min(kept, axis=-1, keepdims=True)  # [B, 1]
+                l = jnp.where(l < thresh, -jnp.inf, l)
             return jax.random.categorical(rng, l).astype(jnp.int32)
 
-        def many(params, logits0, start_pos, cache, block_table, rng, temperature):
+        def many(params, logits0, start_pos, cache, block_table, rng,
+                 temperature, p):
             def step(carry, i):
                 logits, cache, rng = carry
                 rng, sub = jax.random.split(rng)
-                tok = pick(logits, sub, temperature)  # [B]
+                tok = pick(logits, sub, temperature, p)  # [B]
                 pos = start_pos + i  # [B]
                 page_idx = pos // T
                 slot_blocks = jnp.take_along_axis(
@@ -513,12 +529,13 @@ class InferenceEngine:
         sample: str = "greedy",
         temperature: float = 1.0,
         top_k: int = 0,
+        top_p: float = 1.0,
         rng: Optional[jax.Array] = None,
     ) -> List[int]:
         """Decode ``n_steps`` tokens for one sequence."""
         return self.decode_batch(
             [state], n_steps, sample=sample, temperature=temperature,
-            top_k=top_k, rng=rng,
+            top_k=top_k, top_p=top_p, rng=rng,
         )[0]
 
     def decode_batch(
@@ -528,6 +545,7 @@ class InferenceEngine:
         sample: str = "greedy",
         temperature: float = 1.0,
         top_k: int = 0,
+        top_p: float = 1.0,
         rng: Optional[jax.Array] = None,
     ) -> List[List[int]]:
         """Decode ``n_steps`` tokens for a batch of sequences in lockstep
@@ -536,13 +554,15 @@ class InferenceEngine:
 
         ``sample``: "greedy" (default) or "categorical" (softmax sampling at
         ``temperature``, optionally truncated to the ``top_k`` most likely
-        tokens); sampling runs on device with a carried PRNG key.
+        tokens and/or the ``top_p`` nucleus); sampling runs on device with a
+        carried PRNG key.
 
         Pages for the whole run are allocated up front and block tables are
         built once; the token loop runs on device in compiled chunks
         (``decode_chunk`` tokens per dispatch), so the only host syncs are
         the per-chunk token downloads."""
         assert sample in ("greedy", "categorical"), sample
+        assert 0.0 < top_p <= 1.0, top_p
         B = len(states)
         assert B >= 1
         T = self.pc.block_tokens
@@ -564,7 +584,9 @@ class InferenceEngine:
         while remaining > 0:
             chunk = min(remaining, self.decode_chunk)
             rng, sub = jax.random.split(rng)
-            toks, logits, self.cache = self._decode_many(chunk, sample, top_k)(
+            toks, logits, self.cache = self._decode_many(
+                chunk, sample, top_k, top_p
+            )(
                 self.params,
                 logits,
                 jnp.asarray(pos),
@@ -572,6 +594,7 @@ class InferenceEngine:
                 block_table,
                 sub,
                 temp,
+                jnp.asarray(top_p, dtype=jnp.float32),
             )
             host_toks = np.asarray(toks)  # [chunk, B]; one sync/chunk
             for b in range(B):

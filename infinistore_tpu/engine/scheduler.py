@@ -37,6 +37,7 @@ class Request:
     sample: str = "greedy"
     temperature: float = 1.0
     top_k: int = 0
+    top_p: float = 1.0
     # streaming: called at every chunk boundary with the newly visible
     # tokens (already eos/budget-trimmed), then once with ([], True) at
     # retirement — the vLLM streaming-generator analog at chunk granularity
@@ -71,13 +72,19 @@ class Scheduler:
         sample: str = "greedy",
         temperature: float = 1.0,
         top_k: int = 0,
+        top_p: float = 1.0,
         on_token: Optional[Callable[[List[int], bool], None]] = None,
     ) -> int:
+        if sample == "greedy":
+            # greedy ignores these; normalizing keeps greedy requests in one
+            # lockstep batch (and one compiled program) regardless of the
+            # stray sampling params clients send alongside temperature 0
+            temperature, top_k, top_p = 1.0, 0, 1.0
         req = Request(
             req_id=self._next_id, tokens=list(tokens),
             max_new_tokens=max_new_tokens, eos_id=eos_id,
             sample=sample, temperature=temperature, top_k=top_k,
-            on_token=on_token,
+            top_p=top_p, on_token=on_token,
         )
         self._next_id += 1
         self.pending.append(req)
@@ -139,7 +146,7 @@ class Scheduler:
     def _group(req: Request):
         # one lockstep dispatch shares a single compiled sampling program, so
         # a batch only holds requests with identical sampling params
-        return (req.sample, req.temperature, req.top_k)
+        return (req.sample, req.temperature, req.top_k, req.top_p)
 
     def _admit(self) -> None:
         if not self.active and self.pending:
@@ -237,7 +244,7 @@ class Scheduler:
             outs = self.engine.decode_batch(
                 [r.state for r in self.active], chunk,
                 sample=head.sample, temperature=head.temperature,
-                top_k=head.top_k, rng=sub,
+                top_k=head.top_k, top_p=head.top_p, rng=sub,
             )
         except MemoryError:
             # decode-time page exhaustion: shed the newest request back to

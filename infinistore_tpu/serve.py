@@ -1,0 +1,410 @@
+"""HTTP serving front-end: an OpenAI-completions-style API over the
+continuous-batching scheduler.
+
+The reference's serving loop is vLLM, which fronts its engine with an
+OpenAI-compatible HTTP server; a standalone framework needs the same last
+mile.  Design (stdlib only, like the store's manage plane — server.py):
+
+* one **engine thread** owns the ``Scheduler`` and is the only thread that
+  touches it or the TPU; HTTP handler threads talk to it through a staging
+  list guarded by a condition variable (submissions, cancellations) and
+  per-request ``queue.Queue``s (token delivery), so JAX dispatch never runs
+  concurrently;
+* ``POST /v1/completions`` — body ``{"prompt": [token ids], "max_tokens",
+  "temperature", "top_p", "top_k", "stop_token_ids": [eos], "stream"}``.
+  Prompts are token ids: tokenization is deliberately outside the engine
+  (the reference's vLLM pairs with an external tokenizer the same way when
+  driven over RPC).  Non-streaming answers one JSON body; ``"stream": true``
+  answers Server-Sent Events (``data: {...}``, final ``data: [DONE]``) at
+  decode-chunk granularity, riding the scheduler's ``on_token`` hook;
+* ``GET /v1/models`` — model card; ``GET /metrics`` — Prometheus text
+  (requests served/active, tokens generated, free KV pages).
+
+A client disconnect mid-stream cancels the request at the next chunk
+boundary (pages freed, batchmates unaffected — scheduler.cancel semantics).
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional
+
+from .engine import Scheduler
+from .utils.logging import Logger
+
+
+class ServingServer:
+    """Owns the engine thread and the HTTP server."""
+
+    def __init__(self, engine, host: str = "127.0.0.1", port: int = 8000,
+                 max_batch: int = 8, model_id: str = "infinistore-tpu"):
+        self.engine = engine
+        self.model_id = model_id
+        self.sched = Scheduler(engine, max_batch=max_batch)
+        self._cv = threading.Condition()
+        self._staged: List[Dict[str, Any]] = []   # submissions from handlers
+        self._cancels: List[int] = []
+        self._queues: Dict[int, "queue.Queue"] = {}  # live req_id -> events
+        self._stop = False
+        self.stats = {"requests": 0, "completed": 0, "tokens": 0}
+        self._engine_thread = threading.Thread(
+            target=self._engine_loop, name="istpu-engine", daemon=True
+        )
+        handler = _make_handler(self)
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.port = self.httpd.server_address[1]
+
+    # -- lifecycle --
+
+    def start(self) -> None:
+        self._engine_thread.start()
+        threading.Thread(
+            target=self.httpd.serve_forever, name="istpu-http", daemon=True
+        ).start()
+        Logger.info(f"serving {self.model_id} on :{self.port}")
+
+    def close(self) -> None:
+        with self._cv:
+            self._stop = True
+            self._cv.notify()
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        self._engine_thread.join(timeout=30)
+
+    # -- handler-side API (any thread) --
+
+    def submit(self, body: Dict[str, Any]) -> "queue.Queue":
+        """Stage a request; returns the queue its events arrive on.
+        Events: ("tokens", [ids]) then ("done", finish_reason)."""
+        q: queue.Queue = queue.Queue()
+        with self._cv:
+            self._staged.append({"body": body, "q": q})
+            self.stats["requests"] += 1
+            self._cv.notify()
+        return q
+
+    def cancel(self, req_id: int) -> None:
+        with self._cv:
+            self._cancels.append(req_id)
+            self._cv.notify()
+
+    # -- engine thread --
+
+    def _engine_loop(self) -> None:
+        while True:
+            with self._cv:
+                while not (self._staged or self._cancels or self._stop
+                           or self.sched.has_work):
+                    self._cv.wait()
+                if self._stop:
+                    return
+                staged, self._staged = self._staged, []
+                cancels, self._cancels = self._cancels, []
+            for rid in cancels:
+                self.sched.cancel(rid)
+                self._queues.pop(rid, None)
+            for item in staged:
+                self._submit_to_sched(item)
+            if self.sched.has_work:
+                try:
+                    for req in self.sched.step():
+                        self.stats["completed"] += 1
+                        self.stats["tokens"] += len(req.output)
+                        self._queues.pop(req.req_id, None)
+                except Exception as e:
+                    # last-resort fault path (validation keeps bad requests
+                    # out, so this is an engine/runtime failure): free every
+                    # page and tell waiting clients the truth — an error,
+                    # not a completion
+                    Logger.error(f"engine step failed: {e!r}")
+                    for req in list(self.sched.active) + list(self.sched.pending):
+                        if req.state is not None:
+                            self.engine.release(req.state)
+                            req.state = None
+                        req.done = True
+                        req.on_token = None
+                        q = self._queues.pop(req.req_id, None)
+                        if q is not None:
+                            q.put(("error", f"engine fault: {e!r}"))
+                    self.sched.active.clear()
+                    self.sched.pending.clear()
+
+    def _validate(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        """Range-check everything client-supplied BEFORE it reaches the
+        scheduler: a bad request must be a 400, never an assertion inside
+        an engine step that would take the whole batch down."""
+        prompt = body.get("prompt")
+        if not (isinstance(prompt, list) and prompt
+                and all(isinstance(t, int) and not isinstance(t, bool)
+                        for t in prompt)):
+            raise ValueError("prompt must be a non-empty list of token ids")
+        vocab = self.engine.cfg.vocab_size
+        if not all(0 <= t < vocab for t in prompt):
+            raise ValueError(f"prompt token ids must be in [0, {vocab})")
+        max_tokens = int(body.get("max_tokens", 16))
+        if not 1 <= max_tokens <= 1_000_000:
+            raise ValueError("max_tokens must be >= 1")
+        T = self.engine.pc.block_tokens
+        need = -(-(len(prompt) + max_tokens) // T)
+        if need > self.engine.pc.n_blocks:
+            raise ValueError(
+                f"prompt + max_tokens needs {need} KV pages; this engine "
+                f"has {self.engine.pc.n_blocks}"
+            )
+        temperature = float(body.get("temperature", 1.0))
+        if not 0.0 <= temperature <= 100.0:
+            raise ValueError("temperature must be in [0, 100]")
+        sample = "greedy" if temperature == 0.0 else (
+            str(body.get("sample", "categorical")))
+        if sample not in ("greedy", "categorical"):
+            raise ValueError("sample must be 'greedy' or 'categorical'")
+        top_k = int(body.get("top_k", 0))
+        if not 0 <= top_k <= vocab:
+            raise ValueError(f"top_k must be in [0, {vocab}]")
+        top_p = float(body.get("top_p", 1.0))
+        if not 0.0 < top_p <= 1.0:
+            raise ValueError("top_p must be in (0, 1]")
+        stops = body.get("stop_token_ids") or []
+        if stops and not all(isinstance(t, int) for t in stops):
+            raise ValueError("stop_token_ids must be token ids")
+        return {
+            "tokens": prompt, "max_new_tokens": max_tokens,
+            "eos_id": int(stops[0]) if stops else None,
+            "sample": sample,
+            # OpenAI convention: temperature 0 means greedy
+            "temperature": temperature or 1.0,
+            "top_k": top_k, "top_p": top_p,
+        }
+
+    def _submit_to_sched(self, item: Dict[str, Any]) -> None:
+        body, q = item["body"], item["q"]
+
+        def on_token(tokens: List[int], done: bool) -> None:
+            if tokens:
+                q.put(("tokens", list(tokens)))
+            if done:
+                q.put(("done", "stop"))
+
+        try:
+            kwargs = self._validate(body)
+            req_id = self.sched.submit(on_token=on_token, **kwargs)
+            self._queues[req_id] = q
+            q.put(("id", req_id))
+        except Exception as e:
+            q.put(("error", str(e)))
+
+    # -- metrics --
+
+    def metrics_text(self) -> str:
+        s = self.stats
+        lines = [
+            "# TYPE istpu_serve_requests_total counter",
+            f"istpu_serve_requests_total {s['requests']}",
+            "# TYPE istpu_serve_completed_total counter",
+            f"istpu_serve_completed_total {s['completed']}",
+            "# TYPE istpu_serve_tokens_total counter",
+            f"istpu_serve_tokens_total {s['tokens']}",
+            "# TYPE istpu_serve_free_kv_pages gauge",
+            f"istpu_serve_free_kv_pages {self.engine.free_pages}",
+        ]
+        return "\n".join(lines) + "\n"
+
+
+def _make_handler(server: ServingServer):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # route through our logger
+            Logger.debug("http " + fmt % args)
+
+        def _json(self, code: int, obj: Dict[str, Any]) -> None:
+            data = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):
+            if self.path == "/v1/models":
+                self._json(200, {"object": "list", "data": [
+                    {"id": server.model_id, "object": "model",
+                     "owned_by": "infinistore-tpu"}]})
+            elif self.path == "/metrics":
+                data = server.metrics_text().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+            else:
+                self._json(404, {"error": "not found"})
+
+        def do_POST(self):
+            if self.path != "/v1/completions":
+                self._json(404, {"error": "not found"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(n) or b"{}")
+            except ValueError:
+                self._json(400, {"error": "invalid JSON body"})
+                return
+            q = server.submit(body)
+            first = q.get()
+            if first[0] == "error":
+                self._json(400, {"error": first[1]})
+                return
+            req_id = first[1]
+            if body.get("stream"):
+                self._stream(req_id, q)
+            else:
+                self._collect(req_id, q)
+
+        def _client_gone(self) -> bool:
+            """A request-less peek at the socket: readable + EOF means the
+            client hung up (it sent nothing further on this connection)."""
+            import select
+            import socket as socketlib
+
+            try:
+                r, _, _ = select.select([self.connection], [], [], 0)
+                if not r:
+                    return False
+                return self.connection.recv(1, socketlib.MSG_PEEK) == b""
+            except OSError:
+                return True
+
+        def _collect(self, req_id: int, q: "queue.Queue") -> None:
+            tokens: List[int] = []
+            finish = "stop"
+            while True:
+                try:
+                    kind, val = q.get(timeout=1.0)
+                except queue.Empty:
+                    if self._client_gone():
+                        # nobody is waiting: free the batch slot + KV pages
+                        server.cancel(req_id)
+                        return
+                    continue
+                if kind == "tokens":
+                    tokens.extend(val)
+                elif kind == "error":
+                    self._json(500, {"error": val})
+                    return
+                elif kind == "done":
+                    finish = val
+                    break
+            try:
+                self._json(200, {
+                    "id": f"cmpl-{req_id}", "object": "text_completion",
+                    "model": server.model_id,
+                    "choices": [{"index": 0, "token_ids": tokens,
+                                 "finish_reason": finish}],
+                    "usage": {"completion_tokens": len(tokens)},
+                })
+            except (BrokenPipeError, ConnectionResetError):
+                pass  # finished anyway; nothing left to free
+
+        def _stream(self, req_id: int, q: "queue.Queue") -> None:
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.send_header("Connection", "close")
+            self.end_headers()
+            try:
+                while True:
+                    kind, val = q.get()
+                    if kind == "tokens":
+                        chunk = json.dumps({
+                            "id": f"cmpl-{req_id}",
+                            "object": "text_completion",
+                            "model": server.model_id,
+                            "choices": [{"index": 0, "token_ids": val,
+                                         "finish_reason": None}],
+                        })
+                        self.wfile.write(f"data: {chunk}\n\n".encode())
+                        self.wfile.flush()
+                    elif kind == "error":
+                        err = json.dumps({"error": val})
+                        self.wfile.write(f"data: {err}\n\n".encode())
+                        self.wfile.write(b"data: [DONE]\n\n")
+                        self.wfile.flush()
+                        return
+                    elif kind == "done":
+                        self.wfile.write(b"data: [DONE]\n\n")
+                        self.wfile.flush()
+                        return
+            except (BrokenPipeError, ConnectionResetError):
+                # client went away mid-stream: free its pages at the next
+                # chunk boundary; batchmates keep decoding
+                server.cancel(req_id)
+
+    return Handler
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser("infinistore_tpu.serve")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8000)
+    ap.add_argument("--model", default="tiny",
+                    help="'tiny' (random-init demo) or a local HF checkpoint dir")
+    ap.add_argument("--max-batch", type=int, default=8)
+    ap.add_argument("--n-blocks", type=int, default=512)
+    ap.add_argument("--block-tokens", type=int, default=16)
+    ap.add_argument("--prefill-chunk", type=int, default=None)
+    ap.add_argument("--log-level", default="info")
+    args = ap.parse_args(argv)
+    Logger.set_log_level(args.log_level)
+
+    import os
+
+    import jax
+
+    # honor an explicit JAX_PLATFORMS even where a platform plugin pinned
+    # jax_platforms at interpreter start (same rule as tests/conftest.py)
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    from .engine import InferenceEngine
+    from .kv import PagedCacheConfig
+    from .models import TINY, init_params
+
+    if args.model == "tiny":
+        cfg = TINY
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        model_id = "tiny"
+    else:
+        import transformers
+
+        from .models.hf import config_from_hf, params_from_hf
+
+        hf = transformers.AutoModelForCausalLM.from_pretrained(args.model)
+        cfg = config_from_hf(hf.config)
+        params = params_from_hf(hf, cfg)
+        model_id = args.model
+        del hf
+    pc = PagedCacheConfig(
+        n_layers=cfg.n_layers, n_kv_heads=cfg.n_kv_heads,
+        head_dim=cfg.head_dim, n_blocks=args.n_blocks,
+        block_tokens=args.block_tokens, dtype=cfg.dtype,
+    )
+    engine = InferenceEngine(params, cfg, pc, prefill_chunk=args.prefill_chunk)
+    srv = ServingServer(engine, host=args.host, port=args.port,
+                        max_batch=args.max_batch, model_id=model_id)
+    srv.start()
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        srv.close()
+
+
+if __name__ == "__main__":
+    main()

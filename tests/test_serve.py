@@ -1,0 +1,266 @@
+"""HTTP serving front-end: completions (batch + SSE streaming) over the
+continuous-batching scheduler must reproduce the engine's own outputs, and
+the server must survive concurrent clients and mid-stream disconnects."""
+
+import http.client
+import json
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from infinistore_tpu.engine import InferenceEngine
+from infinistore_tpu.kv import PagedCacheConfig
+from infinistore_tpu.models import TINY, init_params, prefill_forward, scaled
+from infinistore_tpu.serve import ServingServer
+
+CFG = scaled(TINY, dtype=jnp.float32)
+PARAMS = init_params(CFG, jax.random.PRNGKey(7))
+PROMPT = [11, 42, 7, 99, 5, 3, 17, 28, 64, 1, 2]
+
+
+def dense_greedy(tokens, n_steps):
+    toks = list(tokens)
+    out = []
+    for _ in range(n_steps):
+        logits, _ = prefill_forward(
+            PARAMS, CFG, jnp.asarray(toks, dtype=jnp.int32)[None]
+        )
+        nxt = int(jnp.argmax(logits[0, -1]))
+        out.append(nxt)
+        toks.append(nxt)
+    return out
+
+
+@pytest.fixture(scope="module")
+def server():
+    eng = InferenceEngine(
+        PARAMS, CFG,
+        PagedCacheConfig(
+            n_layers=CFG.n_layers, n_kv_heads=CFG.n_kv_heads,
+            head_dim=CFG.head_dim, n_blocks=64, block_tokens=4,
+            dtype=CFG.dtype,
+        ),
+    )
+    eng.decode_chunk = 4
+    srv = ServingServer(eng, port=0, max_batch=4, model_id="tiny-test")
+    srv.start()
+    yield srv
+    srv.close()
+
+
+def _post(port, body, timeout=120):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    conn.request("POST", "/v1/completions", json.dumps(body),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, json.loads(data)
+
+
+def test_completion_matches_greedy(server):
+    status, body = _post(server.port, {
+        "prompt": PROMPT, "max_tokens": 6, "temperature": 0,
+    })
+    assert status == 200, body
+    assert body["choices"][0]["token_ids"] == dense_greedy(PROMPT, 6)
+    assert body["choices"][0]["finish_reason"] == "stop"
+    assert body["usage"]["completion_tokens"] == 6
+
+
+def test_streaming_sse_matches_batch(server):
+    conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=120)
+    conn.request("POST", "/v1/completions", json.dumps({
+        "prompt": PROMPT[:7], "max_tokens": 8, "temperature": 0,
+        "stream": True,
+    }), {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    assert resp.status == 200
+    assert resp.getheader("Content-Type").startswith("text/event-stream")
+    tokens, done = [], False
+    buf = b""
+    while not done:
+        chunk = resp.read1(65536)
+        if not chunk:
+            break
+        buf += chunk
+        while b"\n\n" in buf:
+            event, buf = buf.split(b"\n\n", 1)
+            assert event.startswith(b"data: ")
+            payload = event[len(b"data: "):]
+            if payload == b"[DONE]":
+                done = True
+                break
+            tokens.extend(json.loads(payload)["choices"][0]["token_ids"])
+    conn.close()
+    assert done
+    assert tokens == dense_greedy(PROMPT[:7], 8)
+
+
+def test_concurrent_clients_batched(server):
+    prompts = [PROMPT, PROMPT[:5], PROMPT[:8], list(reversed(PROMPT))]
+    want = [dense_greedy(p, 5) for p in prompts]
+    got = [None] * len(prompts)
+    errs = []
+
+    def worker(i):
+        try:
+            status, body = _post(server.port, {
+                "prompt": prompts[i], "max_tokens": 5, "temperature": 0,
+            })
+            assert status == 200, body
+            got[i] = body["choices"][0]["token_ids"]
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(len(prompts))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=180)
+    assert not errs, errs
+    assert got == want
+
+
+def test_eos_and_sampling_params(server):
+    # learn what greedy emits, then set it as the stop token: generation
+    # must stop there (finish included)
+    ref = dense_greedy(PROMPT, 6)
+    eos = ref[2]
+    status, body = _post(server.port, {
+        "prompt": PROMPT, "max_tokens": 6, "temperature": 0,
+        "stop_token_ids": [eos],
+    })
+    assert status == 200
+    toks = body["choices"][0]["token_ids"]
+    assert toks == ref[:3] and toks[-1] == eos
+
+    # sampling path with nucleus: valid tokens, right count
+    status, body = _post(server.port, {
+        "prompt": PROMPT, "max_tokens": 4, "temperature": 0.9,
+        "top_p": 0.8, "top_k": 16,
+    })
+    assert status == 200
+    toks = body["choices"][0]["token_ids"]
+    assert len(toks) == 4 and all(0 <= t < CFG.vocab_size for t in toks)
+
+
+def test_bad_requests_rejected(server):
+    status, body = _post(server.port, {"prompt": "text not ids"})
+    assert status == 400 and "token ids" in body["error"]
+    status, body = _post(server.port, {"prompt": []})
+    assert status == 400
+    conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=30)
+    conn.request("POST", "/v1/completions", b"{not json", {})
+    resp = conn.getresponse()
+    assert resp.status == 400
+    resp.read()
+    conn.close()
+
+
+def test_models_and_metrics(server):
+    conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=30)
+    conn.request("GET", "/v1/models")
+    resp = conn.getresponse()
+    body = json.loads(resp.read())
+    assert resp.status == 200
+    assert body["data"][0]["id"] == "tiny-test"
+    conn.request("GET", "/metrics")
+    resp = conn.getresponse()
+    text = resp.read().decode()
+    conn.close()
+    assert resp.status == 200
+    assert "istpu_serve_requests_total" in text
+    assert "istpu_serve_free_kv_pages" in text
+
+
+def test_disconnect_mid_stream_frees_pages(server):
+    """Dropping the SSE connection cancels the request at the next chunk
+    boundary; its pages come back and the server keeps serving."""
+    free_before = server.engine.free_pages
+    conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=60)
+    conn.request("POST", "/v1/completions", json.dumps({
+        "prompt": PROMPT, "max_tokens": 64, "temperature": 0,
+        "stream": True,
+    }), {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    resp.read1(16)  # first bytes arrived: request is live
+    conn.close()    # hang up mid-generation
+
+    # the server must still answer, and the orphan's pages must free once
+    # the cancel lands
+    status, body = _post(server.port, {
+        "prompt": PROMPT[:5], "max_tokens": 4, "temperature": 0,
+    })
+    assert status == 200
+    assert body["choices"][0]["token_ids"] == dense_greedy(PROMPT[:5], 4)
+    deadline = 30
+    import time
+    while server.engine.free_pages < free_before and deadline > 0:
+        time.sleep(0.5)
+        deadline -= 0.5
+    assert server.engine.free_pages == free_before
+
+
+def test_param_validation_protects_batchmates(server):
+    """Out-of-range sampling params and impossible budgets are 400s at the
+    door — they must never reach an engine step (where they would take the
+    whole batch down)."""
+    for bad in (
+        {"prompt": PROMPT, "top_p": 1.5},
+        {"prompt": PROMPT, "top_p": 0},
+        {"prompt": PROMPT, "temperature": -1},
+        {"prompt": PROMPT, "sample": "nucleus"},
+        {"prompt": PROMPT, "top_k": -2},
+        {"prompt": PROMPT, "max_tokens": 0},
+        {"prompt": PROMPT, "max_tokens": 10_000},  # > total KV pages
+        {"prompt": [0, 999999]},  # out of vocab
+        {"prompt": [True, False]},  # bools are not token ids
+    ):
+        status, body = _post(server.port, bad)
+        assert status == 400, (bad, body)
+    # the server still serves fine afterwards
+    status, body = _post(server.port, {
+        "prompt": PROMPT, "max_tokens": 3, "temperature": 0})
+    assert status == 200
+    assert body["choices"][0]["token_ids"] == dense_greedy(PROMPT, 3)
+
+
+def test_greedy_requests_batch_despite_stray_params(server):
+    """temperature=0 normalizes stray top_k/top_p so greedy requests share
+    one lockstep batch and one compiled program."""
+    from infinistore_tpu.engine import Scheduler
+
+    sched = server.sched
+    assert isinstance(sched, Scheduler)
+    a = sched.submit(PROMPT, 1, sample="greedy", top_p=0.9, top_k=7)
+    b = sched.submit(PROMPT[:5], 1, sample="greedy", top_p=0.5)
+    ra = next(r for r in sched.pending if r.req_id == a)
+    rb = next(r for r in sched.pending if r.req_id == b)
+    assert Scheduler._group(ra) == Scheduler._group(rb)
+    sched.pending.remove(ra)
+    sched.pending.remove(rb)
+
+
+def test_top_p_values_share_one_compiled_program():
+    """top_p is a traced scalar: distinct values must NOT grow the decode
+    jit cache (a recompile per client value would be a DoS vector)."""
+    eng = InferenceEngine(
+        PARAMS, CFG,
+        PagedCacheConfig(
+            n_layers=CFG.n_layers, n_kv_heads=CFG.n_kv_heads,
+            head_dim=CFG.head_dim, n_blocks=64, block_tokens=4,
+            dtype=CFG.dtype,
+        ),
+    )
+    for i, p in enumerate((0.9, 0.91, 0.905, 0.5)):
+        st = eng.prefill(PROMPT[: 5 + i])
+        eng.decode(st, 2, sample="categorical", top_p=p,
+                   rng=jax.random.PRNGKey(i))
+        eng.release(st)
+    keys = set(eng._decode_many_cache)
+    assert keys == {(2, "categorical", 0, True)}, keys
