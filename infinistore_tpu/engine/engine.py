@@ -65,6 +65,7 @@ class SequenceState:
     chunk_keys: List[str]
     reused_chunks: int = 0
     last_logits: Optional[jax.Array] = None
+    adapter_id: int = 0  # LoRA adapter slot (0 = base model)
 
 
 class InferenceEngine:
@@ -84,6 +85,7 @@ class InferenceEngine:
         mesh=None,
         param_specs=None,
         pallas_tp: bool = False,
+        lora=None,
     ):
         """``prefill_fn``/``decode_fn`` plug in other model families with the
         same contracts as models.llama.prefill_forward / decode_forward
@@ -95,6 +97,14 @@ class InferenceEngine:
 
         ``kv_quant="int8"``: store/retrieve KV pages quantized (kv/quant.py)
         — half the bytes per hop; HBM pages stay full precision.
+
+        ``lora``: a ``models.lora.LoraBank`` enables multi-adapter serving —
+        every prefill/decode/verify dispatch takes a per-row adapter-id
+        vector, so one lockstep batch mixes adapters (the punica pattern);
+        requests pick an adapter via ``prefill(..., adapter_id=)`` /
+        ``Scheduler.submit(adapter_id=)``.  Adapter KV is namespaced in the
+        prefix cache and the store (an adapter's pages never serve another
+        adapter's prefix).  Built-in Llama family only.
 
         ``mesh``: a ``jax.sharding.Mesh`` with a ``tp`` axis turns this into
         a tensor-parallel serving engine: params are sharded Megatron-style
@@ -148,8 +158,19 @@ class InferenceEngine:
         # XLA attention path (models/attention.py rationale); prefill/decode
         # of every family take use_pallas for this reason
         pallas_kw = {"use_pallas": False} if mesh is not None else {}
+        self.lora = lora
+        lora_kw = {}
+        if lora is not None:
+            assert prefill_fn is None and decode_fn is None and verify_fn is None, (
+                "LoRA composes the built-in Llama family; custom families "
+                "must thread lora/adapter_ids through their own forwards"
+            )
+            lora_kw = {"lora_scale": lora.scale}
         self._prefill_jit = jax.jit(
-            partial(prefill_fn or prefill_forward, cfg=self.cfg, **pallas_kw)
+            partial(
+                prefill_fn or prefill_forward, cfg=self.cfg,
+                **pallas_kw, **lora_kw,
+            )
         )
         # pallas_tp: decode attention runs the Pallas kernel head-locally
         # inside a shard_map over tp instead of the partitioned XLA gather
@@ -163,7 +184,7 @@ class InferenceEngine:
             )
             decode_kw["tp_mesh"] = mesh
         self._decode_raw = partial(
-            decode_fn or decode_forward, cfg=self.cfg, **decode_kw
+            decode_fn or decode_forward, cfg=self.cfg, **decode_kw, **lora_kw
         )
         # a custom model family must bring its own verify step: silently
         # binding llama's verify_forward to foreign params would die deep in
@@ -180,7 +201,10 @@ class InferenceEngine:
             if "use_pallas" in inspect.signature(verify_fn).parameters:
                 verify_kw = {"use_pallas": False}
         self._verify_jit = jax.jit(
-            partial(verify_fn or verify_forward, cfg=self.cfg, **verify_kw),
+            partial(
+                verify_fn or verify_forward, cfg=self.cfg,
+                **verify_kw, **lora_kw,
+            ),
             donate_argnames=("cache",),
         )
         # tokens per compiled decode dispatch; the scan length is static so

@@ -149,10 +149,31 @@ def rmsnorm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
     return (x32 * scale).astype(x.dtype) * w
 
 
-def _attn_qkv(layer: Params, cfg: LlamaConfig, x: jax.Array, positions: jax.Array):
+def _lora_term(x, lora, name, ids, scale):
+    """Batched adapter delta for one projection (models/lora.py), or 0."""
+    if lora is None or name not in lora:
+        return 0
+    from .lora import lora_delta
+
+    A, B = lora[name]
+    return lora_delta(x, A, B, ids, scale)
+
+
+def _layer_lora(bank_tree, li: int):
+    from .lora import layer_lora
+
+    return layer_lora(bank_tree, li)
+
+
+def _attn_qkv(layer: Params, cfg: LlamaConfig, x: jax.Array, positions: jax.Array,
+              lora=None, adapter_ids=None, lora_scale: float = 1.0):
     B, S, _ = x.shape
     hd = cfg.head_dim
     q, k, v = x @ layer["wq"], x @ layer["wk"], x @ layer["wv"]
+    if lora is not None:
+        q = q + _lora_term(x, lora, "wq", adapter_ids, lora_scale)
+        k = k + _lora_term(x, lora, "wk", adapter_ids, lora_scale)
+        v = v + _lora_term(x, lora, "wv", adapter_ids, lora_scale)
     if cfg.attn_bias:
         q, k, v = q + layer["bq"], k + layer["bk"], v + layer["bv"]
     q = q.reshape(B, S, cfg.n_heads, hd)
@@ -184,6 +205,9 @@ def prefill_forward(
     prefix_kv: jax.Array | None = None,
     use_pallas: bool = True,
     prefix_len: jax.Array | None = None,
+    lora=None,
+    adapter_ids: jax.Array | None = None,
+    lora_scale: float = 1.0,
 ) -> Tuple[jax.Array, jax.Array]:
     """tokens: [B, S] -> (logits [B, S, V], kv [L, 2, B, S, Hkv, D]).
 
@@ -210,8 +234,11 @@ def prefill_forward(
     kvs = []
     for li in range(cfg.n_layers):
         layer = _layer(li)(params["layers"])
+        ll = None if lora is None else _layer_lora(lora, li)
         h = rmsnorm(x, layer["ln_attn"], cfg.norm_eps)
-        q, k, v = _attn_qkv(layer, cfg, h, positions)
+        q, k, v = _attn_qkv(layer, cfg, h, positions,
+                            lora=ll, adapter_ids=adapter_ids,
+                            lora_scale=lora_scale)
         kvs.append(jnp.stack([k, v], axis=0))  # [2, B, S, Hkv, D]
         if prefix_kv is None:
             attn = causal_attention(
@@ -225,7 +252,8 @@ def prefill_forward(
                 prefix_pad=P if prefix_len is not None else None,
                 prefix_len=prefix_len, window=cfg.sliding_window,
             )
-        x = x + attn.reshape(B, S, -1) @ layer["wo"]
+        a = attn.reshape(B, S, -1)
+        x = x + a @ layer["wo"] + _lora_term(a, ll, "wo", adapter_ids, lora_scale)
         h = rmsnorm(x, layer["ln_mlp"], cfg.norm_eps)
         x = x + _mlp(layer, h)
     x = rmsnorm(x, params["ln_out"], cfg.norm_eps)
@@ -245,6 +273,9 @@ def decode_forward(
     slot_ids: jax.Array,
     use_pallas: bool = True,
     tp_mesh=None,
+    lora=None,
+    adapter_ids: jax.Array | None = None,
+    lora_scale: float = 1.0,
 ) -> Tuple[jax.Array, jax.Array]:
     """Single-token paged decode.
 
@@ -268,15 +299,18 @@ def decode_forward(
     pos = positions[:, None]
     for li in range(cfg.n_layers):
         layer = _layer(li)(params["layers"])
+        ll = None if lora is None else _layer_lora(lora, li)
         h = rmsnorm(x, layer["ln_attn"], cfg.norm_eps)
-        q, k, v = _attn_qkv(layer, cfg, h, pos)
+        q, k, v = _attn_qkv(layer, cfg, h, pos, lora=ll,
+                            adapter_ids=adapter_ids, lora_scale=lora_scale)
         # scatter this token's kv into its page slot
         cache = write_token_kv(cache, li, slot_block_ids, slot_ids, k[:, 0], v[:, 0])
         attn = paged_decode_attention(
             q[:, 0], cache[li], block_table, seq_lens, allow_pallas=use_pallas,
             tp_mesh=tp_mesh, window=cfg.sliding_window,
         )
-        x = x + (attn.reshape(B, -1) @ layer["wo"])[:, None, :]
+        a = attn.reshape(B, -1)[:, None, :]
+        x = x + a @ layer["wo"] + _lora_term(a, ll, "wo", adapter_ids, lora_scale)
         h = rmsnorm(x, layer["ln_mlp"], cfg.norm_eps)
         x = x + _mlp(layer, h)
     x = rmsnorm(x, params["ln_out"], cfg.norm_eps)
@@ -293,6 +327,9 @@ def verify_forward(
     block_table: jax.Array,
     slot_block_ids: jax.Array,
     slot_ids: jax.Array,
+    lora=None,
+    adapter_ids: jax.Array | None = None,
+    lora_scale: float = 1.0,
 ) -> Tuple[jax.Array, jax.Array]:
     """Multi-token paged step: process a short run of tokens against the
     paged cache in ONE forward (the speculative-decode verify step — the
@@ -311,13 +348,16 @@ def verify_forward(
     x = params["embed"][tokens]  # [B, S, dim]
     for li in range(cfg.n_layers):
         layer = _layer(li)(params["layers"])
+        ll = None if lora is None else _layer_lora(lora, li)
         h = rmsnorm(x, layer["ln_attn"], cfg.norm_eps)
-        q, k, v = _attn_qkv(layer, cfg, h, positions)
+        q, k, v = _attn_qkv(layer, cfg, h, positions, lora=ll,
+                            adapter_ids=adapter_ids, lora_scale=lora_scale)
         cache = write_tokens_kv(cache, li, slot_block_ids, slot_ids, k, v)
         attn = paged_multitoken_attention_xla(
             q, cache[li], block_table, positions, window=cfg.sliding_window
         )
-        x = x + attn.reshape(B, S, -1) @ layer["wo"]
+        a = attn.reshape(B, S, -1)
+        x = x + a @ layer["wo"] + _lora_term(a, ll, "wo", adapter_ids, lora_scale)
         h = rmsnorm(x, layer["ln_mlp"], cfg.norm_eps)
         x = x + _mlp(layer, h)
     x = rmsnorm(x, params["ln_out"], cfg.norm_eps)
