@@ -2,9 +2,13 @@
 
 Start a server first:
     python -m infinistore_tpu.serve --model tiny --port 8000
+or, for text in / text out, point it at an HF checkpoint dir (its tokenizer
+is loaded automatically; --tokenizer overrides):
+    python -m infinistore_tpu.serve --model /path/to/llama --port 8000
 
 Then:
-    python examples/serve_client.py --port 8000
+    python examples/serve_client.py --port 8000                    # token ids
+    python examples/serve_client.py --port 8000 --text "Hello"     # text
 """
 
 import argparse
@@ -16,6 +20,12 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=8000)
+    ap.add_argument("--text", default=None,
+                    help="send a STRING prompt (server must have a "
+                         "tokenizer); responses then carry text")
+    ap.add_argument("--stop", default=None,
+                    help="stop string (text mode): output is truncated "
+                         "before its first occurrence")
     args = ap.parse_args()
 
     conn = http.client.HTTPConnection(args.host, args.port, timeout=300)
@@ -24,15 +34,21 @@ def main():
     conn.request("GET", "/v1/models")
     print("models:", json.loads(conn.getresponse().read()))
 
-    # one-shot completion (token ids in, token ids out; temperature 0 =
-    # greedy — pair with your tokenizer of choice outside the engine)
-    prompt = [11, 42, 7, 99, 5, 3, 17, 28]
-    conn.request("POST", "/v1/completions", json.dumps({
-        "prompt": prompt, "max_tokens": 16, "temperature": 0,
-    }), {"Content-Type": "application/json"})
-    print("completion:", json.loads(conn.getresponse().read()))
+    # prompt: a string when the server has a tokenizer, else token ids
+    prompt = args.text if args.text is not None else [11, 42, 7, 99, 5, 3, 17, 28]
 
-    # streaming (SSE): tokens arrive at decode-chunk granularity
+    # one-shot completion (temperature 0 = greedy)
+    body = {"prompt": prompt, "max_tokens": 16, "temperature": 0}
+    if args.stop:
+        body["stop"] = [args.stop]
+    conn.request("POST", "/v1/completions", json.dumps(body),
+                 {"Content-Type": "application/json"})
+    out = json.loads(conn.getresponse().read())
+    choice = out["choices"][0]
+    print("completion:", choice.get("text", choice["token_ids"]))
+
+    # streaming (SSE): deltas arrive at decode-chunk granularity — text
+    # deltas when the server detokenizes, token ids otherwise
     conn.request("POST", "/v1/completions", json.dumps({
         "prompt": prompt, "max_tokens": 16, "temperature": 0.8,
         "top_p": 0.95, "stream": True,
@@ -51,7 +67,8 @@ def main():
                 print("stream: [DONE]")
                 conn.close()
                 return
-            print("stream:", json.loads(payload)["choices"][0]["token_ids"])
+            c = json.loads(payload)["choices"][0]
+            print("stream:", c.get("text", c["token_ids"]))
 
 
 if __name__ == "__main__":

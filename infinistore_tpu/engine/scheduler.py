@@ -4,11 +4,12 @@ The reference leaves request scheduling to vLLM; a standalone serving stack
 needs one.  Model: requests are admitted and retired only at decode-chunk
 boundaries, and every in-flight request decodes in lockstep through
 ``InferenceEngine.decode_batch``.  Chunk lengths are powers of two capped at
-``engine.decode_chunk`` and a batch only mixes requests with identical
-sampling params, so the jit cache stays bounded by ``max_batch`` batch
-shapes x log2(decode_chunk)+1 scan lengths — the TPU analog of vLLM's
-CUDA-graph batch-size buckets.  A request whose budget ends mid-chunk
-decodes to the boundary and is trimmed at retirement.
+``engine.decode_chunk``; sampling params ride into the compiled decode as
+per-row traced vectors, so admission is pure FIFO and mixed-params requests
+share one lockstep batch while the jit cache stays bounded by ``max_batch``
+batch shapes x log2(decode_chunk)+1 scan lengths x 3 sampling variants — the
+TPU analog of vLLM's CUDA-graph batch-size buckets.  A request whose budget
+ends mid-chunk decodes to the boundary and is trimmed at retirement.
 
 Flow per ``step()``:
 1. admit pending requests up to ``max_batch`` (prefill runs immediately,
@@ -33,7 +34,10 @@ class Request:
     req_id: int
     tokens: List[int]
     max_new_tokens: int
-    eos_id: Optional[int] = None
+    # generation stops at the FIRST occurrence of ANY of these token ids
+    # (vLLM stop_token_ids semantics; ``eos_id`` kept as the single-id
+    # convenience spelling)
+    eos_ids: Optional[List[int]] = None
     sample: str = "greedy"
     temperature: float = 1.0
     top_k: int = 0
@@ -69,6 +73,7 @@ class Scheduler:
         tokens: Sequence[int],
         max_new_tokens: int,
         eos_id: Optional[int] = None,
+        eos_ids: Optional[Sequence[int]] = None,
         sample: str = "greedy",
         temperature: float = 1.0,
         top_k: int = 0,
@@ -80,9 +85,12 @@ class Scheduler:
             # lockstep batch (and one compiled program) regardless of the
             # stray sampling params clients send alongside temperature 0
             temperature, top_k, top_p = 1.0, 0, 1.0
+        stops = list(eos_ids) if eos_ids else []
+        if eos_id is not None and eos_id not in stops:
+            stops.append(eos_id)
         req = Request(
             req_id=self._next_id, tokens=list(tokens),
-            max_new_tokens=max_new_tokens, eos_id=eos_id,
+            max_new_tokens=max_new_tokens, eos_ids=stops or None,
             sample=sample, temperature=temperature, top_k=top_k,
             top_p=top_p, on_token=on_token,
         )
@@ -109,10 +117,14 @@ class Scheduler:
     @staticmethod
     def _visible_len(req: Request) -> int:
         """Tokens of ``req.output`` that will survive retirement trimming
-        (stop at eos, cap at budget) — the streaming horizon."""
+        (stop at the FIRST of any stop id, cap at budget) — the streaming
+        horizon."""
         out = req.output
-        if req.eos_id is not None and req.eos_id in out:
-            return min(out.index(req.eos_id) + 1, req.max_new_tokens)
+        if req.eos_ids:
+            stops = set(req.eos_ids)
+            for i, t in enumerate(out):
+                if t in stops:
+                    return min(i + 1, req.max_new_tokens)
         return min(len(out), req.max_new_tokens)
 
     def _stream(self, req: Request, done: bool) -> None:
@@ -142,26 +154,15 @@ class Scheduler:
     def has_work(self) -> bool:
         return bool(self.pending or self.active)
 
-    @staticmethod
-    def _group(req: Request):
-        # one lockstep dispatch shares a single compiled sampling program, so
-        # a batch only holds requests with identical sampling params
-        return (req.sample, req.temperature, req.top_k, req.top_p)
-
     def _admit(self) -> None:
-        if not self.active and self.pending:
-            key = self._group(self.pending[0])
-        elif self.active:
-            key = self._group(self.active[0])
-        else:
+        # sampling params are per-row traced vectors in the compiled decode
+        # (engine._decode_many), so admission is pure FIFO — a greedy request
+        # and a top-p request share one lockstep batch
+        if not self.pending:
             return
         admit: List[Request] = []
-        i = 0
-        while i < len(self.pending) and len(self.active) + len(admit) < self.max_batch:
-            if self._group(self.pending[i]) == key:
-                admit.append(self.pending.pop(i))
-            else:
-                i += 1  # different sampling params: wait for this batch
+        while self.pending and len(self.active) + len(admit) < self.max_batch:
+            admit.append(self.pending.pop(0))
         # one padded forward per length bucket for the admission wave (falls
         # back to per-sequence prefill when store reuse applies).  The wave
         # is first sized against the allocator host-side (no wasted device
@@ -204,7 +205,7 @@ class Scheduler:
         still: List[Request] = []
         for req in self.active:
             out = req.output
-            hit_eos = req.eos_id is not None and req.eos_id in out
+            hit_eos = bool(req.eos_ids) and not set(req.eos_ids).isdisjoint(out)
             if req.cancelled or hit_eos or len(out) >= req.max_new_tokens:
                 del out[self._visible_len(req):]
                 req.done = True
@@ -229,7 +230,6 @@ class Scheduler:
         if any(r.cancelled for r in self.active):
             # retire cancellations before burning a decode chunk on them
             return self._retire()
-        head = self.active[0]
         # chunk lengths are powers of two capped at decode_chunk, so the jit
         # cache holds at most log2(decode_chunk)+1 scan lengths per batch
         # shape; a request whose budget lands mid-chunk decodes to the chunk
@@ -243,8 +243,11 @@ class Scheduler:
         try:
             outs = self.engine.decode_batch(
                 [r.state for r in self.active], chunk,
-                sample=head.sample, temperature=head.temperature,
-                top_k=head.top_k, top_p=head.top_p, rng=sub,
+                sample=[r.sample for r in self.active],
+                temperature=[r.temperature for r in self.active],
+                top_k=[r.top_k for r in self.active],
+                top_p=[r.top_p for r in self.active],
+                rng=sub,
             )
         except MemoryError:
             # decode-time page exhaustion: shed the newest request back to

@@ -1,4 +1,4 @@
-"""Greedy speculative decoding: a draft model proposes, the target verifies.
+"""Speculative decoding: a draft model proposes, the target verifies.
 
 The reference serves through vLLM, whose speculative mode is a headline
 throughput feature; ours is rebuilt on the paged TPU engine.  Per round:
@@ -8,25 +8,40 @@ throughput feature; ours is rebuilt on the paged TPU engine.  Per round:
 2. the TARGET engine scores ``[last_accepted_token, p_1..p_k]`` in ONE
    multi-token paged forward (``InferenceEngine.verify``) — one dispatch
    instead of ``k``;
-3. proposals are accepted while they match the target's greedy choice, then
-   the target's own next token is appended (so every round emits between 1
-   and k+1 tokens);
+3. proposals are accepted per the decision rule (below), then a token from
+   the target's own distribution is appended, so every round emits between
+   1 and k+1 tokens;
 4. the draft is resynced by verifying the accepted tail against its own
    cache (rewrites of already-correct slots are harmless — position-masked
    attention and slot overwrite semantics, see ``verify``'s docstring).
 
-Output is the target's greedy decode — speculation changes the dispatch
-count, not the decision rule (property-tested in tests/test_speculative.py).
-Exactness holds to the extent the verify forward's numerics match the scan
-decode's: in bf16 the batched einsum's reduction order can flip an argmax
-between near-tied logits, so low-precision serving should treat the
-guarantee as statistical rather than bitwise.
+Decision rules:
+
+* ``sample="greedy"`` (default): accept while the proposal matches the
+  target's argmax; output is EXACTLY the target's greedy decode —
+  speculation changes the dispatch count, not the decision rule
+  (property-tested in tests/test_speculative.py).  Exactness holds to the
+  extent the verify forward's numerics match the scan decode's: in bf16 the
+  batched einsum's reduction order can flip an argmax between near-tied
+  logits, so low-precision serving should treat the guarantee as
+  statistical rather than bitwise.
+* ``sample="categorical"``: REJECTION SAMPLING (Leviathan et al. 2023 /
+  the vLLM rule): draft token ``x_i ~ q_i`` is accepted with probability
+  ``min(1, p_i(x_i) / q_i(x_i))``; on the first rejection a replacement is
+  drawn from the residual ``norm(max(p_i - q_i, 0))`` and the round ends;
+  if all ``k`` survive, a bonus token is drawn from ``p_{k+1}``.  This
+  provably makes every emitted token an exact sample from the target's
+  post-truncation distribution (temperature / top-k / top-p included —
+  both p and q come from the same ``_truncate_logits`` math), regardless
+  of draft quality.  Statistically verified in tests/test_speculative.py
+  (chi-squared over the support).
 """
 
 from __future__ import annotations
 
-from typing import List, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -50,6 +65,7 @@ class SpeculativeDecoder:
         self.rounds = 0
         self.accepted = 0
         self.proposed = 0
+        self._rng = jax.random.PRNGKey(0)
 
     def prefill(self, tokens: Sequence[int]) -> Tuple[SequenceState, SequenceState]:
         return self.target.prefill(tokens), self.draft.prefill(tokens)
@@ -71,27 +87,86 @@ class SpeculativeDecoder:
         st_t: SequenceState,
         st_d: SequenceState,
         n_steps: int,
+        sample: str = "greedy",
+        temperature: float = 1.0,
+        top_k: int = 0,
+        top_p: float = 1.0,
+        rng: Optional[jax.Array] = None,
     ) -> List[int]:
-        """Emit exactly ``n_steps`` tokens (greedy-equivalent to
-        ``target.decode(st_t, n_steps)``)."""
+        """Emit exactly ``n_steps`` tokens.  Greedy mode is equivalent to
+        ``target.decode(st_t, n_steps)``; categorical mode draws every token
+        from the target's post-truncation sampling distribution (rejection
+        sampling — see module docstring)."""
+        assert sample in ("greedy", "categorical"), sample
+        if rng is None:
+            self._rng, rng = jax.random.split(self._rng)
         out: List[int] = []
         while len(out) < n_steps:
             k = self.k
-            # 1. draft proposes k tokens (advances st_d by k)
-            proposals = self.draft.decode(st_d, k)
+            if sample == "greedy":
+                # 1. draft proposes k tokens (advances st_d by k)
+                proposals = self.draft.decode(st_d, k)
 
-            # 2. target scores [prev_token, p_1..p_k] in one dispatch; row j
-            #    gives the target's choice AFTER consuming that row's token
-            prev = st_t.tokens[-1]
-            run = [prev] + proposals
-            logits = self.target.verify(st_t, run, len(st_t.tokens) - 1)
-            choices = np.asarray(jnp.argmax(logits, axis=-1))  # [k+1]
+                # 2. target scores [prev_token, p_1..p_k] in one dispatch;
+                #    row j gives the target's choice AFTER consuming that
+                #    row's token
+                prev = st_t.tokens[-1]
+                run = [prev] + proposals
+                logits = self.target.verify(st_t, run, len(st_t.tokens) - 1)
+                choices = np.asarray(jnp.argmax(logits, axis=-1))  # [k+1]
 
-            # 3. accept while the draft agreed, then take the target's token
-            m = 0
-            while m < k and proposals[m] == int(choices[m]):
-                m += 1
-            emitted = proposals[:m] + [int(choices[m])]
+                # 3. accept while the draft agreed, then take the target's
+                #    token
+                m = 0
+                while m < k and proposals[m] == int(choices[m]):
+                    m += 1
+                emitted = proposals[:m] + [int(choices[m])]
+            else:
+                rng, r_draft, r_accept = jax.random.split(rng, 3)
+                # 1. draft samples k tokens AND the exact distributions they
+                #    came from (q_i after temperature/top-k/top-p)
+                proposals, q = self.draft.propose(
+                    st_d, k, temperature=temperature, top_k=top_k,
+                    top_p=top_p, rng=r_draft,
+                )
+
+                # 2. target distributions p_1..p_{k+1} from one verify
+                prev = st_t.tokens[-1]
+                run = [prev] + proposals
+                logits = self.target.verify(st_t, run, len(st_t.tokens) - 1)
+                p = np.asarray(
+                    self.target.sampling_probs(
+                        logits, temperature=temperature, top_k=top_k,
+                        top_p=top_p,
+                    ),
+                    dtype=np.float64,
+                )  # [k+1, V]
+                q = np.asarray(q, dtype=np.float64)  # [k, V]
+
+                # 3. accept x_i with prob min(1, p_i(x_i)/q_i(x_i)); first
+                #    rejection resamples from the residual and ends the round
+                us = np.asarray(jax.random.uniform(r_accept, (k + 1,)))
+                m = 0
+                replacement = None
+                while m < k:
+                    x = proposals[m]
+                    qx = q[m, x]
+                    accept = qx > 0 and us[m] < min(1.0, p[m, x] / qx)
+                    if not accept:
+                        residual = np.maximum(p[m] - q[m], 0.0)
+                        tot = residual.sum()
+                        if tot <= 0:
+                            # p <= q everywhere reachable: p's support is
+                            # contained in q's and the densities match there;
+                            # draw from p directly
+                            residual, tot = p[m], p[m].sum()
+                        replacement = self._draw(residual / tot, us[k])
+                        break
+                    m += 1
+                if replacement is None:  # all k accepted: bonus token
+                    replacement = self._draw(p[k], us[k])
+                emitted = proposals[:m] + [int(replacement)]
+
             self.rounds += 1
             self.proposed += k
             self.accepted += m
@@ -113,9 +188,17 @@ class SpeculativeDecoder:
         )[-1]
         return out
 
-    def generate(self, tokens: Sequence[int], n_steps: int) -> List[int]:
+    @staticmethod
+    def _draw(probs: np.ndarray, u: float) -> int:
+        """Inverse-CDF draw from a host-side probability vector using a
+        uniform already consumed from the jax stream (keeps all randomness
+        on one key-split discipline)."""
+        cdf = np.cumsum(probs)
+        return int(np.searchsorted(cdf, u * cdf[-1], side="right").clip(0, len(probs) - 1))
+
+    def generate(self, tokens: Sequence[int], n_steps: int, **kw) -> List[int]:
         st_t, st_d = self.prefill(tokens)
-        return self.decode(st_t, st_d, n_steps)
+        return self.decode(st_t, st_d, n_steps, **kw)
 
     @property
     def acceptance_rate(self) -> float:

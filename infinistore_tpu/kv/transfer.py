@@ -84,31 +84,29 @@ class KVTransferEngine:
             k for k, _ in self._page_blocks(chunk_keys_, 0, self.cfg.n_layers)
         ]
 
-    def save_pages(
-        self, cache: jax.Array, block_ids: Sequence[int], chunk_keys_: Sequence[str]
-    ) -> int:
-        """Gather pages from HBM and put them into the store.
-
-        ``block_ids[i]`` holds the page whose key stem is ``chunk_keys_[i]``.
-        Returns bytes written.
-        """
-        assert len(block_ids) == len(chunk_keys_)
-        n = len(block_ids)
-        if n == 0:
-            return 0
+    def gather_pages(self, cache: jax.Array, block_ids: Sequence[int]) -> jax.Array:
+        """Device-side half of a save: fused gather (+ transpose, + int8
+        quantize) of ``block_ids``'s pages — dispatch-only, returns a small
+        device array [L, n, ...] so a caller can snapshot pages mid-prefill
+        (jax arrays are immutable) and hand them to a background pusher
+        while the next chunk computes."""
         ids = jnp.asarray(np.asarray(block_ids, dtype=np.int32))
         gathered = read_pages(cache, ids)  # [L, 2, H, n, T, D]
         # -> [L, n, 2, H, T, D] so each (layer, chunk) page is contiguous
         pages = jnp.transpose(gathered, (0, 3, 1, 2, 4, 5))
         if self.quant:
-            # fuse quantize+pack on device; the D2H below then moves half
-            # the bytes (the packed rows ARE the wire pages)
+            # fuse quantize+pack on device; the D2H then moves half the
+            # bytes (the packed rows ARE the wire pages)
             pages = quantize_pages(pages)  # [L, n, wire_page_bytes] uint8
-        # Split into layer bands, start every band's D2H up front
-        # (copy_to_host_async), then write band i into the pool while bands
-        # i+1.. are still streaming device->host.  Each band's host array
-        # pointer goes straight to the put, so the only synchronous host
-        # copy is the client->pool write (the RDMA-WRITE analog).
+        return pages
+
+    def push_pages(self, pages: jax.Array, chunk_keys_: Sequence[str]) -> int:
+        """Host-side half of a save: move gathered pages D2H and put them
+        into the store.  Split into layer bands, start every band's D2H up
+        front (copy_to_host_async), then write band i into the pool while
+        bands i+1.. are still streaming device->host.  Each band's host
+        array pointer goes straight to the put, so the only synchronous
+        host copy is the client->pool write (the RDMA-WRITE analog)."""
         L = self.cfg.n_layers
         pb = self.wire_page_bytes
         G = max(1, min(self.pipeline_groups, L))
@@ -124,6 +122,21 @@ class KVTransferEngine:
             self.conn.write_cache(blocks, pb, host.ctypes.data)
             total += host.nbytes
         return total
+
+    def save_pages(
+        self, cache: jax.Array, block_ids: Sequence[int], chunk_keys_: Sequence[str]
+    ) -> int:
+        """Gather pages from HBM and put them into the store.
+
+        ``block_ids[i]`` holds the page whose key stem is ``chunk_keys_[i]``.
+        Returns bytes written.
+        """
+        assert len(block_ids) == len(chunk_keys_)
+        if len(block_ids) == 0:
+            return 0
+        return self.push_pages(
+            self.gather_pages(cache, block_ids), chunk_keys_
+        )
 
     def load_pages(
         self, cache: jax.Array, block_ids: Sequence[int], chunk_keys_: Sequence[str]

@@ -10,13 +10,19 @@ mile.  Design (stdlib only, like the store's manage plane — server.py):
   list guarded by a condition variable (submissions, cancellations) and
   per-request ``queue.Queue``s (token delivery), so JAX dispatch never runs
   concurrently;
-* ``POST /v1/completions`` — body ``{"prompt": [token ids], "max_tokens",
-  "temperature", "top_p", "top_k", "stop_token_ids": [eos], "stream"}``.
-  Prompts are token ids: tokenization is deliberately outside the engine
-  (the reference's vLLM pairs with an external tokenizer the same way when
-  driven over RPC).  Non-streaming answers one JSON body; ``"stream": true``
+* ``POST /v1/completions`` — body ``{"prompt": "text" | [token ids],
+  "max_tokens", "temperature", "top_p", "top_k", "stop": "s" | [..],
+  "stop_token_ids": [..], "stream"}``.  With a tokenizer attached
+  (``--tokenizer`` / the checkpoint's own), string prompts are encoded and
+  responses carry detokenized ``"text"`` next to ``"token_ids"``; string
+  ``stop`` sequences are honored vLLM-style (output truncated BEFORE the
+  stop string), and EVERY entry of ``stop_token_ids`` stops generation
+  (first occurrence wins).  Token-id prompts keep working without any
+  tokenizer.  Non-streaming answers one JSON body; ``"stream": true``
   answers Server-Sent Events (``data: {...}``, final ``data: [DONE]``) at
-  decode-chunk granularity, riding the scheduler's ``on_token`` hook;
+  decode-chunk granularity, riding the scheduler's ``on_token`` hook —
+  streamed events carry text deltas, holding back any tail that could
+  still become a stop string or an incomplete UTF-8 sequence;
 * ``GET /v1/models`` — model card; ``GET /metrics`` — Prometheus text
   (requests served/active, tokens generated, free KV pages).
 
@@ -41,9 +47,14 @@ class ServingServer:
     """Owns the engine thread and the HTTP server."""
 
     def __init__(self, engine, host: str = "127.0.0.1", port: int = 8000,
-                 max_batch: int = 8, model_id: str = "infinistore-tpu"):
+                 max_batch: int = 8, model_id: str = "infinistore-tpu",
+                 tokenizer=None):
+        """``tokenizer``: any object with ``encode(str) -> [int]`` and
+        ``decode([int]) -> str`` (an HF tokenizer qualifies) — enables
+        string prompts, text responses, and string stop sequences."""
         self.engine = engine
         self.model_id = model_id
+        self.tokenizer = tokenizer
         self.sched = Scheduler(engine, max_batch=max_batch)
         self._cv = threading.Condition()
         self._staged: List[Dict[str, Any]] = []   # submissions from handlers
@@ -138,10 +149,21 @@ class ServingServer:
         scheduler: a bad request must be a 400, never an assertion inside
         an engine step that would take the whole batch down."""
         prompt = body.get("prompt")
+        if isinstance(prompt, str):
+            if self.tokenizer is None:
+                raise ValueError(
+                    "string prompt requires a tokenizer (start the server "
+                    "with --tokenizer); send a list of token ids instead"
+                )
+            if not prompt:
+                raise ValueError("prompt must be non-empty")
+            prompt = [int(t) for t in self.tokenizer.encode(prompt)]
         if not (isinstance(prompt, list) and prompt
                 and all(isinstance(t, int) and not isinstance(t, bool)
                         for t in prompt)):
-            raise ValueError("prompt must be a non-empty list of token ids")
+            raise ValueError(
+                "prompt must be a non-empty string or list of token ids"
+            )
         vocab = self.engine.cfg.vocab_size
         if not all(0 <= t < vocab for t in prompt):
             raise ValueError(f"prompt token ids must be in [0, {vocab})")
@@ -171,9 +193,21 @@ class ServingServer:
         stops = body.get("stop_token_ids") or []
         if stops and not all(isinstance(t, int) for t in stops):
             raise ValueError("stop_token_ids must be token ids")
+        stop = body.get("stop") or []
+        if isinstance(stop, str):
+            stop = [stop]
+        if not (isinstance(stop, list)
+                and all(isinstance(s, str) and s for s in stop)):
+            raise ValueError("stop must be a string or list of strings")
+        if stop and self.tokenizer is None:
+            raise ValueError(
+                "string stop sequences require a tokenizer; use "
+                "stop_token_ids instead"
+            )
         return {
             "tokens": prompt, "max_new_tokens": max_tokens,
-            "eos_id": int(stops[0]) if stops else None,
+            # the FULL stop list (first occurrence of any id stops)
+            "eos_ids": [int(t) for t in stops] or None,
             "sample": sample,
             # OpenAI convention: temperature 0 means greedy
             "temperature": temperature or 1.0,
@@ -212,6 +246,132 @@ class ServingServer:
             f"istpu_serve_free_kv_pages {self.engine.free_pages}",
         ]
         return "\n".join(lines) + "\n"
+
+
+_REPL = "�"  # tokenizers emit U+FFFD for incomplete multibyte output
+
+
+class _TextAccum:
+    """Incremental detokenization with vLLM stop-string semantics.
+
+    * The decoded text grows by APPEND-ONLY deltas computed with the
+      two-offset incremental scheme (``convert_ids_to_tokens`` /
+      ``convert_tokens_to_string`` — the vLLM detokenizer pattern, exact
+      for SentencePiece/BPE where a plain ``decode`` of an id slice is
+      not), so per-chunk cost is O(chunk), not O(total output).  A
+      tokenizer without that API falls back to full re-decode per chunk.
+    * The output is truncated BEFORE the earliest stop-string match —
+      both the text AND the visible token ids (``visible_ids``).
+    * Streamed deltas hold back any tail that could still grow into a
+      stop string or an incomplete UTF-8 sequence.
+    """
+
+    def __init__(self, tokenizer, stop_strs: List[str]):
+        self.tok = tokenizer
+        self.stops = [s for s in stop_strs if s]
+        self.hold = max((len(s) - 1 for s in self.stops), default=0)
+        self.ids: List[int] = []
+        self.emitted = 0  # chars already released downstream
+        self.stop_cut: Optional[int] = None  # char index of the stop match
+        self._text = ""  # decoded so far (append-only on the incr path)
+        # (ids consumed, text length) milestones: maps the stop's char cut
+        # back to the id prefix whose decode covers it
+        self._miles: List[tuple] = []
+        self._incr = callable(
+            getattr(tokenizer, "convert_ids_to_tokens", None)
+        ) and callable(getattr(tokenizer, "convert_tokens_to_string", None))
+        self._toks: List[str] = []  # token strings (incremental path)
+        self._p = 0  # prefix offset: tokens already folded into _text
+        self._r = 0  # read offset: end of the last complete decode window
+
+    def _ingest(self, ids: List[int]) -> None:
+        if not self._incr:
+            self.ids.extend(ids)
+            self._text = self.tok.decode(self.ids)
+            return
+        for tok_s, tid in zip(self.tok.convert_ids_to_tokens(ids), ids):
+            self._toks.append(tok_s)
+            self.ids.append(tid)
+            full = self.tok.convert_tokens_to_string(self._toks[self._p:])
+            if full and not full.endswith(_REPL):
+                prefix = self.tok.convert_tokens_to_string(
+                    self._toks[self._p:self._r]
+                )
+                if len(full) > len(prefix):
+                    self._text += full[len(prefix):]
+                    self._p, self._r = self._r, len(self._toks)
+            self._miles.append((len(self.ids), len(self._text)))
+
+    def _release(self, final: bool):
+        text = self._text
+        cut = -1
+        for s in self.stops:  # str.find is cheap; detok was the O(n^2) part
+            i = text.find(s)
+            if i != -1 and (cut == -1 or i < cut):
+                cut = i
+        if cut != -1:
+            self.stop_cut = cut
+            delta = text[self.emitted:cut] if cut > self.emitted else ""
+            self.emitted = max(self.emitted, cut)
+            return delta, True
+        safe = len(text) if final else max(len(text) - self.hold, self.emitted)
+        while safe > self.emitted and not final and text[safe - 1] == _REPL:
+            safe -= 1
+        delta = text[self.emitted:safe]
+        self.emitted = safe
+        return delta, False
+
+    def add(self, ids: List[int]):
+        """Consume newly generated ids; returns ``(delta_text, stopped)``."""
+        self._ingest(list(ids))
+        return self._release(final=False)
+
+    def finish(self) -> str:
+        """Release the held-back tail (scanning it for a late stop)."""
+        if self._incr and self._r < len(self._toks):
+            # flush an unterminated partial sequence as-is (genuinely
+            # malformed output keeps its replacement chars)
+            prefix = self.tok.convert_tokens_to_string(
+                self._toks[self._p:self._r]
+            )
+            full = self.tok.convert_tokens_to_string(self._toks[self._p:])
+            if len(full) > len(prefix):
+                self._text += full[len(prefix):]
+                self._miles.append((len(self.ids), len(self._text)))
+        return self._release(final=True)[0]
+
+    def _covering_prefix_fallback(self) -> int:
+        """Smallest id count whose full decode covers the stop horizon
+        (bisection; only runs once, at stop time, on the fallback path)."""
+        lo, hi = 0, len(self.ids)
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if len(self.tok.decode(self.ids[:mid])) >= self.stop_cut:
+                hi = mid
+            else:
+                lo = mid + 1
+        return lo
+
+    @property
+    def text(self) -> str:
+        """Everything released so far (the visible completion)."""
+        return self._text[: self.emitted]
+
+    def visible_ids(self) -> List[int]:
+        """token_ids matching the visible text: the shortest id prefix
+        whose decoded text covers the stop-truncated horizon (all ids when
+        no stop was hit) — ids and text never disagree about what was
+        generated."""
+        if self.stop_cut is None:
+            return list(self.ids)
+        if not self._incr:
+            return self.ids[: self._covering_prefix_fallback()]
+        # virtual (0, 0) milestone: a stop matching at char 0 (the model
+        # echoes the stop immediately) maps to ZERO visible ids
+        for n, chars in ((0, 0), *self._miles):
+            if chars >= self.stop_cut:
+                return self.ids[:n]
+        return list(self.ids)
 
 
 def _make_handler(server: ServingServer):
@@ -260,10 +420,16 @@ def _make_handler(server: ServingServer):
                 self._json(400, {"error": first[1]})
                 return
             req_id = first[1]
+            accum = None
+            if server.tokenizer is not None:
+                stop = body.get("stop") or []
+                accum = _TextAccum(
+                    server.tokenizer, [stop] if isinstance(stop, str) else stop
+                )
             if body.get("stream"):
-                self._stream(req_id, q)
+                self._stream(req_id, q, accum)
             else:
-                self._collect(req_id, q)
+                self._collect(req_id, q, accum)
 
         def _client_gone(self) -> bool:
             """A request-less peek at the socket: readable + EOF means the
@@ -279,7 +445,8 @@ def _make_handler(server: ServingServer):
             except OSError:
                 return True
 
-        def _collect(self, req_id: int, q: "queue.Queue") -> None:
+        def _collect(self, req_id: int, q: "queue.Queue",
+                     accum: Optional[_TextAccum]) -> None:
             tokens: List[int] = []
             finish = "stop"
             while True:
@@ -293,51 +460,98 @@ def _make_handler(server: ServingServer):
                     continue
                 if kind == "tokens":
                     tokens.extend(val)
+                    if accum is not None and accum.add(val)[1]:
+                        # stop string hit: end generation NOW (free the
+                        # batch slot) instead of decoding to the budget
+                        server.cancel(req_id)
+                        break
                 elif kind == "error":
                     self._json(500, {"error": val})
                     return
                 elif kind == "done":
                     finish = val
                     break
+            choice: Dict[str, Any] = {
+                "index": 0, "token_ids": tokens, "finish_reason": finish,
+            }
+            if accum is not None:
+                accum.finish()
+                choice["text"] = accum.text
+                # ids, text, and usage agree: all truncated at the stop
+                choice["token_ids"] = tokens = accum.visible_ids()
+                if accum.stop_cut is not None:
+                    # a stop that only completed inside the held-back tail
+                    # (found at finish) is still a stop, not "length"
+                    choice["finish_reason"] = "stop"
             try:
                 self._json(200, {
                     "id": f"cmpl-{req_id}", "object": "text_completion",
                     "model": server.model_id,
-                    "choices": [{"index": 0, "token_ids": tokens,
-                                 "finish_reason": finish}],
+                    "choices": [choice],
                     "usage": {"completion_tokens": len(tokens)},
                 })
             except (BrokenPipeError, ConnectionResetError):
                 pass  # finished anyway; nothing left to free
 
-        def _stream(self, req_id: int, q: "queue.Queue") -> None:
+        def _stream(self, req_id: int, q: "queue.Queue",
+                    accum: Optional[_TextAccum]) -> None:
             self.send_response(200)
             self.send_header("Content-Type", "text/event-stream")
             self.send_header("Cache-Control", "no-cache")
             self.send_header("Connection", "close")
             self.end_headers()
+
+            def emit(token_ids: List[int], text: Optional[str]) -> None:
+                choice: Dict[str, Any] = {
+                    "index": 0, "token_ids": token_ids, "finish_reason": None,
+                }
+                if text is not None:
+                    choice["text"] = text
+                chunk = json.dumps({
+                    "id": f"cmpl-{req_id}", "object": "text_completion",
+                    "model": server.model_id, "choices": [choice],
+                })
+                self.wfile.write(f"data: {chunk}\n\n".encode())
+                self.wfile.flush()
+
+            def done() -> None:
+                self.wfile.write(b"data: [DONE]\n\n")
+                self.wfile.flush()
+
+            ids_sent = 0
             try:
                 while True:
                     kind, val = q.get()
                     if kind == "tokens":
-                        chunk = json.dumps({
-                            "id": f"cmpl-{req_id}",
-                            "object": "text_completion",
-                            "model": server.model_id,
-                            "choices": [{"index": 0, "token_ids": val,
-                                         "finish_reason": None}],
-                        })
-                        self.wfile.write(f"data: {chunk}\n\n".encode())
-                        self.wfile.flush()
+                        if accum is None:
+                            emit(val, None)
+                            continue
+                        delta, stopped = accum.add(val)
+                        if stopped:
+                            # stop string hit mid-stream: final event
+                            # carries the pre-stop text AND the remaining
+                            # stop-truncated ids, then the stream ends and
+                            # the batch slot frees
+                            emit(accum.visible_ids()[ids_sent:], delta)
+                            server.cancel(req_id)
+                            done()
+                            return
+                        # every id is delivered even when its text is held
+                        # back (stop-prefix / partial UTF-8): id stream
+                        # stays complete, text stream stays safe
+                        emit(val, delta)
+                        ids_sent += len(val)
                     elif kind == "error":
                         err = json.dumps({"error": val})
                         self.wfile.write(f"data: {err}\n\n".encode())
-                        self.wfile.write(b"data: [DONE]\n\n")
-                        self.wfile.flush()
+                        done()
                         return
                     elif kind == "done":
-                        self.wfile.write(b"data: [DONE]\n\n")
-                        self.wfile.flush()
+                        if accum is not None:
+                            tail = accum.finish()
+                            if tail:
+                                emit([], tail)
+                        done()
                         return
             except (BrokenPipeError, ConnectionResetError):
                 # client went away mid-stream: free its pages at the next
@@ -355,6 +569,10 @@ def main(argv: Optional[List[str]] = None) -> None:
     ap.add_argument("--port", type=int, default=8000)
     ap.add_argument("--model", default="tiny",
                     help="'tiny' (random-init demo) or a local HF checkpoint dir")
+    ap.add_argument("--tokenizer", default=None,
+                    help="HF tokenizer dir/name enabling text prompts and "
+                         "responses; defaults to --model when that is an HF "
+                         "checkpoint dir")
     ap.add_argument("--max-batch", type=int, default=8)
     ap.add_argument("--n-blocks", type=int, default=512)
     ap.add_argument("--block-tokens", type=int, default=16)
@@ -376,6 +594,7 @@ def main(argv: Optional[List[str]] = None) -> None:
     from .kv import PagedCacheConfig
     from .models import TINY, init_params
 
+    tokenizer = None
     if args.model == "tiny":
         cfg = TINY
         params = init_params(cfg, jax.random.PRNGKey(0))
@@ -390,6 +609,11 @@ def main(argv: Optional[List[str]] = None) -> None:
         params = params_from_hf(hf, cfg)
         model_id = args.model
         del hf
+    tok_src = args.tokenizer or (args.model if args.model != "tiny" else None)
+    if tok_src is not None:
+        import transformers
+
+        tokenizer = transformers.AutoTokenizer.from_pretrained(tok_src)
     pc = PagedCacheConfig(
         n_layers=cfg.n_layers, n_kv_heads=cfg.n_kv_heads,
         head_dim=cfg.head_dim, n_blocks=args.n_blocks,
@@ -397,7 +621,8 @@ def main(argv: Optional[List[str]] = None) -> None:
     )
     engine = InferenceEngine(params, cfg, pc, prefill_chunk=args.prefill_chunk)
     srv = ServingServer(engine, host=args.host, port=args.port,
-                        max_batch=args.max_batch, model_id=model_id)
+                        max_batch=args.max_batch, model_id=model_id,
+                        tokenizer=tokenizer)
     srv.start()
     try:
         while True:

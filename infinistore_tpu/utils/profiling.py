@@ -16,12 +16,17 @@ from typing import Dict
 
 
 class LatencyStats:
-    """Per-op latency accumulator: count / total / max (thread-safe, cheap
-    enough for the data path — two perf_counter calls and a dict update)."""
+    """Per-op latency accumulator: count / total / max plus a bounded
+    ring of recent samples for percentiles (thread-safe, cheap enough for
+    the data path — two perf_counter calls and a dict update).  p50 backs
+    the driver metric's latency half (BASELINE.json: "p50 read latency")."""
+
+    SAMPLES = 512  # recent-sample ring per op (percentile window)
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._ops: Dict[str, list] = {}  # name -> [count, total_s, max_s]
+        # name -> [count, total_s, max_s, ring list, ring cursor]
+        self._ops: Dict[str, list] = {}
 
     @contextlib.contextmanager
     def timed(self, name: str):
@@ -31,22 +36,36 @@ class LatencyStats:
         finally:
             dt = time.perf_counter() - t0
             with self._lock:
-                rec = self._ops.setdefault(name, [0, 0.0, 0.0])
+                rec = self._ops.setdefault(name, [0, 0.0, 0.0, [], 0])
                 rec[0] += 1
                 rec[1] += dt
                 rec[2] = max(rec[2], dt)
+                ring = rec[3]
+                if len(ring) < self.SAMPLES:
+                    ring.append(dt)
+                else:  # write at cursor, then advance: oldest-first overwrite
+                    ring[rec[4]] = dt
+                    rec[4] = (rec[4] + 1) % self.SAMPLES
+
+    @staticmethod
+    def _pct(sorted_samples: list, q: float) -> float:
+        i = min(int(q * len(sorted_samples)), len(sorted_samples) - 1)
+        return sorted_samples[i]
 
     def snapshot(self) -> Dict[str, Dict[str, float]]:
         with self._lock:
-            return {
-                name: {
+            out = {}
+            for name, (c, total, mx, ring, _) in self._ops.items():
+                s = sorted(ring)
+                out[name] = {
                     "count": c,
                     "total_ms": round(total * 1e3, 3),
                     "avg_ms": round(total / c * 1e3, 3) if c else 0.0,
+                    "p50_ms": round(self._pct(s, 0.50) * 1e3, 3) if s else 0.0,
+                    "p99_ms": round(self._pct(s, 0.99) * 1e3, 3) if s else 0.0,
                     "max_ms": round(mx * 1e3, 3),
                 }
-                for name, (c, total, mx) in self._ops.items()
-            }
+            return out
 
 
 @contextlib.contextmanager

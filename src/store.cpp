@@ -17,7 +17,12 @@ static std::string rand_prefix() {
 Store::Store(const StoreConfig& cfg)
     : cfg_(cfg),
       mm_(cfg.prealloc_bytes, cfg.block_bytes,
-          cfg.shm_prefix.empty() ? rand_prefix() : cfg.shm_prefix) {}
+          cfg.shm_prefix.empty() ? rand_prefix() : cfg.shm_prefix) {
+  // pre-size the hash tables: a serving round puts/gets thousands of page
+  // keys and a mid-batch rehash stalls the single-threaded event loop
+  kv_.reserve(1 << 15);
+  pending_.reserve(1 << 12);
+}
 
 double Store::now() {
   return std::chrono::duration<double>(
@@ -132,30 +137,45 @@ bool Store::allocate(uint64_t size, size_t n, std::vector<Region>* out) {
 
 Status Store::alloc_put(const std::vector<std::string>& keys, uint64_t block_size,
                         std::vector<Desc>* descs) {
-  // duplicate keys in one batch would hand out two regions for one map slot
-  {
-    std::unordered_map<std::string, int> seen;
-    for (const auto& k : keys) {
-      if (seen.count(k)) return INVALID_REQ;
-      seen.emplace(k, 1);
-    }
-  }
+  // ONE hash pass covers dedup + busy-check + slot lookup (the put path's
+  // map traffic dominated the put/get bandwidth gap): each key is
+  // try_emplace'd once; an existing slot already stamped with THIS batch's
+  // epoch is an intra-batch duplicate (two regions for one map slot), a
+  // busy slot is an in-flight inline write.  The error paths roll back the
+  // placeholders they inserted BEFORE any region was allocated or freed,
+  // so RETRY / INVALID_REQ stay side-effect free.  (Pointers into an
+  // unordered_map survive rehash; only iterators die.)
+  const uint64_t epoch = ++alloc_epoch_;
+  struct Ref { Slot* slot; bool existed; };
+  std::vector<Ref> refs;
+  refs.reserve(keys.size());
+  auto rollback = [&]() {
+    for (size_t i = 0; i < refs.size(); i++)
+      if (!refs[i].existed) pending_.erase(keys[i]);
+  };
   for (const auto& k : keys) {
-    auto it = pending_.find(k);
-    if (it != pending_.end() && it->second.busy) return RETRY;
+    auto [it, inserted] = pending_.try_emplace(k);
+    Slot& s = it->second;
+    if (!inserted && (s.e.busy || s.e.batch == epoch)) {
+      const bool busy = s.e.busy;
+      rollback();
+      return busy ? RETRY : INVALID_REQ;
+    }
+    s.e.batch = epoch;
+    refs.push_back({&s, !inserted});
   }
   std::vector<Region> regions;
   regions.reserve(keys.size());
-  if (!allocate(block_size, keys.size(), &regions)) return OUT_OF_MEMORY;
+  if (!allocate(block_size, keys.size(), &regions)) {
+    rollback();
+    return OUT_OF_MEMORY;
+  }
   descs->reserve(keys.size());
   for (size_t i = 0; i < keys.size(); i++) {
-    auto it = pending_.find(keys[i]);
-    if (it != pending_.end()) {
-      free_entry(it->second);
-      pending_.erase(it);
-    }
-    pending_.emplace(keys[i],
-                     Entry{regions[i].pool_idx, regions[i].offset, block_size});
+    Slot& s = *refs[i].slot;
+    if (refs[i].existed) free_entry(s.e);  // pending overwrite: old region out
+    s.e = Entry{regions[i].pool_idx, regions[i].offset, block_size};
+    s.e.batch = epoch;
     descs->push_back({regions[i].pool_idx, regions[i].offset, block_size});
   }
   return FINISH;
@@ -165,7 +185,7 @@ void Store::abort_put(const std::vector<std::string>& keys) {
   for (const auto& k : keys) {
     auto it = pending_.find(k);
     if (it != pending_.end()) {
-      free_entry(it->second);
+      free_entry(it->second.e);
       pending_.erase(it);
     }
   }
@@ -176,13 +196,23 @@ Status Store::commit_put(const std::vector<std::string>& keys, int32_t* committe
   for (const auto& k : keys) {
     auto it = pending_.find(k);
     if (it == pending_.end()) continue;
-    Entry e = it->second;
-    e.busy = false;
-    pending_.erase(it);
-    insert_committed(k, e);
-    (*committed)++;
+    // splice the node from pending_ into kv_ (extract/insert moves the
+    // allocated node: no new allocation, no key copy on the put hot path)
+    auto node = pending_.extract(it);
+    Slot& s = node.mapped();
+    s.e.busy = false;
     stats_.puts++;
-    stats_.bytes_in += e.size;
+    stats_.bytes_in += s.e.size;
+    (*committed)++;
+    auto old = kv_.find(k);
+    if (old != kv_.end()) {  // overwrite: old region freed when safe
+      free_or_defer(old->second.e, now());
+      lru_.erase(old->second.lru_it);
+      kv_.erase(old);
+    }
+    lru_.push_back(k);
+    s.lru_it = std::prev(lru_.end());
+    kv_.insert(std::move(node));
   }
   return *committed == static_cast<int32_t>(keys.size()) ? FINISH : INVALID_REQ;
 }
@@ -274,12 +304,12 @@ int32_t Store::purge() {
   kv_.clear();
   lru_.clear();
   // keep regions an op is actively streaming into; free the rest
-  std::unordered_map<std::string, Entry> keep;
-  for (auto& [k, e] : pending_) {
-    if (e.busy)
-      keep.emplace(k, e);
+  std::unordered_map<std::string, Slot> keep;
+  for (auto& [k, s] : pending_) {
+    if (s.e.busy)
+      keep.emplace(k, s);
     else
-      free_entry(e);
+      free_entry(s.e);
   }
   pending_ = std::move(keep);
   return n;
@@ -287,7 +317,7 @@ int32_t Store::purge() {
 
 Entry* Store::pending_entry(const std::string& key) {
   auto it = pending_.find(key);
-  return it == pending_.end() ? nullptr : &it->second;
+  return it == pending_.end() ? nullptr : &it->second.e;
 }
 
 std::string Store::stats_json() const {

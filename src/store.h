@@ -28,11 +28,15 @@ constexpr double kOnDemandMax = 0.95;
 constexpr double kReadLeaseS = 5.0;
 
 struct Entry {
-  uint32_t pool_idx;
-  uint64_t offset;
-  uint64_t size;
+  uint32_t pool_idx = 0;
+  uint64_t offset = 0;
+  uint64_t size = 0;
   double lease = 0.0;
   bool busy = false;  // an op is streaming payload into this pending region
+  // alloc_put batch epoch: lets one map pass detect intra-batch duplicate
+  // keys without a side dedup map (put-path hash traffic is the put/get
+  // bandwidth gap)
+  uint64_t batch = 0;
 };
 
 struct StoreStats {
@@ -106,8 +110,12 @@ class Store {
   StoreConfig cfg_;
   MM mm_;
   std::unordered_map<std::string, Slot> kv_;
-  std::unordered_map<std::string, Entry> pending_;
+  // same mapped type as kv_ so commit_put can SPLICE nodes between the two
+  // maps (extract/insert: no per-key node allocation on the put hot path);
+  // lru_it is unset while pending
+  std::unordered_map<std::string, Slot> pending_;
   LruList lru_;
+  uint64_t alloc_epoch_ = 0;
   StoreStats stats_;
   std::vector<std::pair<double, Entry>> deferred_;  // (lease expiry, region)
   using RegionId = std::pair<uint32_t, uint64_t>;   // (pool_idx, offset)

@@ -116,6 +116,67 @@ def test_speculative_moe_family():
         bad.verify(st, [1, 2], len(st.tokens))
 
 
+def test_stochastic_self_draft_accepts_everything():
+    """Draft == target: p == q, so min(1, p/q) == 1 and every proposal is
+    accepted (up to f32 noise between the scan and verify forwards)."""
+    spec = SpeculativeDecoder(
+        make_engine(TARGET_PARAMS, CFG),
+        make_engine(TARGET_PARAMS, CFG),
+        k=3,
+    )
+    out = spec.generate(PROMPT, 12, sample="categorical", temperature=0.9,
+                        top_p=0.8)
+    assert len(out) == 12
+    assert all(0 <= t < CFG.vocab_size for t in out)
+    assert spec.acceptance_rate >= 0.9
+
+
+def test_stochastic_speculative_matches_target_distribution():
+    """The rejection-sampling guarantee: each emitted token is an exact
+    draw from the target's post-truncation distribution regardless of the
+    draft.  Chi-squared over the top-k support of the first emitted token,
+    against the target's own sampling_probs; fixed seeds keep the test
+    deterministic."""
+    target = make_engine(TARGET_PARAMS, CFG)
+    draft = make_engine(DRAFT_PARAMS, DRAFT_CFG)
+    spec = SpeculativeDecoder(target, draft, k=3)
+    st_t, st_d = spec.prefill(PROMPT)
+    base_t, base_d = list(st_t.tokens), list(st_d.tokens)
+    logits_t, logits_d = st_t.last_logits, st_d.last_logits
+
+    # pure temperature sampling: full-support overlap between p and q, so
+    # both the accept path AND the reject/residual path run (truncation
+    # would make the random draft's and target's top-k supports disjoint
+    # and force rejection every round)
+    TEMP = 1.0
+    p = np.asarray(
+        target.sampling_probs(logits_t[None], temperature=TEMP),
+        dtype=np.float64,
+    )[0]
+
+    N = 400
+    counts: dict = {}
+    for i in range(N):
+        st_t.tokens, st_t.last_logits = list(base_t), logits_t
+        st_d.tokens, st_d.last_logits = list(base_d), logits_d
+        tok = spec.decode(
+            st_t, st_d, 1, sample="categorical", temperature=TEMP,
+            rng=jax.random.PRNGKey(1000 + i),
+        )[0]
+        counts[tok] = counts.get(tok, 0) + 1
+    # both the accept and the reject/residual paths actually ran
+    assert 0.0 < spec.acceptance_rate < 1.0, spec.acceptance_rate
+    # chi-squared over the 7 most likely tokens + everything-else bucket
+    # (full-vocab bins would leave expected counts < 5)
+    top = np.argsort(-p)[:7]
+    exp = [N * p[t] for t in top] + [N * (1.0 - p[top].sum())]
+    obs = [counts.get(int(t), 0) for t in top]
+    obs.append(N - sum(obs))
+    chi2 = sum((o - e) ** 2 / e for o, e in zip(obs, exp))
+    # df=7, p=0.001 critical value 24.32; fixed seeds => deterministic
+    assert chi2 < 24.32, (chi2, counts)
+
+
 def test_speculative_continues_after_decode():
     """The target state stays usable for plain decode after speculation."""
     spec = SpeculativeDecoder(
