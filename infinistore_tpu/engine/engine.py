@@ -207,6 +207,30 @@ class SequenceState:
     adapter_id: int = 0  # LoRA adapter slot (0 = base model)
 
 
+@dataclass
+class PartialPrefill:
+    """Resumable prefill: everything ``prefill_step`` needs to run the next
+    chunk forward.  Lets the scheduler time-slice a long prompt's ingestion
+    against the active batch's decode (chunked-prefill continuous
+    batching)."""
+
+    tokens: List[int]
+    keys: List[str]
+    block_ids: List[int]
+    reused: int          # chunks satisfied from cache/store
+    done: int            # pages written into the HBM cache so far
+    n_complete: int      # complete (store-eligible) chunks
+    padded: List[int]    # suffix tokens padded to whole pages
+    C: int               # tokens per chunk forward
+    single: bool         # whole suffix fits one forward
+    buf: Optional[jax.Array]   # bucketed prefix-KV buffer
+    plen: int            # valid prefix length inside buf
+    S: int               # unpadded suffix length
+    off: int = 0         # next chunk offset into padded
+    off_last: int = 0
+    logits: Optional[jax.Array] = None
+
+
 class InferenceEngine:
     def __init__(
         self,
@@ -357,6 +381,22 @@ class InferenceEngine:
     # ---- prefill ----
 
     def prefill(self, tokens: Sequence[int]) -> SequenceState:
+        """Prompt ingestion: runs every prefill chunk back to back.  The
+        resumable halves (``prefill_start`` / ``prefill_step``) exist so the
+        scheduler can INTERLEAVE a newcomer's prefill chunks with the active
+        batch's decode chunks (vLLM-style chunked-prefill continuous
+        batching) instead of stalling in-flight requests for a long prompt."""
+        pp = self.prefill_start(tokens)
+        while True:
+            st = self.prefill_step(pp)
+            if st is not None:
+                return st
+
+    def prefill_start(self, tokens: Sequence[int]) -> "PartialPrefill":
+        """Admission half of a prefill: prefix-reuse lookup, page
+        acquisition, store prefix load, and chunking setup.  Compute
+        happens in subsequent ``prefill_step`` calls (one chunk forward
+        each)."""
         T = self.pc.block_tokens
         tokens = list(tokens)
         S_total = len(tokens)
@@ -440,84 +480,108 @@ class InferenceEngine:
         else:
             buf, plen = None, 0
 
-        done = reused
-        n_complete = S_total // T  # complete chunks = store-eligible pages
-        logits = None
-        off_last = 0
-        for off in range(0, len(padded), C):
-            chunk = padded[off : off + C]
-            arr = jnp.asarray(chunk, dtype=jnp.int32)[None]
-            if buf is None:
-                logits, kv = self._prefill_jit(self.params, tokens=arr)
-            elif single:
-                logits, kv = self._prefill_jit(
-                    self.params, tokens=arr, prefix_kv=buf
+        return PartialPrefill(
+            tokens=tokens, keys=keys, block_ids=block_ids, reused=reused,
+            done=reused, n_complete=S_total // T, padded=padded, C=C,
+            single=single, buf=buf, plen=plen, S=S,
+        )
+
+    def prefill_step(self, pp: "PartialPrefill") -> Optional[SequenceState]:
+        """One prefill chunk forward (+ cache scatter + store streaming).
+        Returns the finished SequenceState on the last chunk, else None."""
+        T = self.pc.block_tokens
+        off, C = pp.off, pp.C
+        chunk = pp.padded[off : off + C]
+        arr = jnp.asarray(chunk, dtype=jnp.int32)[None]
+        if pp.buf is None:
+            pp.logits, kv = self._prefill_jit(self.params, tokens=arr)
+        elif pp.single:
+            pp.logits, kv = self._prefill_jit(
+                self.params, tokens=arr, prefix_kv=pp.buf
+            )
+        else:
+            pp.logits, kv = self._prefill_jit(
+                self.params, tokens=arr, prefix_kv=pp.buf,
+                prefix_len=jnp.asarray(pp.plen, dtype=jnp.int32),
+            )
+        n_pg = len(chunk) // T
+        self.cache = write_pages(
+            self.cache,
+            jnp.asarray(pp.block_ids[pp.done : pp.done + n_pg]),
+            prefill_to_pages(kv[:, :, 0], n_pg, T),
+        )
+        prev_done, pp.done = pp.done, pp.done + n_pg
+        pp.off_last = off
+        # stream this chunk's complete pages to the store NOW — the
+        # background pusher moves them D2H and into the pool while the
+        # next chunk's forward runs on device (reference design.rst's
+        # layer-by-layer prefill write, at chunk granularity)
+        if self.transfer is not None:
+            lo, hi = max(prev_done, pp.reused), min(pp.done, pp.n_complete)
+            if hi > lo:
+                self._streamer.submit(
+                    self.transfer.gather_pages(self.cache, pp.block_ids[lo:hi]),
+                    pp.keys[lo:hi],
+                )
+        pp.off = off + C
+        if pp.off < len(pp.padded):
+            # another chunk still attends to this KV: grow the bucketed
+            # prefix buffer and append in place
+            need = pp.plen + len(chunk)
+            ncap = _round_up_pow2(need, C)
+            if pp.buf is None:
+                pp.buf = jnp.pad(
+                    kv, ((0, 0),) * 3 + ((0, ncap - len(chunk)),) + ((0, 0),) * 2
                 )
             else:
-                logits, kv = self._prefill_jit(
-                    self.params, tokens=arr, prefix_kv=buf,
-                    prefix_len=jnp.asarray(plen, dtype=jnp.int32),
+                if ncap > pp.buf.shape[3]:
+                    pp.buf = jnp.pad(
+                        pp.buf,
+                        ((0, 0),) * 3
+                        + ((0, ncap - pp.buf.shape[3]),)
+                        + ((0, 0),) * 2,
+                    )
+                pp.buf = self._kv_append(
+                    pp.buf, kv, jnp.asarray(pp.plen, dtype=jnp.int32)
                 )
-            n_pg = len(chunk) // T
-            self.cache = write_pages(
-                self.cache,
-                jnp.asarray(block_ids[done : done + n_pg]),
-                prefill_to_pages(kv[:, :, 0], n_pg, T),
-            )
-            prev_done, done = done, done + n_pg
-            off_last = off
-            # stream this chunk's complete pages to the store NOW — the
-            # background pusher moves them D2H and into the pool while the
-            # next chunk's forward runs on device (reference design.rst's
-            # layer-by-layer prefill write, at chunk granularity)
-            if self.transfer is not None:
-                lo, hi = max(prev_done, reused), min(done, n_complete)
-                if hi > lo:
-                    self._streamer.submit(
-                        self.transfer.gather_pages(self.cache, block_ids[lo:hi]),
-                        keys[lo:hi],
-                    )
-            if off + C < len(padded):  # another chunk still attends to this KV
-                need = plen + len(chunk)
-                ncap = cap_for(need)
-                if buf is None:
-                    buf = jnp.pad(
-                        kv, ((0, 0),) * 3 + ((0, ncap - len(chunk)),) + ((0, 0),) * 2
-                    )
-                else:
-                    if ncap > buf.shape[3]:
-                        buf = jnp.pad(
-                            buf,
-                            ((0, 0),) * 3
-                            + ((0, ncap - buf.shape[3]),)
-                            + ((0, 0),) * 2,
-                        )
-                    buf = self._kv_append(
-                        buf, kv, jnp.asarray(plen, dtype=jnp.int32)
-                    )
-                plen = need
+            pp.plen = need
+            return None
 
-        # every complete chunk was streamed from inside the loop; join the
-        # pusher so the pages are durably in the store before we return
-        # (prefill-node contract), surfacing any push error here
+        # finished: join the pusher so the pages are durably in the store
+        # before the state is visible (prefill-node contract), surfacing
+        # any push error here
         if self.transfer is not None:
             self._streamer.flush()
 
         # name this sequence's complete-chunk pages so later prefills can
         # share them in place (no-op for keys already resident)
-        self.pages.register(keys[:n_complete], block_ids[:n_complete])
+        self.pages.register(
+            pp.keys[: pp.n_complete], pp.block_ids[: pp.n_complete]
+        )
 
         state = SequenceState(
             seq_id=self._next_id,
-            tokens=tokens,
-            block_ids=block_ids,
-            chunk_keys=keys,
-            reused_chunks=reused,
-            last_logits=logits[0, (S - 1) - off_last],
+            tokens=pp.tokens,
+            block_ids=pp.block_ids,
+            chunk_keys=pp.keys,
+            reused_chunks=pp.reused,
+            last_logits=pp.logits[0, (pp.S - 1) - pp.off_last],
         )
         self._next_id += 1
         self.seqs[state.seq_id] = state
         return state
+
+    def abandon_prefill(self, pp: "PartialPrefill") -> None:
+        """Cancel a partial prefill: release its pages (pushed store pages
+        stay — they are content-addressed and reusable) and join the
+        streamer so no push still references the abandoned ids."""
+        if self.transfer is not None:
+            try:
+                self._streamer.flush()
+            except Exception:  # noqa: BLE001 — abandoning anyway
+                pass
+        self.pages.unpin(pp.block_ids)
+        pp.block_ids = []
 
     def prefill_batch(self, prompts: Sequence[Sequence[int]]) -> List[SequenceState]:
         """Prefill several prompts (vLLM-style batched prefill for the

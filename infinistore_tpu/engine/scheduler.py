@@ -12,10 +12,15 @@ TPU analog of vLLM's CUDA-graph batch-size buckets.  A request whose budget
 ends mid-chunk decodes to the boundary and is trimmed at retirement.
 
 Flow per ``step()``:
-1. admit pending requests up to ``max_batch`` (prefill runs immediately,
-   store-backed prefix reuse included);
-2. decode one chunk for the active batch;
-3. retire requests that hit ``max_new_tokens`` or emitted ``eos_id``
+1. admit pending requests up to ``max_batch``: with an EMPTY batch a whole
+   wave prefills at once (one padded forward per length bucket); with a
+   batch already decoding, ONE newcomer is admitted via chunked prefill —
+   a single prefill chunk per step, interleaved with the batch's decode
+   chunks (vLLM chunked-prefill continuous batching), so a long prompt
+   cannot stall in-flight requests for its whole ingestion;
+2. advance the in-progress chunked prefill by one chunk, if any;
+3. decode one chunk for the active batch;
+4. retire requests that hit ``max_new_tokens`` or emitted a stop id
    (checked host-side at the chunk boundary), freeing their KV pages.
 """
 
@@ -26,7 +31,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 
 import jax
 
-from .engine import InferenceEngine, SequenceState
+from .engine import InferenceEngine, PartialPrefill, SequenceState
 
 
 @dataclass
@@ -61,6 +66,10 @@ class Scheduler:
         self.max_batch = max_batch
         self.pending: List[Request] = []
         self.active: List[Request] = []
+        # chunked-prefill admission: at most one newcomer ingests its
+        # prompt one chunk per step, interleaved with the active batch's
+        # decode chunks (vLLM chunked-prefill continuous batching)
+        self._prefilling: Optional[tuple] = None  # (Request, PartialPrefill)
         self._next_id = 0
         self._rng = rng if rng is not None else jax.random.PRNGKey(0)
         # set when decode sheds a request for lack of KV pages: admission
@@ -99,15 +108,21 @@ class Scheduler:
         return req.req_id
 
     def cancel(self, req_id: int) -> bool:
-        """Abort a request.  Pending: removed immediately.  Active: retired
-        at the next chunk boundary (pages freed, partial output kept).
-        Returns False for ids that are unknown or already finished."""
+        """Abort a request.  Pending: removed immediately.  Active or
+        mid-prefill: retired at the next chunk boundary (pages freed,
+        partial output kept).  Returns False for ids that are unknown or
+        already finished."""
         for i, req in enumerate(self.pending):
             if req.req_id == req_id:
                 req.cancelled = req.done = True
                 self.pending.pop(i)
                 self._stream(req, done=True)
                 return True
+        if (self._prefilling is not None
+                and self._prefilling[0].req_id == req_id
+                and not self._prefilling[0].cancelled):
+            self._prefilling[0].cancelled = True
+            return True
         for req in self.active:
             if req.req_id == req_id and not req.cancelled:
                 req.cancelled = True
@@ -152,13 +167,34 @@ class Scheduler:
 
     @property
     def has_work(self) -> bool:
-        return bool(self.pending or self.active)
+        return bool(self.pending or self.active or self._prefilling)
 
     def _admit(self) -> None:
         # sampling params are per-row traced vectors in the compiled decode
         # (engine._decode_many), so admission is pure FIFO — a greedy request
         # and a top-p request share one lockstep batch
-        if not self.pending:
+        if self._prefilling is not None or not self.pending:
+            return
+        if self.active:
+            # a batch is decoding: admit ONE newcomer via CHUNKED prefill —
+            # prefill_start here, one prefill_step per step() interleaved
+            # with the batch's decode chunks, so a long prompt cannot stall
+            # in-flight requests for its whole ingestion
+            if len(self.active) >= self.max_batch:
+                return
+            T = self.engine.pc.block_tokens
+            req = self.pending[0]
+            need = -(-(len(req.tokens) + len(req.output)) // T)
+            if need > self.engine.free_pages:
+                return  # wait for a retirement to free pages
+            self.pending.pop(0)
+            try:
+                pp = self.engine.prefill_start(req.tokens + req.output)
+            except MemoryError:
+                self.pending.insert(0, req)
+                self._admission_hold = True
+                return
+            self._prefilling = (req, pp)
             return
         admit: List[Request] = []
         while self.pending and len(self.active) + len(admit) < self.max_batch:
@@ -221,15 +257,31 @@ class Scheduler:
         return done_now
 
     def step(self) -> List[Request]:
-        """Admit, decode one chunk for the whole batch, retire.  Returns the
-        requests that finished this step."""
+        """Admit, advance at most one prefill chunk for an incoming request,
+        decode one chunk for the whole batch, retire.  Returns the requests
+        that finished this step."""
         if not (self._admission_hold and self.active):
             self._admit()
+        cancelled_prefill: List[Request] = []
+        if self._prefilling is not None:
+            req, pp = self._prefilling
+            if req.cancelled:
+                self.engine.abandon_prefill(pp)
+                req.done = True
+                self._stream(req, done=True)
+                self._prefilling = None
+                cancelled_prefill.append(req)
+            else:
+                st = self.engine.prefill_step(pp)  # ONE chunk this step
+                if st is not None:
+                    req.state = st
+                    self.active.append(req)
+                    self._prefilling = None
         if not self.active:
-            return []
+            return cancelled_prefill
         if any(r.cancelled for r in self.active):
             # retire cancellations before burning a decode chunk on them
-            return self._retire()
+            return cancelled_prefill + self._retire()
         # chunk lengths are powers of two capped at decode_chunk, so the jit
         # cache holds at most log2(decode_chunk)+1 scan lengths per batch
         # shape; a request whose budget lands mid-chunk decodes to the chunk
@@ -260,10 +312,10 @@ class Scheduler:
             victim.state = None
             self.pending.insert(0, victim)
             self._admission_hold = True
-            return []
+            return cancelled_prefill
         for req, toks in zip(self.active, outs):
             req.output.extend(toks)
-        return self._retire()
+        return cancelled_prefill + self._retire()
 
     def run(self) -> Dict[int, List[int]]:
         """Drive until every submitted request finishes; returns

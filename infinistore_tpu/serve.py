@@ -23,6 +23,11 @@ mile.  Design (stdlib only, like the store's manage plane — server.py):
   decode-chunk granularity, riding the scheduler's ``on_token`` hook —
   streamed events carry text deltas, holding back any tail that could
   still become a stop string or an incomplete UTF-8 sequence;
+* ``POST /v1/chat/completions`` — the OpenAI chat surface: ``messages``
+  are templated into a prompt (the tokenizer's own
+  ``apply_chat_template`` when present, a minimal role-tagged transcript
+  otherwise) and answered as an assistant message / streaming
+  ``delta.content`` chunks;
 * ``GET /v1/models`` — model card; ``GET /metrics`` — Prometheus text
   (requests served/active, tokens generated, free KV pages).
 
@@ -132,7 +137,19 @@ class ServingServer:
                     # page and tell waiting clients the truth — an error,
                     # not a completion
                     Logger.error(f"engine step failed: {e!r}")
-                    for req in list(self.sched.active) + list(self.sched.pending):
+                    faulted = list(self.sched.active) + list(self.sched.pending)
+                    if self.sched._prefilling is not None:
+                        # the in-flight chunked prefill is in neither list:
+                        # release its pinned pages and fail its client too,
+                        # or has_work re-runs the failing step forever
+                        req, pp = self.sched._prefilling
+                        try:
+                            self.engine.abandon_prefill(pp)
+                        except Exception:  # noqa: BLE001 — already faulting
+                            pass
+                        self.sched._prefilling = None
+                        faulted.append(req)
+                    for req in faulted:
                         if req.state is not None:
                             self.engine.release(req.state)
                             req.state = None
@@ -144,10 +161,38 @@ class ServingServer:
                     self.sched.active.clear()
                     self.sched.pending.clear()
 
+    def _messages_to_ids(self, messages) -> List[int]:
+        """Chat-completions prompt construction.  HF tokenizers bring their
+        model's own chat template (``apply_chat_template``); a plain
+        tokenizer falls back to a minimal role-tagged transcript ending
+        with the assistant cue."""
+        if self.tokenizer is None:
+            raise ValueError(
+                "chat completions require a tokenizer (start the server "
+                "with --tokenizer)"
+            )
+        if not (isinstance(messages, list) and messages and all(
+                isinstance(m, dict) and isinstance(m.get("role"), str)
+                and isinstance(m.get("content"), str) for m in messages)):
+            raise ValueError(
+                "messages must be a non-empty list of {role, content}"
+            )
+        tmpl = getattr(self.tokenizer, "apply_chat_template", None)
+        if callable(tmpl):
+            ids = tmpl(messages, tokenize=True, add_generation_prompt=True)
+            return [int(t) for t in ids]
+        text = "".join(
+            f"{m['role']}: {m['content']}\n" for m in messages
+        ) + "assistant:"
+        return [int(t) for t in self.tokenizer.encode(text)]
+
     def _validate(self, body: Dict[str, Any]) -> Dict[str, Any]:
         """Range-check everything client-supplied BEFORE it reaches the
         scheduler: a bad request must be a 400, never an assertion inside
         an engine step that would take the whole batch down."""
+        if "messages" in body and "prompt" not in body:
+            body = dict(body)
+            body["prompt"] = self._messages_to_ids(body.pop("messages"))
         prompt = body.get("prompt")
         if isinstance(prompt, str):
             if self.tokenizer is None:
@@ -405,9 +450,10 @@ def _make_handler(server: ServingServer):
                 self._json(404, {"error": "not found"})
 
         def do_POST(self):
-            if self.path != "/v1/completions":
+            if self.path not in ("/v1/completions", "/v1/chat/completions"):
                 self._json(404, {"error": "not found"})
                 return
+            chat = self.path == "/v1/chat/completions"
             try:
                 n = int(self.headers.get("Content-Length", 0))
                 body = json.loads(self.rfile.read(n) or b"{}")
@@ -427,9 +473,9 @@ def _make_handler(server: ServingServer):
                     server.tokenizer, [stop] if isinstance(stop, str) else stop
                 )
             if body.get("stream"):
-                self._stream(req_id, q, accum)
+                self._stream(req_id, q, accum, chat)
             else:
-                self._collect(req_id, q, accum)
+                self._collect(req_id, q, accum, chat)
 
         def _client_gone(self) -> bool:
             """A request-less peek at the socket: readable + EOF means the
@@ -446,7 +492,7 @@ def _make_handler(server: ServingServer):
                 return True
 
         def _collect(self, req_id: int, q: "queue.Queue",
-                     accum: Optional[_TextAccum]) -> None:
+                     accum: Optional[_TextAccum], chat: bool = False) -> None:
             tokens: List[int] = []
             finish = "stop"
             while True:
@@ -483,9 +529,14 @@ def _make_handler(server: ServingServer):
                     # a stop that only completed inside the held-back tail
                     # (found at finish) is still a stop, not "length"
                     choice["finish_reason"] = "stop"
+            if chat:  # chat requires a tokenizer, so accum is set
+                choice["message"] = {
+                    "role": "assistant", "content": choice.pop("text", ""),
+                }
             try:
                 self._json(200, {
-                    "id": f"cmpl-{req_id}", "object": "text_completion",
+                    "id": f"{'chatcmpl' if chat else 'cmpl'}-{req_id}",
+                    "object": "chat.completion" if chat else "text_completion",
                     "model": server.model_id,
                     "choices": [choice],
                     "usage": {"completion_tokens": len(tokens)},
@@ -494,21 +545,31 @@ def _make_handler(server: ServingServer):
                 pass  # finished anyway; nothing left to free
 
         def _stream(self, req_id: int, q: "queue.Queue",
-                    accum: Optional[_TextAccum]) -> None:
+                    accum: Optional[_TextAccum], chat: bool = False) -> None:
             self.send_response(200)
             self.send_header("Content-Type", "text/event-stream")
             self.send_header("Cache-Control", "no-cache")
             self.send_header("Connection", "close")
             self.end_headers()
+            first_delta = [True]
 
             def emit(token_ids: List[int], text: Optional[str]) -> None:
                 choice: Dict[str, Any] = {
                     "index": 0, "token_ids": token_ids, "finish_reason": None,
                 }
-                if text is not None:
+                if chat:
+                    delta: Dict[str, Any] = {"content": text or ""}
+                    if first_delta[0]:
+                        delta["role"] = "assistant"
+                        first_delta[0] = False
+                    choice["delta"] = delta
+                elif text is not None:
                     choice["text"] = text
                 chunk = json.dumps({
-                    "id": f"cmpl-{req_id}", "object": "text_completion",
+                    "id": f"{'chatcmpl' if chat else 'cmpl'}-{req_id}",
+                    "object": (
+                        "chat.completion.chunk" if chat else "text_completion"
+                    ),
                     "model": server.model_id, "choices": [choice],
                 })
                 self.wfile.write(f"data: {chunk}\n\n".encode())

@@ -30,6 +30,7 @@
 #include <mutex>
 #include <thread>
 #include <unordered_map>
+#include <unordered_set>
 
 #include "protocol.h"
 #include "store.h"
@@ -69,8 +70,11 @@ struct Conn {
   uint64_t stream_off = 0;
   uint64_t discard_bytes = 0;  // drain-and-drop after a failed batch alloc
   int32_t discard_status = 0;
-  // keys allocated but not yet committed by this connection
-  std::vector<std::string> pending_keys;
+  // keys allocated but not yet committed by this connection.  A SET:
+  // commit removes its whole batch here, and the old vector scan made
+  // that O(batch^2) string compares — the dominant per-key put overhead
+  // at serving batch sizes (2048-key rounds)
+  std::unordered_set<std::string> pending_keys;
 };
 }  // namespace
 
@@ -227,7 +231,9 @@ class StoreServer {
     if (!c->pending_keys.empty() || !c->seg_descs.empty()) {
       std::lock_guard<std::mutex> g(mu_);
       // client went away mid-write: reclaim uncommitted regions
-      if (!c->pending_keys.empty()) store_.abort_put(c->pending_keys);
+      if (!c->pending_keys.empty())
+        store_.abort_put(std::vector<std::string>(c->pending_keys.begin(),
+                                                  c->pending_keys.end()));
       // release pins on zero-copy segments it never finished receiving
       if (!c->seg_descs.empty()) store_.unpin(c->seg_descs);
     }
@@ -372,14 +378,7 @@ class StoreServer {
   }
 
   static void remove_pending(Conn* c, const std::vector<std::string>& keys) {
-    for (const auto& k : keys) {
-      for (auto it = c->pending_keys.begin(); it != c->pending_keys.end(); ++it) {
-        if (*it == k) {
-          c->pending_keys.erase(it);
-          break;
-        }
-      }
-    }
+    for (const auto& k : keys) c->pending_keys.erase(k);
   }
 
   void queue_bytes(Conn* c, std::string bytes) {
@@ -497,7 +496,7 @@ class StoreServer {
         std::vector<Desc> descs;
         Status st = store_.alloc_put(keys, block_size, &descs);
         if (st == FINISH)
-          c->pending_keys.insert(c->pending_keys.end(), keys.begin(), keys.end());
+          c->pending_keys.insert(keys.begin(), keys.end());
         std::string out(reinterpret_cast<const char*>(descs.data()),
                         descs.size() * sizeof(Desc));
         respond(c, st, out);
@@ -578,7 +577,7 @@ class StoreServer {
           Entry* e = store_.pending_entry(k);
           if (e) e->busy = true;  // purge must not free mid-stream regions
         }
-        c->pending_keys.insert(c->pending_keys.end(), keys.begin(), keys.end());
+        c->pending_keys.insert(keys.begin(), keys.end());
         c->stream_keys = std::move(keys);
         c->stream_descs = std::move(descs);
         c->stream_idx = 0;
