@@ -235,6 +235,14 @@ def _chip_peak_flops_bf16(device_kind: str) -> float:
     return 197e12
 
 
+def _bench_model():
+    """LLAMA3_1B for the real run; ISTPU_BENCH_MODEL=tiny swaps in the TINY
+    config so the leg code itself can be smoke-tested on CPU."""
+    from infinistore_tpu.models.llama import LLAMA3_1B, TINY
+
+    return TINY if os.environ.get("ISTPU_BENCH_MODEL") == "tiny" else LLAMA3_1B
+
+
 def leg_model_perf(out: dict) -> None:
     """Largest-config-that-fits serving figures (VERDICT r2 next #2):
     LLAMA3_1B bf16 through the engine — TTFT for a 512-token prompt, p50
@@ -245,9 +253,9 @@ def leg_model_perf(out: dict) -> None:
 
     from infinistore_tpu.engine.engine import InferenceEngine
     from infinistore_tpu.kv.cache import PagedCacheConfig
-    from infinistore_tpu.models.llama import LLAMA3_1B, init_params
+    from infinistore_tpu.models.llama import init_params
 
-    cfg = LLAMA3_1B
+    cfg = _bench_model()
     params = init_params(cfg, jax.random.PRNGKey(0))
     jax.block_until_ready(params)
     epc = PagedCacheConfig(
@@ -326,9 +334,9 @@ def leg_prefill_stream(out: dict) -> None:
     from infinistore_tpu.config import TYPE_SHM
     from infinistore_tpu.engine.engine import InferenceEngine
     from infinistore_tpu.kv.cache import PagedCacheConfig
-    from infinistore_tpu.models.llama import LLAMA3_1B, init_params
+    from infinistore_tpu.models.llama import init_params
 
-    cfg = LLAMA3_1B
+    cfg = _bench_model()
     params = init_params(cfg, jax.random.PRNGKey(0))
     jax.block_until_ready(params)
     epc = PagedCacheConfig(
@@ -410,6 +418,11 @@ def main() -> int:
     threading.Thread(target=watchdog, daemon=True).start()
 
     import jax
+
+    # honor an explicit JAX_PLATFORMS even where a platform plugin pinned
+    # jax_platforms at interpreter start (same rule as tests/conftest.py)
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
     platform = jax.devices()[0].platform
     init_done.set()

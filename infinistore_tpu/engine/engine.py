@@ -229,6 +229,7 @@ class PartialPrefill:
     off: int = 0         # next chunk offset into padded
     off_last: int = 0
     logits: Optional[jax.Array] = None
+    adapter_id: int = 0  # LoRA adapter slot (0 = base model)
 
 
 class InferenceEngine:
@@ -325,6 +326,10 @@ class InferenceEngine:
         # of every family take use_pallas for this reason
         pallas_kw = {"use_pallas": False} if mesh is not None else {}
         self.lora = lora
+        # the bank TENSORS enter every dispatch as traced args (jit would
+        # constant-fold a closed-over bank into the program); only the
+        # scalar scale is bound statically
+        self._lora_tree = lora.tree if lora is not None else None
         lora_kw = {}
         if lora is not None:
             assert prefill_fn is None and decode_fn is None and verify_fn is None, (
@@ -378,21 +383,47 @@ class InferenceEngine:
         # in-place append into the bucketed chunked-prefill KV buffer
         self._kv_append = _KV_APPEND
 
+    def _lora_args(self, adapter_ids) -> Dict[str, Any]:
+        """Per-dispatch LoRA kwargs: the bank tree + a per-row adapter-id
+        vector (punica-style batched adapters).  Empty for engines without
+        a bank, so their compiled signatures stay unchanged."""
+        if self.lora is None:
+            return {}
+        return {
+            "lora": self._lora_tree,
+            "adapter_ids": jnp.asarray(adapter_ids, dtype=jnp.int32),
+        }
+
+    def _adapter_model_id(self, adapter_id: int) -> str:
+        """Prefix-cache / store key namespace for an adapter: adapter KV
+        must never serve another adapter's prefix."""
+        if adapter_id == 0:
+            return self.model_id
+        return f"{self.model_id}#a{adapter_id}"
+
     # ---- prefill ----
 
-    def prefill(self, tokens: Sequence[int]) -> SequenceState:
+    def prefill(
+        self, tokens: Sequence[int], adapter_id: int = 0
+    ) -> SequenceState:
         """Prompt ingestion: runs every prefill chunk back to back.  The
         resumable halves (``prefill_start`` / ``prefill_step``) exist so the
         scheduler can INTERLEAVE a newcomer's prefill chunks with the active
         batch's decode chunks (vLLM-style chunked-prefill continuous
-        batching) instead of stalling in-flight requests for a long prompt."""
-        pp = self.prefill_start(tokens)
+        batching) instead of stalling in-flight requests for a long prompt.
+
+        ``adapter_id`` picks a LoRA adapter from the engine's bank (0 =
+        base model); adapter KV is key-namespaced so prefix reuse never
+        crosses adapters."""
+        pp = self.prefill_start(tokens, adapter_id=adapter_id)
         while True:
             st = self.prefill_step(pp)
             if st is not None:
                 return st
 
-    def prefill_start(self, tokens: Sequence[int]) -> "PartialPrefill":
+    def prefill_start(
+        self, tokens: Sequence[int], adapter_id: int = 0
+    ) -> "PartialPrefill":
         """Admission half of a prefill: prefix-reuse lookup, page
         acquisition, store prefix load, and chunking setup.  Compute
         happens in subsequent ``prefill_step`` calls (one chunk forward
@@ -401,7 +432,12 @@ class InferenceEngine:
         tokens = list(tokens)
         S_total = len(tokens)
         assert S_total >= 1
-        keys = chunk_keys(tokens, self.model_id, chunk_tokens=T)
+        assert adapter_id == 0 or (
+            self.lora is not None and 0 <= adapter_id < self.lora.n_adapters
+        ), adapter_id  # negative ids would silently wrap in the gather
+        keys = chunk_keys(
+            tokens, self._adapter_model_id(adapter_id), chunk_tokens=T
+        )
 
         # longest reusable prefix, capped so >=1 token is computed locally
         # (we need last-token logits to start decoding).  Cheapest first:
@@ -483,7 +519,7 @@ class InferenceEngine:
         return PartialPrefill(
             tokens=tokens, keys=keys, block_ids=block_ids, reused=reused,
             done=reused, n_complete=S_total // T, padded=padded, C=C,
-            single=single, buf=buf, plen=plen, S=S,
+            single=single, buf=buf, plen=plen, S=S, adapter_id=adapter_id,
         )
 
     def prefill_step(self, pp: "PartialPrefill") -> Optional[SequenceState]:
@@ -493,16 +529,17 @@ class InferenceEngine:
         off, C = pp.off, pp.C
         chunk = pp.padded[off : off + C]
         arr = jnp.asarray(chunk, dtype=jnp.int32)[None]
+        lkw = self._lora_args([pp.adapter_id])
         if pp.buf is None:
-            pp.logits, kv = self._prefill_jit(self.params, tokens=arr)
+            pp.logits, kv = self._prefill_jit(self.params, tokens=arr, **lkw)
         elif pp.single:
             pp.logits, kv = self._prefill_jit(
-                self.params, tokens=arr, prefix_kv=pp.buf
+                self.params, tokens=arr, prefix_kv=pp.buf, **lkw
             )
         else:
             pp.logits, kv = self._prefill_jit(
                 self.params, tokens=arr, prefix_kv=pp.buf,
-                prefix_len=jnp.asarray(pp.plen, dtype=jnp.int32),
+                prefix_len=jnp.asarray(pp.plen, dtype=jnp.int32), **lkw
             )
         n_pg = len(chunk) // T
         self.cache = write_pages(
@@ -566,6 +603,7 @@ class InferenceEngine:
             chunk_keys=pp.keys,
             reused_chunks=pp.reused,
             last_logits=pp.logits[0, (pp.S - 1) - pp.off_last],
+            adapter_id=pp.adapter_id,
         )
         self._next_id += 1
         self.seqs[state.seq_id] = state
@@ -583,22 +621,29 @@ class InferenceEngine:
         self.pages.unpin(pp.block_ids)
         pp.block_ids = []
 
-    def prefill_batch(self, prompts: Sequence[Sequence[int]]) -> List[SequenceState]:
+    def prefill_batch(
+        self,
+        prompts: Sequence[Sequence[int]],
+        adapter_ids: Optional[Sequence[int]] = None,
+    ) -> List[SequenceState]:
         """Prefill several prompts (vLLM-style batched prefill for the
         scheduler's admission path).
 
         Prompts are grouped by their power-of-two length bucket and each
         group runs as ONE padded forward (batch dim also bucketed), so the
         jit cache grows log x log and a stray long prompt never inflates the
-        short ones' padding.  Per-sequence fallback when a store is attached
-        (each sequence's reusable prefix differs), for singleton groups, and
-        when a group's total padded tokens would exceed ``prefill_chunk``
-        (the configured prefill memory bound).
+        short ones' padding — a group mixes LoRA adapters freely (the
+        forward takes a per-row adapter-id vector).  Per-sequence fallback
+        when a store is attached (each sequence's reusable prefix differs),
+        for singleton groups, and when a group's total padded tokens would
+        exceed ``prefill_chunk`` (the configured prefill memory bound).
 
         On page exhaustion mid-batch, states created so far are released
         before the MemoryError propagates — the engine is left unchanged."""
         prompts = [list(p) for p in prompts]
         assert prompts and all(len(p) >= 1 for p in prompts)
+        aids = list(adapter_ids) if adapter_ids else [0] * len(prompts)
+        assert len(aids) == len(prompts)
         T = self.pc.block_tokens
 
         out: List[Optional[SequenceState]] = [None] * len(prompts)
@@ -606,7 +651,7 @@ class InferenceEngine:
         try:
             if self.transfer is not None:
                 for i, p in enumerate(prompts):
-                    st = self.prefill(p)
+                    st = self.prefill(p, adapter_id=aids[i])
                     created.append(st)
                     out[i] = st
                 return out  # type: ignore[return-value]
@@ -620,7 +665,9 @@ class InferenceEngine:
             deferred: List[int] = []
             wave_chunk0: set = set()
             for i, p in enumerate(prompts):
-                ks = chunk_keys(p, self.model_id, chunk_tokens=T)
+                ks = chunk_keys(
+                    p, self._adapter_model_id(aids[i]), chunk_tokens=T
+                )
                 cap = (len(p) - 1) // T
                 if self.pages.peek_prefix(ks[:cap]) > 0 or (
                     cap > 0 and ks[0] in wave_chunk0
@@ -638,18 +685,20 @@ class InferenceEngine:
                     and len(group) * bucket > self.prefill_chunk
                 ):
                     states = []
-                    for p in group:
-                        st = self.prefill(p)
+                    for i in idxs:
+                        st = self.prefill(prompts[i], adapter_id=aids[i])
                         created.append(st)
                         states.append(st)
                 else:
-                    states = self._prefill_group(group, bucket)
+                    states = self._prefill_group(
+                        group, bucket, [aids[i] for i in idxs]
+                    )
                     created.extend(states)
                 for i, st in zip(idxs, states):
                     out[i] = st
 
             for i in deferred:  # now the wave's pages are registered
-                st = self.prefill(prompts[i])
+                st = self.prefill(prompts[i], adapter_id=aids[i])
                 created.append(st)
                 out[i] = st
         except MemoryError:
@@ -658,8 +707,11 @@ class InferenceEngine:
             raise
         return out  # type: ignore[return-value]
 
-    def _prefill_group(self, group: List[List[int]], bucket: int) -> List[SequenceState]:
-        """One padded forward + one cache scatter for a same-bucket group."""
+    def _prefill_group(
+        self, group: List[List[int]], bucket: int, aids: List[int]
+    ) -> List[SequenceState]:
+        """One padded forward + one cache scatter for a same-bucket group
+        (mixed adapters ride the per-row id vector)."""
         T = self.pc.block_tokens
         B = len(group)
         Bp = _round_up_pow2(B, 1)  # batch-dim bucket: bounded compile count
@@ -668,7 +720,10 @@ class InferenceEngine:
         tokens = np.zeros((Bp, bucket), dtype=np.int32)
         for b, p in enumerate(group):
             tokens[b, : len(p)] = p
-        logits, kv = self._prefill_jit(self.params, tokens=jnp.asarray(tokens))
+        lkw = self._lora_args(aids + [0] * (Bp - B)) if self.lora else {}
+        logits, kv = self._prefill_jit(
+            self.params, tokens=jnp.asarray(tokens), **lkw
+        )
         parts = [
             prefill_to_pages(kv[:, :, b], bucket // T, T)[:, :, :, :n_pg]
             for b, n_pg in enumerate(n_pages_each)
@@ -684,8 +739,11 @@ class InferenceEngine:
                 seq_id=self._next_id,
                 tokens=list(p),
                 block_ids=list(ids_all[off : off + n_pg]),
-                chunk_keys=chunk_keys(p, self.model_id, chunk_tokens=T),
+                chunk_keys=chunk_keys(
+                    p, self._adapter_model_id(aids[b]), chunk_tokens=T
+                ),
                 last_logits=logits[b, len(p) - 1],
+                adapter_id=aids[b],
             )
             self.pages.register(st.chunk_keys, st.block_ids[: len(p) // T])
             self._next_id += 1
@@ -747,7 +805,15 @@ class InferenceEngine:
             return tok, (jax.nn.softmax(l, axis=-1) if collect else None)
 
         def many(params, logits0, start_pos, cache, block_table, rng,
-                 greedy_mask, temperature, top_k, top_p):
+                 greedy_mask, temperature, top_k, top_p, lora, adapter_ids):
+            # lora/adapter_ids are None for engines without a bank — the
+            # Python branch below is static at trace time, so their
+            # compiled programs are unchanged
+            lkw = (
+                {} if lora is None
+                else {"lora": lora, "adapter_ids": adapter_ids}
+            )
+
             def step(carry, i):
                 logits, cache, rng = carry
                 rng, sub = jax.random.split(rng)
@@ -767,6 +833,7 @@ class InferenceEngine:
                     seq_lens=pos + 1,
                     slot_block_ids=slot_blocks,
                     slot_ids=pos % T,
+                    **lkw,
                 )
                 y = (tok, probs) if collect else tok
                 return (logits2, cache, rng), y
@@ -879,6 +946,11 @@ class InferenceEngine:
         temp_d = jnp.asarray(temp)
         top_k_d = jnp.asarray(top_k_v)
         top_p_d = jnp.asarray(top_p_v)
+        lora_t = self._lora_tree
+        aid_d = (
+            None if self.lora is None
+            else jnp.asarray([st.adapter_id for st in states], jnp.int32)
+        )
         remaining = n_steps
         while remaining > 0:
             chunk = min(remaining, self.decode_chunk)
@@ -894,6 +966,8 @@ class InferenceEngine:
                 temp_d,
                 top_k_d,
                 top_p_d,
+                lora_t,
+                aid_d,
             )
             host_toks = np.asarray(toks)  # [chunk, B]; one sync/chunk
             for b in range(B):
@@ -941,6 +1015,9 @@ class InferenceEngine:
             jnp.full((B,), max(temperature, 1e-6), dtype=jnp.float32),
             jnp.full((B,), top_k, dtype=jnp.int32),
             jnp.full((B,), top_p, dtype=jnp.float32),
+            self._lora_tree,
+            None if self.lora is None
+            else jnp.asarray([state.adapter_id], jnp.int32),
         )
         out = [int(t) for t in np.asarray(toks)[:, 0]]
         state.tokens.extend(out)
@@ -1012,6 +1089,7 @@ class InferenceEngine:
             block_table=self._block_table([state]),
             slot_block_ids=jnp.asarray(slot_blocks[None]),
             slot_ids=jnp.asarray((poss % T)[None]),
+            **self._lora_args([state.adapter_id]),
         )
         return logits[0]
 

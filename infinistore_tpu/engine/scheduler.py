@@ -47,6 +47,7 @@ class Request:
     temperature: float = 1.0
     top_k: int = 0
     top_p: float = 1.0
+    adapter_id: int = 0  # LoRA adapter slot (0 = base model)
     # streaming: called at every chunk boundary with the newly visible
     # tokens (already eos/budget-trimmed), then once with ([], True) at
     # retirement — the vLLM streaming-generator analog at chunk granularity
@@ -87,6 +88,7 @@ class Scheduler:
         temperature: float = 1.0,
         top_k: int = 0,
         top_p: float = 1.0,
+        adapter_id: int = 0,
         on_token: Optional[Callable[[List[int], bool], None]] = None,
     ) -> int:
         if sample == "greedy":
@@ -101,7 +103,7 @@ class Scheduler:
             req_id=self._next_id, tokens=list(tokens),
             max_new_tokens=max_new_tokens, eos_ids=stops or None,
             sample=sample, temperature=temperature, top_k=top_k,
-            top_p=top_p, on_token=on_token,
+            top_p=top_p, adapter_id=adapter_id, on_token=on_token,
         )
         self._next_id += 1
         self.pending.append(req)
@@ -189,7 +191,9 @@ class Scheduler:
                 return  # wait for a retirement to free pages
             self.pending.pop(0)
             try:
-                pp = self.engine.prefill_start(req.tokens + req.output)
+                pp = self.engine.prefill_start(
+                    req.tokens + req.output, adapter_id=req.adapter_id
+                )
             except MemoryError:
                 self.pending.insert(0, req)
                 self._admission_hold = True
@@ -220,7 +224,8 @@ class Scheduler:
                 # prompt + output-so-far: a request shed mid-decode resumes
                 # where it left off (its generated tokens re-prefill)
                 states = self.engine.prefill_batch(
-                    [r.tokens + r.output for r in admit]
+                    [r.tokens + r.output for r in admit],
+                    adapter_ids=[r.adapter_id for r in admit],
                 )
             except MemoryError:
                 if len(admit) > 1:

@@ -93,6 +93,38 @@ class ServingServer:
 
     # -- handler-side API (any thread) --
 
+    def prepare_body(self, body: Dict[str, Any], chat: bool) -> Dict[str, Any]:
+        """Tokenization-heavy request preparation, run on the HTTP HANDLER
+        thread so chat templating / long-transcript encoding never stalls
+        the engine thread's decode loop.  Enforces the endpoint contract:
+        chat takes ``messages``, completions takes ``prompt``.  Raises
+        ValueError -> 400."""
+        body = dict(body)
+        if chat:
+            if "messages" not in body or "prompt" in body:
+                raise ValueError(
+                    "chat completions take 'messages' (not 'prompt')"
+                )
+            body["prompt"] = self._messages_to_ids(body.pop("messages"))
+        else:
+            if "messages" in body:
+                raise ValueError(
+                    "completions take 'prompt'; use /v1/chat/completions "
+                    "for 'messages'"
+                )
+            prompt = body.get("prompt")
+            if isinstance(prompt, str):
+                if self.tokenizer is None:
+                    raise ValueError(
+                        "string prompt requires a tokenizer (start the "
+                        "server with --tokenizer); send a list of token "
+                        "ids instead"
+                    )
+                if not prompt:
+                    raise ValueError("prompt must be non-empty")
+                body["prompt"] = [int(t) for t in self.tokenizer.encode(prompt)]
+        return body
+
     def submit(self, body: Dict[str, Any]) -> "queue.Queue":
         """Stage a request; returns the queue its events arrive on.
         Events: ("tokens", [ids]) then ("done", finish_reason)."""
@@ -249,8 +281,28 @@ class ServingServer:
                 "string stop sequences require a tokenizer; use "
                 "stop_token_ids instead"
             )
+        # multi-LoRA serving (the vLLM "served adapter as a model" pattern):
+        # "model" naming a bank adapter routes the request to that adapter;
+        # the base model id (or omitting "model") is the base weights
+        adapter_id = 0
+        model = body.get("model")
+        if model is not None and model != self.model_id:
+            bank = getattr(self.engine, "lora", None)
+            if bank is None:
+                raise ValueError(
+                    f"unknown model {model!r}; this server serves "
+                    f"{self.model_id!r}"
+                )
+            try:
+                adapter_id = bank.adapter_id(str(model))
+            except KeyError:
+                raise ValueError(
+                    f"unknown model/adapter {model!r}; have "
+                    f"{[self.model_id] + bank.names[1:]}"
+                ) from None
         return {
             "tokens": prompt, "max_new_tokens": max_tokens,
+            "adapter_id": adapter_id,
             # the FULL stop list (first occurrence of any id stops)
             "eos_ids": [int(t) for t in stops] or None,
             "sample": sample,
@@ -261,15 +313,29 @@ class ServingServer:
 
     def _submit_to_sched(self, item: Dict[str, Any]) -> None:
         body, q = item["body"], item["q"]
+        # finish_reason per the OpenAI contract: "stop" when a stop id
+        # ended generation (visible tokens are eos-trimmed, so the last
+        # delivered token tells), "length" when the budget did
+        tally = {"n": 0, "eos": False, "budget": 0, "eos_set": frozenset()}
 
         def on_token(tokens: List[int], done: bool) -> None:
             if tokens:
+                tally["n"] += len(tokens)
+                if tokens[-1] in tally["eos_set"]:
+                    tally["eos"] = True
                 q.put(("tokens", list(tokens)))
             if done:
-                q.put(("done", "stop"))
+                q.put((
+                    "done",
+                    "length"
+                    if not tally["eos"] and tally["n"] >= tally["budget"]
+                    else "stop",
+                ))
 
         try:
             kwargs = self._validate(body)
+            tally["budget"] = kwargs["max_new_tokens"]
+            tally["eos_set"] = frozenset(kwargs["eos_ids"] or ())
             req_id = self.sched.submit(on_token=on_token, **kwargs)
             self._queues[req_id] = q
             q.put(("id", req_id))
@@ -436,9 +502,17 @@ def _make_handler(server: ServingServer):
 
         def do_GET(self):
             if self.path == "/v1/models":
-                self._json(200, {"object": "list", "data": [
-                    {"id": server.model_id, "object": "model",
-                     "owned_by": "infinistore-tpu"}]})
+                cards = [{"id": server.model_id, "object": "model",
+                          "owned_by": "infinistore-tpu"}]
+                bank = getattr(server.engine, "lora", None)
+                if bank is not None:  # each served adapter is a "model"
+                    cards += [
+                        {"id": name, "object": "model",
+                         "owned_by": "infinistore-tpu",
+                         "parent": server.model_id}
+                        for name in bank.names[1:]
+                    ]
+                self._json(200, {"object": "list", "data": cards})
             elif self.path == "/metrics":
                 data = server.metrics_text().encode()
                 self.send_response(200)
@@ -460,12 +534,20 @@ def _make_handler(server: ServingServer):
             except ValueError:
                 self._json(400, {"error": "invalid JSON body"})
                 return
+            try:
+                # tokenization-heavy prep on THIS thread, not the engine's
+                body = server.prepare_body(body, chat)
+            except ValueError as e:
+                self._json(400, {"error": str(e)})
+                return
             q = server.submit(body)
             first = q.get()
             if first[0] == "error":
                 self._json(400, {"error": first[1]})
                 return
             req_id = first[1]
+            # adapter-routed requests echo the adapter name they asked for
+            model_name = str(body.get("model") or server.model_id)
             accum = None
             if server.tokenizer is not None:
                 stop = body.get("stop") or []
@@ -473,9 +555,9 @@ def _make_handler(server: ServingServer):
                     server.tokenizer, [stop] if isinstance(stop, str) else stop
                 )
             if body.get("stream"):
-                self._stream(req_id, q, accum, chat)
+                self._stream(req_id, q, accum, chat, model_name)
             else:
-                self._collect(req_id, q, accum, chat)
+                self._collect(req_id, q, accum, chat, model_name)
 
         def _client_gone(self) -> bool:
             """A request-less peek at the socket: readable + EOF means the
@@ -492,7 +574,8 @@ def _make_handler(server: ServingServer):
                 return True
 
         def _collect(self, req_id: int, q: "queue.Queue",
-                     accum: Optional[_TextAccum], chat: bool = False) -> None:
+                     accum: Optional[_TextAccum], chat: bool = False,
+                     model_name: Optional[str] = None) -> None:
             tokens: List[int] = []
             finish = "stop"
             while True:
@@ -537,7 +620,7 @@ def _make_handler(server: ServingServer):
                 self._json(200, {
                     "id": f"{'chatcmpl' if chat else 'cmpl'}-{req_id}",
                     "object": "chat.completion" if chat else "text_completion",
-                    "model": server.model_id,
+                    "model": model_name or server.model_id,
                     "choices": [choice],
                     "usage": {"completion_tokens": len(tokens)},
                 })
@@ -545,7 +628,8 @@ def _make_handler(server: ServingServer):
                 pass  # finished anyway; nothing left to free
 
         def _stream(self, req_id: int, q: "queue.Queue",
-                    accum: Optional[_TextAccum], chat: bool = False) -> None:
+                    accum: Optional[_TextAccum], chat: bool = False,
+                    model_name: Optional[str] = None) -> None:
             self.send_response(200)
             self.send_header("Content-Type", "text/event-stream")
             self.send_header("Cache-Control", "no-cache")
@@ -553,9 +637,11 @@ def _make_handler(server: ServingServer):
             self.end_headers()
             first_delta = [True]
 
-            def emit(token_ids: List[int], text: Optional[str]) -> None:
+            def emit(token_ids: List[int], text: Optional[str],
+                     finish: Optional[str] = None) -> None:
                 choice: Dict[str, Any] = {
-                    "index": 0, "token_ids": token_ids, "finish_reason": None,
+                    "index": 0, "token_ids": token_ids,
+                    "finish_reason": finish,
                 }
                 if chat:
                     delta: Dict[str, Any] = {"content": text or ""}
@@ -570,7 +656,8 @@ def _make_handler(server: ServingServer):
                     "object": (
                         "chat.completion.chunk" if chat else "text_completion"
                     ),
-                    "model": server.model_id, "choices": [choice],
+                    "model": model_name or server.model_id,
+                    "choices": [choice],
                 })
                 self.wfile.write(f"data: {chunk}\n\n".encode())
                 self.wfile.flush()
@@ -590,10 +677,12 @@ def _make_handler(server: ServingServer):
                         delta, stopped = accum.add(val)
                         if stopped:
                             # stop string hit mid-stream: final event
-                            # carries the pre-stop text AND the remaining
-                            # stop-truncated ids, then the stream ends and
-                            # the batch slot frees
-                            emit(accum.visible_ids()[ids_sent:], delta)
+                            # carries the pre-stop text, the remaining
+                            # stop-truncated ids, and the finish_reason
+                            # (the OpenAI stream-termination signal), then
+                            # the stream ends and the batch slot frees
+                            emit(accum.visible_ids()[ids_sent:], delta,
+                                 finish="stop")
                             server.cancel(req_id)
                             done()
                             return
@@ -608,10 +697,12 @@ def _make_handler(server: ServingServer):
                         done()
                         return
                     elif kind == "done":
-                        if accum is not None:
-                            tail = accum.finish()
-                            if tail:
-                                emit([], tail)
+                        tail = accum.finish() if accum is not None else ""
+                        # final chunk announces finish_reason before [DONE]
+                        fin = val
+                        if accum is not None and accum.stop_cut is not None:
+                            fin = "stop"
+                        emit([], tail or None, finish=fin)
                         done()
                         return
             except (BrokenPipeError, ConnectionResetError):

@@ -17,3 +17,38 @@ if not os.environ.get("ISTPU_TEST_TPU"):
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+
+
+def make_dense_greedy(params, cfg):
+    """Shared memoized dense-greedy reference (`from conftest import
+    make_dense_greedy`): the unjitted full-context forward per step is the
+    suite's hottest cost, and many tests re-derive identical trajectories.
+    Longer cached runs over the same prompt serve shorter requests (greedy
+    is prefix-stable)."""
+    import jax.numpy as jnp
+
+    from infinistore_tpu.models import prefill_forward
+
+    cache = {}
+
+    def dense_greedy(tokens, n_steps):
+        key = (tuple(tokens), n_steps)
+        hit = cache.get(key)
+        if hit is not None:
+            return list(hit)
+        for (t, n), out in cache.items():
+            if t == key[0] and n > n_steps:
+                return list(out[:n_steps])
+        toks = list(tokens)
+        out = []
+        for _ in range(n_steps):
+            logits, _ = prefill_forward(
+                params, cfg, jnp.asarray(toks, dtype=jnp.int32)[None]
+            )
+            nxt = int(jnp.argmax(logits[0, -1]))
+            out.append(nxt)
+            toks.append(nxt)
+        cache[key] = list(out)
+        return out
+
+    return dense_greedy

@@ -35,30 +35,9 @@ def make_pc(n_blocks=64):
     )
 
 
-_DENSE_CACHE = {}
+from conftest import make_dense_greedy
 
-
-def dense_greedy(tokens, n_steps):
-    """Exact reference: full dense forward each step.  Memoized — many
-    tests re-derive the same trajectories, and the unjitted dense forward
-    is the suite's single hottest cost."""
-    key = (tuple(tokens), n_steps)
-    hit = _DENSE_CACHE.get(key)
-    if hit is not None:
-        return list(hit)
-    # reuse a longer/shorter cached run over the same prompt
-    for (t, n), out in _DENSE_CACHE.items():
-        if t == key[0] and n > n_steps:
-            return list(out[:n_steps])
-    toks = list(tokens)
-    out = []
-    for _ in range(n_steps):
-        logits, _ = prefill_forward(PARAMS, CFG, jnp.asarray(toks, dtype=jnp.int32)[None])
-        nxt = int(jnp.argmax(logits[0, -1]))
-        out.append(nxt)
-        toks.append(nxt)
-    _DENSE_CACHE[key] = list(out)
-    return out
+dense_greedy = make_dense_greedy(PARAMS, CFG)
 
 
 def _free_port():

@@ -21,29 +21,9 @@ PARAMS = init_params(CFG, jax.random.PRNGKey(7))
 PROMPT = [11, 42, 7, 99, 5, 3, 17, 28, 64, 1, 2]
 
 
-_DENSE_CACHE = {}
+from conftest import make_dense_greedy
 
-
-def dense_greedy(tokens, n_steps):
-    """Memoized dense reference (see test_engine.dense_greedy rationale)."""
-    key = (tuple(tokens), n_steps)
-    hit = _DENSE_CACHE.get(key)
-    if hit is not None:
-        return list(hit)
-    for (t, n), out in _DENSE_CACHE.items():
-        if t == key[0] and n > n_steps:
-            return list(out[:n_steps])
-    toks = list(tokens)
-    out = []
-    for _ in range(n_steps):
-        logits, _ = prefill_forward(
-            PARAMS, CFG, jnp.asarray(toks, dtype=jnp.int32)[None]
-        )
-        nxt = int(jnp.argmax(logits[0, -1]))
-        out.append(nxt)
-        toks.append(nxt)
-    _DENSE_CACHE[key] = list(out)
-    return out
+dense_greedy = make_dense_greedy(PARAMS, CFG)
 
 
 @pytest.fixture(scope="module")
@@ -79,7 +59,8 @@ def test_completion_matches_greedy(server):
     })
     assert status == 200, body
     assert body["choices"][0]["token_ids"] == dense_greedy(PROMPT, 6)
-    assert body["choices"][0]["finish_reason"] == "stop"
+    # budget-terminated: OpenAI reports "length", not "stop"
+    assert body["choices"][0]["finish_reason"] == "length"
     assert body["usage"]["completion_tokens"] == 6
 
 
@@ -150,6 +131,7 @@ def test_eos_and_sampling_params(server):
     assert status == 200
     toks = body["choices"][0]["token_ids"]
     assert toks == ref[:3] and toks[-1] == eos
+    assert body["choices"][0]["finish_reason"] == "stop"
 
     # sampling path with nucleus: valid tokens, right count
     status, body = _post(server.port, {
