@@ -203,7 +203,8 @@ class NativeStoreServer:
         return float(self._lib.istpu_server_usage(self._h))
 
     def stats_dict(self) -> dict:
-        buf = ctypes.create_string_buffer(4096)
+        # 8 KiB: store stats + the per-op latency section
+        buf = ctypes.create_string_buffer(8192)
         self._lib.istpu_server_stats_json(self._h, buf, len(buf))
         return json.loads(buf.value.decode() or "{}")
 

@@ -644,6 +644,13 @@ class InferenceEngine:
         assert prompts and all(len(p) >= 1 for p in prompts)
         aids = list(adapter_ids) if adapter_ids else [0] * len(prompts)
         assert len(aids) == len(prompts)
+        # validate up front so every sub-path (grouped forward included)
+        # rejects out-of-range ids — XLA clamps gather indices, so a bad id
+        # would otherwise silently serve another adapter's weights
+        for aid in aids:
+            assert aid == 0 or (
+                self.lora is not None and 0 <= aid < self.lora.n_adapters
+            ), aid
         T = self.pc.block_tokens
 
         out: List[Optional[SequenceState]] = [None] * len(prompts)

@@ -13,6 +13,8 @@ from __future__ import annotations
 
 import asyncio
 import json
+import threading
+import time
 from typing import Optional
 
 from . import protocol as P
@@ -28,6 +30,27 @@ class StoreServer:
         self.store = store or Store(config)
         self._server: Optional[asyncio.AbstractServer] = None
         self._evict_task = None
+        # per-op latency accumulators: op -> [count, total_s, max_s].
+        # Locked: the manage plane reads from HTTP handler threads while
+        # the asyncio loop updates (native parity: mu_ in stats_json_full)
+        self._op_lat: dict = {}
+        self._lat_lock = threading.Lock()
+
+    def stats_dict(self) -> dict:
+        """Store stats + the server-side per-op latency section (native
+        parity: store_server.cpp stats_json_full)."""
+        stats = self.store.stats_dict()
+        with self._lat_lock:
+            snap = {o: list(rec) for o, rec in self._op_lat.items()}
+        stats["op_latency"] = {
+            P.op_name(o): {
+                "count": c,
+                "avg_ms": round(total / c * 1e3, 3) if c else 0.0,
+                "max_ms": round(mx * 1e3, 3),
+            }
+            for o, (c, total, mx) in snap.items()
+        }
+        return stats
 
     async def start(self, host: str = "0.0.0.0") -> None:
         self._server = await asyncio.start_server(
@@ -80,7 +103,14 @@ class StoreServer:
                     Logger.error(f"body too large: {body_len}")
                     break
                 body = memoryview(await reader.readexactly(body_len)) if body_len else memoryview(b"")
+                t0 = time.perf_counter()
                 resp = await self._dispatch(op, body, reader, writer, conn_pending)
+                dt = time.perf_counter() - t0
+                with self._lat_lock:
+                    rec = self._op_lat.setdefault(op, [0, 0.0, 0.0])
+                    rec[0] += 1
+                    rec[1] += dt
+                    rec[2] = max(rec[2], dt)
                 if resp is not None:  # streaming ops write directly
                     writer.write(resp)
                 await writer.drain()
@@ -153,7 +183,9 @@ class StoreServer:
         if op == P.OP_PURGE:
             return P.pack_resp(P.FINISH, P.pack_i32(st.purge()))
         if op == P.OP_STATS:
-            return P.pack_resp(P.FINISH, json.dumps(st.stats_dict()).encode())
+            # store stats + server-side per-op latency (the server half of
+            # observability next to the client's latency_stats)
+            return P.pack_resp(P.FINISH, json.dumps(self.stats_dict()).encode())
         if op == P.OP_EVICT:
             mn, mx = P.unpack_evict(body)
             st.evict(mn, mx)

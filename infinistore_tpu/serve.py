@@ -221,20 +221,14 @@ class ServingServer:
     def _validate(self, body: Dict[str, Any]) -> Dict[str, Any]:
         """Range-check everything client-supplied BEFORE it reaches the
         scheduler: a bad request must be a 400, never an assertion inside
-        an engine step that would take the whole batch down."""
-        if "messages" in body and "prompt" not in body:
-            body = dict(body)
-            body["prompt"] = self._messages_to_ids(body.pop("messages"))
+        an engine step that would take the whole batch down.
+
+        Tokenization (string prompts / messages) delegates to
+        ``prepare_body`` — the HTTP path already ran it on the handler
+        thread (idempotent here: the prompt is ids by then); direct
+        ``submit()`` callers get the same conversion."""
+        body = self.prepare_body(body, chat="messages" in body)
         prompt = body.get("prompt")
-        if isinstance(prompt, str):
-            if self.tokenizer is None:
-                raise ValueError(
-                    "string prompt requires a tokenizer (start the server "
-                    "with --tokenizer); send a list of token ids instead"
-                )
-            if not prompt:
-                raise ValueError("prompt must be non-empty")
-            prompt = [int(t) for t in self.tokenizer.encode(prompt)]
         if not (isinstance(prompt, list) and prompt
                 and all(isinstance(t, int) and not isinstance(t, bool)
                         for t in prompt)):

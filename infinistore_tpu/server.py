@@ -99,7 +99,13 @@ def _manage_handler(server_ref):
             elif self.path == "/usage":
                 self._json({"usage": store.usage() if store else 0.0})
             elif self.path == "/metrics":
-                self._json(store.stats_dict() if store else {})
+                # server-level stats when available (adds the per-op
+                # latency section); bare-store stats otherwise
+                srv = server_ref()
+                if srv is not None and hasattr(srv, "stats_dict"):
+                    self._json(srv.stats_dict())
+                else:
+                    self._json(store.stats_dict() if store else {})
             elif self.path == "/metrics.prom":
                 # Prometheus text exposition of the same counters, for
                 # scrape-based monitoring of serving clusters

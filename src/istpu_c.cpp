@@ -15,6 +15,7 @@ void server_stop(StoreServer* s);
 void server_destroy(StoreServer* s);
 Store* server_store(StoreServer* s);
 std::mutex* server_mutex(StoreServer* s);
+std::string server_stats_json(StoreServer* s);
 }  // namespace istpu
 
 using istpu::Store;
@@ -75,8 +76,8 @@ double istpu_server_usage(void* h) {
 
 int istpu_server_stats_json(void* h, char* buf, int cap) {
   auto* s = static_cast<StoreServer*>(h);
-  std::lock_guard<std::mutex> g(*istpu::server_mutex(s));
-  std::string j = istpu::server_store(s)->stats_json();
+  // includes the server-layer op_latency section (locks internally)
+  std::string j = istpu::server_stats_json(s);
   int n = std::min<int>(cap - 1, j.size());
   std::memcpy(buf, j.data(), n);
   buf[n] = 0;

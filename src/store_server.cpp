@@ -150,6 +150,10 @@ class StoreServer {
 
   Store* store() { return &store_; }
   std::mutex* store_mutex() { return &mu_; }
+  std::string stats_json_full() {
+    std::lock_guard<std::mutex> g(mu_);
+    return stats_json_locked();
+  }
 
  private:
   struct Shard {
@@ -448,9 +452,53 @@ class StoreServer {
     return true;
   }
 
+  // per-op server-side latency accumulators (count, total_s, max_s):
+  // the server half of observability next to the client's latency_stats
+  // (reference analog: per-op timing visibility on the data plane)
+  struct OpLatency { uint64_t count = 0; double total_s = 0, max_s = 0; };
+
+  std::string stats_json_locked() {
+    // store stats + the server-side per-op latency section (callers hold mu_)
+    std::string js = store_.stats_json();
+    js.pop_back();  // trailing '}'
+    return js + ", \"op_latency\": " + op_latency_json() + "}";
+  }
+
+  std::string op_latency_json() {
+    std::string out = "{";
+    bool first = true;
+    for (const auto& [op, s] : op_lat_) {
+      char buf[160];
+      snprintf(buf, sizeof(buf),
+               "%s\"%s\": {\"count\": %llu, \"avg_ms\": %.3f, \"max_ms\": %.3f}",
+               first ? "" : ", ", op_name(op),
+               static_cast<unsigned long long>(s.count),
+               s.count ? s.total_s / s.count * 1e3 : 0.0, s.max_s * 1e3);
+      out += buf;
+      first = false;
+    }
+    return out + "}";
+  }
+
   bool dispatch(Conn* c, const uint8_t* body, size_t body_len) {
     Reader rd(body, body_len);
     std::lock_guard<std::mutex> g(mu_);
+    // scope-exit timing so every early return of the switch is covered
+    struct Timed {
+      StoreServer* s;
+      uint8_t op;
+      std::chrono::steady_clock::time_point t0 =
+          std::chrono::steady_clock::now();
+      ~Timed() {
+        double dt = std::chrono::duration<double>(
+                        std::chrono::steady_clock::now() - t0)
+                        .count();
+        auto& rec = s->op_lat_[op];
+        rec.count++;
+        rec.total_s += dt;
+        if (dt > rec.max_s) rec.max_s = dt;
+      }
+    } timed{this, c->hdr.op};
     switch (c->hdr.op) {
       case OP_HELLO:
       case OP_POOLS: {
@@ -549,7 +597,7 @@ class StoreServer {
         return true;
       }
       case OP_STATS: {
-        respond(c, FINISH, store_.stats_json());
+        respond(c, FINISH, stats_json_locked());
         return true;
       }
       case OP_EVICT: {
@@ -627,6 +675,7 @@ class StoreServer {
 
   Store store_;
   std::mutex mu_;
+  std::unordered_map<uint8_t, OpLatency> op_lat_;  // guarded by mu_
   int port_;
   int listen_fd_ = -1;
   std::atomic<bool> running_{false};
@@ -646,4 +695,5 @@ void server_stop(StoreServer* s) { s->stop(); }
 void server_destroy(StoreServer* s) { delete s; }
 Store* server_store(StoreServer* s) { return s->store(); }
 std::mutex* server_mutex(StoreServer* s) { return s->store_mutex(); }
+std::string server_stats_json(StoreServer* s) { return s->stats_json_full(); }
 }  // namespace istpu

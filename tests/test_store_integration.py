@@ -329,6 +329,14 @@ def test_manage_plane(server):
     ) as r:
         m = json.load(r)
     assert "usage" in m and "puts" in m
+    # server-side per-op latency accumulators (both backends): earlier
+    # tests in this module already drove puts/gets through this server
+    lat = m.get("op_latency", {})
+    assert lat, m
+    assert any(
+        v.get("count", 0) > 0 and v.get("avg_ms", -1) >= 0
+        for v in lat.values()
+    ), lat
     # Prometheus exposition of the same counters
     with urllib.request.urlopen(
         f"http://127.0.0.1:{MANAGE_PORT}/metrics.prom", timeout=30
