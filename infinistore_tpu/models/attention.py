@@ -89,6 +89,7 @@ def causal_attention(
     prefix_pad: int | None = None,
     prefix_len: jax.Array | None = None,
     window: int | None = None,
+    softcap: float | None = None,
 ) -> jax.Array:
     """Causal SDPA.  q: [B, Sq, H, D]; k/v: [B, Sk, H_kv, D].
 
@@ -118,6 +119,7 @@ def causal_attention(
     if (
         allow_pallas
         and window is None
+        and softcap is None  # the flash kernels carry no logit softcap
         and D % 128 == 0
         and jax.default_backend() == "tpu"
         and not os.environ.get("ISTPU_NO_PALLAS")
@@ -141,6 +143,8 @@ def causal_attention(
     v = repeat_kv(v, H // Hkv)
     scale = 1.0 / np.sqrt(D)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if softcap is not None:  # Gemma-2 logit soft-capping
+        logits = softcap * jnp.tanh(logits / softcap)
     k_pos = jnp.arange(k.shape[1])
     if prefix_len is not None:
         assert prefix_pad is not None
@@ -174,6 +178,7 @@ def paged_decode_attention_xla(
     block_table: jax.Array,
     seq_lens: jax.Array,
     window: int | None = None,
+    softcap: float | None = None,
 ) -> jax.Array:
     """One-token decode attention against the paged cache (XLA gather path).
 
@@ -194,6 +199,8 @@ def paged_decode_attention_xla(
     v = repeat_kv(v, H // Hkv)
     scale = 1.0 / np.sqrt(D)
     logits = jnp.einsum("bhd,bkhd->bhk", q, k).astype(jnp.float32) * scale
+    if softcap is not None:  # Gemma-2 logit soft-capping
+        logits = softcap * jnp.tanh(logits / softcap)
     pos = jnp.arange(max_pages * T)
     mask = pos[None, :] < seq_lens[:, None]  # [B, S_max]
     if window is not None:
@@ -210,6 +217,7 @@ def paged_multitoken_attention_xla(
     block_table: jax.Array,
     positions: jax.Array,
     window: int | None = None,
+    softcap: float | None = None,
 ) -> jax.Array:
     """Attention for a short run of new tokens against the paged cache
     (the speculative-decode verify step: S proposal tokens attend to the
@@ -233,6 +241,8 @@ def paged_multitoken_attention_xla(
     v = repeat_kv(v, H // Hkv)
     scale = 1.0 / np.sqrt(D)
     logits = jnp.einsum("bshd,bkhd->bhsk", q, k).astype(jnp.float32) * scale
+    if softcap is not None:  # Gemma-2 logit soft-capping
+        logits = softcap * jnp.tanh(logits / softcap)
     k_pos = jnp.arange(max_pages * T)
     mask = k_pos[None, None, :] <= positions[:, :, None]  # [B, S, S_max]
     if window is not None:
@@ -302,6 +312,7 @@ def paged_decode_attention(
     allow_pallas: bool = True,
     tp_mesh=None,
     window: int | None = None,
+    softcap: float | None = None,
 ) -> jax.Array:
     """Paged decode attention; Pallas kernel on TPU, XLA gather elsewhere.
 
@@ -321,11 +332,13 @@ def paged_decode_attention(
     """
     import os
 
-    if window is not None:
-        # the Pallas kernels carry no sliding-window mask; the XLA path
-        # partitions fine under GSPMD, so windowed models always take it
+    if window is not None or softcap is not None:
+        # the Pallas kernels carry no sliding-window mask or logit softcap;
+        # the XLA path partitions fine under GSPMD, so those models always
+        # take it
         return paged_decode_attention_xla(
-            q, layer_cache, block_table, seq_lens, window=window
+            q, layer_cache, block_table, seq_lens, window=window,
+            softcap=softcap,
         )
     if tp_mesh is not None:
         interp = bool(os.environ.get("ISTPU_PALLAS_INTERPRET"))

@@ -1,9 +1,9 @@
-"""HuggingFace checkpoint import: Llama, Mistral, Qwen2/2.5, Qwen3.
+"""HuggingFace checkpoint import: Llama, Mistral, Qwen2/2.5, Qwen3, Gemma-2.
 
 The reference rides vLLM, which loads HF checkpoints; a standalone framework
 needs its own loader.  ``params_from_hf`` maps a ``transformers`` dense
 decoder state dict (LlamaForCausalLM, MistralForCausalLM, Qwen2ForCausalLM,
-Qwen3ForCausalLM) onto our pytree (models/llama.py layout: stacked
+Qwen3ForCausalLM, Gemma2ForCausalLM) onto our pytree (models/llama.py layout: stacked
 per-layer leaves, ``x @ W`` orientation), converting two representation
 differences:
 
@@ -37,12 +37,13 @@ _FAMILIES = {
     "mistral": (False, False),
     "qwen2": (True, False),
     "qwen3": (False, True),
+    "gemma2": (False, False),
 }
 
 
 def config_from_hf(hf_config: Any, dtype: Any = jnp.bfloat16) -> LlamaConfig:
     """Map a ``transformers`` dense-decoder config (Llama / Mistral / Qwen2 /
-    Qwen3) onto ours.
+    Qwen3 / Gemma-2) onto ours.
 
     Raises on configurations this architecture cannot represent (an unknown
     ``model_type`` or ``rope_scaling`` type) rather than importing weights
@@ -67,10 +68,26 @@ def config_from_hf(hf_config: Any, dtype: Any = jnp.bfloat16) -> LlamaConfig:
             )
         elif rtype != "default":
             raise ValueError(f"unsupported rope_scaling type {rtype!r}")
+    extra: Dict[str, Any] = {}
+    if family == "gemma2":
+        # GeGLU, logit softcaps, sandwich norms, (1+w) norms, sqrt(dim)
+        # embed scaling, alternating local/global attention, query scale
+        extra = dict(
+            act="gelu_tanh",
+            attn_softcap=getattr(hf_config, "attn_logit_softcapping", None),
+            final_softcap=getattr(hf_config, "final_logit_softcapping", None),
+            norm_offset=True,
+            post_norms=True,
+            embed_scale=True,
+            query_pre_attn_scalar=float(
+                getattr(hf_config, "query_pre_attn_scalar", derived_hd)
+            ),
+            window_pattern=2,  # HF: even layers sliding, odd global
+        )
     window = getattr(hf_config, "sliding_window", None)
     if window is not None and not getattr(hf_config, "use_sliding_window", True):
         window = None  # Qwen2/3 ship the field but default it off
-    if window is not None:
+    if window is not None and family != "gemma2":
         # HF semantics: the first max_window_layers layers run FULL
         # attention, layers >= mwl are windowed.  mwl >= n_layers ⇒ no
         # layer is windowed; mwl == 0 ⇒ uniformly windowed; anything
@@ -109,6 +126,7 @@ def config_from_hf(hf_config: Any, dtype: Any = jnp.bfloat16) -> LlamaConfig:
             else None
         ),
         dtype=dtype,
+        **extra,
     )
 
 
@@ -186,8 +204,16 @@ def params_from_hf(
             "w_up": _proj_in_out(get(p + "mlp.up_proj.weight")),
             "w_down": _proj_in_out(get(p + "mlp.down_proj.weight")),
             "ln_attn": get(p + "input_layernorm.weight"),
-            "ln_mlp": get(p + "post_attention_layernorm.weight"),
         }
+        if cfg.post_norms:
+            # Gemma-2 sandwich: post_attention_layernorm is genuinely
+            # POST-attention; the pre-FFN norm is pre_feedforward_layernorm
+            layer["ln_post_attn"] = get(p + "post_attention_layernorm.weight")
+            layer["ln_mlp"] = get(p + "pre_feedforward_layernorm.weight")
+            layer["ln_post_mlp"] = get(p + "post_feedforward_layernorm.weight")
+        else:
+            # Llama-family: post_attention_layernorm IS the pre-FFN norm
+            layer["ln_mlp"] = get(p + "post_attention_layernorm.weight")
         if cfg.attn_bias:
             layer["bq"] = _qk_bias(
                 get(p + "self_attn.q_proj.bias"), cfg.n_heads, hd

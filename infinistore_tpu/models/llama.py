@@ -62,7 +62,20 @@ class LlamaConfig:
     # window stay allocated (the paged cache is append-only); the mask makes
     # them invisible.
     sliding_window: int | None = None
+    # the window applies to layers with ``li % window_pattern == 0``
+    # (Gemma-2 alternates local/global attention: pattern 2); pattern 1 =
+    # every layer (Mistral)
+    window_pattern: int = 1
     head_dim_override: int | None = None
+    # --- Gemma-2 family knobs ---
+    act: str = "silu"  # "gelu_tanh" (GeGLU) for Gemma
+    attn_softcap: float | None = None   # tanh soft-cap on attention logits
+    final_softcap: float | None = None  # tanh soft-cap on output logits
+    norm_offset: bool = False           # RMSNorm scales by (1 + w)
+    post_norms: bool = False            # post-attn/post-ffn norms (sandwich)
+    embed_scale: bool = False           # hidden state scaled by sqrt(dim)
+    # attention scale becomes 1/sqrt(query_pre_attn_scalar) when set
+    query_pre_attn_scalar: float | None = None
     dtype: Any = jnp.bfloat16
 
     @property
@@ -96,6 +109,13 @@ QWEN3_8B = LlamaConfig(  # Q/K norm, decoupled head_dim
     ffn_dim=12288, rope_theta=1000000.0, norm_eps=1e-6, qk_norm=True,
     head_dim_override=128,
 )
+GEMMA2_9B = LlamaConfig(  # GeGLU, softcaps, sandwich norms, local/global
+    vocab_size=256000, dim=3584, n_layers=42, n_heads=16, n_kv_heads=8,
+    ffn_dim=14336, rope_theta=10000.0, norm_eps=1e-6,
+    head_dim_override=256, act="gelu_tanh", attn_softcap=50.0,
+    final_softcap=30.0, norm_offset=True, post_norms=True, embed_scale=True,
+    query_pre_attn_scalar=224.0, sliding_window=4096, window_pattern=2,
+)
 
 
 def scaled(cfg: LlamaConfig, **kw) -> LlamaConfig:
@@ -110,6 +130,8 @@ def init_params(cfg: LlamaConfig, key: jax.Array) -> Params:
 
     keys = jax.random.split(key, cfg.n_layers + 2)
     hd = cfg.head_dim
+    # with the Gemma (1 + w) convention, zeros give identity scale
+    ln_one = (jnp.zeros if cfg.norm_offset else jnp.ones)
     layers = []
     for li in range(cfg.n_layers):
         k = jax.random.split(keys[li], 10)
@@ -121,9 +143,12 @@ def init_params(cfg: LlamaConfig, key: jax.Array) -> Params:
             "w_gate": dense(k[4], (cfg.dim, cfg.ffn_dim), cfg.dim),
             "w_up": dense(k[5], (cfg.dim, cfg.ffn_dim), cfg.dim),
             "w_down": dense(k[6], (cfg.ffn_dim, cfg.dim), cfg.ffn_dim),
-            "ln_attn": jnp.ones((cfg.dim,), cfg.dtype),
-            "ln_mlp": jnp.ones((cfg.dim,), cfg.dtype),
+            "ln_attn": ln_one((cfg.dim,), cfg.dtype),
+            "ln_mlp": ln_one((cfg.dim,), cfg.dtype),
         }
+        if cfg.post_norms:  # Gemma-2 sandwich norms
+            layer["ln_post_attn"] = ln_one((cfg.dim,), cfg.dtype)
+            layer["ln_post_mlp"] = ln_one((cfg.dim,), cfg.dtype)
         if cfg.attn_bias:
             layer["bq"] = dense(k[7], (cfg.n_heads * hd,), cfg.dim)
             layer["bk"] = dense(k[8], (cfg.n_kv_heads * hd,), cfg.dim)
@@ -138,15 +163,49 @@ def init_params(cfg: LlamaConfig, key: jax.Array) -> Params:
     return {
         "embed": dense(keys[-2], (cfg.vocab_size, cfg.dim), cfg.dim),
         "layers": stacked,
-        "ln_out": jnp.ones((cfg.dim,), cfg.dtype),
+        "ln_out": ln_one((cfg.dim,), cfg.dtype),
         "lm_head": dense(keys[-1], (cfg.dim, cfg.vocab_size), cfg.dim),
     }
 
 
-def rmsnorm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
+def rmsnorm(x: jax.Array, w: jax.Array, eps: float,
+            offset: bool = False) -> jax.Array:
     x32 = x.astype(jnp.float32)
     scale = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    if offset:
+        # Gemma convention: scale by (1 + w) in f32, then cast (HF
+        # Gemma2RMSNorm) — checkpoints store w around 0, not around 1
+        return ((x32 * scale) * (1.0 + w.astype(jnp.float32))).astype(x.dtype)
     return (x32 * scale).astype(x.dtype) * w
+
+
+def _norm(cfg: LlamaConfig, x: jax.Array, w: jax.Array) -> jax.Array:
+    return rmsnorm(x, w, cfg.norm_eps, offset=cfg.norm_offset)
+
+
+def _window_for(cfg: LlamaConfig, li: int) -> int | None:
+    """Per-layer sliding window: Gemma-2 alternates local/global layers
+    (window_pattern=2); Mistral windows every layer (pattern=1)."""
+    if cfg.sliding_window is None or li % cfg.window_pattern != 0:
+        return None
+    return cfg.sliding_window
+
+
+def _embed(params: Params, cfg: LlamaConfig, tokens: jax.Array) -> jax.Array:
+    x = params["embed"][tokens]
+    if cfg.embed_scale:  # Gemma: hidden scaled by sqrt(dim), in model dtype
+        x = x * jnp.asarray(np.sqrt(cfg.dim), dtype=x.dtype)
+    return x
+
+
+def _final_logits(params: Params, cfg: LlamaConfig, x: jax.Array) -> jax.Array:
+    logits = x @ params["lm_head"]
+    if cfg.final_softcap is not None:
+        capped = cfg.final_softcap * jnp.tanh(
+            logits.astype(jnp.float32) / cfg.final_softcap
+        )
+        logits = capped.astype(logits.dtype)
+    return logits
 
 
 def _lora_term(x, lora, name, ids, scale):
@@ -184,11 +243,22 @@ def _attn_qkv(layer: Params, cfg: LlamaConfig, x: jax.Array, positions: jax.Arra
         k = rmsnorm(k, layer["k_norm"], cfg.norm_eps)
     q = apply_rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
     k = apply_rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
+    if cfg.query_pre_attn_scalar is not None:
+        # attention kernels divide by sqrt(head_dim); pre-scaling q makes
+        # the net scale 1/sqrt(query_pre_attn_scalar) (Gemma-2)
+        q = q * jnp.asarray(
+            np.sqrt(hd) / np.sqrt(cfg.query_pre_attn_scalar), dtype=q.dtype
+        )
     return q, k, v
 
 
-def _mlp(layer: Params, x: jax.Array) -> jax.Array:
-    return (jax.nn.silu(x @ layer["w_gate"]) * (x @ layer["w_up"])) @ layer["w_down"]
+def _mlp(layer: Params, x: jax.Array, cfg: LlamaConfig | None = None) -> jax.Array:
+    gate = x @ layer["w_gate"]
+    if cfg is not None and cfg.act == "gelu_tanh":  # GeGLU (Gemma)
+        act = jax.nn.gelu(gate, approximate=True)
+    else:
+        act = jax.nn.silu(gate)
+    return (act * (x @ layer["w_up"])) @ layer["w_down"]
 
 
 def _layer(ix: int):
@@ -230,19 +300,21 @@ def prefill_forward(
     P = 0 if prefix_kv is None else prefix_kv.shape[3]
     start = P if prefix_len is None else prefix_len
     positions = jnp.broadcast_to(jnp.arange(S) + start, (B, S))
-    x = params["embed"][tokens]
+    x = _embed(params, cfg, tokens)
     kvs = []
     for li in range(cfg.n_layers):
         layer = _layer(li)(params["layers"])
         ll = None if lora is None else _layer_lora(lora, li)
-        h = rmsnorm(x, layer["ln_attn"], cfg.norm_eps)
+        win = _window_for(cfg, li)
+        h = _norm(cfg, x, layer["ln_attn"])
         q, k, v = _attn_qkv(layer, cfg, h, positions,
                             lora=ll, adapter_ids=adapter_ids,
                             lora_scale=lora_scale)
         kvs.append(jnp.stack([k, v], axis=0))  # [2, B, S, Hkv, D]
         if prefix_kv is None:
             attn = causal_attention(
-                q, k, v, allow_pallas=use_pallas, window=cfg.sliding_window
+                q, k, v, allow_pallas=use_pallas, window=win,
+                softcap=cfg.attn_softcap,
             )
         else:
             k_full = jnp.concatenate([prefix_kv[li, 0], k], axis=1)
@@ -250,15 +322,21 @@ def prefill_forward(
             attn = causal_attention(
                 q, k_full, v_full, q_offset=P, allow_pallas=use_pallas,
                 prefix_pad=P if prefix_len is not None else None,
-                prefix_len=prefix_len, window=cfg.sliding_window,
+                prefix_len=prefix_len, window=win,
+                softcap=cfg.attn_softcap,
             )
         a = attn.reshape(B, S, -1)
-        x = x + a @ layer["wo"] + _lora_term(a, ll, "wo", adapter_ids, lora_scale)
-        h = rmsnorm(x, layer["ln_mlp"], cfg.norm_eps)
-        x = x + _mlp(layer, h)
-    x = rmsnorm(x, params["ln_out"], cfg.norm_eps)
-    logits = x @ params["lm_head"]
-    return logits, jnp.stack(kvs)
+        a = a @ layer["wo"] + _lora_term(a, ll, "wo", adapter_ids, lora_scale)
+        if cfg.post_norms:
+            a = _norm(cfg, a, layer["ln_post_attn"])
+        x = x + a
+        h = _norm(cfg, x, layer["ln_mlp"])
+        m = _mlp(layer, h, cfg)
+        if cfg.post_norms:
+            m = _norm(cfg, m, layer["ln_post_mlp"])
+        x = x + m
+    x = _norm(cfg, x, params["ln_out"])
+    return _final_logits(params, cfg, x), jnp.stack(kvs)
 
 
 def decode_forward(
@@ -295,27 +373,33 @@ def decode_forward(
     from ..kv.cache import write_token_kv
 
     B = tokens.shape[0]
-    x = params["embed"][tokens][:, None, :]  # [B, 1, dim]
+    x = _embed(params, cfg, tokens)[:, None, :]  # [B, 1, dim]
     pos = positions[:, None]
     for li in range(cfg.n_layers):
         layer = _layer(li)(params["layers"])
         ll = None if lora is None else _layer_lora(lora, li)
-        h = rmsnorm(x, layer["ln_attn"], cfg.norm_eps)
+        h = _norm(cfg, x, layer["ln_attn"])
         q, k, v = _attn_qkv(layer, cfg, h, pos, lora=ll,
                             adapter_ids=adapter_ids, lora_scale=lora_scale)
         # scatter this token's kv into its page slot
         cache = write_token_kv(cache, li, slot_block_ids, slot_ids, k[:, 0], v[:, 0])
         attn = paged_decode_attention(
             q[:, 0], cache[li], block_table, seq_lens, allow_pallas=use_pallas,
-            tp_mesh=tp_mesh, window=cfg.sliding_window,
+            tp_mesh=tp_mesh, window=_window_for(cfg, li),
+            softcap=cfg.attn_softcap,
         )
         a = attn.reshape(B, -1)[:, None, :]
-        x = x + a @ layer["wo"] + _lora_term(a, ll, "wo", adapter_ids, lora_scale)
-        h = rmsnorm(x, layer["ln_mlp"], cfg.norm_eps)
-        x = x + _mlp(layer, h)
-    x = rmsnorm(x, params["ln_out"], cfg.norm_eps)
-    logits = x[:, 0] @ params["lm_head"]
-    return logits, cache
+        a = a @ layer["wo"] + _lora_term(a, ll, "wo", adapter_ids, lora_scale)
+        if cfg.post_norms:
+            a = _norm(cfg, a, layer["ln_post_attn"])
+        x = x + a
+        h = _norm(cfg, x, layer["ln_mlp"])
+        m = _mlp(layer, h, cfg)
+        if cfg.post_norms:
+            m = _norm(cfg, m, layer["ln_post_mlp"])
+        x = x + m
+    x = _norm(cfg, x, params["ln_out"])
+    return _final_logits(params, cfg, x[:, 0]), cache
 
 
 def verify_forward(
@@ -345,23 +429,30 @@ def verify_forward(
     from ..kv.cache import write_tokens_kv
 
     B, S = tokens.shape
-    x = params["embed"][tokens]  # [B, S, dim]
+    x = _embed(params, cfg, tokens)  # [B, S, dim]
     for li in range(cfg.n_layers):
         layer = _layer(li)(params["layers"])
         ll = None if lora is None else _layer_lora(lora, li)
-        h = rmsnorm(x, layer["ln_attn"], cfg.norm_eps)
+        h = _norm(cfg, x, layer["ln_attn"])
         q, k, v = _attn_qkv(layer, cfg, h, positions, lora=ll,
                             adapter_ids=adapter_ids, lora_scale=lora_scale)
         cache = write_tokens_kv(cache, li, slot_block_ids, slot_ids, k, v)
         attn = paged_multitoken_attention_xla(
-            q, cache[li], block_table, positions, window=cfg.sliding_window
+            q, cache[li], block_table, positions, window=_window_for(cfg, li),
+            softcap=cfg.attn_softcap,
         )
         a = attn.reshape(B, S, -1)
-        x = x + a @ layer["wo"] + _lora_term(a, ll, "wo", adapter_ids, lora_scale)
-        h = rmsnorm(x, layer["ln_mlp"], cfg.norm_eps)
-        x = x + _mlp(layer, h)
-    x = rmsnorm(x, params["ln_out"], cfg.norm_eps)
-    return x @ params["lm_head"], cache
+        a = a @ layer["wo"] + _lora_term(a, ll, "wo", adapter_ids, lora_scale)
+        if cfg.post_norms:
+            a = _norm(cfg, a, layer["ln_post_attn"])
+        x = x + a
+        h = _norm(cfg, x, layer["ln_mlp"])
+        m = _mlp(layer, h, cfg)
+        if cfg.post_norms:
+            m = _norm(cfg, m, layer["ln_post_mlp"])
+        x = x + m
+    x = _norm(cfg, x, params["ln_out"])
+    return _final_logits(params, cfg, x), cache
 
 
 def loss_fn(params: Params, cfg: LlamaConfig, tokens: jax.Array) -> jax.Array:

@@ -742,6 +742,21 @@ def test_engine_serves_windowed_mistral_style_model():
     _family_engine_roundtrip(scaled(TINY, dtype=jnp.float32, sliding_window=6))
 
 
+def test_engine_serves_gemma2_style_model():
+    """Gemma-2 knobs through the full serving path: GeGLU, attention +
+    final logit softcaps, sandwich (post) norms with the (1+w) RMSNorm
+    convention, sqrt(dim) embed scaling, query_pre_attn_scalar, and
+    alternating local/global attention — paged decode must match dense."""
+    _family_engine_roundtrip(
+        scaled(
+            TINY, dtype=jnp.float32, act="gelu_tanh", attn_softcap=30.0,
+            final_softcap=15.0, norm_offset=True, post_norms=True,
+            embed_scale=True, query_pre_attn_scalar=24.0,
+            sliding_window=6, window_pattern=2,
+        )
+    )
+
+
 def test_top_p_nucleus_sampling():
     """top_p: a tiny nucleus (p→0) collapses to greedy even at temperature
     1; p=1.0 is a no-op vs plain categorical under the same key; sampled

@@ -227,3 +227,52 @@ def test_state_dict_entry_point():
     logits, kv = prefill_forward(params, cfg, tokens)
     assert logits.shape == (1, 3, cfg.vocab_size)
     assert kv.shape[0] == cfg.n_layers
+
+
+def test_gemma2_logits_match():
+    """Gemma-2 = GeGLU + logit softcaps + sandwich (post) norms + (1+w)
+    RMSNorm + sqrt(dim) embed scaling + query_pre_attn_scalar + alternating
+    local/global attention + tied embeddings.  A tiny window on a prompt
+    longer than the window exercises the even-layer sliding mask."""
+    hf_cfg = transformers.Gemma2Config(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=4,  # even: alternation pattern fully exercised
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        head_dim=32,  # decoupled: 4 * 32 != 64
+        max_position_embeddings=128,
+        rms_norm_eps=1e-6,
+        rope_theta=10000.0,
+        attn_logit_softcapping=50.0,
+        final_logit_softcapping=30.0,
+        query_pre_attn_scalar=24.0,
+        sliding_window=8,
+        hidden_activation="gelu_pytorch_tanh",
+        tie_word_embeddings=True,
+        attention_bias=False,
+    )
+    torch.manual_seed(3)
+    with torch.no_grad():
+        model = transformers.Gemma2ForCausalLM(hf_cfg)
+        for p in model.parameters():
+            p.mul_(3.0)
+    model.eval()
+    cfg = config_from_hf(model.config, dtype=jnp.float32)
+    assert cfg.act == "gelu_tanh" and cfg.post_norms and cfg.norm_offset
+    assert cfg.attn_softcap == 50.0 and cfg.final_softcap == 30.0
+    assert cfg.sliding_window == 8 and cfg.window_pattern == 2
+    assert cfg.head_dim == 32
+    params = params_from_hf(model, cfg)
+
+    tokens = np.array(
+        [[5, 17, 99, 3, 42, 200, 7, 1, 88, 23, 150, 66, 9, 4, 31, 77]],
+        dtype=np.int64,
+    )  # 16 tokens > window 8
+    with torch.no_grad():
+        want = model(torch.from_numpy(tokens)).logits.numpy()
+    got, _ = prefill_forward(params, cfg, jnp.asarray(tokens, dtype=jnp.int32))
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), want, rtol=2e-3, atol=2e-3
+    )
