@@ -115,39 +115,18 @@ def bench_tpu_leg(timeout_s: int = 900) -> dict:
     """Run the TPU-in-the-loop leg (bench_tpu.py) in a subprocess with a hard
     timeout: a wedged TPU tunnel must never hang the driver bench.
 
-    Gate (VERDICT r2 weak #1 — one 60 s probe cost the whole round's
-    hardware evidence): probe up to 3x with backoff spread over ~5 min (a
-    wedged tunnel can recover between probes), and if every probe HANGS,
-    attempt the leg anyway — bench_tpu.py has its own init watchdog and
-    exits cleanly when the backend can't come up, so the worst case is
-    bounded and the best case recovers the round's numbers.  Only a CLEAN
-    "this host has no tpu" answer skips the leg.  Returns the leg's JSON
-    dict, or {} if no TPU / timeout / failure."""
+    The leg's own staged init watchdog bounds a hung PJRT client AND names
+    the phase it hung in, so there is no separate probe step.  Returns the
+    leg's JSON dict on success, ``{"unavailable": <structured failure
+    record>}`` when init hung or found no TPU (surfaced in the bench output
+    as ``tpu_unavailable``), or {} on timeout/unparseable output."""
     if os.environ.get("ISTPU_BENCH_TPU") == "0":
         return {}
     script = os.path.join(os.path.dirname(os.path.abspath(__file__)), "bench_tpu.py")
-    probe_ok = False
-    for attempt in range(3):
-        try:
-            probe = subprocess.run(
-                [sys.executable, "-c",
-                 "import jax; print(jax.devices()[0].platform)"],
-                capture_output=True, timeout=75,
-            )
-        except subprocess.TimeoutExpired:
-            print(f"# tpu probe {attempt + 1}/3 hung (tunnel wedged?)",
-                  file=sys.stderr)
-            if attempt < 2:
-                time.sleep(30 * (attempt + 1))
-            continue
-        if probe.returncode == 0 and probe.stdout.decode().strip() == "tpu":
-            probe_ok = True
-            break
-        print("# tpu leg: no tpu device, skipping", file=sys.stderr)
-        return {}
-    if not probe_ok:
-        print("# tpu probes all hung; attempting leg anyway under its own "
-              "watchdog", file=sys.stderr)
+    # No separate probe: bench_tpu.py's staged init watchdog bounds a wedged
+    # tunnel by itself AND names the phase it hung in (round-3's probe loop
+    # burned ~5 min to learn only "hung").  Worst case here is one
+    # init-timeout; best case recovers the round's hardware numbers.
     try:
         # own process group: on timeout we must also kill the server
         # subprocess bench_tpu spawns (SIGKILL to the leg alone would orphan
@@ -177,8 +156,24 @@ def bench_tpu_leg(timeout_s: int = 900) -> dict:
         print("# tpu leg: timed out mid-run", file=sys.stderr)
         return {}
     if r.returncode != 0:
-        tail = r.stderr.decode(errors="replace")[-300:].replace("\n", " | ")
-        print(f"# tpu leg: unavailable ({tail})", file=sys.stderr)
+        # structured failure: bench_tpu's watchdog prints a JSON record
+        # naming the init phase reached + relay socket picture; fold it (and
+        # the stderr tail, which carries the faulthandler stack of the hung
+        # init thread) into the bench output so the round's BENCH file
+        # documents exactly WHY hardware was unreachable
+        stderr_tail = r.stderr.decode(errors="replace")[-1200:]
+        print(f"# tpu leg: unavailable ({stderr_tail[-300:].replace(chr(10), ' | ')})",
+              file=sys.stderr)
+        rec: dict = {}
+        for line in reversed(r.stdout.decode(errors="replace").strip().splitlines()):
+            try:
+                rec = json.loads(line)
+                break
+            except ValueError:
+                continue
+        if rec.get("error"):
+            rec["stderr_tail"] = stderr_tail
+            return {"unavailable": rec}
         return {}
     try:
         return json.loads(r.stdout.decode().strip().splitlines()[-1])

@@ -73,7 +73,11 @@ def _truncate_logits(l: jax.Array, top_k: jax.Array, top_p: jax.Array) -> jax.Ar
     slk = jnp.where(sl < kth, -jnp.inf, sl)
     probs = jax.nn.softmax(slk, axis=-1)  # -inf -> 0; survivors renormalized
     excl = jnp.cumsum(probs, axis=-1) - probs
-    kept = jnp.where(excl < top_p[:, None], slk, jnp.inf)
+    # top_p >= 1.0 rows keep everything unconditionally: f32 cumsum of the
+    # softmax can hit exactly 1.0 before the last survivor, so `excl < 1.0`
+    # alone would drop tail tokens nucleus is supposed to leave alone.
+    keep_all = (top_p >= 1.0)[:, None]
+    kept = jnp.where(keep_all | (excl < top_p[:, None]), slk, jnp.inf)
     pthresh = jnp.min(kept, axis=-1, keepdims=True)  # [B, 1]
     return jnp.where(lk < pthresh, -jnp.inf, lk)
 

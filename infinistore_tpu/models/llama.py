@@ -114,7 +114,7 @@ GEMMA2_9B = LlamaConfig(  # GeGLU, softcaps, sandwich norms, local/global
     ffn_dim=14336, rope_theta=10000.0, norm_eps=1e-6,
     head_dim_override=256, act="gelu_tanh", attn_softcap=50.0,
     final_softcap=30.0, norm_offset=True, post_norms=True, embed_scale=True,
-    query_pre_attn_scalar=224.0, sliding_window=4096, window_pattern=2,
+    query_pre_attn_scalar=256.0, sliding_window=4096, window_pattern=2,
 )
 
 

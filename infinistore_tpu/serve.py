@@ -388,6 +388,8 @@ class _TextAccum:
         self._toks: List[str] = []  # token strings (incremental path)
         self._p = 0  # prefix offset: tokens already folded into _text
         self._r = 0  # read offset: end of the last complete decode window
+        self._hcur = 0  # emit_ids_horizon cursor into _miles (incr path)
+        self._hids = 0  # last horizon id count (fallback path)
 
     def _ingest(self, ids: List[int]) -> None:
         if not self._incr:
@@ -445,16 +447,58 @@ class _TextAccum:
                 self._miles.append((len(self.ids), len(self._text)))
         return self._release(final=True)[0]
 
-    def _covering_prefix_fallback(self) -> int:
-        """Smallest id count whose full decode covers the stop horizon
-        (bisection; only runs once, at stop time, on the fallback path)."""
-        lo, hi = 0, len(self.ids)
+    def _covering_prefix(self, chars: int) -> int:
+        """Smallest id count whose decoded text covers ``chars`` — the one
+        id/text correspondence rule, shared by ``visible_ids`` (stop
+        truncation) and ``emit_ids_horizon`` (streaming) so the two can
+        never disagree about which ids a char boundary maps to."""
+        if chars <= 0:
+            # a boundary at char 0 (e.g. the model echoes the stop
+            # immediately) maps to ZERO ids
+            return 0
+        if self._incr:
+            for n, c in self._miles:
+                if c >= chars:
+                    return n
+            return len(self.ids)
+        lo, hi = 0, len(self.ids)  # bisection on the fallback path
         while lo < hi:
             mid = (lo + hi) // 2
-            if len(self.tok.decode(self.ids[:mid])) >= self.stop_cut:
+            if len(self.tok.decode(self.ids[:mid])) >= chars:
                 hi = mid
             else:
                 lo = mid + 1
+        return lo
+
+    def emit_ids_horizon(self) -> int:
+        """ids safe to stream now: the prefix whose decode is covered by
+        the RELEASED text.  Ids for held-back text (stop-prefix / partial
+        UTF-8 tail) are withheld with it, so a stop that later completes
+        can never leave the client holding ids past the stop cut; any
+        future cut is >= ``emitted``, hence maps to >= this many ids.
+
+        Called once per streamed chunk, so it keeps a cursor instead of
+        re-deriving from scratch: ``emitted`` only grows and ``_miles`` is
+        monotone, making the incremental path O(1) amortized; the fallback
+        path restarts its bisection above the last horizon (that path's
+        ``_ingest`` full re-decode dominates anyway)."""
+        if self.emitted <= 0:
+            return 0
+        if self._incr:
+            i = self._hcur
+            miles = self._miles
+            while i < len(miles) and miles[i][1] < self.emitted:
+                i += 1
+            self._hcur = i
+            return miles[i][0] if i < len(miles) else len(self.ids)
+        lo, hi = self._hids, len(self.ids)
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if len(self.tok.decode(self.ids[:mid])) >= self.emitted:
+                hi = mid
+            else:
+                lo = mid + 1
+        self._hids = lo
         return lo
 
     @property
@@ -469,14 +513,7 @@ class _TextAccum:
         generated."""
         if self.stop_cut is None:
             return list(self.ids)
-        if not self._incr:
-            return self.ids[: self._covering_prefix_fallback()]
-        # virtual (0, 0) milestone: a stop matching at char 0 (the model
-        # echoes the stop immediately) maps to ZERO visible ids
-        for n, chars in ((0, 0), *self._miles):
-            if chars >= self.stop_cut:
-                return self.ids[:n]
-        return list(self.ids)
+        return self.ids[: self._covering_prefix(self.stop_cut)]
 
 
 def _make_handler(server: ServingServer):
@@ -680,11 +717,16 @@ def _make_handler(server: ServingServer):
                             server.cancel(req_id)
                             done()
                             return
-                        # every id is delivered even when its text is held
-                        # back (stop-prefix / partial UTF-8): id stream
-                        # stays complete, text stream stays safe
-                        emit(val, delta)
-                        ids_sent += len(val)
+                        # ids ride the same release horizon as the text:
+                        # ids for held-back chars are withheld too, so the
+                        # streamed id total can never pass a stop cut that
+                        # only completes later
+                        horizon = accum.emit_ids_horizon()
+                        if horizon > ids_sent or delta:
+                            # skip content-free chunks (all of ids/text held
+                            # back behind a stop prefix or partial UTF-8)
+                            emit(accum.ids[ids_sent:horizon], delta)
+                            ids_sent = horizon
                     elif kind == "error":
                         err = json.dumps({"error": val})
                         self.wfile.write(f"data: {err}\n\n".encode())
@@ -694,9 +736,14 @@ def _make_handler(server: ServingServer):
                         tail = accum.finish() if accum is not None else ""
                         # final chunk announces finish_reason before [DONE]
                         fin = val
-                        if accum is not None and accum.stop_cut is not None:
-                            fin = "stop"
-                        emit([], tail or None, finish=fin)
+                        last_ids: List[int] = []
+                        if accum is not None:
+                            if accum.stop_cut is not None:
+                                fin = "stop"
+                            # flush the withheld tail ids (stop-truncated
+                            # when a stop was found at finish)
+                            last_ids = accum.visible_ids()[ids_sent:]
+                        emit(last_ids, tail or None, finish=fin)
                         done()
                         return
             except (BrokenPipeError, ConnectionResetError):

@@ -431,6 +431,50 @@ def test_streaming_text_deltas(text_server):
     assert text == tok.decode(want)
 
 
+def test_streaming_ids_respect_stop_horizon(text_server):
+    """Streamed token_ids ride the text release horizon: when a stop
+    string completes mid-stream, the concatenation of every chunk's
+    token_ids equals the non-streaming response's stop-truncated ids —
+    the client is never left holding ids past the stop cut."""
+    tok = text_server.tokenizer
+    full = dense_greedy(PROMPT, 8)
+    stop_char = tok.decode([full[3]])
+    req = {"prompt": PROMPT, "max_tokens": 8, "temperature": 0,
+           "stop": stop_char}
+    status, body = _post(text_server.port, req)
+    assert status == 200, body
+    want_ids = body["choices"][0]["token_ids"]
+    want_text = body["choices"][0]["text"]
+
+    conn = http.client.HTTPConnection("127.0.0.1", text_server.port,
+                                      timeout=120)
+    conn.request("POST", "/v1/completions",
+                 json.dumps({**req, "stream": True}),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    assert resp.status == 200
+    ids, text, done = [], "", False
+    buf = b""
+    while not done:
+        chunk = resp.read1(65536)
+        if not chunk:
+            break
+        buf += chunk
+        while b"\n\n" in buf:
+            event, buf = buf.split(b"\n\n", 1)
+            payload = event[len(b"data: "):]
+            if payload == b"[DONE]":
+                done = True
+                break
+            choice = json.loads(payload)["choices"][0]
+            ids.extend(choice["token_ids"])
+            text += choice.get("text", "") or ""
+    conn.close()
+    assert done
+    assert text == want_text
+    assert ids == want_ids
+
+
 def test_chat_completions(text_server):
     """OpenAI chat surface: messages are templated into a prompt (fallback
     role-tagged transcript for tokenizers without a chat template) and the
