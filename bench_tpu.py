@@ -218,6 +218,51 @@ def leg_engine(out: dict) -> None:
     out["decode_tok_s_tiny"] = round(128 / dt, 1)
 
 
+def leg_speculative(out: dict) -> None:
+    """Speculative vs plain decode tokens/s (VERDICT r3 next #2's recorded
+    comparison).  Self-draft on the bench model: acceptance ~1, so the
+    measured ratio is the upper bound the dispatch pipeline can deliver at
+    k=4 (real deployments trade it against draft quality)."""
+    import jax
+    import numpy as np
+
+    from infinistore_tpu.engine.engine import InferenceEngine
+    from infinistore_tpu.engine.speculative import SpeculativeDecoder
+    from infinistore_tpu.kv.cache import PagedCacheConfig
+    from infinistore_tpu.models.llama import init_params, scaled
+
+    cfg = scaled(_bench_model())
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    jax.block_until_ready(params)
+
+    def eng():
+        return InferenceEngine(params, cfg, PagedCacheConfig(
+            n_layers=cfg.n_layers, n_kv_heads=cfg.n_kv_heads,
+            head_dim=cfg.head_dim, block_tokens=16, n_blocks=256,
+            dtype="bfloat16",
+        ))
+
+    prompt = [int(x) for x in np.arange(1, 65)]
+    N = 96
+    plain = eng()
+    st = plain.prefill(prompt)
+    plain.decode(st, 32)  # compile
+    t0 = time.perf_counter()
+    plain.decode(st, N)
+    t_plain = time.perf_counter() - t0
+    out["plain_tok_s"] = round(N / t_plain, 1)
+
+    spec = SpeculativeDecoder(eng(), eng(), k=4)
+    st_t, st_d = spec.prefill(prompt)
+    spec.decode(st_t, st_d, 8)  # compile propose/verify shapes
+    t0 = time.perf_counter()
+    spec.decode(st_t, st_d, N)
+    t_spec = time.perf_counter() - t0
+    out["spec_tok_s"] = round(N / t_spec, 1)
+    out["spec_speedup"] = round(t_plain / t_spec, 2)
+    out["spec_acceptance"] = round(spec.acceptance_rate, 3)
+
+
 def _chip_peak_flops_bf16(device_kind: str) -> float:
     """Per-chip peak bf16 FLOPs/s by device kind (public spec sheets); the
     MFU denominator.  Falls back to v5e when the kind is unrecognized."""
@@ -566,6 +611,7 @@ def main() -> int:
         ("decode_kernel", leg_decode_kernel),
         ("model_perf", leg_model_perf),
         ("engine", leg_engine),
+        ("speculative", leg_speculative),
         ("flash_kernel", leg_flash_kernel),
         ("prefill_stream", leg_prefill_stream),
         # real chip only (ISTPU_TEST_TPU=1 un-pins the test conftest's CPU

@@ -1,7 +1,12 @@
 """Speculative decoding: a draft model proposes, the target verifies.
 
 The reference serves through vLLM, whose speculative mode is a headline
-throughput feature; ours is rebuilt on the paged TPU engine.  Per round:
+throughput feature; ours is rebuilt on the paged TPU engine and SERVED
+through the scheduler's batch=1 fast path (``Scheduler(draft_engine=...)``,
+``serve.py --draft-model``): speculation engages exactly when the chip is
+latency-bound (one request in flight) and steps aside when lockstep
+batching already fills the MXU.  Acceptance counters surface in
+``/metrics`` (``istpu_spec_*``).  Per round:
 
 1. the DRAFT engine scan-decodes ``k`` proposal tokens (cheap model, its own
    paged cache);
@@ -101,6 +106,38 @@ class SpeculativeDecoder:
         if rng is None:
             self._rng, rng = jax.random.split(self._rng)
         out: List[int] = []
+        try:
+            out = self._rounds(st_t, st_d, n_steps, sample, temperature,
+                               top_k, top_p, rng)
+        except MemoryError:
+            # an allocator (draft or target) ran dry mid-round.  Mid-decode
+            # the target state is NOT decode-ready — the round's final
+            # emitted token's KV is only written by the NEXT round's verify
+            # and ``last_logits`` is only refreshed at the successful end —
+            # so a caller falling back to the plain decode path would
+            # silently resample stale logits over an unwritten KV slot.
+            # Re-verify the tail to restore decode-readiness, then
+            # propagate (if the TARGET is the dry pool this raises again,
+            # exactly like the plain batch=1 path would).
+            st_t.last_logits = self.target.verify(
+                st_t, [st_t.tokens[-1]], len(st_t.tokens) - 1
+            )[-1]
+            raise
+        excess = len(out) - n_steps
+        if excess:
+            del out[n_steps:]
+            del st_t.tokens[-excess:]
+            self._resync_draft(st_d, list(st_t.tokens))
+        # verify rounds do not carry logits for the bonus token, so refresh
+        # last_logits to leave the target state decode()-ready
+        st_t.last_logits = self.target.verify(
+            st_t, [st_t.tokens[-1]], len(st_t.tokens) - 1
+        )[-1]
+        return out
+
+    def _rounds(self, st_t, st_d, n_steps, sample, temperature, top_k,
+                top_p, rng) -> List[int]:
+        out: List[int] = []
         while len(out) < n_steps:
             k = self.k
             if sample == "greedy":
@@ -175,17 +212,6 @@ class SpeculativeDecoder:
 
             # 4. resync the draft onto the accepted sequence
             self._resync_draft(st_d, list(st_t.tokens))
-
-        excess = len(out) - n_steps
-        if excess:
-            del out[n_steps:]
-            del st_t.tokens[-excess:]
-            self._resync_draft(st_d, list(st_t.tokens))
-        # verify rounds do not carry logits for the bonus token, so refresh
-        # last_logits to leave the target state decode()-ready
-        st_t.last_logits = self.target.verify(
-            st_t, [st_t.tokens[-1]], len(st_t.tokens) - 1
-        )[-1]
         return out
 
     @staticmethod

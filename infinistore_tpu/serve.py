@@ -53,14 +53,18 @@ class ServingServer:
 
     def __init__(self, engine, host: str = "127.0.0.1", port: int = 8000,
                  max_batch: int = 8, model_id: str = "infinistore-tpu",
-                 tokenizer=None):
+                 tokenizer=None, draft_engine=None, spec_k: int = 4):
         """``tokenizer``: any object with ``encode(str) -> [int]`` and
         ``decode([int]) -> str`` (an HF tokenizer qualifies) — enables
-        string prompts, text responses, and string stop sequences."""
+        string prompts, text responses, and string stop sequences.
+        ``draft_engine``: a second (smaller) ``InferenceEngine`` over the
+        same vocab turns on speculative decoding as the scheduler's
+        batch=1 fast path (``--draft-model``)."""
         self.engine = engine
         self.model_id = model_id
         self.tokenizer = tokenizer
-        self.sched = Scheduler(engine, max_batch=max_batch)
+        self.sched = Scheduler(engine, max_batch=max_batch,
+                               draft_engine=draft_engine, spec_k=spec_k)
         self._cv = threading.Condition()
         self._staged: List[Dict[str, Any]] = []   # submissions from handlers
         self._cancels: List[int] = []
@@ -165,33 +169,15 @@ class ServingServer:
                         self._queues.pop(req.req_id, None)
                 except Exception as e:
                     # last-resort fault path (validation keeps bad requests
-                    # out, so this is an engine/runtime failure): free every
-                    # page and tell waiting clients the truth — an error,
-                    # not a completion
+                    # out, so this is an engine/runtime failure): the
+                    # scheduler owns the cleanup invariants (fault_reset);
+                    # this layer only tells waiting clients the truth — an
+                    # error, not a completion
                     Logger.error(f"engine step failed: {e!r}")
-                    faulted = list(self.sched.active) + list(self.sched.pending)
-                    if self.sched._prefilling is not None:
-                        # the in-flight chunked prefill is in neither list:
-                        # release its pinned pages and fail its client too,
-                        # or has_work re-runs the failing step forever
-                        req, pp = self.sched._prefilling
-                        try:
-                            self.engine.abandon_prefill(pp)
-                        except Exception:  # noqa: BLE001 — already faulting
-                            pass
-                        self.sched._prefilling = None
-                        faulted.append(req)
-                    for req in faulted:
-                        if req.state is not None:
-                            self.engine.release(req.state)
-                            req.state = None
-                        req.done = True
-                        req.on_token = None
+                    for req in self.sched.fault_reset():
                         q = self._queues.pop(req.req_id, None)
                         if q is not None:
                             q.put(("error", f"engine fault: {e!r}"))
-                    self.sched.active.clear()
-                    self.sched.pending.clear()
 
     def _messages_to_ids(self, messages) -> List[int]:
         """Chat-completions prompt construction.  HF tokenizers bring their
@@ -350,6 +336,18 @@ class ServingServer:
             "# TYPE istpu_serve_free_kv_pages gauge",
             f"istpu_serve_free_kv_pages {self.engine.free_pages}",
         ]
+        if self.sched.spec is not None:
+            sm = self.sched.spec_metrics
+            lines += [
+                "# TYPE istpu_spec_rounds_total counter",
+                f"istpu_spec_rounds_total {sm['rounds']}",
+                "# TYPE istpu_spec_proposed_tokens_total counter",
+                f"istpu_spec_proposed_tokens_total {sm['proposed']}",
+                "# TYPE istpu_spec_accepted_tokens_total counter",
+                f"istpu_spec_accepted_tokens_total {sm['accepted']}",
+                "# TYPE istpu_spec_acceptance_rate gauge",
+                f"istpu_spec_acceptance_rate {sm['rate']}",
+            ]
         return "\n".join(lines) + "\n"
 
 
@@ -770,6 +768,15 @@ def main(argv: Optional[List[str]] = None) -> None:
     ap.add_argument("--n-blocks", type=int, default=512)
     ap.add_argument("--block-tokens", type=int, default=16)
     ap.add_argument("--prefill-chunk", type=int, default=None)
+    ap.add_argument("--draft-model", default=None,
+                    help="'tiny' or a local HF checkpoint dir for a draft "
+                         "model (same vocab as --model): turns on "
+                         "speculative decoding as the scheduler's batch=1 "
+                         "fast path")
+    ap.add_argument("--draft-n-blocks", type=int, default=None,
+                    help="draft KV pages (default: --n-blocks)")
+    ap.add_argument("--spec-k", type=int, default=4,
+                    help="draft tokens proposed per speculative round")
     ap.add_argument("--log-level", default="info")
     args = ap.parse_args(argv)
     Logger.set_log_level(args.log_level)
@@ -787,21 +794,20 @@ def main(argv: Optional[List[str]] = None) -> None:
     from .kv import PagedCacheConfig
     from .models import TINY, init_params
 
-    tokenizer = None
-    if args.model == "tiny":
-        cfg = TINY
-        params = init_params(cfg, jax.random.PRNGKey(0))
-        model_id = "tiny"
-    else:
+    def load_model(name: str, seed: int = 0):
+        if name == "tiny":
+            return TINY, init_params(TINY, jax.random.PRNGKey(seed))
         import transformers
 
         from .models.hf import config_from_hf, params_from_hf
 
-        hf = transformers.AutoModelForCausalLM.from_pretrained(args.model)
+        hf = transformers.AutoModelForCausalLM.from_pretrained(name)
         cfg = config_from_hf(hf.config)
-        params = params_from_hf(hf, cfg)
-        model_id = args.model
-        del hf
+        return cfg, params_from_hf(hf, cfg)
+
+    tokenizer = None
+    cfg, params = load_model(args.model)
+    model_id = args.model
     tok_src = args.tokenizer or (args.model if args.model != "tiny" else None)
     if tok_src is not None:
         import transformers
@@ -813,9 +819,28 @@ def main(argv: Optional[List[str]] = None) -> None:
         block_tokens=args.block_tokens, dtype=cfg.dtype,
     )
     engine = InferenceEngine(params, cfg, pc, prefill_chunk=args.prefill_chunk)
+    draft_engine = None
+    if args.draft_model is not None:
+        # the draft proposes tokens the target verifies, so the vocabs must
+        # agree; pages must chunk identically for the two caches to track
+        # the same sequence (SpeculativeDecoder asserts block_tokens)
+        dcfg, dparams = load_model(args.draft_model, seed=1)
+        if dcfg.vocab_size != cfg.vocab_size:
+            raise SystemExit(
+                f"--draft-model vocab {dcfg.vocab_size} != target vocab "
+                f"{cfg.vocab_size}; speculation needs a shared vocabulary"
+            )
+        dpc = PagedCacheConfig(
+            n_layers=dcfg.n_layers, n_kv_heads=dcfg.n_kv_heads,
+            head_dim=dcfg.head_dim,
+            n_blocks=args.draft_n_blocks or args.n_blocks,
+            block_tokens=args.block_tokens, dtype=dcfg.dtype,
+        )
+        draft_engine = InferenceEngine(dparams, dcfg, dpc)
     srv = ServingServer(engine, host=args.host, port=args.port,
                         max_batch=args.max_batch, model_id=model_id,
-                        tokenizer=tokenizer)
+                        tokenizer=tokenizer, draft_engine=draft_engine,
+                        spec_k=args.spec_k)
     srv.start()
     try:
         while True:

@@ -53,6 +53,53 @@ def _post(port, body, timeout=120, path="/v1/completions"):
     return resp.status, json.loads(data)
 
 
+@pytest.fixture(scope="module")
+def spec_server():
+    """A server with a draft engine attached: speculation as the scheduler's
+    batch=1 fast path, reachable over HTTP (VERDICT r3 next #2)."""
+    def make(params, cfg):
+        return InferenceEngine(
+            params, cfg,
+            PagedCacheConfig(
+                n_layers=cfg.n_layers, n_kv_heads=cfg.n_kv_heads,
+                head_dim=cfg.head_dim, n_blocks=64, block_tokens=4,
+                dtype=cfg.dtype,
+            ),
+        )
+
+    eng = make(PARAMS, CFG)
+    eng.decode_chunk = 4
+    dcfg = scaled(TINY, dtype=jnp.float32, n_layers=1, dim=64, ffn_dim=128)
+    draft = make(init_params(dcfg, jax.random.PRNGKey(99)), dcfg)
+    srv = ServingServer(eng, port=0, max_batch=4, model_id="tiny-spec",
+                        draft_engine=draft, spec_k=3)
+    srv.start()
+    yield srv
+    srv.close()
+
+
+def test_speculative_http_matches_greedy(spec_server):
+    """An HTTP request served through speculation returns exactly the
+    non-speculative greedy output, and /metrics reports the speculative
+    counters."""
+    status, body = _post(spec_server.port, {
+        "prompt": PROMPT, "max_tokens": 10, "temperature": 0,
+    })
+    assert status == 200, body
+    assert body["choices"][0]["token_ids"] == dense_greedy(PROMPT, 10)
+
+    conn = http.client.HTTPConnection("127.0.0.1", spec_server.port,
+                                      timeout=30)
+    conn.request("GET", "/metrics")
+    resp = conn.getresponse()
+    text = resp.read().decode()
+    conn.close()
+    assert "istpu_spec_acceptance_rate" in text
+    rounds = [line for line in text.splitlines()
+              if line.startswith("istpu_spec_rounds_total")]
+    assert rounds and float(rounds[0].split()[1]) >= 1  # fast path ran
+
+
 def test_completion_matches_greedy(server):
     status, body = _post(server.port, {
         "prompt": PROMPT, "max_tokens": 6, "temperature": 0,
