@@ -328,6 +328,11 @@ class Scheduler:
         source of truth."""
         if req._spec_off:
             return False
+        # the fast path drives the target through verify(), which never
+        # reclaims; a fully-windowed target would otherwise grow its pool
+        # without bound.  Trim-safe here by the same argument as decode
+        # entry: spec.decode never rewinds below entry+n_steps.
+        self.engine._reclaim_window_pages(req.state)
         st_d = self._draft_state_for(req)
         if st_d is None:
             return False

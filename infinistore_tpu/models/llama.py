@@ -58,9 +58,10 @@ class LlamaConfig:
     rope_scaling: Tuple[float, float, float, int] | None = None
     attn_bias: bool = False  # QKV projection biases (Qwen2/2.5)
     qk_norm: bool = False  # per-head RMSNorm on Q/K before RoPE (Qwen3)
-    # attend only to the last N positions (Mistral SWA).  Pages beyond the
-    # window stay allocated (the paged cache is append-only); the mask makes
-    # them invisible.
+    # attend only to the last N positions (Mistral SWA).  When EVERY layer
+    # is windowed (pattern 1) the engine returns window-dead pages to the
+    # pool (engine._reclaim_window_pages); mixed local/global stacks keep
+    # all pages (blocks span the layer stack) and the mask hides them.
     sliding_window: int | None = None
     # the window applies to layers with ``li % window_pattern == 0``
     # (Gemma-2 alternates local/global attention: pattern 2); pattern 1 =

@@ -328,6 +328,43 @@ def test_scheduler_spec_draft_pool_dry_falls_back_correctly():
     assert sched.draft.free_pages == 4  # draft state dropped, pages home
 
 
+def test_scheduler_spec_windowed_target_reclaims_pages():
+    """Fully-windowed target on the speculative fast path: verify() never
+    reclaims, so the fast path must reclaim at entry — a pool too small
+    for the un-reclaimed generation still completes WITHOUT tripping the
+    mid-round MemoryError that would permanently disable speculation."""
+    from infinistore_tpu.models import init_params, scaled
+
+    wcfg = scaled(CFG, sliding_window=8)
+    wparams = init_params(wcfg, jax.random.PRNGKey(21))
+
+    def weng(n_blocks):
+        pc = PagedCacheConfig(
+            n_layers=wcfg.n_layers, n_kv_heads=wcfg.n_kv_heads,
+            head_dim=wcfg.head_dim, n_blocks=n_blocks, block_tokens=T,
+            dtype=wcfg.dtype,
+        )
+        return InferenceEngine(wparams, wcfg, pc)
+
+    plain = Scheduler(weng(64))
+    rid = plain.submit(PROMPT, max_new_tokens=60)
+    want = plain.run()[rid]
+
+    # 11 + 60 tokens -> 18 pages un-reclaimed; pool of 12 forces reclaim
+    sched = Scheduler(weng(12), draft_engine=make_engine(
+        DRAFT_PARAMS, DRAFT_CFG), spec_k=4)
+    rid = sched.submit(PROMPT, max_new_tokens=60)
+    results = {}
+    reqs = []
+    while sched.has_work:
+        for r in sched.step():
+            results[r.req_id] = r.output
+            reqs.append(r)
+    assert results[rid] == want
+    assert reqs and not reqs[0]._spec_off  # speculation survived throughout
+    assert sched.spec.rounds >= 5
+
+
 def test_scheduler_fault_reset_releases_everything():
     """fault_reset: every page (target and draft) returns to the pools,
     queues drain, and dropped requests come back marked done."""
