@@ -768,7 +768,8 @@ class InferenceEngine:
 
     # ---- decode ----
 
-    def _decode_many(self, n_steps: int, variant: str, collect: bool = False):
+    def _decode_many(self, n_steps: int, variant: str, collect: bool = False,
+                     logprobs_k: int = 0):
         """Compiled ``n_steps``-token decode: a ``lax.scan`` whose body
         samples on device (no per-token host sync) and derives the KV scatter
         slot from the device-resident block table.  Works for any batch of
@@ -790,10 +791,19 @@ class InferenceEngine:
         [n_steps, B, V] — the draft side of speculative decoding needs
         q_i(x) for the accept/reject test (``propose``).
 
+        ``logprobs_k > 0`` additionally emits, per step, the chosen token's
+        log-probability and the top-k (ids, logprobs) alternatives from the
+        RAW model distribution (pre-temperature log-softmax — the OpenAI
+        ``logprobs`` convention), all computed on device inside the scan so
+        serving logprobs costs one top-k per step, not a [V]-logit
+        download.  Mutually exclusive with ``collect`` (the speculative
+        path's full-distribution capture).
+
         The reference decodes through vLLM's CUDA-graph step loop; the TPU
         analog is one traced scan so XLA pipelines all ``n_steps`` steps
         without returning to Python (VERDICT round-1 weak #9)."""
-        cache_key = (n_steps, variant, collect)
+        assert not (collect and logprobs_k), "collect and logprobs are exclusive"
+        cache_key = (n_steps, variant, collect, logprobs_k)
         fn = self._decode_many_cache.get(cache_key)
         if fn is not None:
             return fn
@@ -801,7 +811,8 @@ class InferenceEngine:
         decode_fn = self._decode_raw
         # engines with the same model family/config/paging share ONE
         # compiled scan (decode_fn identity is memoized by _shared_partial)
-        global_key = ("decode_many", decode_fn, T, n_steps, variant, collect)
+        global_key = ("decode_many", decode_fn, T, n_steps, variant, collect,
+                      logprobs_k)
         fn = _JIT_CACHE.get(global_key)
         if fn is not None:
             self._decode_many_cache[cache_key] = fn
@@ -849,15 +860,24 @@ class InferenceEngine:
                     slot_ids=pos % T,
                     **lkw,
                 )
-                y = (tok, probs) if collect else tok
+                if logprobs_k:
+                    lp = jax.nn.log_softmax(
+                        logits.astype(jnp.float32), axis=-1
+                    )
+                    chosen = jnp.take_along_axis(lp, tok[:, None], axis=1)[:, 0]
+                    top_lp, top_id = jax.lax.top_k(lp, logprobs_k)
+                    y = (tok, chosen, top_id.astype(jnp.int32), top_lp)
+                elif collect:
+                    y = (tok, probs)
+                else:
+                    y = tok
                 return (logits2, cache, rng), y
 
             (logits, cache, _), ys = jax.lax.scan(
                 step, (logits0, cache, rng), jnp.arange(n_steps)
             )
-            if collect:
-                toks, probs = ys
-                return toks, probs, logits, cache
+            if collect or logprobs_k:
+                return (*ys, logits, cache)
             return ys, logits, cache
 
         fn = jax.jit(many, donate_argnums=(3,))
@@ -900,6 +920,8 @@ class InferenceEngine:
         top_k=0,
         top_p=1.0,
         rng: Optional[jax.Array] = None,
+        logprobs: int = 0,
+        logprobs_rows: Optional[Sequence[bool]] = None,
     ) -> List[List[int]]:
         """Decode ``n_steps`` tokens for a batch of sequences in lockstep
         (vLLM-style batched decode; sequences may have different lengths —
@@ -916,7 +938,15 @@ class InferenceEngine:
         Pages for the whole run are allocated up front and block tables are
         built once; the token loop runs on device in compiled chunks
         (``decode_chunk`` tokens per dispatch), so the only host syncs are
-        the per-chunk token downloads."""
+        the per-chunk token downloads.
+
+        ``logprobs=k > 0`` switches to the logprob-collecting program and
+        returns ``(outs, lps)`` where ``lps[b]`` holds one record per
+        generated token: ``(chosen_logprob, [(token_id, logprob) x k])``
+        from the raw model distribution (OpenAI ``logprobs``).
+        ``logprobs_rows`` limits the HOST-side record building to the rows
+        that asked (the device program is per-batch either way); other
+        rows get empty lists."""
         B = len(states)
         assert B >= 1
         samples = (
@@ -968,11 +998,12 @@ class InferenceEngine:
             None if self.lora is None
             else jnp.asarray([st.adapter_id for st in states], jnp.int32)
         )
+        lps: List[List[tuple]] = [[] for _ in range(B)]
         remaining = n_steps
         while remaining > 0:
             chunk = min(remaining, self.decode_chunk)
             rng, sub = jax.random.split(rng)
-            toks, logits, self.cache = self._decode_many(chunk, variant)(
+            res = self._decode_many(chunk, variant, logprobs_k=logprobs)(
                 self.params,
                 logits,
                 jnp.asarray(pos),
@@ -986,6 +1017,22 @@ class InferenceEngine:
                 lora_t,
                 aid_d,
             )
+            if logprobs:
+                toks, chosen, top_id, top_lp, logits, self.cache = res
+                h_ch = np.asarray(chosen)   # [chunk, B]
+                h_ti = np.asarray(top_id)   # [chunk, B, k]
+                h_tl = np.asarray(top_lp)   # [chunk, B, k]
+                for b in range(B):
+                    if logprobs_rows is not None and not logprobs_rows[b]:
+                        continue  # row didn't ask; skip the tuple building
+                    lps[b].extend(
+                        (float(h_ch[s, b]),
+                         [(int(h_ti[s, b, j]), float(h_tl[s, b, j]))
+                          for j in range(logprobs)])
+                        for s in range(chunk)
+                    )
+            else:
+                toks, logits, self.cache = res
             host_toks = np.asarray(toks)  # [chunk, B]; one sync/chunk
             for b in range(B):
                 out[b].extend(int(t) for t in host_toks[:, b])
@@ -994,6 +1041,8 @@ class InferenceEngine:
         for b, st in enumerate(states):
             st.tokens.extend(out[b])
             st.last_logits = logits[b]
+        if logprobs:
+            return out, lps
         return out
 
     def propose(

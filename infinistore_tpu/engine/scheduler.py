@@ -56,6 +56,10 @@ class Request:
     top_k: int = 0
     top_p: float = 1.0
     adapter_id: int = 0  # LoRA adapter slot (0 = base model)
+    # OpenAI logprobs: collect the chosen token's logprob + the top-k
+    # alternatives per generated token (0 = off); records land in lp_data
+    # aligned 1:1 with output
+    logprobs: int = 0
     # streaming: called at every chunk boundary with the newly visible
     # tokens (already eos/budget-trimmed), then once with ([], True) at
     # retirement — the vLLM streaming-generator analog at chunk granularity
@@ -63,6 +67,7 @@ class Request:
     # filled by the scheduler
     state: Optional[SequenceState] = None
     output: List[int] = field(default_factory=list)
+    lp_data: List[tuple] = field(default_factory=list)
     done: bool = False
     cancelled: bool = False
     _sent: int = 0
@@ -75,6 +80,11 @@ class Request:
 
 
 class Scheduler:
+    # logprob requests all collect this many alternatives on device (ONE
+    # compiled top-k shape per chunk length; rows slice down to what they
+    # asked for host-side) — also the admission cap for top_logprobs
+    LOGPROBS_K = 8
+
     def __init__(self, engine: InferenceEngine, max_batch: int = 8,
                  rng: Optional[jax.Array] = None,
                  draft_engine: Optional[InferenceEngine] = None,
@@ -117,6 +127,7 @@ class Scheduler:
         top_k: int = 0,
         top_p: float = 1.0,
         adapter_id: int = 0,
+        logprobs: int = 0,
         on_token: Optional[Callable[[List[int], bool], None]] = None,
     ) -> int:
         if sample == "greedy":
@@ -131,7 +142,9 @@ class Scheduler:
             req_id=self._next_id, tokens=list(tokens),
             max_new_tokens=max_new_tokens, eos_ids=stops or None,
             sample=sample, temperature=temperature, top_k=top_k,
-            top_p=top_p, adapter_id=adapter_id, on_token=on_token,
+            top_p=top_p, adapter_id=adapter_id,
+            logprobs=min(max(int(logprobs), 0), self.LOGPROBS_K),
+            on_token=on_token,
         )
         self._next_id += 1
         self.pending.append(req)
@@ -281,6 +294,7 @@ class Scheduler:
             hit_eos = bool(req.eos_ids) and not set(req.eos_ids).isdisjoint(out)
             if req.cancelled or hit_eos or len(out) >= req.max_new_tokens:
                 del out[self._visible_len(req):]
+                del req.lp_data[len(out):]  # aligned 1:1 with output
                 req.done = True
                 self._stream(req, done=True)
                 self._drop_draft(req)
@@ -399,6 +413,7 @@ class Scheduler:
             for r in self.active:
                 self._drop_draft(r)
         elif (self.spec is not None and self.active[0].adapter_id == 0
+                and self.active[0].logprobs == 0  # spec emits no logprobs
                 and self._spec_step(self.active[0], chunk)):
             # speculation pays exactly when the chip is latency-bound (one
             # request in flight); with a batch, lockstep decode already
@@ -406,6 +421,9 @@ class Scheduler:
             # draft carries no adapters).
             return cancelled_prefill + self._retire()
         self._rng, sub = jax.random.split(self._rng)
+        # any row asking for logprobs switches the batch to the collecting
+        # program (fixed top-LOGPROBS_K shape; rows slice to their own k)
+        want_lp = any(r.logprobs for r in self.active)
         try:
             outs = self.engine.decode_batch(
                 [r.state for r in self.active], chunk,
@@ -414,6 +432,11 @@ class Scheduler:
                 top_k=[r.top_k for r in self.active],
                 top_p=[r.top_p for r in self.active],
                 rng=sub,
+                logprobs=self.LOGPROBS_K if want_lp else 0,
+                logprobs_rows=(
+                    [bool(r.logprobs) for r in self.active] if want_lp
+                    else None
+                ),
             )
         except MemoryError:
             # decode-time page exhaustion: shed the newest request back to
@@ -428,6 +451,11 @@ class Scheduler:
             self.pending.insert(0, victim)
             self._admission_hold = True
             return cancelled_prefill
+        if want_lp:
+            outs, lps = outs
+            for req, lp in zip(self.active, lps):
+                if req.logprobs:
+                    req.lp_data.extend(lp)
         for req, toks in zip(self.active, outs):
             req.output.extend(toks)
         return cancelled_prefill + self._retire()

@@ -104,6 +104,10 @@ class ServingServer:
         chat takes ``messages``, completions takes ``prompt``.  Raises
         ValueError -> 400."""
         body = dict(body)
+        # endpoint marker survives the messages->prompt conversion, so the
+        # engine-thread _validate can still apply the chat-specific
+        # parameter spellings (logprobs/top_logprobs) after this pop
+        body["_chat"] = bool(chat or body.get("_chat"))
         if chat:
             if "messages" not in body or "prompt" in body:
                 raise ValueError(
@@ -213,6 +217,7 @@ class ServingServer:
         ``prepare_body`` — the HTTP path already ran it on the handler
         thread (idempotent here: the prompt is ids by then); direct
         ``submit()`` callers get the same conversion."""
+        chat = "messages" in body or bool(body.get("_chat"))
         body = self.prepare_body(body, chat="messages" in body)
         prompt = body.get("prompt")
         if not (isinstance(prompt, list) and prompt
@@ -247,6 +252,38 @@ class ServingServer:
         top_p = float(body.get("top_p", 1.0))
         if not 0.0 < top_p <= 1.0:
             raise ValueError("top_p must be in (0, 1]")
+        n = body.get("n", 1)
+        if not (isinstance(n, int) and 1 <= n <= 8):
+            raise ValueError("n must be an integer in [1, 8]")
+        # logprobs: the two endpoints spell it differently (OpenAI contract)
+        # — completions: logprobs = int top-k (0 = chosen token only);
+        # chat: logprobs = bool + top_logprobs = int.  Both map onto the
+        # scheduler's single collector (k alternatives + the chosen token).
+        from .engine.scheduler import Scheduler as _S
+
+        lp_k = 0
+        if chat:
+            lp_flag = body.get("logprobs", False)
+            if not isinstance(lp_flag, bool):
+                raise ValueError("logprobs must be a boolean on "
+                                 "/v1/chat/completions")
+            top_lp = body.get("top_logprobs", 0) or 0
+            if not (isinstance(top_lp, int)
+                    and 0 <= top_lp <= _S.LOGPROBS_K):
+                raise ValueError(
+                    f"top_logprobs must be an integer in "
+                    f"[0, {_S.LOGPROBS_K}]"
+                )
+            if top_lp and not lp_flag:
+                raise ValueError("top_logprobs requires logprobs: true")
+            lp_k = max(top_lp, 1) if lp_flag else 0
+        else:
+            lp = body.get("logprobs")
+            if lp is not None:
+                if not (isinstance(lp, int) and not isinstance(lp, bool)
+                        and 0 <= lp <= 5):
+                    raise ValueError("logprobs must be an integer in [0, 5]")
+                lp_k = max(lp, 1)
         stops = body.get("stop_token_ids") or []
         if stops and not all(isinstance(t, int) for t in stops):
             raise ValueError("stop_token_ids must be token ids")
@@ -289,17 +326,49 @@ class ServingServer:
             # OpenAI convention: temperature 0 means greedy
             "temperature": temperature or 1.0,
             "top_k": top_k, "top_p": top_p,
+            "logprobs": lp_k,
         }
+
+    def logprobs_display_k(self, body: Dict[str, Any],
+                           chat: bool) -> Optional[int]:
+        """How many top-alternatives the RESPONSE should show: None when
+        the request didn't ask for logprobs at all, else the alternative
+        count (0 = chosen-token logprob only).  Mirrors ``_validate``'s
+        endpoint-specific spelling."""
+        if chat:
+            if not body.get("logprobs", False):
+                return None
+            return int(body.get("top_logprobs", 0) or 0)
+        lp = body.get("logprobs")
+        return None if lp is None else int(lp)
+
+    def tok_str(self, tid: int) -> str:
+        """Display form of a token for logprobs payloads: the tokenizer's
+        own token string when available, the bare id otherwise."""
+        if self.tokenizer is not None:
+            conv = getattr(self.tokenizer, "convert_ids_to_tokens", None)
+            if callable(conv):
+                return str(conv([tid])[0])
+            return self.tokenizer.decode([tid])
+        return str(tid)
 
     def _submit_to_sched(self, item: Dict[str, Any]) -> None:
         body, q = item["body"], item["q"]
         # finish_reason per the OpenAI contract: "stop" when a stop id
         # ended generation (visible tokens are eos-trimmed, so the last
         # delivered token tells), "length" when the budget did
-        tally = {"n": 0, "eos": False, "budget": 0, "eos_set": frozenset()}
+        tally = {"n": 0, "eos": False, "budget": 0, "eos_set": frozenset(),
+                 "req": None}
 
         def on_token(tokens: List[int], done: bool) -> None:
             if tokens:
+                req = tally["req"]
+                if req is not None and req.logprobs:
+                    # lp records ride AHEAD of their tokens so stream
+                    # handlers have them when the chunk goes out; slices
+                    # align 1:1 with the visible-token stream
+                    lo = tally["n"]
+                    q.put(("lp", list(req.lp_data[lo:lo + len(tokens)])))
                 tally["n"] += len(tokens)
                 if tokens[-1] in tally["eos_set"]:
                     tally["eos"] = True
@@ -317,6 +386,12 @@ class ServingServer:
             tally["budget"] = kwargs["max_new_tokens"]
             tally["eos_set"] = frozenset(kwargs["eos_ids"] or ())
             req_id = self.sched.submit(on_token=on_token, **kwargs)
+            if kwargs.get("logprobs"):
+                # the engine thread owns both this submit and every later
+                # on_token call, so holding the Request here is race-free
+                tally["req"] = next(
+                    r for r in self.sched.pending if r.req_id == req_id
+                )
             self._queues[req_id] = q
             q.put(("id", req_id))
         except Exception as e:
@@ -349,6 +424,34 @@ class ServingServer:
                 f"istpu_spec_acceptance_rate {sm['rate']}",
             ]
         return "\n".join(lines) + "\n"
+
+
+def _lp_payload(server, token_ids: List[int], lps: List[tuple],
+                k: int, chat: bool) -> Dict[str, Any]:
+    """OpenAI logprobs object for ``token_ids`` from the scheduler's
+    records ``(chosen_logprob, [(alt_id, alt_logprob) x K])``.  The two
+    endpoints use different shapes: completions a column-oriented dict,
+    chat a per-token ``content`` list.  ``k`` = alternatives to show
+    (records carry Scheduler.LOGPROBS_K; rows slice down)."""
+    if chat:
+        return {"content": [
+            {
+                "token": server.tok_str(t),
+                "logprob": chosen,
+                "top_logprobs": [
+                    {"token": server.tok_str(a), "logprob": alp}
+                    for a, alp in top[:k]
+                ],
+            }
+            for t, (chosen, top) in zip(token_ids, lps)
+        ]}
+    return {
+        "tokens": [server.tok_str(t) for t in token_ids],
+        "token_logprobs": [chosen for chosen, _ in lps],
+        "top_logprobs": [
+            {server.tok_str(a): alp for a, alp in top[:k]} for _, top in lps
+        ],
+    }
 
 
 _REPL = "�"  # tokenizers emit U+FFFD for incomplete multibyte output
@@ -563,30 +666,52 @@ def _make_handler(server: ServingServer):
             except ValueError:
                 self._json(400, {"error": "invalid JSON body"})
                 return
+            if isinstance(body, dict):
+                # internal endpoint marker; a wire body must not spoof it
+                # (it would cross-wire the two endpoints' validation)
+                body.pop("_chat", None)
             try:
                 # tokenization-heavy prep on THIS thread, not the engine's
                 body = server.prepare_body(body, chat)
             except ValueError as e:
                 self._json(400, {"error": str(e)})
                 return
-            q = server.submit(body)
-            first = q.get()
-            if first[0] == "error":
-                self._json(400, {"error": first[1]})
+            n = body.get("n", 1)
+            if not (isinstance(n, int) and 1 <= n <= 8):
+                self._json(400, {"error": "n must be an integer in [1, 8]"})
                 return
-            req_id = first[1]
+            # n choices = n scheduler requests sharing the prompt (the
+            # prefix cache pins one set of prompt pages; each choice
+            # decodes its own continuation — the vLLM n>1 model)
+            qs = [server.submit(body) for _ in range(n)]
+            req_ids, err = [], None
+            for q in qs:
+                kind, val = q.get()
+                if kind == "error":
+                    err = val
+                else:
+                    req_ids.append(val)
+            if err is not None:
+                for rid in req_ids:
+                    server.cancel(rid)
+                self._json(400, {"error": err})
+                return
             # adapter-routed requests echo the adapter name they asked for
             model_name = str(body.get("model") or server.model_id)
-            accum = None
+            accums: List[Optional[_TextAccum]] = [None] * n
             if server.tokenizer is not None:
                 stop = body.get("stop") or []
-                accum = _TextAccum(
-                    server.tokenizer, [stop] if isinstance(stop, str) else stop
-                )
+                stop = [stop] if isinstance(stop, str) else stop
+                accums = [_TextAccum(server.tokenizer, stop)
+                          for _ in range(n)]
+            lp_k = server.logprobs_display_k(body, chat)
+            prompt_len = len(body["prompt"])
             if body.get("stream"):
-                self._stream(req_id, q, accum, chat, model_name)
+                self._stream(req_ids, qs, accums, chat, model_name,
+                             prompt_len, lp_k)
             else:
-                self._collect(req_id, q, accum, chat, model_name)
+                self._collect(req_ids, qs, accums, chat, model_name,
+                              prompt_len, lp_k)
 
         def _client_gone(self) -> bool:
             """A request-less peek at the socket: readable + EOF means the
@@ -602,86 +727,142 @@ def _make_handler(server: ServingServer):
             except OSError:
                 return True
 
-        def _collect(self, req_id: int, q: "queue.Queue",
-                     accum: Optional[_TextAccum], chat: bool = False,
-                     model_name: Optional[str] = None) -> None:
-            tokens: List[int] = []
-            finish = "stop"
-            while True:
-                try:
-                    kind, val = q.get(timeout=1.0)
-                except queue.Empty:
-                    if self._client_gone():
-                        # nobody is waiting: free the batch slot + KV pages
-                        server.cancel(req_id)
+        def _collect(self, req_ids: List[int], qs: List["queue.Queue"],
+                     accums: List[Optional[_TextAccum]], chat: bool,
+                     model_name: Optional[str], prompt_len: int,
+                     lp_k: Optional[int]) -> None:
+            choices: List[Dict[str, Any]] = []
+            for i, (req_id, q, accum) in enumerate(zip(req_ids, qs, accums)):
+                tokens: List[int] = []
+                lps: List[tuple] = []
+                finish = "stop"
+                while True:
+                    try:
+                        kind, val = q.get(timeout=1.0)
+                    except queue.Empty:
+                        if self._client_gone():
+                            # nobody is waiting: free every batch slot
+                            for rid in req_ids:
+                                server.cancel(rid)
+                            return
+                        continue
+                    if kind == "lp":
+                        lps.extend(val)
+                    elif kind == "tokens":
+                        tokens.extend(val)
+                        if accum is not None and accum.add(val)[1]:
+                            # stop string hit: end generation NOW (free the
+                            # batch slot) instead of decoding to the budget
+                            server.cancel(req_id)
+                            break
+                    elif kind == "error":
+                        for rid in req_ids:
+                            server.cancel(rid)
+                        self._json(500, {"error": val})
                         return
-                    continue
-                if kind == "tokens":
-                    tokens.extend(val)
-                    if accum is not None and accum.add(val)[1]:
-                        # stop string hit: end generation NOW (free the
-                        # batch slot) instead of decoding to the budget
-                        server.cancel(req_id)
+                    elif kind == "done":
+                        finish = val
                         break
-                elif kind == "error":
-                    self._json(500, {"error": val})
-                    return
-                elif kind == "done":
-                    finish = val
-                    break
-            choice: Dict[str, Any] = {
-                "index": 0, "token_ids": tokens, "finish_reason": finish,
-            }
-            if accum is not None:
-                accum.finish()
-                choice["text"] = accum.text
-                # ids, text, and usage agree: all truncated at the stop
-                choice["token_ids"] = tokens = accum.visible_ids()
-                if accum.stop_cut is not None:
-                    # a stop that only completed inside the held-back tail
-                    # (found at finish) is still a stop, not "length"
-                    choice["finish_reason"] = "stop"
-            if chat:  # chat requires a tokenizer, so accum is set
-                choice["message"] = {
-                    "role": "assistant", "content": choice.pop("text", ""),
+                choice: Dict[str, Any] = {
+                    "index": i, "token_ids": tokens, "finish_reason": finish,
                 }
+                if accum is not None:
+                    accum.finish()
+                    choice["text"] = accum.text
+                    # ids, text, and usage agree: all truncated at the stop
+                    choice["token_ids"] = tokens = accum.visible_ids()
+                    if accum.stop_cut is not None:
+                        # a stop that only completed inside the held-back
+                        # tail (found at finish) is still a stop
+                        choice["finish_reason"] = "stop"
+                if lp_k is not None:
+                    choice["logprobs"] = _lp_payload(
+                        server, tokens, lps[:len(tokens)], lp_k, chat
+                    )
+                if chat:  # chat requires a tokenizer, so accum is set
+                    choice["message"] = {
+                        "role": "assistant",
+                        "content": choice.pop("text", ""),
+                    }
+                choices.append(choice)
+            completion_tokens = sum(len(c["token_ids"]) for c in choices)
             try:
                 self._json(200, {
-                    "id": f"{'chatcmpl' if chat else 'cmpl'}-{req_id}",
+                    "id": f"{'chatcmpl' if chat else 'cmpl'}-{req_ids[0]}",
                     "object": "chat.completion" if chat else "text_completion",
                     "model": model_name or server.model_id,
-                    "choices": [choice],
-                    "usage": {"completion_tokens": len(tokens)},
+                    "choices": choices,
+                    "usage": {
+                        "prompt_tokens": prompt_len,
+                        "completion_tokens": completion_tokens,
+                        "total_tokens": prompt_len + completion_tokens,
+                    },
                 })
             except (BrokenPipeError, ConnectionResetError):
                 pass  # finished anyway; nothing left to free
 
-        def _stream(self, req_id: int, q: "queue.Queue",
-                    accum: Optional[_TextAccum], chat: bool = False,
-                    model_name: Optional[str] = None) -> None:
+        def _stream(self, req_ids: List[int], qs: List["queue.Queue"],
+                    accums: List[Optional[_TextAccum]], chat: bool,
+                    model_name: Optional[str], prompt_len: int,
+                    lp_k: Optional[int]) -> None:
             self.send_response(200)
             self.send_header("Content-Type", "text/event-stream")
             self.send_header("Cache-Control", "no-cache")
             self.send_header("Connection", "close")
             self.end_headers()
-            first_delta = [True]
+            n = len(req_ids)
+            first_delta = [True] * n
+            ids_sent = [0] * n
+            lps: List[List[tuple]] = [[] for _ in range(n)]
+            live = [True] * n
 
-            def emit(token_ids: List[int], text: Optional[str],
+            # n>1: one SSE stream carries every choice; per-queue pump
+            # threads merge the scheduler's per-request queues into one,
+            # tagged with the choice index (events within a choice keep
+            # their order; choices interleave as they decode)
+            if n == 1:
+                merged = None
+            else:
+                merged = queue.Queue()
+
+                def pump(i: int, qi: "queue.Queue") -> None:
+                    while True:
+                        ev = qi.get()
+                        merged.put((i, ev))
+                        if ev[0] in ("done", "error"):
+                            return
+
+                for i, qi in enumerate(qs):
+                    threading.Thread(target=pump, args=(i, qi),
+                                     daemon=True).start()
+
+            def next_event():
+                if merged is None:
+                    return 0, qs[0].get()
+                return merged.get()
+
+            def emit(i: int, token_ids: List[int], text: Optional[str],
                      finish: Optional[str] = None) -> None:
                 choice: Dict[str, Any] = {
-                    "index": 0, "token_ids": token_ids,
+                    "index": i, "token_ids": token_ids,
                     "finish_reason": finish,
                 }
+                if lp_k is not None:
+                    lo = ids_sent[i]
+                    choice["logprobs"] = _lp_payload(
+                        server, token_ids,
+                        lps[i][lo:lo + len(token_ids)], lp_k, chat,
+                    )
                 if chat:
                     delta: Dict[str, Any] = {"content": text or ""}
-                    if first_delta[0]:
+                    if first_delta[i]:
                         delta["role"] = "assistant"
-                        first_delta[0] = False
+                        first_delta[i] = False
                     choice["delta"] = delta
                 elif text is not None:
                     choice["text"] = text
                 chunk = json.dumps({
-                    "id": f"{'chatcmpl' if chat else 'cmpl'}-{req_id}",
+                    "id": f"{'chatcmpl' if chat else 'cmpl'}-{req_ids[0]}",
                     "object": (
                         "chat.completion.chunk" if chat else "text_completion"
                     ),
@@ -691,40 +872,51 @@ def _make_handler(server: ServingServer):
                 self.wfile.write(f"data: {chunk}\n\n".encode())
                 self.wfile.flush()
 
+            def finish_choice(i: int) -> bool:
+                """Mark choice ``i`` done; True when ALL choices ended."""
+                live[i] = False
+                return not any(live)
+
             def done() -> None:
                 self.wfile.write(b"data: [DONE]\n\n")
                 self.wfile.flush()
 
-            ids_sent = 0
             try:
                 while True:
-                    kind, val = q.get()
-                    if kind == "tokens":
+                    i, (kind, val) = next_event()
+                    if not live[i]:
+                        # a stop-cancelled choice stays subscribed until the
+                        # scheduler retires it; its trailing tokens/done
+                        # events must not re-emit a terminal chunk
+                        continue
+                    accum = accums[i]
+                    if kind == "lp":
+                        lps[i].extend(val)
+                    elif kind == "tokens":
                         if accum is None:
-                            emit(val, None)
+                            emit(i, val, None)
+                            ids_sent[i] += len(val)
                             continue
                         delta, stopped = accum.add(val)
                         if stopped:
-                            # stop string hit mid-stream: final event
-                            # carries the pre-stop text, the remaining
-                            # stop-truncated ids, and the finish_reason
-                            # (the OpenAI stream-termination signal), then
-                            # the stream ends and the batch slot frees
-                            emit(accum.visible_ids()[ids_sent:], delta,
-                                 finish="stop")
-                            server.cancel(req_id)
-                            done()
-                            return
-                        # ids ride the same release horizon as the text:
-                        # ids for held-back chars are withheld too, so the
-                        # streamed id total can never pass a stop cut that
-                        # only completes later
+                            # stop string hit mid-stream: final event for
+                            # THIS choice carries the pre-stop text, the
+                            # remaining stop-truncated ids and the
+                            # finish_reason; the batch slot frees now
+                            emit(i, accum.visible_ids()[ids_sent[i]:],
+                                 delta, finish="stop")
+                            server.cancel(req_ids[i])
+                            if finish_choice(i):
+                                done()
+                                return
+                            continue
+                        # ids (and their lp records) ride the text release
+                        # horizon: held-back ids can never pass a stop cut
+                        # that only completes later
                         horizon = accum.emit_ids_horizon()
-                        if horizon > ids_sent or delta:
-                            # skip content-free chunks (all of ids/text held
-                            # back behind a stop prefix or partial UTF-8)
-                            emit(accum.ids[ids_sent:horizon], delta)
-                            ids_sent = horizon
+                        if horizon > ids_sent[i] or delta:
+                            emit(i, accum.ids[ids_sent[i]:horizon], delta)
+                            ids_sent[i] = horizon
                     elif kind == "error":
                         err = json.dumps({"error": val})
                         self.wfile.write(f"data: {err}\n\n".encode())
@@ -732,7 +924,6 @@ def _make_handler(server: ServingServer):
                         return
                     elif kind == "done":
                         tail = accum.finish() if accum is not None else ""
-                        # final chunk announces finish_reason before [DONE]
                         fin = val
                         last_ids: List[int] = []
                         if accum is not None:
@@ -740,14 +931,16 @@ def _make_handler(server: ServingServer):
                                 fin = "stop"
                             # flush the withheld tail ids (stop-truncated
                             # when a stop was found at finish)
-                            last_ids = accum.visible_ids()[ids_sent:]
-                        emit(last_ids, tail or None, finish=fin)
-                        done()
-                        return
+                            last_ids = accum.visible_ids()[ids_sent[i]:]
+                        emit(i, last_ids, tail or None, finish=fin)
+                        if finish_choice(i):
+                            done()
+                            return
             except (BrokenPipeError, ConnectionResetError):
-                # client went away mid-stream: free its pages at the next
-                # chunk boundary; batchmates keep decoding
-                server.cancel(req_id)
+                # client went away mid-stream: free every choice's pages at
+                # the next chunk boundary; batchmates keep decoding
+                for rid in req_ids:
+                    server.cancel(rid)
 
     return Handler
 

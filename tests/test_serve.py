@@ -53,6 +53,215 @@ def _post(port, body, timeout=120, path="/v1/completions"):
     return resp.status, json.loads(data)
 
 
+def test_n_choices_and_usage(server):
+    """OpenAI n>1: one request returns n indexed choices; usage counts the
+    prompt once and sums completions (VERDICT r3 weak #8)."""
+    status, body = _post(server.port, {
+        "prompt": PROMPT, "max_tokens": 5, "temperature": 0, "n": 3,
+    })
+    assert status == 200, body
+    choices = body["choices"]
+    assert [c["index"] for c in choices] == [0, 1, 2]
+    want = dense_greedy(PROMPT, 5)
+    for c in choices:  # greedy: all n identical, each exact
+        assert c["token_ids"] == want
+    assert body["usage"] == {
+        "prompt_tokens": len(PROMPT),
+        "completion_tokens": 15,
+        "total_tokens": len(PROMPT) + 15,
+    }
+    # sampled n>1: choices draw independently
+    status, body = _post(server.port, {
+        "prompt": PROMPT, "max_tokens": 16, "temperature": 5.0, "n": 4,
+    })
+    assert status == 200, body
+    outs = {tuple(c["token_ids"]) for c in body["choices"]}
+    assert len(outs) > 1  # astronomically unlikely to collide at temp 5
+
+
+def test_completions_logprobs_contract(server):
+    """Legacy completions logprobs: token_logprobs aligned with token_ids,
+    top_logprobs dicts of the requested size; greedy's chosen logprob is
+    the max of its top alternatives (argmax == top-1)."""
+    status, body = _post(server.port, {
+        "prompt": PROMPT, "max_tokens": 6, "temperature": 0, "logprobs": 2,
+    })
+    assert status == 200, body
+    choice = body["choices"][0]
+    assert choice["token_ids"] == dense_greedy(PROMPT, 6)
+    lp = choice["logprobs"]
+    assert len(lp["tokens"]) == len(lp["token_logprobs"]) == 6
+    assert len(lp["top_logprobs"]) == 6
+    for chosen, top in zip(lp["token_logprobs"], lp["top_logprobs"]):
+        assert len(top) == 2
+        assert chosen == pytest.approx(max(top.values()), abs=1e-5)
+        assert chosen <= 0.0
+    # logprobs: 0 => chosen logprob only, empty top dicts
+    status, body = _post(server.port, {
+        "prompt": PROMPT, "max_tokens": 3, "temperature": 0, "logprobs": 0,
+    })
+    assert status == 200, body
+    lp = body["choices"][0]["logprobs"]
+    assert len(lp["token_logprobs"]) == 3
+    assert all(t == {} for t in lp["top_logprobs"])
+
+
+def test_logprobs_validation(server):
+    for bad in (
+        {"logprobs": 9},          # completions cap is 5
+        {"logprobs": "x"},
+        {"logprobs": True},       # bools are the CHAT spelling
+        {"n": 0},
+        {"n": 99},
+        # "_chat" is an internal marker; a wire body must not be able to
+        # spoof it to borrow the chat endpoint's validation rules
+        {"_chat": True, "logprobs": True, "top_logprobs": 8},
+    ):
+        status, body = _post(server.port, {
+            "prompt": PROMPT, "max_tokens": 2, **bad,
+        })
+        assert status == 400, (bad, body)
+
+
+def test_chat_logprobs_contract(text_server):
+    """Chat logprobs spelling: logprobs bool + top_logprobs int; response
+    carries per-token content entries with top_logprobs lists."""
+    status, body = _post(text_server.port, {
+        "messages": [{"role": "user", "content": "hi"}],
+        "max_tokens": 4, "temperature": 0,
+        "logprobs": True, "top_logprobs": 3,
+    }, path="/v1/chat/completions")
+    assert status == 200, body
+    choice = body["choices"][0]
+    content = choice["logprobs"]["content"]
+    assert len(content) == len(choice["token_ids"]) == 4
+    for entry in content:
+        assert isinstance(entry["token"], str)
+        assert entry["logprob"] <= 0.0
+        assert len(entry["top_logprobs"]) == 3
+    # top_logprobs without logprobs: true is a 400
+    status, _ = _post(text_server.port, {
+        "messages": [{"role": "user", "content": "hi"}],
+        "max_tokens": 2, "top_logprobs": 3,
+    }, path="/v1/chat/completions")
+    assert status == 400
+
+
+def test_streaming_n_choices(server):
+    """n>1 streaming: one SSE stream interleaves indexed chunks; each
+    choice's concatenated ids match the non-streaming result."""
+    want = dense_greedy(PROMPT, 5)
+    conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=120)
+    conn.request("POST", "/v1/completions", json.dumps({
+        "prompt": PROMPT, "max_tokens": 5, "temperature": 0, "n": 2,
+        "stream": True,
+    }), {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    assert resp.status == 200
+    per_choice: dict = {0: [], 1: []}
+    finishes = {}
+    buf, done = b"", False
+    while not done:
+        chunk = resp.read1(65536)
+        if not chunk:
+            break
+        buf += chunk
+        while b"\n\n" in buf:
+            event, buf = buf.split(b"\n\n", 1)
+            payload = event[len(b"data: "):]
+            if payload == b"[DONE]":
+                done = True
+                break
+            c = json.loads(payload)["choices"][0]
+            per_choice[c["index"]].extend(c["token_ids"])
+            if c["finish_reason"]:
+                finishes[c["index"]] = c["finish_reason"]
+    conn.close()
+    assert done
+    assert per_choice[0] == want and per_choice[1] == want
+    assert finishes == {0: "length", 1: "length"}
+
+
+def test_streaming_n_choices_with_stop_no_duplicate_final(text_server):
+    """n=2 streaming with a stop string: a stop-cancelled choice must emit
+    exactly ONE terminal chunk — its trailing scheduler events (retirement
+    'done') must not repeat the tail ids or the finish_reason."""
+    tok = text_server.tokenizer
+    full = dense_greedy(PROMPT, 8)
+    stop_char = tok.decode([full[3]])
+    status, body = _post(text_server.port, {
+        "prompt": PROMPT, "max_tokens": 8, "temperature": 0,
+        "stop": stop_char,
+    })
+    assert status == 200, body
+    want_ids = body["choices"][0]["token_ids"]
+
+    conn = http.client.HTTPConnection("127.0.0.1", text_server.port,
+                                      timeout=120)
+    conn.request("POST", "/v1/completions", json.dumps({
+        "prompt": PROMPT, "max_tokens": 8, "temperature": 0, "n": 2,
+        "stop": stop_char, "stream": True,
+    }), {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    assert resp.status == 200
+    ids = {0: [], 1: []}
+    finals = {0: 0, 1: 0}
+    buf, done = b"", False
+    while not done:
+        chunk = resp.read1(65536)
+        if not chunk:
+            break
+        buf += chunk
+        while b"\n\n" in buf:
+            event, buf = buf.split(b"\n\n", 1)
+            payload = event[len(b"data: "):]
+            if payload == b"[DONE]":
+                done = True
+                break
+            c = json.loads(payload)["choices"][0]
+            ids[c["index"]].extend(c["token_ids"])
+            if c["finish_reason"]:
+                finals[c["index"]] += 1
+    conn.close()
+    assert done
+    assert finals == {0: 1, 1: 1}  # exactly one terminal chunk each
+    assert ids[0] == want_ids and ids[1] == want_ids
+
+
+def test_streaming_logprobs(server):
+    """Streamed chunks carry logprobs aligned with their token_ids."""
+    conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=120)
+    conn.request("POST", "/v1/completions", json.dumps({
+        "prompt": PROMPT, "max_tokens": 4, "temperature": 0,
+        "logprobs": 1, "stream": True,
+    }), {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    assert resp.status == 200
+    ids, lp_tokens = [], []
+    buf, done = b"", False
+    while not done:
+        chunk = resp.read1(65536)
+        if not chunk:
+            break
+        buf += chunk
+        while b"\n\n" in buf:
+            event, buf = buf.split(b"\n\n", 1)
+            payload = event[len(b"data: "):]
+            if payload == b"[DONE]":
+                done = True
+                break
+            c = json.loads(payload)["choices"][0]
+            ids.extend(c["token_ids"])
+            lp = c.get("logprobs")
+            if lp:
+                lp_tokens.extend(lp["token_logprobs"])
+    conn.close()
+    assert done
+    assert ids == dense_greedy(PROMPT, 4)
+    assert len(lp_tokens) == 4
+    assert all(x <= 0.0 for x in lp_tokens)
+
+
 @pytest.fixture(scope="module")
 def spec_server():
     """A server with a draft engine attached: speculation as the scheduler's
@@ -609,4 +818,4 @@ def test_top_p_values_share_one_compiled_program():
                    rng=jax.random.PRNGKey(i))
         eng.release(st)
     keys = set(eng._decode_many_cache)
-    assert keys == {(2, "filter", False)}, keys
+    assert keys == {(2, "filter", False, 0)}, keys
