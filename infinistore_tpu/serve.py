@@ -253,14 +253,14 @@ class ServingServer:
         if not 0.0 < top_p <= 1.0:
             raise ValueError("top_p must be in (0, 1]")
         n = body.get("n", 1)
-        if not (isinstance(n, int) and 1 <= n <= 8):
+        if not (isinstance(n, int) and not isinstance(n, bool)
+                and 1 <= n <= 8):
             raise ValueError("n must be an integer in [1, 8]")
         # logprobs: the two endpoints spell it differently (OpenAI contract)
         # — completions: logprobs = int top-k (0 = chosen token only);
         # chat: logprobs = bool + top_logprobs = int.  Both map onto the
         # scheduler's single collector (k alternatives + the chosen token).
-        from .engine.scheduler import Scheduler as _S
-
+        _S = Scheduler
         lp_k = 0
         if chat:
             lp_flag = body.get("logprobs", False)
@@ -268,7 +268,7 @@ class ServingServer:
                 raise ValueError("logprobs must be a boolean on "
                                  "/v1/chat/completions")
             top_lp = body.get("top_logprobs", 0) or 0
-            if not (isinstance(top_lp, int)
+            if not (isinstance(top_lp, int) and not isinstance(top_lp, bool)
                     and 0 <= top_lp <= _S.LOGPROBS_K):
                 raise ValueError(
                     f"top_logprobs must be an integer in "
@@ -677,7 +677,8 @@ def _make_handler(server: ServingServer):
                 self._json(400, {"error": str(e)})
                 return
             n = body.get("n", 1)
-            if not (isinstance(n, int) and 1 <= n <= 8):
+            if not (isinstance(n, int) and not isinstance(n, bool)
+                    and 1 <= n <= 8):
                 self._json(400, {"error": "n must be an integer in [1, 8]"})
                 return
             # n choices = n scheduler requests sharing the prompt (the

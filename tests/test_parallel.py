@@ -314,6 +314,52 @@ def test_sharded_engine_pallas_tp_decode(monkeypatch):
     assert got == want
 
 
+def test_sharded_engine_pallas_tp_prefill(monkeypatch):
+    """tp PREFILL through the shard_map flash kernel (interpret mode on
+    the CPU mesh): with pallas_tp the mesh path no longer forces XLA
+    attention for the compute-bound phase (VERDICT r3 weak #6 / next #5).
+    Logits and decode tokens must match the single-device engine, and the
+    sharded flash kernel must actually have been traced in."""
+    import infinistore_tpu.models.attention as A
+    from infinistore_tpu.engine.engine import InferenceEngine
+    from infinistore_tpu.kv.cache import PagedCacheConfig
+
+    monkeypatch.setenv("ISTPU_PALLAS_INTERPRET", "1")
+    # flash kernels need lane-aligned heads: head_dim = 512/4 = 128
+    cfg = LlamaConfig(vocab_size=256, dim=512, n_layers=2, n_heads=4,
+                      n_kv_heads=2, ffn_dim=128, dtype=jnp.float32)
+    params = init_params(cfg, jax.random.PRNGKey(3))
+    pc = PagedCacheConfig(
+        n_layers=cfg.n_layers, n_kv_heads=cfg.n_kv_heads,
+        head_dim=cfg.head_dim, n_blocks=32, block_tokens=4,
+        dtype=jnp.float32)
+    prompt = [int(t) for t in
+              np.random.RandomState(5).randint(1, cfg.vocab_size, 13)]
+
+    ref = InferenceEngine(params, cfg, pc)
+    st_ref = ref.prefill(prompt)
+    want_logits = np.asarray(st_ref.last_logits)
+    want = ref.decode(st_ref, 6)
+
+    calls = []
+    orig = A.flash_causal_attention_tp
+
+    def spy(*a, **k):
+        calls.append(1)
+        return orig(*a, **k)
+
+    monkeypatch.setattr(A, "flash_causal_attention_tp", spy)
+    mesh = make_mesh(tp=2)
+    with jax.set_mesh(mesh):
+        eng = InferenceEngine(params, cfg, pc, mesh=mesh, pallas_tp=True)
+        st = eng.prefill(prompt)
+        np.testing.assert_allclose(
+            np.asarray(st.last_logits), want_logits, rtol=2e-4, atol=2e-4)
+        got = eng.decode(st, 6)
+    assert got == want
+    assert calls, "tp prefill never reached the shard_map flash kernel"
+
+
 def test_sharded_engine_serves_biased_family():
     """A Qwen2-style pytree (QKV biases) under mesh=: shard_params must pick
     up the bias specs (head-partitioned) and the GSPMD loop must match the
