@@ -92,6 +92,12 @@ class ServerConfig:
         # ours: shm segment name prefix; backend selects native C++ or python
         self.shm_prefix = kwargs.get("shm_prefix", "")
         self.backend = kwargs.get("backend", "auto")  # auto | native | python
+        # second storage tier ("Historical KVCache in DRAM and SSD",
+        # reference docs/source/design.rst:36): LRU-evicted entries spill
+        # to a file-backed slab at this path and promote back on access.
+        # Empty = DRAM only.  Python backend feature.
+        self.disk_tier_path = kwargs.get("disk_tier_path", "")
+        self.disk_tier_size = kwargs.get("disk_tier_size", 64)  # GB
 
     def __repr__(self):
         return (
@@ -101,7 +107,8 @@ class ServerConfig:
             f"auto_increase={self.auto_increase}, "
             f"evict_min_threshold={self.evict_min_threshold}, "
             f"evict_max_threshold={self.evict_max_threshold}, "
-            f"evict_interval={self.evict_interval}, backend='{self.backend}')"
+            f"evict_interval={self.evict_interval}, backend='{self.backend}', "
+            f"disk_tier_path='{self.disk_tier_path}')"
         )
 
     def verify(self):

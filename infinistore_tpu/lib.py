@@ -745,6 +745,22 @@ class InfinityConnection:
             )
         return ret
 
+    def purge(self) -> int:
+        """Drop every committed entry (wire OP_PURGE; manage-plane /purge
+        is the HTTP spelling of the same op)."""
+        return self._call("purge")
+
+    def evict(self, min_threshold: float, max_threshold: float) -> None:
+        """Run one eviction pass with explicit thresholds (wire OP_EVICT).
+        With a disk tier attached, evicted entries spill instead of
+        vanishing."""
+        return self._call("evict", min_threshold, max_threshold)
+
+    def stats(self) -> dict:
+        """Server stats snapshot (wire OP_STATS; same payload as the
+        manage plane's /metrics)."""
+        return self._call("stats")
+
     def register_mr(self, arg: Union[int, "np.ndarray"], size: Optional[int] = None) -> int:
         if isinstance(arg, (int, np.integer)):
             if not self.rdma_connected and self.config.connection_type == TYPE_SHM:

@@ -25,10 +25,18 @@ One addition over the reference: descriptor reads hand out raw pool offsets
 to shm clients, so committed entries carry a short *lease* after a GET_DESC
 and the evictor skips leased entries.  The reference has the same window with
 in-flight RDMA reads and relies on LRU touch alone.
+
+Second storage tier: with ``disk_tier_path`` set, LRU-evicted entries SPILL
+to a file-backed slab instead of vanishing, and any access (read, exist,
+prefix match) PROMOTES them back into DRAM — the reference design's
+"Historical KVCache in DRAM and SSD" (reference docs/source/design.rst:36).
+The tier is transparent to the wire protocol: clients only ever see pool
+descriptors, never disk state.
 """
 
 from __future__ import annotations
 
+import os
 import time
 from collections import OrderedDict
 from dataclasses import dataclass, field
@@ -62,6 +70,131 @@ class Stats:
     evicted: int = 0
     bytes_in: int = 0
     bytes_out: int = 0
+    spilled: int = 0    # DRAM -> disk tier
+    promoted: int = 0   # disk tier -> DRAM
+
+
+class DiskTier:
+    """File-backed slab for the cold half of the cache hierarchy.
+
+    Fixed ``block_size`` slots in one sparse file (same allocation
+    granularity as the DRAM pools, so any DRAM entry fits exactly one
+    slot); an OrderedDict doubles as the tier's own LRU — when the slab is
+    full the oldest spilled entry is dropped for good, which is the
+    reference hierarchy's behavior at the bottom of the stack.  I/O is
+    pread/pwrite on slot offsets: no fsync (a cache tier, not a database —
+    host crash loses only re-computable KV).
+    """
+
+    def __init__(self, path: str, capacity_bytes: int, block_size: int):
+        os.makedirs(path, exist_ok=True)
+        self.path = os.path.join(path, "istpu_disk_tier.dat")
+        self._f = open(self.path, "w+b")
+        self.block_size = block_size
+        self.capacity_slots = max(1, capacity_bytes // block_size)
+        # key -> (slot, size); insertion order = spill LRU (head = oldest).
+        # Entries span ceil(size/block) CONSECUTIVE slots — DRAM regions
+        # are contiguous multi-block runs, so the slab must hold them too.
+        self.index: "OrderedDict[bytes, Tuple[int, int]]" = OrderedDict()
+        self._free: List[int] = []  # sorted free slot list
+        self._next_slot = 0
+        self._bytes = 0
+        self.dropped = 0
+
+    def __contains__(self, key: bytes) -> bool:
+        return key in self.index
+
+    def __len__(self) -> int:
+        return len(self.index)
+
+    def _slots_for(self, size: int) -> int:
+        return max(1, -(-size // self.block_size))
+
+    def _release_run(self, slot: int, size: int) -> None:
+        import bisect
+
+        for s in range(slot, slot + self._slots_for(size)):
+            bisect.insort(self._free, s)
+
+    def _find_run(self, n: int) -> Optional[int]:
+        """First-fit run of ``n`` consecutive slots in the sorted free
+        list; removed from the list when found."""
+        count, start_i = 0, 0
+        prev = None
+        for i, s in enumerate(self._free):
+            if prev is not None and s == prev + 1:
+                count += 1
+            else:
+                start_i, count = i, 1
+            prev = s
+            if count == n:
+                start = self._free[start_i]
+                del self._free[start_i:start_i + n]
+                return start
+        return None
+
+    def _alloc_run(self, n: int) -> Optional[int]:
+        if n > self.capacity_slots:
+            return None
+        while True:
+            start = self._find_run(n)
+            if start is not None:
+                return start
+            if self._next_slot + n <= self.capacity_slots:
+                start = self._next_slot
+                self._next_slot += n
+                return start
+            if not self.index:
+                return None
+            # slab full: the coldest spilled entries leave the hierarchy
+            # until a big-enough run frees up
+            _, (slot, size) = self.index.popitem(last=False)
+            self._bytes -= size
+            self.dropped += 1
+            self._release_run(slot, size)
+
+    def put(self, key: bytes, data) -> bool:
+        self.pop(key)  # an old copy's run goes back to the free list
+        slot = self._alloc_run(self._slots_for(len(data)))
+        if slot is None:
+            return False
+        os.pwrite(self._f.fileno(), bytes(data), slot * self.block_size)
+        self.index[key] = (slot, len(data))
+        self._bytes += len(data)
+        return True
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        rec = self.index.get(key)
+        if rec is None:
+            return None
+        slot, size = rec
+        return os.pread(self._f.fileno(), size, slot * self.block_size)
+
+    def pop(self, key: bytes) -> None:
+        rec = self.index.pop(key, None)
+        if rec is not None:
+            self._bytes -= rec[1]
+            self._release_run(*rec)
+
+    def clear(self) -> int:
+        n = len(self.index)
+        self.index.clear()
+        self._free = []
+        self._next_slot = 0
+        self._bytes = 0
+        return n
+
+    def used_bytes(self) -> int:
+        return self._bytes
+
+    def close(self) -> None:
+        try:
+            self._f.close()
+        finally:
+            try:
+                os.unlink(self.path)
+            except OSError:
+                pass
 
 
 class Store:
@@ -81,6 +214,16 @@ class Store:
         # may still be memcpying from them)
         self._deferred: List[Tuple[float, Entry]] = []
         self.stats = Stats()
+        # second tier: LRU-evicted entries spill here and promote back on
+        # access ("Historical KVCache in DRAM and SSD")
+        self.disk: Optional[DiskTier] = None
+        tier_path = getattr(config, "disk_tier_path", "") or ""
+        if tier_path:
+            self.disk = DiskTier(
+                tier_path,
+                int(getattr(config, "disk_tier_size", 64)) << 30,
+                self.mm.block_size,
+            )
 
     # ---- helpers ----
 
@@ -129,6 +272,13 @@ class Store:
                         break
                     continue
                 del self.kv[key]
+                if self.disk is not None:
+                    # spill before the blocks are reused: the entry is not
+                    # leased (checked above), so the bytes are stable
+                    if self.disk.put(
+                        key, self.mm.view(e.pool_idx, e.offset, e.size)
+                    ):
+                        self.stats.spilled += 1
                 self._free(e)
                 evicted += 1
         self.stats.evicted += evicted
@@ -173,8 +323,31 @@ class Store:
         self.pending[key] = e
         return e
 
+    def _promote(self, key: bytes) -> Optional[Entry]:
+        """Pull a spilled entry back into a DRAM pool (the tier's read
+        path): allocate (which may itself evict-and-spill colder keys),
+        copy the bytes up, commit at the MRU end.  None when the key isn't
+        on disk or DRAM truly can't fit it."""
+        if self.disk is None:
+            return None
+        data = self.disk.get(key)
+        if data is None:
+            return None
+        regions = self._allocate(len(data), 1)
+        if regions is None:
+            return None
+        pool_idx, offset = regions[0]
+        self.mm.view(pool_idx, offset, len(data))[:] = data
+        e = Entry(pool_idx, offset, len(data))
+        # _insert_committed drops the disk copy (its supersede rule)
+        self._insert_committed(key, e)
+        self.stats.promoted += 1
+        return e
+
     def get_inline(self, key: bytes):
         e = self.kv.get(key)
+        if e is None:
+            e = self._promote(key)
         if e is None:
             self.stats.misses += 1
             return None
@@ -230,37 +403,56 @@ class Store:
             # overwrite: an shm reader may hold a live lease on the old
             # region; defer the free just like delete/purge do
             self._free_or_defer(old, time.monotonic())
+        if self.disk is not None:
+            # a fresh commit supersedes any spilled copy (stale data must
+            # never promote back over it)
+            self.disk.pop(key)
         self.kv[key] = e  # appended at MRU end
 
     def get_desc(self, keys: Sequence[bytes], block_size: int = 0):
-        """Batched descriptors for zero-copy reads.  404 if any key missing."""
-        descs = []
+        """Batched descriptors for zero-copy reads.  404 if any key missing.
+
+        Two passes on purpose: promoting a spilled batchmate allocates,
+        which can evict — leasing each key the moment it checks out keeps
+        the evictor's hands off earlier keys of the SAME batch, so the
+        descriptors built in pass 2 can never go stale mid-request."""
         now = time.monotonic()
         for key in keys:
             e = self.kv.get(key)
+            if e is None:
+                # zero-copy reads hand out POOL offsets, so a spilled
+                # entry must come back to DRAM before it can be served
+                e = self._promote(key)
             if e is None:
                 self.stats.misses += 1
                 return P.KEY_NOT_FOUND, []
             if block_size and e.size > block_size:
                 return P.INVALID_REQ, []
-            descs.append((e.pool_idx, e.offset, e.size))
+            e.lease = now + READ_LEASE_S
+        descs = []
         for key in keys:
             e = self.kv[key]
-            e.lease = now + READ_LEASE_S
             self._touch(key)
             self.stats.gets += 1
             self.stats.hits += 1
             self.stats.bytes_out += e.size
+            descs.append((e.pool_idx, e.offset, e.size))
         return P.FINISH, descs
 
+    def _present(self, key: bytes) -> bool:
+        """Retrievable from EITHER tier — the presence notion exist and the
+        prefix match advertise (a spilled entry still serves reads via
+        promotion, so hiding it would break prefix reuse after pressure)."""
+        return key in self.kv or (self.disk is not None and key in self.disk)
+
     def exist(self, key: bytes) -> bool:
-        return key in self.kv
+        return self._present(key)
 
     def match_last_index(self, keys: Sequence[bytes]) -> int:
         left, right = 0, len(keys)
         while left < right:
             mid = (left + right) // 2
-            if keys[mid] in self.kv:
+            if self._present(keys[mid]):
                 left = mid + 1
             else:
                 right = mid
@@ -272,8 +464,12 @@ class Store:
         self._reap_deferred(now)
         for key in keys:
             e = self.kv.pop(key, None)
+            on_disk = self.disk is not None and key in self.disk
+            if self.disk is not None:
+                self.disk.pop(key)
             if e is not None:
                 self._free_or_defer(e, now)
+            if e is not None or on_disk:
                 count += 1
         return count
 
@@ -291,16 +487,21 @@ class Store:
             if not e.busy:
                 self._free(e)
         self.pending = keep
+        if self.disk is not None:
+            n += self.disk.clear()
         return n
 
     # point-in-time values in stats_dict(); everything else is monotonic.
     # Lives next to the schema so /metrics.prom's TYPE lines can't drift
     # from what stats_dict() actually returns.
-    STATS_GAUGES = frozenset({"kvmap_len", "pending", "usage", "pools", "block_size"})
+    STATS_GAUGES = frozenset({
+        "kvmap_len", "pending", "usage", "pools", "block_size",
+        "disk_entries", "disk_bytes",
+    })
 
     def stats_dict(self) -> dict:
         s = self.stats
-        return {
+        d = {
             "kvmap_len": len(self.kv),
             "pending": len(self.pending),
             "usage": self.mm.usage(),
@@ -314,6 +515,17 @@ class Store:
             "bytes_in": s.bytes_in,
             "bytes_out": s.bytes_out,
         }
+        if self.disk is not None:
+            d.update({
+                "disk_entries": len(self.disk),
+                "disk_bytes": self.disk.used_bytes(),
+                "disk_spilled": s.spilled,
+                "disk_promoted": s.promoted,
+                "disk_dropped": self.disk.dropped,
+            })
+        return d
 
     def close(self) -> None:
+        if self.disk is not None:
+            self.disk.close()
         self.mm.close()

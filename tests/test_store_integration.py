@@ -869,3 +869,77 @@ def test_client_reconnects_after_server_restart(client_mode, monkeypatch):
             srv.wait(timeout=10)
         except subprocess.TimeoutExpired:
             srv.kill()
+
+
+# ---- disk spill tier, end to end over the wire ----
+
+
+@pytest.fixture(scope="module")
+def tiered_server(tmp_path_factory):
+    """A python-backend server with the SSD/disk spill tier attached."""
+    service, manage = _free_port(), _free_port()
+    tier_dir = str(tmp_path_factory.mktemp("disk_tier"))
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "infinistore_tpu.server",
+         "--service-port", str(service), "--manage-port", str(manage),
+         "--prealloc-size", "1", "--minimal-allocate-size", "16",
+         "--log-level", "warning",
+         "--disk-tier-path", tier_dir, "--disk-tier-size", "1"],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            pytest.fail("tiered server failed to start")
+        try:
+            socket.create_connection(("127.0.0.1", service), timeout=0.5).close()
+            break
+        except OSError:
+            time.sleep(0.1)
+    else:
+        pytest.fail("tiered server did not come up")
+    yield service, manage
+    proc.send_signal(signal.SIGTERM)
+    proc.wait(timeout=10)
+
+
+def test_disk_tier_survives_eviction_over_wire(tiered_server):
+    """The full hierarchy over TCP: write, force a FULL eviction (all
+    entries spill to disk), then read everything back byte-identical
+    through promotion, with the manage plane reporting tier counters."""
+    import json
+    import urllib.request
+
+    service, manage = tiered_server
+    cfg = ist.ClientConfig(host_addr="127.0.0.1", service_port=service,
+                           connection_type=ist.TYPE_TCP, log_level="warning")
+    conn = ist.InfinityConnection(cfg)
+    conn.connect()
+    rng = np.random.RandomState(7)
+    n, blk = 12, 16 << 10
+    buf = rng.randint(0, 256, size=n * blk, dtype=np.uint8)
+    conn.register_mr(buf)
+    keys = [f"tier-{i}" for i in range(n)]
+    conn.write_cache([(k, i * blk) for i, k in enumerate(keys)], blk,
+                     buf.ctypes.data)
+    # force-evict EVERYTHING (thresholds 0.0): with the tier attached the
+    # entries spill instead of vanishing
+    conn.evict(0.0, 0.0)
+    stats = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{manage}/metrics", timeout=10).read())
+    assert stats["kvmap_len"] == 0          # DRAM fully drained
+    assert stats["disk_entries"] == n       # ...onto the disk tier
+    assert stats["disk_spilled"] == n
+    # prefix matching still sees the spilled run
+    assert conn.get_match_last_index(keys + ["absent"]) == n - 1
+    # reads promote back and are byte-identical
+    out = np.zeros(n * blk, dtype=np.uint8)
+    conn.register_mr(out)
+    conn.read_cache([(k, i * blk) for i, k in enumerate(keys)], blk,
+                    out.ctypes.data)
+    assert np.array_equal(out, buf)
+    stats = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{manage}/metrics", timeout=10).read())
+    assert stats["disk_promoted"] == n
+    assert stats["disk_entries"] == 0
+    conn.close()

@@ -24,7 +24,18 @@ def make_store(prealloc_mb=1, block_kb=16, **kw):
     store.pending = {}
     store._deferred = []
     store.stats = Stats()
+    store.disk = None
     return store
+
+
+def make_tiered_store(tmp_path, prealloc_mb=1, block_kb=16, disk_slots=64):
+    """A store with the disk spill tier attached (tiny capacities)."""
+    from infinistore_tpu.store import DiskTier
+
+    s = make_store(prealloc_mb=prealloc_mb, block_kb=block_kb)
+    s.disk = DiskTier(str(tmp_path), disk_slots * (block_kb << 10),
+                      block_kb << 10)
+    return s
 
 
 @pytest.fixture
@@ -184,3 +195,132 @@ def test_purge_leased_key_defers_free(store):
     assert store.purge() == 1
     assert store.kvmap_len() == 0
     assert len(store._deferred) == 1
+
+
+# ---- disk spill tier ("Historical KVCache in DRAM and SSD") ----
+
+
+def test_disk_tier_spill_and_promote(tmp_path):
+    s = make_tiered_store(tmp_path)
+    payloads = {f"k{i}".encode(): bytes([i]) * (16 << 10) for i in range(32)}
+    for k, data in payloads.items():
+        assert s.put_inline(k, data) == P.FINISH
+    for k in payloads:  # read leases would block eviction
+        s.kv[k].lease = 0
+    evicted = s.evict(0.25, 0.4)
+    assert evicted > 0
+    assert s.stats.spilled == evicted  # every evicted entry spilled
+    assert len(s.disk) == evicted
+    # a spilled entry is still present and reads back byte-identical
+    # (promotion pulls it into DRAM and takes it off the disk index)
+    victim = next(k for k in payloads if k not in s.kv)
+    assert s.exist(victim)
+    assert bytes(s.get_inline(victim)) == payloads[victim]
+    assert victim in s.kv and victim not in s.disk
+    assert s.stats.promoted == 1
+    d = s.stats_dict()
+    assert d["disk_spilled"] == evicted and d["disk_promoted"] == 1
+    s.close()
+    import os
+
+    assert not os.path.exists(s.disk.path)  # slab unlinked on close
+
+
+def test_disk_tier_serves_get_desc_and_prefix_match(tmp_path):
+    s = make_tiered_store(tmp_path)
+    keys = [f"c{i}".encode() for i in range(24)]
+    for k in keys:
+        assert s.put_inline(k, b"z" * (16 << 10)) == P.FINISH
+    for k in keys:
+        s.kv[k].lease = 0
+    assert s.evict(0.1, 0.2) > 0
+    # the prefix match sees BOTH tiers: reuse survives memory pressure
+    assert s.match_last_index(keys + [b"absent"]) == len(keys) - 1
+    # zero-copy descriptors promote on demand
+    cold = next(k for k in keys if k not in s.kv)
+    st, descs = s.get_desc([cold])
+    assert st == P.FINISH and len(descs) == 1
+    pool_idx, offset, size = descs[0]
+    assert bytes(s.mm.view(pool_idx, offset, size)) == b"z" * (16 << 10)
+    s.close()
+
+
+def test_disk_tier_delete_purge_and_overwrite(tmp_path):
+    s = make_tiered_store(tmp_path)
+    for i in range(24):
+        s.put_inline(f"k{i}".encode(), b"a" * (16 << 10))
+    for k in list(s.kv):
+        s.kv[k].lease = 0
+    s.evict(0.1, 0.2)
+    cold = next(iter(s.disk.index))
+    # delete reaches the disk tier too
+    assert s.delete_keys([cold]) == 1
+    assert not s.exist(cold)
+    # a fresh commit supersedes a stale spilled copy
+    cold2 = next(iter(s.disk.index))
+    assert s.put_inline(cold2, b"NEW" * 16) == P.FINISH
+    assert cold2 not in s.disk
+    assert bytes(s.get_inline(cold2)) == b"NEW" * 16
+    # purge clears both tiers
+    assert len(s.disk) > 0
+    s.purge()
+    assert len(s.disk) == 0 and s.kvmap_len() == 0
+    s.close()
+
+
+def test_disk_tier_capacity_drops_oldest(tmp_path):
+    from infinistore_tpu.store import DiskTier
+
+    tier = DiskTier(str(tmp_path), 4 * 1024, 1024)  # 4 slots
+    for i in range(6):
+        assert tier.put(f"k{i}".encode(), bytes([i]) * 100)
+    assert len(tier) == 4 and tier.dropped == 2
+    assert tier.get(b"k0") is None and tier.get(b"k1") is None  # oldest out
+    assert tier.get(b"k5") == bytes([5]) * 100
+    tier.close()
+
+
+def test_disk_tier_multiblock_entries_spill(tmp_path):
+    """Entries spanning several pool blocks (contiguous multi-block DRAM
+    regions) must spill and promote too — the slab allocates consecutive
+    slot runs, not single slots (regression: they used to vanish)."""
+    s = make_tiered_store(tmp_path)
+    big = bytes(range(256)) * 192  # 48 KB = 3 x 16 KB blocks
+    for i in range(8):
+        assert s.put_inline(f"big{i}".encode(), big) == P.FINISH
+    for k in list(s.kv):
+        s.kv[k].lease = 0
+    evicted = s.evict(0.1, 0.2)
+    assert evicted > 0
+    assert s.stats.spilled == evicted  # nothing vanished
+    cold = next(k for k in (f"big{i}".encode() for i in range(8))
+                if k not in s.kv)
+    assert s.exist(cold)
+    assert bytes(s.get_inline(cold)) == big  # byte-identical round trip
+    assert s.stats_dict()["disk_bytes"] == (evicted - 1) * len(big)
+    s.close()
+
+
+def test_disk_tier_mixed_batch_get_desc_promotes_safely(tmp_path):
+    """get_desc over a batch mixing resident and spilled keys under memory
+    pressure: promotion-triggered eviction must never free a batchmate's
+    region mid-request (regression: KeyError / stale descriptor)."""
+    s = make_tiered_store(tmp_path, disk_slots=128)
+    data = {}
+    for i in range(60):  # fill most of the 64-block pool
+        k = f"m{i}".encode()
+        data[k] = bytes([i]) * (16 << 10)
+        assert s.put_inline(k, data[k]) == P.FINISH
+    for k in list(s.kv):
+        s.kv[k].lease = 0
+    s.evict(0.3, 0.4)  # spill a cold prefix
+    cold = [k for k in data if k not in s.kv][:4]
+    hot = [k for k in data if k in s.kv][:4]
+    for k in hot:
+        s.kv[k].lease = 0  # expired leases: evictable unless re-leased
+    batch = hot + cold  # promotions happen AFTER hot keys joined the batch
+    st, descs = s.get_desc(batch)
+    assert st == P.FINISH and len(descs) == len(batch)
+    for k, (pool_idx, offset, size) in zip(batch, descs):
+        assert bytes(s.mm.view(pool_idx, offset, size)) == data[k]
+    s.close()
