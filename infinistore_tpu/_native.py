@@ -60,6 +60,18 @@ def _build():
         )
 
 
+_ABI_VERSION = 2  # must match istpu_abi_version() in src/istpu_c.cpp
+
+
+def _abi_ok(lib) -> bool:
+    try:
+        fn = lib.istpu_abi_version
+    except AttributeError:
+        return False
+    fn.restype = ctypes.c_int
+    return fn() == _ABI_VERSION
+
+
 def _load():
     global _lib
     if _lib is not None:
@@ -68,14 +80,35 @@ def _load():
         _build()
     if not os.path.exists(_LIB_PATH):
         return None
+    # a PREVIOUSLY built .so may predate an ABI change; an existence-only
+    # check would happily call old signatures with new arguments (silently
+    # dropping them on x86-64).  Rebuild once on mismatch; relinking
+    # replaces the inode, so the second CDLL maps the fresh library.
+    if not os.environ.get("ISTPU_NO_BUILD"):
+        try:
+            probe = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            probe = None
+        if probe is None or not _abi_ok(probe):
+            _build()
     try:
         lib = ctypes.CDLL(_LIB_PATH)
     except OSError:
         return None
+    if not _abi_ok(lib):
+        import sys
+
+        print(
+            "[infinistore_tpu] libistpu.so ABI mismatch (rebuild failed?); "
+            "using the Python fallback",
+            file=sys.stderr,
+        )
+        return None
 
     lib.istpu_server_create.restype = ctypes.c_void_p
     lib.istpu_server_create.argtypes = [
-        ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int, ctypes.c_int,
+        ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int,
+        ctypes.c_int, ctypes.c_char_p, ctypes.c_uint64,
     ]
     lib.istpu_server_start.argtypes = [ctypes.c_void_p]
     lib.istpu_server_stop.argtypes = [ctypes.c_void_p]
@@ -163,6 +196,8 @@ class NativeStoreServer:
             int(config.minimal_allocate_size) << 10,
             1 if config.auto_increase else 0,
             int(config.service_port),
+            (getattr(config, "disk_tier_path", "") or "").encode(),
+            int(getattr(config, "disk_tier_size", 64)) << 30,
         )
         if not self._h:
             raise RuntimeError("native server create failed")

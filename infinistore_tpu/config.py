@@ -95,7 +95,7 @@ class ServerConfig:
         # second storage tier ("Historical KVCache in DRAM and SSD",
         # reference docs/source/design.rst:36): LRU-evicted entries spill
         # to a file-backed slab at this path and promote back on access.
-        # Empty = DRAM only.  Python backend feature.
+        # Empty = DRAM only.  Both backends.
         self.disk_tier_path = kwargs.get("disk_tier_path", "")
         self.disk_tier_size = kwargs.get("disk_tier_size", 64)  # GB
 

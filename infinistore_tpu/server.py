@@ -35,15 +35,6 @@ def register_server(loop, config: ServerConfig):
     schedules the data-plane server on ``loop``."""
     global _SERVER
     backend = getattr(config, "backend", "auto")
-    if getattr(config, "disk_tier_path", "") and backend != "python":
-        # the spill/promote tier lives in the python store core
-        if backend == "native":
-            raise RuntimeError(
-                "--disk-tier-path requires the python backend "
-                "(--backend python)"
-            )
-        Logger.info("disk tier enabled; selecting the python backend")
-        backend = "python"
     if backend in ("auto", "native"):
         try:
             from . import _native  # noqa: F401
@@ -179,7 +170,7 @@ def parse_args():
     parser.add_argument("--disk-tier-path", required=False, default="", type=str,
                         help="directory for the SSD/disk spill tier; evicted "
                              "entries spill there and promote back on access "
-                             "(forces the python backend)")
+                             "(both backends)")
     parser.add_argument("--disk-tier-size", required=False, default=64, type=int,
                         help="disk tier capacity in GB")
     return parser.parse_args()

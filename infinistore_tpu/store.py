@@ -158,9 +158,19 @@ class DiskTier:
         slot = self._alloc_run(self._slots_for(len(data)))
         if slot is None:
             return False
-        os.pwrite(self._f.fileno(), bytes(data), slot * self.block_size)
-        self.index[key] = (slot, len(data))
-        self._bytes += len(data)
+        payload = bytes(data)
+        try:
+            n = os.pwrite(self._f.fileno(), payload, slot * self.block_size)
+        except OSError:
+            n = -1
+        if n != len(payload):
+            # disk full / IO error / short write: the entry simply doesn't
+            # spill (the caller's eviction continues; a truncated record
+            # must never sit in the index to promote back as corrupt KV)
+            self._release_run(slot, len(payload))
+            return False
+        self.index[key] = (slot, len(payload))
+        self._bytes += len(payload)
         return True
 
     def get(self, key: bytes) -> Optional[bytes]:
@@ -170,11 +180,14 @@ class DiskTier:
         slot, size = rec
         return os.pread(self._f.fileno(), size, slot * self.block_size)
 
-    def pop(self, key: bytes) -> None:
+    def pop(self, key: bytes) -> bool:
+        """Drop an entry; True when one was present."""
         rec = self.index.pop(key, None)
-        if rec is not None:
-            self._bytes -= rec[1]
-            self._release_run(*rec)
+        if rec is None:
+            return False
+        self._bytes -= rec[1]
+        self._release_run(*rec)
+        return True
 
     def clear(self) -> int:
         n = len(self.index)
@@ -464,9 +477,7 @@ class Store:
         self._reap_deferred(now)
         for key in keys:
             e = self.kv.pop(key, None)
-            on_disk = self.disk is not None and key in self.disk
-            if self.disk is not None:
-                self.disk.pop(key)
+            on_disk = self.disk is not None and self.disk.pop(key)
             if e is not None:
                 self._free_or_defer(e, now)
             if e is not None or on_disk:

@@ -1,9 +1,13 @@
 #include "store.h"
 
+#include <fcntl.h>
+#include <sys/stat.h>
 #include <unistd.h>
 
+#include <cerrno>
 #include <cstdio>
 #include <cstring>
+#include <stdexcept>
 
 namespace istpu {
 
@@ -14,6 +18,129 @@ static std::string rand_prefix() {
   return buf;
 }
 
+// ---- DiskTier ----
+
+static void mkdirs(const std::string& dir) {
+  // recursive create (os.makedirs parity); EEXIST is fine at every level
+  for (size_t i = 1; i <= dir.size(); i++) {
+    if (i == dir.size() || dir[i] == '/')
+      mkdir(dir.substr(0, i).c_str(), 0777);
+  }
+}
+
+DiskTier::DiskTier(const std::string& dir, uint64_t capacity_bytes,
+                   uint64_t block)
+    : block_(block),
+      capacity_slots_(capacity_bytes / block ? capacity_bytes / block : 1) {
+  mkdirs(dir);
+  path_ = dir + "/istpu_disk_tier.dat";
+  fd_ = open(path_.c_str(), O_RDWR | O_CREAT | O_TRUNC, 0600);
+  if (fd_ < 0) {
+    // fail LOUDLY at startup (python-backend parity): a tier the operator
+    // asked for that silently drops every spill is worse than no server
+    throw std::runtime_error("disk tier: cannot open " + path_ + ": " +
+                             std::strerror(errno));
+  }
+}
+
+DiskTier::~DiskTier() {
+  if (fd_ >= 0) close(fd_);
+  unlink(path_.c_str());
+}
+
+void DiskTier::release_run(uint64_t slot, uint64_t size) {
+  for (uint64_t s = slot; s < slot + slots_for(size); s++) free_.insert(s);
+}
+
+int64_t DiskTier::find_run(uint64_t n) {
+  // first-fit over the sorted free set
+  uint64_t count = 0, start = 0, prev = 0;
+  for (uint64_t s : free_) {
+    if (count && s == prev + 1) {
+      count++;
+    } else {
+      start = s;
+      count = 1;
+    }
+    prev = s;
+    if (count == n) {
+      for (uint64_t i = start; i < start + n; i++) free_.erase(i);
+      return static_cast<int64_t>(start);
+    }
+  }
+  return -1;
+}
+
+int64_t DiskTier::alloc_run(uint64_t n) {
+  if (n > capacity_slots_) return -1;
+  for (;;) {
+    int64_t start = find_run(n);
+    if (start >= 0) return start;
+    if (next_slot_ + n <= capacity_slots_) {
+      start = static_cast<int64_t>(next_slot_);
+      next_slot_ += n;
+      return start;
+    }
+    if (index_.empty()) return -1;
+    // slab full: the coldest spilled entries leave the hierarchy until a
+    // big-enough run frees up
+    const std::string victim = lru_.front();
+    auto it = index_.find(victim);
+    bytes_ -= it->second.size;
+    dropped_++;
+    release_run(it->second.slot, it->second.size);
+    lru_.pop_front();
+    index_.erase(it);
+  }
+}
+
+bool DiskTier::put(const std::string& key, const uint8_t* data, uint64_t size) {
+  if (fd_ < 0) return false;
+  pop(key);  // an old copy's run goes back to the free set
+  int64_t slot = alloc_run(slots_for(size));
+  if (slot < 0) return false;
+  if (pwrite(fd_, data, size, static_cast<off_t>(slot) * block_) !=
+      static_cast<ssize_t>(size)) {
+    release_run(static_cast<uint64_t>(slot), size);
+    return false;  // disk full / IO error: entry simply doesn't spill
+  }
+  lru_.push_back(key);
+  index_[key] = Rec{static_cast<uint64_t>(slot), size, std::prev(lru_.end())};
+  bytes_ += size;
+  return true;
+}
+
+bool DiskTier::get(const std::string& key, std::vector<uint8_t>* out) const {
+  auto it = index_.find(key);
+  if (it == index_.end()) return false;
+  out->resize(it->second.size);
+  return pread(fd_, out->data(), it->second.size,
+               static_cast<off_t>(it->second.slot) * block_) ==
+         static_cast<ssize_t>(it->second.size);
+}
+
+bool DiskTier::pop(const std::string& key) {
+  auto it = index_.find(key);
+  if (it == index_.end()) return false;
+  bytes_ -= it->second.size;
+  release_run(it->second.slot, it->second.size);
+  lru_.erase(it->second.lru_it);
+  index_.erase(it);
+  return true;
+}
+
+size_t DiskTier::clear() {
+  size_t n = index_.size();
+  index_.clear();
+  lru_.clear();
+  free_.clear();
+  next_slot_ = 0;
+  bytes_ = 0;
+  return n;
+}
+
+// ---- Store ----
+
 Store::Store(const StoreConfig& cfg)
     : cfg_(cfg),
       mm_(cfg.prealloc_bytes, cfg.block_bytes,
@@ -22,6 +149,9 @@ Store::Store(const StoreConfig& cfg)
   // keys and a mid-batch rehash stalls the single-threaded event loop
   kv_.reserve(1 << 15);
   pending_.reserve(1 << 12);
+  if (!cfg.disk_tier_path.empty())
+    disk_ = std::make_unique<DiskTier>(cfg.disk_tier_path,
+                                       cfg.disk_tier_bytes, cfg.block_bytes);
 }
 
 double Store::now() {
@@ -90,8 +220,26 @@ void Store::insert_committed(const std::string& key, const Entry& e) {
     lru_.erase(it->second.lru_it);
     kv_.erase(it);
   }
+  // a fresh commit supersedes any spilled copy (stale data must never
+  // promote back over it)
+  if (disk_) disk_->pop(key);
   lru_.push_back(key);
   kv_.emplace(key, Slot{e, std::prev(lru_.end())});
+}
+
+Entry* Store::promote(const std::string& key) {
+  if (!disk_) return nullptr;
+  std::vector<uint8_t> data;
+  if (!disk_->get(key, &data)) return nullptr;
+  std::vector<Region> regions;
+  if (!allocate(data.size(), 1, &regions)) return nullptr;
+  std::memcpy(mm_.view(regions[0].pool_idx, regions[0].offset), data.data(),
+              data.size());
+  // insert_committed drops the disk copy (its supersede rule)
+  insert_committed(key, Entry{regions[0].pool_idx, regions[0].offset,
+                              data.size()});
+  stats_.promoted++;
+  return &kv_.find(key)->second.e;
 }
 
 int64_t Store::evict(double min_threshold, double max_threshold) {
@@ -112,6 +260,13 @@ int64_t Store::evict(double min_threshold, double max_threshold) {
         touch(it->second, key);
         if (++rotated >= kv_.size()) break;
         continue;
+      }
+      if (disk_) {
+        // spill before the blocks are reused: not leased (checked above),
+        // so the bytes are stable
+        const Entry& e = it->second.e;
+        if (disk_->put(key, mm_.view(e.pool_idx, e.offset), e.size))
+          stats_.spilled++;
       }
       free_entry(it->second.e);
       lru_.pop_front();
@@ -219,28 +374,29 @@ Status Store::commit_put(const std::vector<std::string>& keys, int32_t* committe
 
 Status Store::get_desc(const std::vector<std::string>& keys, uint64_t block_size,
                        std::vector<Desc>* descs) {
-  descs->reserve(keys.size());
-  for (const auto& k : keys) {
-    auto it = kv_.find(k);
-    if (it == kv_.end()) {
-      stats_.misses++;
-      descs->clear();
-      return KEY_NOT_FOUND;
-    }
-    if (block_size && it->second.e.size > block_size) {
-      descs->clear();
-      return INVALID_REQ;
-    }
-    descs->push_back({it->second.e.pool_idx, it->second.e.offset, it->second.e.size});
-  }
+  // two passes on purpose: promoting a spilled batchmate allocates, which
+  // can evict — leasing each key the moment it checks out keeps the
+  // evictor's hands off earlier keys of the SAME batch, so the
+  // descriptors built in pass 2 can never go stale mid-request
   double t = now();
   for (const auto& k : keys) {
+    auto it = kv_.find(k);
+    Entry* e = it != kv_.end() ? &it->second.e : promote(k);
+    if (e == nullptr) {
+      stats_.misses++;
+      return KEY_NOT_FOUND;
+    }
+    if (block_size && e->size > block_size) return INVALID_REQ;
+    e->lease = t + kReadLeaseS;
+  }
+  descs->reserve(keys.size());
+  for (const auto& k : keys) {
     auto& s = kv_.find(k)->second;
-    s.e.lease = t + kReadLeaseS;
     touch(s, k);
     stats_.gets++;
     stats_.hits++;
     stats_.bytes_out += s.e.size;
+    descs->push_back({s.e.pool_idx, s.e.offset, s.e.size});
   }
   return FINISH;
 }
@@ -258,8 +414,11 @@ Status Store::put_inline(const std::string& key, const uint8_t* data, uint64_t s
 const Entry* Store::get_inline(const std::string& key) {
   auto it = kv_.find(key);
   if (it == kv_.end()) {
-    stats_.misses++;
-    return nullptr;
+    if (promote(key) == nullptr) {
+      stats_.misses++;
+      return nullptr;
+    }
+    it = kv_.find(key);
   }
   touch(it->second, key);
   stats_.gets++;
@@ -273,7 +432,7 @@ int32_t Store::match_last_index(const std::vector<std::string>& keys) const {
   int32_t left = 0, right = static_cast<int32_t>(keys.size());
   while (left < right) {
     int32_t mid = (left + right) / 2;
-    if (kv_.count(keys[mid]))
+    if (exist(keys[mid]))  // either tier counts (spilled entries serve reads)
       left = mid + 1;
     else
       right = mid;
@@ -286,8 +445,12 @@ int32_t Store::delete_keys(const std::vector<std::string>& keys) {
   double t = now();
   reap_deferred(t);
   for (const auto& k : keys) {
+    bool on_disk = disk_ && disk_->pop(k);
     auto it = kv_.find(k);
-    if (it == kv_.end()) continue;
+    if (it == kv_.end()) {
+      if (on_disk) count++;
+      continue;
+    }
     free_or_defer(it->second.e, t);
     lru_.erase(it->second.lru_it);
     kv_.erase(it);
@@ -312,6 +475,7 @@ int32_t Store::purge() {
       free_entry(s.e);
   }
   pending_ = std::move(keep);
+  if (disk_) n += static_cast<int32_t>(disk_->clear());
   return n;
 }
 
@@ -321,12 +485,12 @@ Entry* Store::pending_entry(const std::string& key) {
 }
 
 std::string Store::stats_json() const {
-  char buf[512];
-  snprintf(buf, sizeof(buf),
+  char buf[768];
+  int n = snprintf(buf, sizeof(buf),
            "{\"kvmap_len\": %zu, \"pending\": %zu, \"usage\": %.6f, "
            "\"pools\": %zu, \"block_size\": %llu, \"puts\": %llu, "
            "\"gets\": %llu, \"hits\": %llu, \"misses\": %llu, "
-           "\"evicted\": %llu, \"bytes_in\": %llu, \"bytes_out\": %llu}",
+           "\"evicted\": %llu, \"bytes_in\": %llu, \"bytes_out\": %llu",
            kv_.size(), pending_.size(), mm_.usage(), mm_.pools().size(),
            static_cast<unsigned long long>(mm_.block_size()),
            static_cast<unsigned long long>(stats_.puts),
@@ -336,6 +500,18 @@ std::string Store::stats_json() const {
            static_cast<unsigned long long>(stats_.evicted),
            static_cast<unsigned long long>(stats_.bytes_in),
            static_cast<unsigned long long>(stats_.bytes_out));
+  if (disk_) {
+    n += snprintf(buf + n, sizeof(buf) - n,
+                  ", \"disk_entries\": %zu, \"disk_bytes\": %llu, "
+                  "\"disk_spilled\": %llu, \"disk_promoted\": %llu, "
+                  "\"disk_dropped\": %llu",
+                  disk_->entries(),
+                  static_cast<unsigned long long>(disk_->used_bytes()),
+                  static_cast<unsigned long long>(stats_.spilled),
+                  static_cast<unsigned long long>(stats_.promoted),
+                  static_cast<unsigned long long>(disk_->dropped()));
+  }
+  snprintf(buf + n, sizeof(buf) - n, "}");
   return buf;
 }
 

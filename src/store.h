@@ -13,6 +13,8 @@
 #include <chrono>
 #include <list>
 #include <map>
+#include <memory>
+#include <set>
 #include <string>
 #include <unordered_map>
 #include <utility>
@@ -42,6 +44,7 @@ struct Entry {
 struct StoreStats {
   uint64_t puts = 0, gets = 0, hits = 0, misses = 0, evicted = 0;
   uint64_t bytes_in = 0, bytes_out = 0;
+  uint64_t spilled = 0, promoted = 0;  // DRAM <-> disk tier traffic
 };
 
 struct StoreConfig {
@@ -49,6 +52,57 @@ struct StoreConfig {
   uint64_t block_bytes = 64 << 10;
   bool auto_increase = false;
   std::string shm_prefix;
+  // second storage tier ("Historical KVCache in DRAM and SSD", reference
+  // docs/source/design.rst:36): LRU-evicted entries spill to a
+  // file-backed slab here and promote back on access.  Empty = DRAM only.
+  std::string disk_tier_path;
+  uint64_t disk_tier_bytes = 64ULL << 30;
+};
+
+// File-backed slab for the cold half of the cache hierarchy (counterpart
+// of infinistore_tpu/store.py DiskTier).  Entries span ceil(size/block)
+// CONSECUTIVE slots (DRAM regions are contiguous multi-block runs);
+// allocation is first-fit over a sorted free-slot set; when the slab
+// fills, the coldest spilled entries are dropped for good.  No fsync: a
+// cache tier, not a database.
+class DiskTier {
+ public:
+  DiskTier(const std::string& dir, uint64_t capacity_bytes, uint64_t block);
+  ~DiskTier();
+
+  bool put(const std::string& key, const uint8_t* data, uint64_t size);
+  // reads into out (resized); false if absent
+  bool get(const std::string& key, std::vector<uint8_t>* out) const;
+  bool contains(const std::string& key) const { return index_.count(key) != 0; }
+  bool pop(const std::string& key);  // true when an entry was removed
+  size_t clear();
+  size_t entries() const { return index_.size(); }
+  uint64_t used_bytes() const { return bytes_; }
+  uint64_t dropped() const { return dropped_; }
+
+ private:
+  struct Rec {
+    uint64_t slot = 0, size = 0;
+    std::list<std::string>::iterator lru_it;
+  };
+  uint64_t slots_for(uint64_t size) const {
+    return size ? (size + block_ - 1) / block_ : 1;
+  }
+  void release_run(uint64_t slot, uint64_t size);
+  // -1 when no run can be made (after dropping everything)
+  int64_t alloc_run(uint64_t n);
+  int64_t find_run(uint64_t n);
+
+  std::string path_;
+  int fd_ = -1;
+  uint64_t block_;
+  uint64_t capacity_slots_;
+  std::unordered_map<std::string, Rec> index_;
+  std::list<std::string> lru_;  // front = oldest spill
+  std::set<uint64_t> free_;     // sorted free slots
+  uint64_t next_slot_ = 0;
+  uint64_t bytes_ = 0;
+  uint64_t dropped_ = 0;
 };
 
 class Store {
@@ -68,7 +122,11 @@ class Store {
   const Entry* get_inline(const std::string& key);  // touches LRU; null if miss
 
   // ---- metadata ----
-  bool exist(const std::string& key) const { return kv_.count(key) != 0; }
+  // present = retrievable from EITHER tier: a spilled entry still serves
+  // reads via promotion, so exist / the prefix match advertise it
+  bool exist(const std::string& key) const {
+    return kv_.count(key) != 0 || (disk_ && disk_->contains(key));
+  }
   int32_t match_last_index(const std::vector<std::string>& keys) const;
   int32_t delete_keys(const std::vector<std::string>& keys);
   int32_t purge();
@@ -97,6 +155,9 @@ class Store {
   };
 
   void free_entry(const Entry& e);  // respects pins (zombie until unpin)
+  // pull a spilled entry back into a DRAM pool (may evict-and-spill
+  // colder keys); nullptr when absent on disk or DRAM can't fit it
+  Entry* promote(const std::string& key);
   // delete/purge/overwrite of a leased entry must not yank pool memory out
   // from under an in-flight shm read: the key disappears immediately, the
   // region is freed once the lease expires
@@ -121,6 +182,7 @@ class Store {
   using RegionId = std::pair<uint32_t, uint64_t>;   // (pool_idx, offset)
   std::map<RegionId, int> pins_;                    // outstanding send refs
   std::map<RegionId, uint64_t> zombies_;            // freed-while-pinned: size
+  std::unique_ptr<DiskTier> disk_;                  // optional spill tier
 };
 
 }  // namespace istpu

@@ -874,16 +874,16 @@ def test_client_reconnects_after_server_restart(client_mode, monkeypatch):
 # ---- disk spill tier, end to end over the wire ----
 
 
-@pytest.fixture(scope="module")
-def tiered_server(tmp_path_factory):
-    """A python-backend server with the SSD/disk spill tier attached."""
+@pytest.fixture(scope="module", params=["python", "native"])
+def tiered_server(request, tmp_path_factory):
+    """A server with the SSD/disk spill tier attached (both backends)."""
     service, manage = _free_port(), _free_port()
-    tier_dir = str(tmp_path_factory.mktemp("disk_tier"))
+    tier_dir = str(tmp_path_factory.mktemp(f"disk_tier_{request.param}"))
     proc = subprocess.Popen(
         [sys.executable, "-m", "infinistore_tpu.server",
          "--service-port", str(service), "--manage-port", str(manage),
          "--prealloc-size", "1", "--minimal-allocate-size", "16",
-         "--log-level", "warning",
+         "--log-level", "warning", "--backend", request.param,
          "--disk-tier-path", tier_dir, "--disk-tier-size", "1"],
         env={**os.environ, "JAX_PLATFORMS": "cpu"},
     )
