@@ -170,6 +170,37 @@ def _qk_bias(b: np.ndarray, n_heads: int, head_dim: int) -> np.ndarray:
     return b.reshape(n_heads, head_dim)[:, perm].reshape(-1)
 
 
+def _attn_tensors(get, p: str, cfg) -> Dict[str, np.ndarray]:
+    """Llama-convention attention mapping (RoPE row permutation included)
+    shared by the dense and MoE importers — a fix here must apply to both."""
+    hd = cfg.head_dim
+    return {
+        "wq": _qk(get(p + "self_attn.q_proj.weight"), cfg.n_heads, hd),
+        "wk": _qk(get(p + "self_attn.k_proj.weight"), cfg.n_kv_heads, hd),
+        "wv": _proj_in_out(get(p + "self_attn.v_proj.weight")),
+        "wo": _proj_in_out(get(p + "self_attn.o_proj.weight")),
+        "ln_attn": get(p + "input_layernorm.weight"),
+    }
+
+
+def _params_tail(state: Mapping[str, Any], cfg, stacked: Dict[str, Any]) -> Params:
+    """embed / final norm / lm_head tail shared by both importers.
+    Tied-embedding checkpoints (no ``lm_head.weight``) reuse the embedding
+    matrix, matching transformers' ``tie_word_embeddings``."""
+    embed = _np(state["model.embed_tokens.weight"])
+    lm_head = (
+        _np(state["lm_head.weight"]).T
+        if "lm_head.weight" in state
+        else embed.T
+    )
+    return {
+        "embed": jnp.asarray(embed, dtype=cfg.dtype),
+        "layers": stacked,
+        "ln_out": jnp.asarray(_np(state["model.norm.weight"]), dtype=cfg.dtype),
+        "lm_head": jnp.asarray(np.ascontiguousarray(lm_head), dtype=cfg.dtype),
+    }
+
+
 def params_from_hf(
     model_or_state: Any, cfg: LlamaConfig | None = None
 ) -> Params:
@@ -196,14 +227,10 @@ def params_from_hf(
     for li in range(cfg.n_layers):
         p = f"model.layers.{li}."
         layer = {
-            "wq": _qk(get(p + "self_attn.q_proj.weight"), cfg.n_heads, hd),
-            "wk": _qk(get(p + "self_attn.k_proj.weight"), cfg.n_kv_heads, hd),
-            "wv": _proj_in_out(get(p + "self_attn.v_proj.weight")),
-            "wo": _proj_in_out(get(p + "self_attn.o_proj.weight")),
+            **_attn_tensors(get, p, cfg),
             "w_gate": _proj_in_out(get(p + "mlp.gate_proj.weight")),
             "w_up": _proj_in_out(get(p + "mlp.up_proj.weight")),
             "w_down": _proj_in_out(get(p + "mlp.down_proj.weight")),
-            "ln_attn": get(p + "input_layernorm.weight"),
         }
         if cfg.post_norms:
             # Gemma-2 sandwich: post_attention_layernorm is genuinely
@@ -234,15 +261,106 @@ def params_from_hf(
         stacked[k] = jnp.asarray(
             np.stack([layer[k] for layer in layers]), dtype=cfg.dtype
         )
-    embed = _np(state["model.embed_tokens.weight"])
-    lm_head = (
-        _np(state["lm_head.weight"]).T
-        if "lm_head.weight" in state
-        else embed.T
+    return _params_tail(state, cfg, stacked)
+
+
+# ---- Mixtral-style sparse MoE ----
+
+
+def moe_config_from_hf(hf_config: Any, dtype: Any = jnp.bfloat16):
+    """Map a ``transformers`` MixtralConfig onto models/moe.MoEConfig.
+
+    Same contract as ``config_from_hf``: raise on what this architecture
+    cannot represent instead of importing weights that would silently
+    produce wrong logits."""
+    from .moe import MoEConfig
+
+    family = getattr(hf_config, "model_type", "")
+    if family != "mixtral":
+        raise ValueError(f"moe_config_from_hf: unsupported model_type {family!r}")
+    if getattr(hf_config, "sliding_window", None) is not None:
+        # the MoE forwards run full causal attention (Mixtral ships
+        # sliding_window: null); importing a windowed variant would
+        # silently change its attention pattern
+        raise ValueError("moe_config_from_hf: sliding_window not supported")
+    rs = getattr(hf_config, "rope_scaling", None)
+    if rs:
+        raise ValueError("moe_config_from_hf: rope_scaling not supported")
+    derived_hd = hf_config.hidden_size // hf_config.num_attention_heads
+    explicit_hd = getattr(hf_config, "head_dim", None)
+    if explicit_hd is not None and explicit_hd != derived_hd:
+        raise ValueError(
+            f"moe_config_from_hf: decoupled head_dim {explicit_hd} != "
+            f"hidden/heads {derived_hd} not supported"
+        )
+    return MoEConfig(
+        vocab_size=hf_config.vocab_size,
+        dim=hf_config.hidden_size,
+        n_layers=hf_config.num_hidden_layers,
+        n_heads=hf_config.num_attention_heads,
+        n_kv_heads=getattr(
+            hf_config, "num_key_value_heads", hf_config.num_attention_heads
+        ),
+        ffn_dim=hf_config.intermediate_size,
+        norm_eps=hf_config.rms_norm_eps,
+        rope_theta=getattr(hf_config, "rope_theta", 1e6),
+        n_experts=hf_config.num_local_experts,
+        top_k=hf_config.num_experts_per_tok,
+        dtype=dtype,
     )
-    return {
-        "embed": jnp.asarray(embed, dtype=cfg.dtype),
-        "layers": stacked,
-        "ln_out": jnp.asarray(_np(state["model.norm.weight"]), dtype=cfg.dtype),
-        "lm_head": jnp.asarray(np.ascontiguousarray(lm_head), dtype=cfg.dtype),
-    }
+
+
+def moe_params_from_hf(model_or_state: Any, cfg=None) -> Params:
+    """Convert an HF MixtralForCausalLM (or its state dict) to our MoE
+    params.  Attention/norm tensors follow the Llama mapping (RoPE row
+    permutation included); the expert FFNs stack on a leading [E] axis
+    (HF per-expert ``w1``=gate, ``w3``=up, ``w2``=down), and the router
+    stays fp32 (gate ordering is precision-sensitive — models/moe.py).
+
+    HF's softmax→top-k→renormalize routing equals our softmax-over-top-k
+    gating exactly (softmax is monotone, renormalizing the top-k softmax
+    mass IS the softmax restricted to those entries), so logits match to
+    dtype precision (tests/test_hf_import.py)."""
+    if hasattr(model_or_state, "state_dict"):
+        if cfg is None:
+            cfg = moe_config_from_hf(model_or_state.config)
+        state: Mapping[str, Any] = model_or_state.state_dict()
+    else:
+        state = model_or_state
+        if cfg is None:
+            raise ValueError("cfg is required when passing a raw state dict")
+
+    def get(name: str) -> np.ndarray:
+        return _np(state[name])
+
+    layers = []
+    for li in range(cfg.n_layers):
+        p = f"model.layers.{li}."
+        moe = p + "block_sparse_moe."
+
+        def experts(w: str) -> np.ndarray:
+            # plain .T views: np.stack makes the one contiguous copy (an
+            # ascontiguousarray per expert would double the transient
+            # footprint — ~90 GB extra at Mixtral-8x7B scale)
+            return np.stack([
+                get(moe + f"experts.{e}.{w}.weight").T
+                for e in range(cfg.n_experts)
+            ])
+
+        layer = {
+            **_attn_tensors(get, p, cfg),
+            "router": _proj_in_out(get(moe + "gate.weight")),  # [dim, E]
+            "w_gate": experts("w1"),
+            "w_up": experts("w3"),
+            "w_down": experts("w2"),
+            "ln_mlp": get(p + "post_attention_layernorm.weight"),
+        }
+        layers.append(layer)
+    stacked: Dict[str, Any] = {}
+    for k in layers[0]:
+        # router stays fp32 (models/moe.py init convention)
+        dt = jnp.float32 if k == "router" else cfg.dtype
+        stacked[k] = jnp.asarray(
+            np.stack([layer[k] for layer in layers]), dtype=dt
+        )
+    return _params_tail(state, cfg, stacked)

@@ -989,36 +989,62 @@ def main(argv: Optional[List[str]] = None) -> None:
     from .models import TINY, init_params
 
     def load_model(name: str, seed: int = 0):
+        """Returns (cfg, params, engine_fns) — engine_fns routes MoE
+        checkpoints (Mixtral) through the MoE forwards."""
         if name == "tiny":
-            return TINY, init_params(TINY, jax.random.PRNGKey(seed))
+            return TINY, init_params(TINY, jax.random.PRNGKey(seed)), {}
         import transformers
 
         from .models.hf import config_from_hf, params_from_hf
 
         hf = transformers.AutoModelForCausalLM.from_pretrained(name)
+        if getattr(hf.config, "model_type", "") == "mixtral":
+            from .models import (
+                moe_decode_forward,
+                moe_prefill_forward,
+                moe_verify_forward,
+            )
+            from .models.hf import moe_config_from_hf, moe_params_from_hf
+
+            mcfg = moe_config_from_hf(hf.config)
+            return mcfg, moe_params_from_hf(hf, mcfg), {
+                "prefill_fn": moe_prefill_forward,
+                "decode_fn": moe_decode_forward,
+                "verify_fn": moe_verify_forward,
+            }
         cfg = config_from_hf(hf.config)
-        return cfg, params_from_hf(hf, cfg)
+        return cfg, params_from_hf(hf, cfg), {}
 
     tokenizer = None
-    cfg, params = load_model(args.model)
+    cfg, params, engine_fns = load_model(args.model)
     model_id = args.model
     tok_src = args.tokenizer or (args.model if args.model != "tiny" else None)
     if tok_src is not None:
         import transformers
 
-        tokenizer = transformers.AutoTokenizer.from_pretrained(tok_src)
+        try:
+            tokenizer = transformers.AutoTokenizer.from_pretrained(tok_src)
+        except Exception:
+            if args.tokenizer is not None:
+                raise  # the operator asked for THIS tokenizer: fail loudly
+            # implicit default (the checkpoint dir): weights-only dirs are
+            # fine — serve token ids without text features
+            Logger.warn(
+                f"no usable tokenizer in {tok_src!r}; serving token ids only"
+            )
     pc = PagedCacheConfig(
         n_layers=cfg.n_layers, n_kv_heads=cfg.n_kv_heads,
         head_dim=cfg.head_dim, n_blocks=args.n_blocks,
         block_tokens=args.block_tokens, dtype=cfg.dtype,
     )
-    engine = InferenceEngine(params, cfg, pc, prefill_chunk=args.prefill_chunk)
+    engine = InferenceEngine(params, cfg, pc, prefill_chunk=args.prefill_chunk,
+                             **engine_fns)
     draft_engine = None
     if args.draft_model is not None:
         # the draft proposes tokens the target verifies, so the vocabs must
         # agree; pages must chunk identically for the two caches to track
         # the same sequence (SpeculativeDecoder asserts block_tokens)
-        dcfg, dparams = load_model(args.draft_model, seed=1)
+        dcfg, dparams, dfns = load_model(args.draft_model, seed=1)
         if dcfg.vocab_size != cfg.vocab_size:
             raise SystemExit(
                 f"--draft-model vocab {dcfg.vocab_size} != target vocab "
@@ -1030,7 +1056,7 @@ def main(argv: Optional[List[str]] = None) -> None:
             n_blocks=args.draft_n_blocks or args.n_blocks,
             block_tokens=args.block_tokens, dtype=dcfg.dtype,
         )
-        draft_engine = InferenceEngine(dparams, dcfg, dpc)
+        draft_engine = InferenceEngine(dparams, dcfg, dpc, **dfns)
     srv = ServingServer(engine, host=args.host, port=args.port,
                         max_batch=args.max_batch, model_id=model_id,
                         tokenizer=tokenizer, draft_engine=draft_engine,

@@ -276,3 +276,45 @@ def test_gemma2_logits_match():
     np.testing.assert_allclose(
         np.asarray(got, np.float32), want, rtol=2e-3, atol=2e-3
     )
+
+
+def test_mixtral_moe_logits_match_transformers():
+    """MoE family import: converted Mixtral weights (per-expert w1/w3/w2
+    stacks, fp32 router, Llama-convention attention) must reproduce
+    transformers' logits — HF's softmax->top-k->renormalize routing equals
+    our softmax-over-top-k gating exactly."""
+    from infinistore_tpu.models import moe_prefill_forward
+    from infinistore_tpu.models.hf import moe_config_from_hf, moe_params_from_hf
+
+    hf_cfg = transformers.MixtralConfig(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=96,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        num_local_experts=4,
+        num_experts_per_tok=2,
+        max_position_embeddings=128,
+        rms_norm_eps=1e-5,
+        rope_theta=10000.0,
+        sliding_window=None,
+    )
+    torch.manual_seed(3)
+    with torch.no_grad():
+        model = transformers.MixtralForCausalLM(hf_cfg)
+        for p in model.parameters():
+            p.mul_(3.0)
+    model.eval()
+
+    cfg = moe_config_from_hf(model.config, dtype=jnp.float32)
+    assert cfg.n_experts == 4 and cfg.top_k == 2
+    params = moe_params_from_hf(model, cfg)
+
+    tokens = np.array([[5, 17, 99, 3, 42, 200, 7, 1]], dtype=np.int64)
+    with torch.no_grad():
+        want = model(torch.from_numpy(tokens)).logits.numpy()
+    got, _ = moe_prefill_forward(params, cfg, jnp.asarray(tokens, jnp.int32))
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), want, rtol=2e-3, atol=2e-3
+    )
