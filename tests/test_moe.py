@@ -104,14 +104,10 @@ def test_moe_serving_engine_paged_decode():
     prompt = list(np.random.default_rng(5).integers(0, CFG.vocab_size, 10))
     out = eng.generate(prompt, 4)
 
-    # dense greedy reference
-    toks = list(prompt)
-    for _ in range(4):
-        logits, _ = moe_prefill_forward(
-            params, CFG, jnp.asarray(toks, jnp.int32)[None]
-        )
-        toks.append(int(jnp.argmax(logits[0, -1])))
-    assert out == toks[len(prompt):]
+    from conftest import make_dense_greedy
+
+    dense = make_dense_greedy(params, CFG, forward=moe_prefill_forward)
+    assert out == dense(prompt, 4)
 
 
 def test_moe_windowed_paged_decode_matches_dense():
@@ -135,13 +131,10 @@ def test_moe_windowed_paged_decode_matches_dense():
     prompt = list(np.random.default_rng(7).integers(0, wcfg.vocab_size, 10))
     out = eng.generate(prompt, 5)
 
-    toks = list(prompt)
-    for _ in range(5):
-        logits, _ = moe_prefill_forward(
-            params, wcfg, jnp.asarray(toks, jnp.int32)[None]
-        )
-        toks.append(int(jnp.argmax(logits[0, -1])))
-    assert out == toks[len(prompt):]
+    from conftest import make_dense_greedy
+
+    dense = make_dense_greedy(params, wcfg, forward=moe_prefill_forward)
+    assert out == dense(prompt, 5)
 
     # and the window must actually change the model vs full causal
     fl, _ = moe_prefill_forward(

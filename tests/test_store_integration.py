@@ -63,16 +63,19 @@ def server(request):
     )
     # wait for the data plane to accept connections
     deadline = time.time() + 15
-    while time.time() < deadline:
-        if proc.poll() is not None:
-            pytest.fail("server process failed to start")
-        try:
-            socket.create_connection(("127.0.0.1", SERVICE_PORT), timeout=0.5).close()
-            break
-        except OSError:
-            time.sleep(0.1)
-    else:
-        pytest.fail("server did not come up")
+    # the data plane and the manage plane come up at different moments;
+    # tests hit both, so probe both before yielding
+    for port in (SERVICE_PORT, MANAGE_PORT):
+        while time.time() < deadline:
+            if proc.poll() is not None:
+                pytest.fail("server process failed to start")
+            try:
+                socket.create_connection(("127.0.0.1", port), timeout=0.5).close()
+                break
+            except OSError:
+                time.sleep(0.1)
+        else:
+            pytest.fail(f"server port {port} did not come up")
     yield proc
     proc.send_signal(signal.SIGINT)
     try:
@@ -887,17 +890,20 @@ def tiered_server(request, tmp_path_factory):
          "--disk-tier-path", tier_dir, "--disk-tier-size", "1"],
         env={**os.environ, "JAX_PLATFORMS": "cpu"},
     )
-    deadline = time.time() + 15
-    while time.time() < deadline:
-        if proc.poll() is not None:
-            pytest.fail("tiered server failed to start")
-        try:
-            socket.create_connection(("127.0.0.1", service), timeout=0.5).close()
-            break
-        except OSError:
-            time.sleep(0.1)
-    else:
-        pytest.fail("tiered server did not come up")
+    deadline = time.time() + 20
+    # the data plane and the manage plane come up at different moments;
+    # the test hits BOTH, so probe both before yielding
+    for port in (service, manage):
+        while time.time() < deadline:
+            if proc.poll() is not None:
+                pytest.fail("tiered server failed to start")
+            try:
+                socket.create_connection(("127.0.0.1", port), timeout=0.5).close()
+                break
+            except OSError:
+                time.sleep(0.1)
+        else:
+            pytest.fail(f"tiered server port {port} did not come up")
     yield service, manage
     proc.send_signal(signal.SIGTERM)
     proc.wait(timeout=10)
