@@ -50,8 +50,14 @@ def make_dense_greedy(params, cfg, forward=None):
 
     if forward is None:
         forward = prefill_forward
-    leaf = np.asarray(jax.tree.leaves(params)[0]).ravel()[:16]
-    memo_key = (cfg, leaf.tobytes(), getattr(forward, "__name__", repr(forward)))
+    # fingerprint EVERY leaf: params differing anywhere (a merged adapter,
+    # quantized layers) must not share a stale reference trajectory
+    import hashlib
+
+    h = hashlib.sha256()
+    for leaf in jax.tree.leaves(params):
+        h.update(np.asarray(leaf).tobytes())
+    memo_key = (cfg, h.hexdigest(), getattr(forward, "__name__", repr(forward)))
     hit = _DENSE_MEMO.get(memo_key)
     if hit is not None:
         return hit

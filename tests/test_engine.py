@@ -263,11 +263,13 @@ def test_scheduler_concurrent_chunked_prefills_fill_idle_slots():
     first = sched.submit(PROMPT[:5], 28)   # long-running active request
     sched.step()                           # wave prefill + first chunk
     long_prompt = PROMPT + PROMPT + PROMPT  # 33 tokens -> 9 chunks at T=4
-    newcomers = [sched.submit(long_prompt, 4) for _ in range(4)]
+    newcomers = [sched.submit(long_prompt, 4) for _ in range(5)]
     sched.step()
     # admission did NOT serialize: several newcomers are mid-ingestion at
-    # once (the old scheduler held exactly one)
+    # once (the old scheduler held exactly one) — and the concurrency CAP
+    # held the fifth back in the queue
     assert len(sched._prefilling) == 4
+    assert len(sched.pending) == 1
     peak_active = 0
     results = {}
     while sched.has_work:

@@ -36,6 +36,24 @@ MANAGE_PORT = 0
 # The whole module runs twice: once against the asyncio server and once
 # against the C++ epoll server (the reference always tests the real native
 # server, infinistore/test_infinistore.py:99-571).
+def _await_ports(proc, ports, deadline_s=25):
+    """Block until the server process listens on EVERY port (data plane
+    and manage plane bind at different moments); each port gets at least
+    one probe even if earlier ports consumed the shared deadline."""
+    deadline = time.time() + deadline_s
+    for port in ports:
+        while True:
+            if proc.poll() is not None:
+                pytest.fail("server process failed to start")
+            try:
+                socket.create_connection(("127.0.0.1", port), timeout=0.5).close()
+                break
+            except OSError:
+                if time.time() >= deadline:
+                    pytest.fail(f"server port {port} did not come up")
+                time.sleep(0.1)
+
+
 @pytest.fixture(scope="module", params=["python", "native"])
 def server(request):
     global SERVICE_PORT, MANAGE_PORT
@@ -61,21 +79,9 @@ def server(request):
         ],
         env={**os.environ, "JAX_PLATFORMS": "cpu"},
     )
-    # wait for the data plane to accept connections
-    deadline = time.time() + 15
     # the data plane and the manage plane come up at different moments;
     # tests hit both, so probe both before yielding
-    for port in (SERVICE_PORT, MANAGE_PORT):
-        while time.time() < deadline:
-            if proc.poll() is not None:
-                pytest.fail("server process failed to start")
-            try:
-                socket.create_connection(("127.0.0.1", port), timeout=0.5).close()
-                break
-            except OSError:
-                time.sleep(0.1)
-        else:
-            pytest.fail(f"server port {port} did not come up")
+    _await_ports(proc, (SERVICE_PORT, MANAGE_PORT), deadline_s=25)
     yield proc
     proc.send_signal(signal.SIGINT)
     try:
@@ -890,20 +896,7 @@ def tiered_server(request, tmp_path_factory):
          "--disk-tier-path", tier_dir, "--disk-tier-size", "1"],
         env={**os.environ, "JAX_PLATFORMS": "cpu"},
     )
-    deadline = time.time() + 20
-    # the data plane and the manage plane come up at different moments;
-    # the test hits BOTH, so probe both before yielding
-    for port in (service, manage):
-        while time.time() < deadline:
-            if proc.poll() is not None:
-                pytest.fail("tiered server failed to start")
-            try:
-                socket.create_connection(("127.0.0.1", port), timeout=0.5).close()
-                break
-            except OSError:
-                time.sleep(0.1)
-        else:
-            pytest.fail(f"tiered server port {port} did not come up")
+    _await_ports(proc, (service, manage))
     yield service, manage
     proc.send_signal(signal.SIGTERM)
     proc.wait(timeout=10)
