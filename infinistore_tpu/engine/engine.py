@@ -773,7 +773,7 @@ class InferenceEngine:
     # ---- decode ----
 
     def _decode_many(self, n_steps: int, variant: str, collect: bool = False,
-                     logprobs_k: int = 0):
+                     logprobs_k: int = 0, penalized: bool = False):
         """Compiled ``n_steps``-token decode: a ``lax.scan`` whose body
         samples on device (no per-token host sync) and derives the KV scatter
         slot from the device-resident block table.  Works for any batch of
@@ -803,11 +803,19 @@ class InferenceEngine:
         download.  Mutually exclusive with ``collect`` (the speculative
         path's full-distribution capture).
 
+        ``penalized=True`` compiles the sampling-penalty program: the scan
+        carries per-row generated-token counts [B, V] (updated on device by
+        a one-hot scatter per step) plus a constant prompt-presence mask,
+        and applies, per row and BEFORE temperature (the vLLM order),
+        repetition penalty (seen tokens: positive logits divided, negative
+        multiplied), then ``-frequency*count - presence*(count>0)``.
+        Greedy rows argmax over the PENALIZED logits.
+
         The reference decodes through vLLM's CUDA-graph step loop; the TPU
         analog is one traced scan so XLA pipelines all ``n_steps`` steps
         without returning to Python (VERDICT round-1 weak #9)."""
         assert not (collect and logprobs_k), "collect and logprobs are exclusive"
-        cache_key = (n_steps, variant, collect, logprobs_k)
+        cache_key = (n_steps, variant, collect, logprobs_k, penalized)
         fn = self._decode_many_cache.get(cache_key)
         if fn is not None:
             return fn
@@ -816,39 +824,71 @@ class InferenceEngine:
         # engines with the same model family/config/paging share ONE
         # compiled scan (decode_fn identity is memoized by _shared_partial)
         global_key = ("decode_many", decode_fn, T, n_steps, variant, collect,
-                      logprobs_k)
+                      logprobs_k, penalized)
         fn = _JIT_CACHE.get(global_key)
         if fn is not None:
             self._decode_many_cache[cache_key] = fn
             return fn
 
-        def pick(logits, rng, greedy_mask, temperature, top_k, top_p):
-            am = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        def pick(logits, rng, greedy_mask, temperature, top_k, top_p,
+                 pen_state):
+            l0 = logits.astype(jnp.float32)
+            if penalized:
+                gen_counts, prompt_seen, presence, frequency, repetition = (
+                    pen_state
+                )
+                seen = prompt_seen | (gen_counts > 0)
+                rep = repetition[:, None]
+                l0 = jnp.where(seen, jnp.where(l0 > 0, l0 / rep, l0 * rep), l0)
+                cnt = gen_counts.astype(jnp.float32)
+                l0 = (l0 - frequency[:, None] * cnt
+                      - presence[:, None] * (cnt > 0))
+            am = jnp.argmax(l0, axis=-1).astype(jnp.int32)
             if variant == "greedy":
                 return am, None
-            l = logits.astype(jnp.float32) / temperature[:, None]
+            l = l0 / temperature[:, None]
             if variant == "filter":
                 l = _truncate_logits(l, top_k, top_p)
-            samp = jax.random.categorical(rng, l).astype(jnp.int32)
+            # rng is PER-ROW keys [B, 2]: each row draws from its own
+            # stream, so a seeded request's tokens don't depend on its
+            # batchmates (vLLM per-request seed semantics)
+            samp = jax.vmap(jax.random.categorical)(rng, l).astype(jnp.int32)
             tok = jnp.where(greedy_mask, am, samp)
             return tok, (jax.nn.softmax(l, axis=-1) if collect else None)
 
         def many(params, logits0, start_pos, cache, block_table, rng,
-                 greedy_mask, temperature, top_k, top_p, lora, adapter_ids):
+                 greedy_mask, temperature, top_k, top_p, lora, adapter_ids,
+                 pen):
             # lora/adapter_ids are None for engines without a bank — the
             # Python branch below is static at trace time, so their
-            # compiled programs are unchanged
+            # compiled programs are unchanged; same for pen (None unless
+            # this is the penalized program)
             lkw = (
                 {} if lora is None
                 else {"lora": lora, "adapter_ids": adapter_ids}
             )
+            if penalized:
+                gen_counts0, prompt_seen, presence, frequency, repetition = pen
 
             def step(carry, i):
-                logits, cache, rng = carry
-                rng, sub = jax.random.split(rng)
-                tok, probs = pick(logits, sub, greedy_mask, temperature,
-                                  top_k, top_p)  # [B]
+                if penalized:
+                    logits, cache, gen_counts = carry
+                    pen_state = (gen_counts, prompt_seen, presence,
+                                 frequency, repetition)
+                else:
+                    logits, cache = carry
+                    pen_state = None
                 pos = start_pos + i  # [B]
+                # per-row streams: the row's base key folded with its
+                # ABSOLUTE position — a seeded row replays the same stream
+                # across chunk boundaries and batch recompositions
+                subs = jax.vmap(jax.random.fold_in)(rng, pos)
+                tok, probs = pick(logits, subs, greedy_mask, temperature,
+                                  top_k, top_p, pen_state)  # [B]
+                if penalized:
+                    gen_counts = gen_counts.at[
+                        jnp.arange(tok.shape[0]), tok
+                    ].add(1)
                 page_idx = pos // T
                 slot_blocks = jnp.take_along_axis(
                     block_table, page_idx[:, None], axis=1
@@ -875,14 +915,19 @@ class InferenceEngine:
                     y = (tok, probs)
                 else:
                     y = tok
-                return (logits2, cache, rng), y
+                if penalized:
+                    return (logits2, cache, gen_counts), y
+                return (logits2, cache), y
 
-            (logits, cache, _), ys = jax.lax.scan(
-                step, (logits0, cache, rng), jnp.arange(n_steps)
+            init = (
+                (logits0, cache, gen_counts0) if penalized
+                else (logits0, cache)
             )
-            if collect or logprobs_k:
-                return (*ys, logits, cache)
-            return ys, logits, cache
+            carry, ys = jax.lax.scan(step, init, jnp.arange(n_steps))
+            logits, cache = carry[0], carry[1]
+            parts = ys if (collect or logprobs_k) else (ys,)
+            tail = (carry[2],) if penalized else ()  # final gen counts
+            return (*parts, logits, cache, *tail)
 
         fn = jax.jit(many, donate_argnums=(3,))
         self._decode_many_cache[cache_key] = fn
@@ -898,11 +943,22 @@ class InferenceEngine:
         top_k: int = 0,
         top_p: float = 1.0,
         rng: Optional[jax.Array] = None,
+        presence_penalty: float = 0.0,
+        frequency_penalty: float = 0.0,
+        repetition_penalty: float = 1.0,
+        gen_start: Optional[int] = None,
+        seed: Optional[int] = None,
     ) -> List[int]:
-        """Decode ``n_steps`` tokens for one sequence."""
+        """Decode ``n_steps`` tokens for one sequence (scalar params; the
+        batch API takes per-row sequences)."""
         return self.decode_batch(
             [state], n_steps, sample=sample, temperature=temperature,
             top_k=top_k, top_p=top_p, rng=rng,
+            presence_penalty=presence_penalty,
+            frequency_penalty=frequency_penalty,
+            repetition_penalty=repetition_penalty,
+            gen_start=None if gen_start is None else [gen_start],
+            seed=None if seed is None else [seed],
         )[0]
 
     @staticmethod
@@ -926,6 +982,11 @@ class InferenceEngine:
         rng: Optional[jax.Array] = None,
         logprobs: int = 0,
         logprobs_rows: Optional[Sequence[bool]] = None,
+        presence_penalty=0.0,
+        frequency_penalty=0.0,
+        repetition_penalty=1.0,
+        gen_start: Optional[Sequence[int]] = None,
+        seed: Optional[Sequence[Optional[int]]] = None,
     ) -> Union[List[List[int]], Tuple[List[List[int]], List[List[tuple]]]]:
         """Decode ``n_steps`` tokens for a batch of sequences in lockstep
         (vLLM-style batched decode; sequences may have different lengths —
@@ -950,7 +1011,22 @@ class InferenceEngine:
         from the raw model distribution (OpenAI ``logprobs``).
         ``logprobs_rows`` limits the HOST-side record building to the rows
         that asked (the device program is per-batch either way); other
-        rows get empty lists."""
+        rows get empty lists.
+
+        ``presence_penalty``/``frequency_penalty`` (OpenAI, over GENERATED
+        tokens) and ``repetition_penalty`` (HF/vLLM, over prompt +
+        generated) are per-row scalars or [B] vectors; any non-default
+        value switches to the penalty-carrying program (counts live on
+        device inside the scan).  ``gen_start[b]`` is the index into
+        ``states[b].tokens`` where generation began (default: everything
+        present counts as prompt).  Reported logprobs stay the RAW model
+        distribution.
+
+        ``seed[b]`` (per-row, None = unseeded) pins row ``b``'s sampling
+        stream: the row's base key is ``PRNGKey(seed)`` folded with each
+        token's ABSOLUTE position, so a seeded request reproduces its
+        tokens exactly regardless of batchmates, chunking, or scheduler
+        state (the vLLM per-request-seed contract)."""
         B = len(states)
         assert B >= 1
         samples = (
@@ -975,6 +1051,27 @@ class InferenceEngine:
             variant = "filter"
         else:
             variant = "plain"
+        pres = self._per_row(presence_penalty, B, np.float32)
+        freq = self._per_row(frequency_penalty, B, np.float32)
+        rep = self._per_row(repetition_penalty, B, np.float32)
+        assert np.all(rep > 0.0), rep
+        penalized = bool(
+            np.any(pres != 0.0) or np.any(freq != 0.0) or np.any(rep != 1.0)
+        )
+        pen = None
+        if penalized:
+            V = self.cfg.vocab_size
+            counts = np.zeros((B, V), np.int32)
+            pseen = np.zeros((B, V), bool)
+            gs = (
+                [len(st.tokens) for st in states] if gen_start is None
+                else list(gen_start)
+            )
+            for b, st in enumerate(states):
+                np.add.at(counts[b], np.asarray(st.tokens[gs[b]:], np.int64), 1)
+                pseen[b, np.asarray(st.tokens[:gs[b]], np.int64)] = True
+            pen = (jnp.asarray(counts), jnp.asarray(pseen),
+                   jnp.asarray(pres), jnp.asarray(freq), jnp.asarray(rep))
         T = self.pc.block_tokens
         for st in states:
             # return window-dead pages first so the run's new tail pages
@@ -1002,25 +1099,46 @@ class InferenceEngine:
             None if self.lora is None
             else jnp.asarray([st.adapter_id for st in states], jnp.int32)
         )
+        seeds = list(seed) if seed is not None else [None] * B
+        assert len(seeds) == B, (len(seeds), B)
+        seeded_mask = np.asarray([s is not None for s in seeds])
+        seeded_keys = seeded_mask_d = None
+        if seeded_mask.any():
+            seeded_keys = jnp.stack([
+                jax.random.PRNGKey(int(s) if s is not None else 0)
+                for s in seeds
+            ])
+            seeded_mask_d = jnp.asarray(seeded_mask)[:, None]
         lps: List[List[tuple]] = [[] for _ in range(B)]
         remaining = n_steps
         while remaining > 0:
             chunk = min(remaining, self.decode_chunk)
             rng, sub = jax.random.split(rng)
-            res = self._decode_many(chunk, variant, logprobs_k=logprobs)(
+            # per-row base keys; seeded rows keep their FIXED key so the
+            # position fold reproduces the same stream in any batch
+            row_keys = jax.random.split(sub, B)
+            if seeded_keys is not None:
+                row_keys = jnp.where(seeded_mask_d, seeded_keys, row_keys)
+            res = self._decode_many(chunk, variant, logprobs_k=logprobs,
+                                    penalized=penalized)(
                 self.params,
                 logits,
                 jnp.asarray(pos),
                 self.cache,
                 block_table,
-                sub,
+                row_keys,
                 greedy_d,
                 temp_d,
                 top_k_d,
                 top_p_d,
                 lora_t,
                 aid_d,
+                pen,
             )
+            if penalized:
+                # thread the device-side counts into the next chunk
+                *res, counts_d = res
+                pen = (counts_d,) + pen[1:]
             if logprobs:
                 toks, chosen, top_id, top_lp, logits, self.cache = res
                 h_ch = np.asarray(chosen)   # [chunk, B]
@@ -1080,7 +1198,7 @@ class InferenceEngine:
             jnp.asarray([len(state.tokens)], dtype=jnp.int32),
             self.cache,
             self._block_table([state]),
-            rng,
+            jax.random.split(rng, 1),  # [1, 2] per-row key
             jnp.zeros((B,), dtype=bool),
             jnp.full((B,), max(temperature, 1e-6), dtype=jnp.float32),
             jnp.full((B,), top_k, dtype=jnp.int32),
@@ -1088,6 +1206,7 @@ class InferenceEngine:
             self._lora_tree,
             None if self.lora is None
             else jnp.asarray([state.adapter_id], jnp.int32),
+            None,  # pen: the draft proposes unpenalized
         )
         out = [int(t) for t in np.asarray(toks)[:, 0]]
         state.tokens.extend(out)

@@ -55,6 +55,16 @@ class Request:
     temperature: float = 1.0
     top_k: int = 0
     top_p: float = 1.0
+    # sampling penalties (vLLM SamplingParams parity): presence/frequency
+    # over generated tokens (OpenAI), repetition over prompt+generated
+    # (HF).  They reshape the distribution greedy argmaxes too, so they
+    # are NOT normalized away for greedy requests.
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
+    repetition_penalty: float = 1.0
+    # per-request sampling seed (None = the scheduler's stream): seeded
+    # requests reproduce their tokens exactly regardless of batchmates
+    seed: Optional[int] = None
     adapter_id: int = 0  # LoRA adapter slot (0 = base model)
     # OpenAI logprobs: collect the chosen token's logprob + the top-k
     # alternatives per generated token (0 = off); records land in lp_data
@@ -126,6 +136,10 @@ class Scheduler:
         temperature: float = 1.0,
         top_k: int = 0,
         top_p: float = 1.0,
+        presence_penalty: float = 0.0,
+        frequency_penalty: float = 0.0,
+        repetition_penalty: float = 1.0,
+        seed: Optional[int] = None,
         adapter_id: int = 0,
         logprobs: int = 0,
         on_token: Optional[Callable[[List[int], bool], None]] = None,
@@ -142,7 +156,10 @@ class Scheduler:
             req_id=self._next_id, tokens=list(tokens),
             max_new_tokens=max_new_tokens, eos_ids=stops or None,
             sample=sample, temperature=temperature, top_k=top_k,
-            top_p=top_p, adapter_id=adapter_id,
+            top_p=top_p, presence_penalty=presence_penalty,
+            frequency_penalty=frequency_penalty,
+            repetition_penalty=repetition_penalty, seed=seed,
+            adapter_id=adapter_id,
             logprobs=min(max(int(logprobs), 0), self.LOGPROBS_K),
             on_token=on_token,
         )
@@ -308,6 +325,11 @@ class Scheduler:
             self._admission_hold = False  # pages freed; admission may resume
         return done_now
 
+    @staticmethod
+    def _penalized(req: Request) -> bool:
+        return (req.presence_penalty != 0.0 or req.frequency_penalty != 0.0
+                or req.repetition_penalty != 1.0)
+
     # -- speculative fast path (batch=1 + draft engine attached) --
 
     def _drop_draft(self, req: Request) -> None:
@@ -414,6 +436,8 @@ class Scheduler:
                 self._drop_draft(r)
         elif (self.spec is not None and self.active[0].adapter_id == 0
                 and self.active[0].logprobs == 0  # spec emits no logprobs
+                and not self._penalized(self.active[0])  # no penalty math
+                and self.active[0].seed is None  # spec has its own stream
                 and self._spec_step(self.active[0], chunk)):
             # speculation pays exactly when the chip is latency-bound (one
             # request in flight); with a batch, lockstep decode already
@@ -422,8 +446,10 @@ class Scheduler:
             return cancelled_prefill + self._retire()
         self._rng, sub = jax.random.split(self._rng)
         # any row asking for logprobs switches the batch to the collecting
-        # program (fixed top-LOGPROBS_K shape; rows slice to their own k)
+        # program (fixed top-LOGPROBS_K shape; rows slice to their own k);
+        # any row with penalties switches to the count-carrying program
         want_lp = any(r.logprobs for r in self.active)
+        want_pen = any(self._penalized(r) for r in self.active)
         try:
             outs = self.engine.decode_batch(
                 [r.state for r in self.active], chunk,
@@ -437,6 +463,18 @@ class Scheduler:
                     [bool(r.logprobs) for r in self.active] if want_lp
                     else None
                 ),
+                presence_penalty=[r.presence_penalty for r in self.active],
+                frequency_penalty=[r.frequency_penalty for r in self.active],
+                repetition_penalty=(
+                    [r.repetition_penalty for r in self.active]
+                ),
+                # generation began after the PROMPT — a shed request's
+                # re-prefilled prior output still counts as generated
+                gen_start=(
+                    [len(r.tokens) for r in self.active] if want_pen
+                    else None
+                ),
+                seed=[r.seed for r in self.active],
             )
         except MemoryError:
             # decode-time page exhaustion: shed the newest request back to

@@ -252,6 +252,18 @@ class ServingServer:
         top_p = float(body.get("top_p", 1.0))
         if not 0.0 < top_p <= 1.0:
             raise ValueError("top_p must be in (0, 1]")
+        presence = float(body.get("presence_penalty", 0.0))
+        frequency = float(body.get("frequency_penalty", 0.0))
+        if not (-2.0 <= presence <= 2.0 and -2.0 <= frequency <= 2.0):
+            raise ValueError(
+                "presence_penalty/frequency_penalty must be in [-2, 2]"
+            )
+        repetition = float(body.get("repetition_penalty", 1.0))
+        if not 0.0 < repetition <= 10.0:
+            raise ValueError("repetition_penalty must be in (0, 10]")
+        seed = body.get("seed")
+        if seed is not None and not _valid_seed(seed):
+            raise ValueError("seed must be an integer in [0, 2**31)")
         n = body.get("n", 1)
         if not (isinstance(n, int) and not isinstance(n, bool)
                 and 1 <= n <= 8):
@@ -326,6 +338,9 @@ class ServingServer:
             # OpenAI convention: temperature 0 means greedy
             "temperature": temperature or 1.0,
             "top_k": top_k, "top_p": top_p,
+            "presence_penalty": presence, "frequency_penalty": frequency,
+            "repetition_penalty": repetition,
+            "seed": seed,
             "logprobs": lp_k,
         }
 
@@ -424,6 +439,14 @@ class ServingServer:
                 f"istpu_spec_acceptance_rate {sm['rate']}",
             ]
         return "\n".join(lines) + "\n"
+
+
+def _valid_seed(seed: Any) -> bool:
+    """The one definition of an acceptable wire seed — shared by _validate
+    (rejection) and the n>1 per-choice derivation (which must only derive
+    from seeds _validate would accept)."""
+    return (isinstance(seed, int) and not isinstance(seed, bool)
+            and 0 <= seed < 2 ** 31)
 
 
 def _lp_payload(server, token_ids: List[int], lps: List[tuple],
@@ -683,8 +706,21 @@ def _make_handler(server: ServingServer):
                 return
             # n choices = n scheduler requests sharing the prompt (the
             # prefix cache pins one set of prompt pages; each choice
-            # decodes its own continuation — the vLLM n>1 model)
-            qs = [server.submit(body) for _ in range(n)]
+            # decodes its own continuation — the vLLM n>1 model).  A
+            # VALID seeded request derives choice i's seed as seed+i (else
+            # all n choices would sample identical continuations); an
+            # invalid seed passes through untouched so _validate rejects
+            # it instead of this derivation accidentally laundering it
+            # into range.
+            seed = body.get("seed")
+            derive = n > 1 and _valid_seed(seed)
+            qs = [
+                server.submit(
+                    {**body, "seed": (seed + i) % (2 ** 31)} if derive
+                    else body
+                )
+                for i in range(n)
+            ]
             req_ids, err = [], None
             for q in qs:
                 kind, val = q.get()

@@ -17,12 +17,10 @@ if not os.environ.get("ISTPU_TEST_TPU"):
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    # persistent compilation cache: identical programs (shared TINY-family
-    # shapes, the GSPMD train steps) compile once per CONTAINER instead of
-    # once per pytest invocation — reruns and the driver's verification
-    # pass skip most XLA compile time
-    jax.config.update("jax_compilation_cache_dir", "/tmp/istpu_jax_cache")
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    # NOTE: a persistent compilation cache (jax_compilation_cache_dir) was
+    # tried here and reverted: XLA:CPU AOT reload warns about machine-
+    # feature mismatches (+prefer-no-gather/scatter) with a SIGILL caveat
+    # on this image — not worth the rerun speedup.
 
 
 _DENSE_MEMO: dict = {}

@@ -106,6 +106,67 @@ def test_completions_logprobs_contract(server):
     assert all(t == {} for t in lp["top_logprobs"])
 
 
+def test_seed_contract(server):
+    """OpenAI `seed`: the same seeded sampled request reproduces exactly
+    (even though the scheduler's own stream advanced in between); seeded
+    n>1 derives distinct per-choice seeds and reproduces as a set."""
+    body = {"prompt": PROMPT, "max_tokens": 8, "temperature": 0.9,
+            "seed": 7}
+    status, a = _post(server.port, body)
+    assert status == 200, a
+    # advance the scheduler's own stream with an unseeded request
+    _post(server.port, {"prompt": PROMPT, "max_tokens": 4,
+                        "temperature": 0.9})
+    status, b = _post(server.port, body)
+    assert status == 200, b
+    assert a["choices"][0]["token_ids"] == b["choices"][0]["token_ids"]
+
+    status, c = _post(server.port, {**body, "seed": 8})
+    assert status == 200, c
+    assert c["choices"][0]["token_ids"] != a["choices"][0]["token_ids"]
+
+    status, multi = _post(server.port, {**body, "n": 3})
+    assert status == 200, multi
+    outs = [tuple(ch["token_ids"]) for ch in multi["choices"]]
+    assert len(set(outs)) == 3          # choices draw distinct seeds
+    assert outs[0] == tuple(a["choices"][0]["token_ids"])  # choice 0 = seed
+    status, multi2 = _post(server.port, {**body, "n": 3})
+    assert [tuple(ch["token_ids"]) for ch in multi2["choices"]] == outs
+
+    status, _ = _post(server.port, {**body, "seed": -1})
+    assert status == 400
+    status, _ = _post(server.port, {**body, "seed": True})
+    assert status == 400
+
+
+def test_sampling_penalties_contract(server):
+    """OpenAI penalty params ride into the compiled decode: a repetition-
+    penalized greedy request is deterministic, differs from the plain
+    greedy output, and out-of-range values are 400s."""
+    want_plain = dense_greedy(PROMPT, 8)
+    bodies = [{
+        "prompt": PROMPT, "max_tokens": 8, "temperature": 0,
+        "repetition_penalty": 1.8, "presence_penalty": 0.5,
+    }] * 2
+    outs = []
+    for body in bodies:
+        status, resp = _post(server.port, body)
+        assert status == 200, resp
+        outs.append(resp["choices"][0]["token_ids"])
+    assert outs[0] == outs[1]          # greedy + penalties: deterministic
+    assert outs[0] != want_plain       # and the penalties actually bit
+    for bad in (
+        {"presence_penalty": 3.0},
+        {"frequency_penalty": -2.5},
+        {"repetition_penalty": 0.0},
+        {"repetition_penalty": 11.0},
+    ):
+        status, resp = _post(server.port, {
+            "prompt": PROMPT, "max_tokens": 2, **bad,
+        })
+        assert status == 400, (bad, resp)
+
+
 def test_logprobs_validation(server):
     for bad in (
         {"logprobs": 9},          # completions cap is 5
@@ -818,4 +879,4 @@ def test_top_p_values_share_one_compiled_program():
                    rng=jax.random.PRNGKey(i))
         eng.release(st)
     keys = set(eng._decode_many_cache)
-    assert keys == {(2, "filter", False, 0)}, keys
+    assert keys == {(2, "filter", False, 0, False)}, keys
