@@ -808,8 +808,10 @@ class InferenceEngine:
         a one-hot scatter per step) plus a constant prompt-presence mask,
         and applies, per row and BEFORE temperature (the vLLM order),
         repetition penalty (seen tokens: positive logits divided, negative
-        multiplied), then ``-frequency*count - presence*(count>0)``.
-        Greedy rows argmax over the PENALIZED logits.
+        multiplied), then ``-frequency*count - presence*(count>0)``, then
+        the constant per-row ``logit_bias`` [B, V] (the OpenAI sparse
+        token-bias map, densified host-side).  Greedy rows argmax over the
+        PENALIZED logits.
 
         The reference decodes through vLLM's CUDA-graph step loop; the TPU
         analog is one traced scan so XLA pipelines all ``n_steps`` steps
@@ -834,15 +836,14 @@ class InferenceEngine:
                  pen_state):
             l0 = logits.astype(jnp.float32)
             if penalized:
-                gen_counts, prompt_seen, presence, frequency, repetition = (
-                    pen_state
-                )
+                (gen_counts, prompt_seen, presence, frequency, repetition,
+                 bias) = pen_state
                 seen = prompt_seen | (gen_counts > 0)
                 rep = repetition[:, None]
                 l0 = jnp.where(seen, jnp.where(l0 > 0, l0 / rep, l0 * rep), l0)
                 cnt = gen_counts.astype(jnp.float32)
                 l0 = (l0 - frequency[:, None] * cnt
-                      - presence[:, None] * (cnt > 0))
+                      - presence[:, None] * (cnt > 0) + bias)
             am = jnp.argmax(l0, axis=-1).astype(jnp.int32)
             if variant == "greedy":
                 return am, None
@@ -868,13 +869,14 @@ class InferenceEngine:
                 else {"lora": lora, "adapter_ids": adapter_ids}
             )
             if penalized:
-                gen_counts0, prompt_seen, presence, frequency, repetition = pen
+                (gen_counts0, prompt_seen, presence, frequency, repetition,
+                 bias) = pen
 
             def step(carry, i):
                 if penalized:
                     logits, cache, gen_counts = carry
                     pen_state = (gen_counts, prompt_seen, presence,
-                                 frequency, repetition)
+                                 frequency, repetition, bias)
                 else:
                     logits, cache = carry
                     pen_state = None
@@ -948,6 +950,7 @@ class InferenceEngine:
         repetition_penalty: float = 1.0,
         gen_start: Optional[int] = None,
         seed: Optional[int] = None,
+        logit_bias: Optional[Dict[int, float]] = None,
     ) -> List[int]:
         """Decode ``n_steps`` tokens for one sequence (scalar params; the
         batch API takes per-row sequences)."""
@@ -959,6 +962,7 @@ class InferenceEngine:
             repetition_penalty=repetition_penalty,
             gen_start=None if gen_start is None else [gen_start],
             seed=None if seed is None else [seed],
+            logit_bias=None if logit_bias is None else [logit_bias],
         )[0]
 
     @staticmethod
@@ -987,6 +991,8 @@ class InferenceEngine:
         repetition_penalty=1.0,
         gen_start: Optional[Sequence[int]] = None,
         seed: Optional[Sequence[Optional[int]]] = None,
+        logit_bias: Optional[Sequence[Optional[Dict[int, float]]]] = None,
+        pen_cache: Optional[dict] = None,
     ) -> Union[List[List[int]], Tuple[List[List[int]], List[List[tuple]]]]:
         """Decode ``n_steps`` tokens for a batch of sequences in lockstep
         (vLLM-style batched decode; sequences may have different lengths —
@@ -1055,23 +1061,54 @@ class InferenceEngine:
         freq = self._per_row(frequency_penalty, B, np.float32)
         rep = self._per_row(repetition_penalty, B, np.float32)
         assert np.all(rep > 0.0), rep
+        biases = list(logit_bias) if logit_bias is not None else [None] * B
+        assert len(biases) == B, (len(biases), B)
         penalized = bool(
             np.any(pres != 0.0) or np.any(freq != 0.0) or np.any(rep != 1.0)
+            or any(biases)
         )
         pen = None
+        pen_key = None
         if penalized:
-            V = self.cfg.vocab_size
-            counts = np.zeros((B, V), np.int32)
-            pseen = np.zeros((B, V), bool)
-            gs = (
-                [len(st.tokens) for st in states] if gen_start is None
-                else list(gen_start)
+            # a continuous-batching caller steps this function once per
+            # chunk; rebuilding the dense [B, V] state every step would
+            # replay the whole generated history and re-upload ~B*V*9
+            # bytes each time.  ``pen_cache`` (caller-owned, e.g. the
+            # scheduler's) carries the DEVICE-side state across calls:
+            # the scan's returned counts are exact as long as the batch
+            # composition, per-row penalty params, and sequence lengths
+            # match what the cache recorded.
+            pen_key = (
+                tuple(st.seq_id for st in states),
+                pres.tobytes(), freq.tobytes(), rep.tobytes(),
+                tuple(
+                    tuple(sorted(b.items())) if b else None for b in biases
+                ),
             )
-            for b, st in enumerate(states):
-                np.add.at(counts[b], np.asarray(st.tokens[gs[b]:], np.int64), 1)
-                pseen[b, np.asarray(st.tokens[:gs[b]], np.int64)] = True
-            pen = (jnp.asarray(counts), jnp.asarray(pseen),
-                   jnp.asarray(pres), jnp.asarray(freq), jnp.asarray(rep))
+            lens = tuple(len(st.tokens) for st in states)
+            hit = None if pen_cache is None else pen_cache.get(pen_key)
+            if hit is not None and hit[0] == lens:
+                pen = hit[1]
+            else:
+                V = self.cfg.vocab_size
+                counts = np.zeros((B, V), np.int32)
+                pseen = np.zeros((B, V), bool)
+                bias = np.zeros((B, V), np.float32)
+                gs = (
+                    [len(st.tokens) for st in states] if gen_start is None
+                    else list(gen_start)
+                )
+                for b, st in enumerate(states):
+                    np.add.at(
+                        counts[b], np.asarray(st.tokens[gs[b]:], np.int64), 1
+                    )
+                    pseen[b, np.asarray(st.tokens[:gs[b]], np.int64)] = True
+                    if biases[b]:
+                        for t, v in biases[b].items():
+                            bias[b, int(t)] = float(v)
+                pen = (jnp.asarray(counts), jnp.asarray(pseen),
+                       jnp.asarray(pres), jnp.asarray(freq),
+                       jnp.asarray(rep), jnp.asarray(bias))
         T = self.pc.block_tokens
         for st in states:
             # return window-dead pages first so the run's new tail pages
@@ -1163,6 +1200,12 @@ class InferenceEngine:
         for b, st in enumerate(states):
             st.tokens.extend(out[b])
             st.last_logits = logits[b]
+        if penalized and pen_cache is not None:
+            # single-entry cache: one active batch composition at a time
+            pen_cache.clear()
+            pen_cache[pen_key] = (
+                tuple(len(st.tokens) for st in states), pen
+            )
         if logprobs:
             return out, lps
         return out

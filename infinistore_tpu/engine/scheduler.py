@@ -65,6 +65,8 @@ class Request:
     # per-request sampling seed (None = the scheduler's stream): seeded
     # requests reproduce their tokens exactly regardless of batchmates
     seed: Optional[int] = None
+    # OpenAI logit_bias: token id -> additive bias (densified on device)
+    logit_bias: Optional[Dict[int, float]] = None
     adapter_id: int = 0  # LoRA adapter slot (0 = base model)
     # OpenAI logprobs: collect the chosen token's logprob + the top-k
     # alternatives per generated token (0 = off); records land in lp_data
@@ -115,6 +117,9 @@ class Scheduler:
         # pauses until something retires, otherwise the shed request would
         # re-admit into the same full allocator and be shed again (livelock)
         self._admission_hold = False
+        # device-side penalty state threaded across steps while the batch
+        # composition is stable (engine.decode_batch pen_cache)
+        self._pen_cache: dict = {}
         # speculative serving: a draft engine turns on the batch=1 fast
         # path (vLLM's speculative mode analog); lazy import avoids a
         # module cycle only in spelling — speculative.py imports engine,
@@ -140,10 +145,25 @@ class Scheduler:
         frequency_penalty: float = 0.0,
         repetition_penalty: float = 1.0,
         seed: Optional[int] = None,
+        logit_bias: Optional[Dict[int, float]] = None,
         adapter_id: int = 0,
         logprobs: int = 0,
         on_token: Optional[Callable[[List[int], bool], None]] = None,
     ) -> int:
+        # boundary validation: a bad request must be rejected HERE, not
+        # explode inside a later engine step and fault out every in-flight
+        # batchmate (ServingServer._validate rejects earlier with 400s;
+        # this guards direct library callers)
+        if repetition_penalty <= 0.0:
+            raise ValueError("repetition_penalty must be > 0")
+        if not (-10.0 <= presence_penalty <= 10.0
+                and -10.0 <= frequency_penalty <= 10.0):
+            raise ValueError("presence/frequency penalties out of range")
+        if logit_bias is not None and not all(
+            isinstance(t, int) and 0 <= t < self.engine.cfg.vocab_size
+            for t in logit_bias
+        ):
+            raise ValueError("logit_bias keys must be in-vocab token ids")
         if sample == "greedy":
             # greedy ignores these; normalizing keeps greedy requests in one
             # lockstep batch (and one compiled program) regardless of the
@@ -159,6 +179,7 @@ class Scheduler:
             top_p=top_p, presence_penalty=presence_penalty,
             frequency_penalty=frequency_penalty,
             repetition_penalty=repetition_penalty, seed=seed,
+            logit_bias=dict(logit_bias) if logit_bias else None,
             adapter_id=adapter_id,
             logprobs=min(max(int(logprobs), 0), self.LOGPROBS_K),
             on_token=on_token,
@@ -328,7 +349,7 @@ class Scheduler:
     @staticmethod
     def _penalized(req: Request) -> bool:
         return (req.presence_penalty != 0.0 or req.frequency_penalty != 0.0
-                or req.repetition_penalty != 1.0)
+                or req.repetition_penalty != 1.0 or bool(req.logit_bias))
 
     # -- speculative fast path (batch=1 + draft engine attached) --
 
@@ -475,6 +496,8 @@ class Scheduler:
                     else None
                 ),
                 seed=[r.seed for r in self.active],
+                logit_bias=[r.logit_bias for r in self.active],
+                pen_cache=self._pen_cache,
             )
         except MemoryError:
             # decode-time page exhaustion: shed the newest request back to
@@ -531,6 +554,7 @@ class Scheduler:
             req.done = True
             req.on_token = None
         self._admission_hold = False
+        self._pen_cache.clear()
         return dropped
 
     @property

@@ -264,6 +264,29 @@ class ServingServer:
         seed = body.get("seed")
         if seed is not None and not _valid_seed(seed):
             raise ValueError("seed must be an integer in [0, 2**31)")
+        raw_bias = body.get("logit_bias")
+        logit_bias = None
+        if raw_bias is not None:
+            if not isinstance(raw_bias, dict) or len(raw_bias) > 300:
+                raise ValueError(
+                    "logit_bias must be a map of at most 300 token ids"
+                )
+            logit_bias = {}
+            for k, v in raw_bias.items():
+                try:
+                    tid = int(k)
+                except (TypeError, ValueError):
+                    raise ValueError(
+                        f"logit_bias key {k!r} is not a token id"
+                    ) from None
+                if not 0 <= tid < vocab:
+                    raise ValueError(
+                        f"logit_bias token id {tid} outside [0, {vocab})"
+                    )
+                if not (isinstance(v, (int, float))
+                        and not isinstance(v, bool) and -100.0 <= v <= 100.0):
+                    raise ValueError("logit_bias values must be in [-100, 100]")
+                logit_bias[tid] = float(v)
         n = body.get("n", 1)
         if not (isinstance(n, int) and not isinstance(n, bool)
                 and 1 <= n <= 8):
@@ -341,6 +364,7 @@ class ServingServer:
             "presence_penalty": presence, "frequency_penalty": frequency,
             "repetition_penalty": repetition,
             "seed": seed,
+            "logit_bias": logit_bias,
             "logprobs": lp_k,
         }
 

@@ -106,6 +106,36 @@ def test_completions_logprobs_contract(server):
     assert all(t == {} for t in lp["top_logprobs"])
 
 
+def test_logit_bias_contract(server):
+    """OpenAI logit_bias: a -100 bias on the greedy token forces a
+    different choice; a +100 bias forces its token; invalid maps are
+    400s."""
+    want = dense_greedy(PROMPT, 1)
+    status, body = _post(server.port, {
+        "prompt": PROMPT, "max_tokens": 1, "temperature": 0,
+        "logit_bias": {str(want[0]): -100},
+    })
+    assert status == 200, body
+    assert body["choices"][0]["token_ids"][0] != want[0]
+    forced = 77
+    status, body = _post(server.port, {
+        "prompt": PROMPT, "max_tokens": 3, "temperature": 0,
+        "logit_bias": {str(forced): 100},
+    })
+    assert status == 200, body
+    assert body["choices"][0]["token_ids"] == [forced] * 3
+    for bad in (
+        {"logit_bias": {"999999": 1}},      # out of vocab
+        {"logit_bias": {"3": 101}},         # bias out of range
+        {"logit_bias": {"x": 1}},           # non-id key
+        {"logit_bias": [1, 2]},             # not a map
+    ):
+        status, body = _post(server.port, {
+            "prompt": PROMPT, "max_tokens": 2, **bad,
+        })
+        assert status == 400, (bad, body)
+
+
 def test_seed_contract(server):
     """OpenAI `seed`: the same seeded sampled request reproduces exactly
     (even though the scheduler's own stream advanced in between); seeded
