@@ -67,6 +67,11 @@ class Request:
     seed: Optional[int] = None
     # OpenAI logit_bias: token id -> additive bias (densified on device)
     logit_bias: Optional[Dict[int, float]] = None
+    # admission priority (vLLM priority scheduling): higher admits first;
+    # FIFO within a priority level.  Affects ADMISSION order only — an
+    # admitted request is never preempted by a later high-priority one
+    # (page backpressure/shedding still applies uniformly).
+    priority: int = 0
     adapter_id: int = 0  # LoRA adapter slot (0 = base model)
     # OpenAI logprobs: collect the chosen token's logprob + the top-k
     # alternatives per generated token (0 = off); records land in lp_data
@@ -146,6 +151,7 @@ class Scheduler:
         repetition_penalty: float = 1.0,
         seed: Optional[int] = None,
         logit_bias: Optional[Dict[int, float]] = None,
+        priority: int = 0,
         adapter_id: int = 0,
         logprobs: int = 0,
         on_token: Optional[Callable[[List[int], bool], None]] = None,
@@ -159,11 +165,20 @@ class Scheduler:
         if not (-10.0 <= presence_penalty <= 10.0
                 and -10.0 <= frequency_penalty <= 10.0):
             raise ValueError("presence/frequency penalties out of range")
-        if logit_bias is not None and not all(
-            isinstance(t, int) and 0 <= t < self.engine.cfg.vocab_size
-            for t in logit_bias
-        ):
-            raise ValueError("logit_bias keys must be in-vocab token ids")
+        if logit_bias is not None:
+            import math
+
+            if not all(
+                isinstance(t, int) and 0 <= t < self.engine.cfg.vocab_size
+                for t in logit_bias
+            ):
+                raise ValueError("logit_bias keys must be in-vocab token ids")
+            if not all(
+                isinstance(v, (int, float)) and math.isfinite(v)
+                and -1000.0 <= v <= 1000.0
+                for v in logit_bias.values()
+            ):
+                raise ValueError("logit_bias values must be finite and sane")
         if sample == "greedy":
             # greedy ignores these; normalizing keeps greedy requests in one
             # lockstep batch (and one compiled program) regardless of the
@@ -180,13 +195,25 @@ class Scheduler:
             frequency_penalty=frequency_penalty,
             repetition_penalty=repetition_penalty, seed=seed,
             logit_bias=dict(logit_bias) if logit_bias else None,
-            adapter_id=adapter_id,
+            priority=priority, adapter_id=adapter_id,
             logprobs=min(max(int(logprobs), 0), self.LOGPROBS_K),
             on_token=on_token,
         )
         self._next_id += 1
-        self.pending.append(req)
+        self._enqueue(req)
         return req.req_id
+
+    def _enqueue(self, req: Request, front: bool = False) -> None:
+        """Insert into the pending queue by (priority desc, FIFO).
+        ``front=True`` re-queues a shed/held request AHEAD of its priority
+        peers (it already waited its turn once)."""
+        i = len(self.pending)
+        while i > 0 and self.pending[i - 1].priority < req.priority:
+            i -= 1
+        if front:
+            while i > 0 and self.pending[i - 1].priority == req.priority:
+                i -= 1
+        self.pending.insert(i, req)
 
     def cancel(self, req_id: int) -> bool:
         """Abort a request.  Pending: removed immediately.  Active or
@@ -278,7 +305,7 @@ class Scheduler:
                         req.tokens + req.output, adapter_id=req.adapter_id
                     )
                 except MemoryError:
-                    self.pending.insert(0, req)
+                    self._enqueue(req, front=True)
                     self._admission_hold = True
                     return
                 self._prefilling.append((req, pp))
@@ -301,7 +328,7 @@ class Scheduler:
             )
 
         while len(admit) > 1 and wave_pages(admit) > self.engine.free_pages:
-            self.pending.insert(0, admit.pop())
+            self._enqueue(admit.pop(), front=True)
         while admit:
             try:
                 # prompt + output-so-far: a request shed mid-decode resumes
@@ -312,11 +339,12 @@ class Scheduler:
                 )
             except MemoryError:
                 if len(admit) > 1:
-                    self.pending.insert(0, admit.pop())
+                    self._enqueue(admit.pop(), front=True)
                     continue
                 if not self.active:
                     raise
-                self.pending[0:0] = admit
+                for r in reversed(admit):
+                    self._enqueue(r, front=True)
                 self._admission_hold = True  # retry after a retire frees pages
                 return
             for req, st in zip(admit, states):
@@ -344,6 +372,11 @@ class Scheduler:
         self.active = still
         if done_now:
             self._admission_hold = False  # pages freed; admission may resume
+            if not any(self._penalized(r) for r in still):
+                # don't pin the dense [B, V] device penalty state after the
+                # batch that needed it retires (its composition key can
+                # never recur — seq ids are monotonic)
+                self._pen_cache.clear()
         return done_now
 
     @staticmethod
@@ -509,7 +542,7 @@ class Scheduler:
             self._drop_draft(victim)
             self.engine.release(victim.state)
             victim.state = None
-            self.pending.insert(0, victim)
+            self._enqueue(victim, front=True)
             self._admission_hold = True
             return cancelled_prefill
         if want_lp:

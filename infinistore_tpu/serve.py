@@ -53,7 +53,8 @@ class ServingServer:
 
     def __init__(self, engine, host: str = "127.0.0.1", port: int = 8000,
                  max_batch: int = 8, model_id: str = "infinistore-tpu",
-                 tokenizer=None, draft_engine=None, spec_k: int = 4):
+                 tokenizer=None, draft_engine=None, spec_k: int = 4,
+                 max_queue: Optional[int] = None):
         """``tokenizer``: any object with ``encode(str) -> [int]`` and
         ``decode([int]) -> str`` (an HF tokenizer qualifies) — enables
         string prompts, text responses, and string stop sequences.
@@ -63,6 +64,10 @@ class ServingServer:
         self.engine = engine
         self.model_id = model_id
         self.tokenizer = tokenizer
+        # admission control: with more than this many requests in the
+        # system, new submissions answer 429 instead of queueing without
+        # bound (None = unbounded)
+        self.max_queue = max_queue
         self.sched = Scheduler(engine, max_batch=max_batch,
                                draft_engine=draft_engine, spec_k=spec_k)
         self._cv = threading.Condition()
@@ -264,6 +269,10 @@ class ServingServer:
         seed = body.get("seed")
         if seed is not None and not _valid_seed(seed):
             raise ValueError("seed must be an integer in [0, 2**31)")
+        prio = body.get("priority", 0)
+        if not (isinstance(prio, int) and not isinstance(prio, bool)
+                and -100 <= prio <= 100):
+            raise ValueError("priority must be an integer in [-100, 100]")
         raw_bias = body.get("logit_bias")
         logit_bias = None
         if raw_bias is not None:
@@ -365,6 +374,7 @@ class ServingServer:
             "repetition_penalty": repetition,
             "seed": seed,
             "logit_bias": logit_bias,
+            "priority": prio,
             "logprobs": lp_k,
         }
 
@@ -420,6 +430,12 @@ class ServingServer:
                     else "stop",
                 ))
 
+        if self.max_queue is not None:
+            depth = (len(self.sched.pending) + len(self.sched.active)
+                     + len(self.sched._prefilling))
+            if depth >= self.max_queue:
+                q.put(("busy", "server at capacity; retry later"))
+                return
         try:
             kwargs = self._validate(body)
             tally["budget"] = kwargs["max_new_tokens"]
@@ -745,17 +761,22 @@ def _make_handler(server: ServingServer):
                 )
                 for i in range(n)
             ]
-            req_ids, err = [], None
+            req_ids, err, busy = [], None, None
             for q in qs:
                 kind, val = q.get()
                 if kind == "error":
                     err = val
+                elif kind == "busy":
+                    busy = val
                 else:
                     req_ids.append(val)
-            if err is not None:
+            if err is not None or busy is not None:
                 for rid in req_ids:
                     server.cancel(rid)
-                self._json(400, {"error": err})
+                if busy is not None:
+                    self._json(429, {"error": busy})
+                else:
+                    self._json(400, {"error": err})
                 return
             # adapter-routed requests echo the adapter name they asked for
             model_name = str(body.get("model") or server.model_id)
@@ -1019,6 +1040,10 @@ def main(argv: Optional[List[str]] = None) -> None:
                          "responses; defaults to --model when that is an HF "
                          "checkpoint dir")
     ap.add_argument("--max-batch", type=int, default=8)
+    ap.add_argument("--max-queue", type=int, default=None,
+                    help="admission cap: more than this many requests in "
+                         "the system answers 429 instead of queueing "
+                         "without bound")
     ap.add_argument("--n-blocks", type=int, default=512)
     ap.add_argument("--block-tokens", type=int, default=16)
     ap.add_argument("--prefill-chunk", type=int, default=None)
@@ -1120,7 +1145,7 @@ def main(argv: Optional[List[str]] = None) -> None:
     srv = ServingServer(engine, host=args.host, port=args.port,
                         max_batch=args.max_batch, model_id=model_id,
                         tokenizer=tokenizer, draft_engine=draft_engine,
-                        spec_k=args.spec_k)
+                        spec_k=args.spec_k, max_queue=args.max_queue)
     srv.start()
     try:
         while True:

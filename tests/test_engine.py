@@ -407,6 +407,34 @@ def test_prefix_reuse_survives_partial_eviction(server):
     decode_conn.close()
 
 
+def test_scheduler_priority_admission_order():
+    """Higher-priority requests jump the pending queue (FIFO within a
+    level); a shed/held request re-queues AHEAD of its priority peers.
+    Admission order only — in-flight requests are never preempted."""
+    from infinistore_tpu.engine import Scheduler
+
+    eng = InferenceEngine(PARAMS, CFG, make_pc())
+    eng.decode_chunk = 2
+    sched = Scheduler(eng, max_batch=1)  # serialize: admission order visible
+    low1 = sched.submit(PROMPT[:4], 3, priority=0)
+    low2 = sched.submit(PROMPT[:5], 3, priority=0)
+    high = sched.submit(PROMPT[:6], 3, priority=5)
+    # the high-priority request sits ahead of the earlier low ones
+    assert [r.req_id for r in sched.pending] == [high, low1, low2]
+
+    finish_order = []
+    results = {}
+    while sched.has_work:
+        for r in sched.step():
+            finish_order.append(r.req_id)
+            results[r.req_id] = r.output
+    assert finish_order == [high, low1, low2]
+    # ordering must not change any output
+    assert results[high] == dense_greedy(PROMPT[:6], 3)
+    assert results[low1] == dense_greedy(PROMPT[:4], 3)
+    assert results[low2] == dense_greedy(PROMPT[:5], 3)
+
+
 def test_sampling_penalties_match_hand_reference():
     """presence/frequency (generated tokens) and repetition (prompt +
     generated) penalties applied on device inside the decode scan must

@@ -5,6 +5,7 @@ the server must survive concurrent clients and mid-stream disconnects."""
 import http.client
 import json
 import threading
+import time
 
 import jax
 import jax.numpy as jnp
@@ -104,6 +105,50 @@ def test_completions_logprobs_contract(server):
     lp = body["choices"][0]["logprobs"]
     assert len(lp["token_logprobs"]) == 3
     assert all(t == {} for t in lp["top_logprobs"])
+
+
+def test_max_queue_backpressure_429():
+    """Admission control: past --max-queue requests answer 429 instead of
+    queueing without bound; capacity frees as requests retire."""
+    eng = InferenceEngine(
+        PARAMS, CFG,
+        PagedCacheConfig(
+            n_layers=CFG.n_layers, n_kv_heads=CFG.n_kv_heads,
+            head_dim=CFG.head_dim, n_blocks=64, block_tokens=4,
+            dtype=CFG.dtype,
+        ),
+    )
+    eng.decode_chunk = 4
+    srv = ServingServer(eng, port=0, max_batch=2, model_id="tiny-q",
+                        max_queue=2)
+    srv.start()
+    try:
+        results = {}
+        threads = []
+
+        def post(i, body):
+            results[i] = _post(srv.port, body, timeout=120)
+
+        # 2 slow requests fill the system; the burst behind them must see
+        # some 429s (depth checked on the engine thread at submission)
+        for i in range(6):
+            t = threading.Thread(target=post, args=(
+                i, {"prompt": PROMPT, "max_tokens": 32, "temperature": 0}))
+            t.start()
+            threads.append(t)
+            if i < 2:
+                time.sleep(0.3)  # let the first two enter the system
+        for t in threads:
+            t.join()
+        statuses = [results[i][0] for i in range(6)]
+        assert statuses[0] == 200 and statuses[1] == 200, statuses
+        assert 429 in statuses, statuses
+        # the server recovers: a fresh request after the burst drains
+        status, body = _post(srv.port, {
+            "prompt": PROMPT, "max_tokens": 2, "temperature": 0})
+        assert status == 200, body
+    finally:
+        srv.close()
 
 
 def test_logit_bias_contract(server):
