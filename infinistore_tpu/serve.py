@@ -269,6 +269,11 @@ class ServingServer:
         seed = body.get("seed")
         if seed is not None and not _valid_seed(seed):
             raise ValueError("seed must be an integer in [0, 2**31)")
+        echo = body.get("echo", False)
+        if not isinstance(echo, bool):
+            raise ValueError("echo must be a boolean")
+        if echo and chat:
+            raise ValueError("echo is a completions-only parameter")
         prio = body.get("priority", 0)
         if not (isinstance(prio, int) and not isinstance(prio, bool)
                 and -100 <= prio <= 100):
@@ -735,6 +740,9 @@ def _make_handler(server: ServingServer):
                 body.pop("_chat", None)
             try:
                 # tokenization-heavy prep on THIS thread, not the engine's
+                # (the raw string survives for echo: decode(encode(s)) may
+                # add special tokens the client never sent)
+                raw_prompt = body.get("prompt")
                 body = server.prepare_body(body, chat)
             except ValueError as e:
                 self._json(400, {"error": str(e)})
@@ -788,12 +796,22 @@ def _make_handler(server: ServingServer):
                           for _ in range(n)]
             lp_k = server.logprobs_display_k(body, chat)
             prompt_len = len(body["prompt"])
+            # OpenAI legacy `echo`: completions prepend the prompt to each
+            # choice (ids always; text when a tokenizer is attached)
+            echo_ids: Optional[List[int]] = None
+            echo_text = ""
+            if body.get("echo") and not chat:
+                echo_ids = list(body["prompt"])
+                if isinstance(raw_prompt, str):
+                    echo_text = raw_prompt  # verbatim, per the contract
+                elif server.tokenizer is not None:
+                    echo_text = server.tokenizer.decode(echo_ids)
             if body.get("stream"):
                 self._stream(req_ids, qs, accums, chat, model_name,
-                             prompt_len, lp_k)
+                             prompt_len, lp_k, echo_ids, echo_text)
             else:
                 self._collect(req_ids, qs, accums, chat, model_name,
-                              prompt_len, lp_k)
+                              prompt_len, lp_k, echo_ids, echo_text)
 
         def _client_gone(self) -> bool:
             """A request-less peek at the socket: readable + EOF means the
@@ -812,7 +830,9 @@ def _make_handler(server: ServingServer):
         def _collect(self, req_ids: List[int], qs: List["queue.Queue"],
                      accums: List[Optional[_TextAccum]], chat: bool,
                      model_name: Optional[str], prompt_len: int,
-                     lp_k: Optional[int]) -> None:
+                     lp_k: Optional[int],
+                     echo_ids: Optional[List[int]] = None,
+                     echo_text: str = "") -> None:
             choices: List[Dict[str, Any]] = []
             for i, (req_id, q, accum) in enumerate(zip(req_ids, qs, accums)):
                 tokens: List[int] = []
@@ -868,6 +888,13 @@ def _make_handler(server: ServingServer):
                     }
                 choices.append(choice)
             completion_tokens = sum(len(c["token_ids"]) for c in choices)
+            if echo_ids is not None:
+                # prepend AFTER usage accounting: echo changes the payload,
+                # not what was generated
+                for c in choices:
+                    c["token_ids"] = echo_ids + c["token_ids"]
+                    if "text" in c:
+                        c["text"] = echo_text + c["text"]
             try:
                 self._json(200, {
                     "id": f"{'chatcmpl' if chat else 'cmpl'}-{req_ids[0]}",
@@ -886,7 +913,9 @@ def _make_handler(server: ServingServer):
         def _stream(self, req_ids: List[int], qs: List["queue.Queue"],
                     accums: List[Optional[_TextAccum]], chat: bool,
                     model_name: Optional[str], prompt_len: int,
-                    lp_k: Optional[int]) -> None:
+                    lp_k: Optional[int],
+                    echo_ids: Optional[List[int]] = None,
+                    echo_text: str = "") -> None:
             self.send_response(200)
             self.send_header("Content-Type", "text/event-stream")
             self.send_header("Cache-Control", "no-cache")
@@ -964,6 +993,29 @@ def _make_handler(server: ServingServer):
                 self.wfile.flush()
 
             try:
+                if echo_ids is not None:
+                    # OpenAI echo in streaming: the prompt arrives as the
+                    # first chunk of each choice.  Inside the try — a
+                    # client that disconnects during the echo write must
+                    # still have its requests cancelled.  (No logprobs on
+                    # this chunk: nothing was generated yet; emit()'s lp
+                    # slicing is for generated ids, hence the bare
+                    # envelope.)
+                    for i in range(n):
+                        choice: Dict[str, Any] = {
+                            "index": i, "token_ids": list(echo_ids),
+                            "finish_reason": None,
+                        }
+                        if accums[i] is not None:
+                            choice["text"] = echo_text
+                        chunk = json.dumps({
+                            "id": f"cmpl-{req_ids[0]}",
+                            "object": "text_completion",
+                            "model": model_name or server.model_id,
+                            "choices": [choice],
+                        })
+                        self.wfile.write(f"data: {chunk}\n\n".encode())
+                    self.wfile.flush()
                 while True:
                     i, (kind, val) = next_event()
                     if not live[i]:

@@ -867,6 +867,68 @@ def test_streaming_ids_respect_stop_horizon(text_server):
     assert ids == want_ids
 
 
+def test_echo_contract(text_server):
+    """OpenAI legacy echo: completions prepend the prompt to the choice
+    (text + ids); usage still counts prompt and completion separately;
+    streaming sends the prompt as the first chunk; chat rejects it."""
+    tok = text_server.tokenizer
+    want = dense_greedy(PROMPT, 4)
+    status, body = _post(text_server.port, {
+        "prompt": PROMPT, "max_tokens": 4, "temperature": 0, "echo": True,
+    })
+    assert status == 200, body
+    choice = body["choices"][0]
+    assert choice["token_ids"] == PROMPT + want
+    assert choice["text"] == tok.decode(PROMPT) + tok.decode(want)
+    assert body["usage"] == {
+        "prompt_tokens": len(PROMPT), "completion_tokens": 4,
+        "total_tokens": len(PROMPT) + 4,
+    }
+    # string prompt: the echoed text is the VERBATIM client string (not
+    # decode(encode(s)), which can grow special tokens)
+    s = tok.decode(PROMPT)
+    status, body = _post(text_server.port, {
+        "prompt": s, "max_tokens": 4, "temperature": 0, "echo": True,
+    })
+    assert status == 200, body
+    assert body["choices"][0]["text"].startswith(s)
+    # streaming: prompt rides the first chunk
+    conn = http.client.HTTPConnection("127.0.0.1", text_server.port,
+                                      timeout=120)
+    conn.request("POST", "/v1/completions", json.dumps({
+        "prompt": PROMPT, "max_tokens": 4, "temperature": 0, "echo": True,
+        "stream": True,
+    }), {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    assert resp.status == 200
+    ids, done, first = [], False, None
+    buf = b""
+    while not done:
+        chunk = resp.read1(65536)
+        if not chunk:
+            break
+        buf += chunk
+        while b"\n\n" in buf:
+            event, buf = buf.split(b"\n\n", 1)
+            payload = event[len(b"data: "):]
+            if payload == b"[DONE]":
+                done = True
+                break
+            c = json.loads(payload)["choices"][0]
+            if first is None:
+                first = list(c["token_ids"])
+            ids.extend(c["token_ids"])
+    conn.close()
+    assert done and first == PROMPT
+    assert ids == PROMPT + want
+    # chat has no echo
+    status, _ = _post(text_server.port, {
+        "messages": [{"role": "user", "content": "hi"}], "max_tokens": 2,
+        "echo": True,
+    }, path="/v1/chat/completions")
+    assert status == 400
+
+
 def test_chat_completions(text_server):
     """OpenAI chat surface: messages are templated into a prompt (fallback
     role-tagged transcript for tokenizers without a chat template) and the
