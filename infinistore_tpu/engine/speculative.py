@@ -75,14 +75,21 @@ class SpeculativeDecoder:
     def prefill(self, tokens: Sequence[int]) -> Tuple[SequenceState, SequenceState]:
         return self.target.prefill(tokens), self.draft.prefill(tokens)
 
-    def _resync_draft(self, st_d: SequenceState, accepted: List[int]) -> None:
+    def _resync_draft(self, st_d: SequenceState, accepted: List[int],
+                      clean: bool = False) -> None:
         """Bring the draft's cache and logits in line with the accepted
         sequence.  The draft speculated past the rejection point, so its
-        tokens are rewound and the accepted tail is re-verified; feeding a
-        fixed-length window ending at the last accepted token keeps the
-        compile count at one shape."""
+        tokens are rewound and the accepted tail is re-verified; the
+        window ending at the last accepted token takes one of exactly TWO
+        widths (k+1, or 1 on clean rounds), bounding the compile count.
+
+        ``clean=True`` (the all-accepted round): every draft-cache slot up
+        to the bonus token already holds the RIGHT tokens' KV — the draft
+        itself decoded them — so only the bonus token needs verifying, a
+        width-1 dispatch instead of k+1 (the common case at high
+        acceptance, where this saves most of the resync cost)."""
         st_d.tokens = list(accepted)
-        w = min(len(accepted), self.k + 1)
+        w = 1 if clean else min(len(accepted), self.k + 1)
         run = accepted[-w:]
         logits = self.draft.verify(st_d, run, len(accepted) - w)
         st_d.last_logits = logits[-1]
@@ -210,8 +217,9 @@ class SpeculativeDecoder:
             st_t.tokens.extend(emitted)
             out.extend(emitted)
 
-            # 4. resync the draft onto the accepted sequence
-            self._resync_draft(st_d, list(st_t.tokens))
+            # 4. resync the draft onto the accepted sequence (width-1 when
+            # every proposal survived: the draft cache is already right)
+            self._resync_draft(st_d, list(st_t.tokens), clean=(m == k))
         return out
 
     @staticmethod
