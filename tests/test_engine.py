@@ -435,6 +435,32 @@ def test_scheduler_priority_admission_order():
     assert results[low2] == dense_greedy(PROMPT[:5], 3)
 
 
+def test_scheduler_enqueue_priority_and_requeue_front():
+    """_enqueue invariants: priority-descending order with FIFO inside a
+    level; front=True (a shed/held request) re-queues AHEAD of its
+    priority peers but never ahead of a higher level."""
+    from infinistore_tpu.engine import Scheduler
+    from infinistore_tpu.engine.scheduler import Request
+
+    eng = InferenceEngine(PARAMS, CFG, make_pc())
+    sched = Scheduler(eng)
+
+    def req(rid, prio):
+        return Request(req_id=rid, tokens=[1], max_new_tokens=1,
+                       priority=prio)
+
+    for rid, prio in ((0, 0), (1, 5), (2, 0), (3, 5), (4, 2)):
+        sched._enqueue(req(rid, prio))
+    assert [r.req_id for r in sched.pending] == [1, 3, 4, 0, 2]
+    # shed request at priority 2 re-queues ahead of priority-2 peers...
+    sched._enqueue(req(9, 2), front=True)
+    assert [r.req_id for r in sched.pending] == [1, 3, 9, 4, 0, 2]
+    # ...but a shed priority-0 request stays below every higher level
+    sched._enqueue(req(8, 0), front=True)
+    assert [r.req_id for r in sched.pending] == [1, 3, 9, 4, 8, 0, 2]
+    sched.pending.clear()
+
+
 def test_sampling_penalties_match_hand_reference():
     """presence/frequency (generated tokens) and repetition (prompt +
     generated) penalties applied on device inside the decode scan must
