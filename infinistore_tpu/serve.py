@@ -76,6 +76,7 @@ class ServingServer:
         self._queues: Dict[int, "queue.Queue"] = {}  # live req_id -> events
         self._stop = False
         self.stats = {"requests": 0, "completed": 0, "tokens": 0}
+        self._score_memo: Optional[tuple] = None  # (key, records)
         self._engine_thread = threading.Thread(
             target=self._engine_loop, name="istpu-engine", daemon=True
         )
@@ -235,8 +236,11 @@ class ServingServer:
         if not all(0 <= t < vocab for t in prompt):
             raise ValueError(f"prompt token ids must be in [0, {vocab})")
         max_tokens = int(body.get("max_tokens", 16))
-        if not 1 <= max_tokens <= 1_000_000:
-            raise ValueError("max_tokens must be >= 1")
+        # max_tokens 0 is the OpenAI scoring idiom (echo + logprobs with
+        # nothing generated); without echo there is nothing to return
+        floor = 0 if body.get("echo") else 1
+        if not floor <= max_tokens <= 1_000_000:
+            raise ValueError(f"max_tokens must be >= {floor}")
         T = self.engine.pc.block_tokens
         need = -(-(len(prompt) + max_tokens) // T)
         if need > self.engine.pc.n_blocks:
@@ -333,6 +337,12 @@ class ServingServer:
                         and 0 <= lp <= 5):
                     raise ValueError("logprobs must be an integer in [0, 5]")
                 lp_k = max(lp, 1)
+        if echo and lp_k and len(prompt) > SCORING_MAX_PROMPT:
+            raise ValueError(
+                f"echo+logprobs scores the prompt in one dense forward; "
+                f"prompts longer than {SCORING_MAX_PROMPT} tokens are not "
+                f"supported"
+            )
         stops = body.get("stop_token_ids") or []
         if stops and not all(isinstance(t, int) for t in stops):
             raise ValueError("stop_token_ids must be token ids")
@@ -406,6 +416,22 @@ class ServingServer:
             return self.tokenizer.decode([tid])
         return str(tid)
 
+    def _score_prompt(self, kwargs: Dict[str, Any]) -> List[tuple]:
+        """Prompt-scoring records, memoized single-entry: an n>1 scoring
+        request submits n identical bodies back to back (only the seed
+        differs, which scoring ignores) — compute the dense forward once
+        and fan the records out."""
+        key = (tuple(kwargs["tokens"]), kwargs.get("adapter_id", 0))
+        hit = self._score_memo
+        if hit is not None and hit[0] == key:
+            return hit[1]
+        recs = self.engine.prompt_logprobs(
+            kwargs["tokens"], k=Scheduler.LOGPROBS_K,
+            adapter_id=kwargs.get("adapter_id", 0),
+        )
+        self._score_memo = (key, recs)
+        return recs
+
     def _submit_to_sched(self, item: Dict[str, Any]) -> None:
         body, q = item["body"], item["q"]
         # finish_reason per the OpenAI contract: "stop" when a stop id
@@ -445,6 +471,16 @@ class ServingServer:
             kwargs = self._validate(body)
             tally["budget"] = kwargs["max_new_tokens"]
             tally["eos_set"] = frozenset(kwargs["eos_ids"] or ())
+            want_score = (body.get("echo") and kwargs.get("logprobs")
+                          and not body.get("_chat"))
+            if want_score and kwargs["max_new_tokens"] == 0:
+                # pure scoring (the OpenAI max_tokens:0 idiom): nothing to
+                # generate, so skip the scheduler entirely — no second
+                # prefill, no page allocation, no queue slot
+                q.put(("id", -1))
+                q.put(("prompt_lp", self._score_prompt(kwargs)))
+                q.put(("done", "length"))
+                return
             req_id = self.sched.submit(on_token=on_token, **kwargs)
             if kwargs.get("logprobs"):
                 # the engine thread owns both this submit and every later
@@ -454,6 +490,12 @@ class ServingServer:
                 )
             self._queues[req_id] = q
             q.put(("id", req_id))
+            if want_score:
+                # OpenAI echo+logprobs scoring: the prompt's own logprobs,
+                # one dense scoring forward on THIS (engine) thread —
+                # queued right after the id, so handlers see it before
+                # any token event (no scheduler step has run yet)
+                q.put(("prompt_lp", self._score_prompt(kwargs)))
         except Exception as e:
             q.put(("error", str(e)))
 
@@ -484,6 +526,26 @@ class ServingServer:
                 f"istpu_spec_acceptance_rate {sm['rate']}",
             ]
         return "\n".join(lines) + "\n"
+
+
+SCORING_MAX_PROMPT = 8192  # echo+logprobs runs ONE dense forward (see
+# InferenceEngine.prompt_logprobs); past this the [S, V] logits dominate
+# HBM, so the contract rejects instead of OOMing mid-request
+
+
+def _prompt_lp_payload(server, echo_ids: List[int], prompt_lps: List[tuple],
+                       lp_k: int) -> Dict[str, Any]:
+    """The prompt half of an echo+logprobs payload: position 0 has no
+    distribution (null), then the scoring records.  One definition shared
+    by batch assembly and the streaming echo chunk."""
+    return {
+        "tokens": [server.tok_str(t) for t in echo_ids],
+        "token_logprobs": [None] + [c for c, _ in prompt_lps],
+        "top_logprobs": [None] + [
+            {server.tok_str(a): v for a, v in top[:lp_k]}
+            for _, top in prompt_lps
+        ],
+    }
 
 
 def _valid_seed(seed: Any) -> bool:
@@ -837,6 +899,7 @@ def _make_handler(server: ServingServer):
             for i, (req_id, q, accum) in enumerate(zip(req_ids, qs, accums)):
                 tokens: List[int] = []
                 lps: List[tuple] = []
+                prompt_lps: List[tuple] = []
                 finish = "stop"
                 while True:
                     try:
@@ -848,7 +911,9 @@ def _make_handler(server: ServingServer):
                                 server.cancel(rid)
                             return
                         continue
-                    if kind == "lp":
+                    if kind == "prompt_lp":
+                        prompt_lps = val
+                    elif kind == "lp":
                         lps.extend(val)
                     elif kind == "tokens":
                         tokens.extend(val)
@@ -878,9 +943,19 @@ def _make_handler(server: ServingServer):
                         # tail (found at finish) is still a stop
                         choice["finish_reason"] = "stop"
                 if lp_k is not None:
-                    choice["logprobs"] = _lp_payload(
+                    payload = _lp_payload(
                         server, tokens, lps[:len(tokens)], lp_k, chat
                     )
+                    if echo_ids is not None and not chat:
+                        # echo+logprobs scoring: the prompt's own records
+                        # prepend (first position has no distribution)
+                        head = _prompt_lp_payload(
+                            server, echo_ids, prompt_lps, lp_k
+                        )
+                        payload = {
+                            kk: head[kk] + payload[kk] for kk in head
+                        }
+                    choice["logprobs"] = payload
                 if chat:  # chat requires a tokenizer, so accum is set
                     choice["message"] = {
                         "role": "assistant",
@@ -992,30 +1067,39 @@ def _make_handler(server: ServingServer):
                 self.wfile.write(b"data: [DONE]\n\n")
                 self.wfile.flush()
 
+            def emit_echo(i: int, prompt_lps=None) -> None:
+                """The prompt as choice i's first chunk (OpenAI echo);
+                with scoring (echo+logprobs) it carries the prompt's own
+                logprob records."""
+                choice: Dict[str, Any] = {
+                    "index": i, "token_ids": list(echo_ids),
+                    "finish_reason": None,
+                }
+                if accums[i] is not None:
+                    choice["text"] = echo_text
+                if prompt_lps is not None:
+                    choice["logprobs"] = _prompt_lp_payload(
+                        server, echo_ids, prompt_lps, lp_k
+                    )
+                chunk = json.dumps({
+                    "id": f"cmpl-{req_ids[0]}",
+                    "object": "text_completion",
+                    "model": model_name or server.model_id,
+                    "choices": [choice],
+                })
+                self.wfile.write(f"data: {chunk}\n\n".encode())
+                self.wfile.flush()
+
             try:
-                if echo_ids is not None:
-                    # OpenAI echo in streaming: the prompt arrives as the
-                    # first chunk of each choice.  Inside the try — a
-                    # client that disconnects during the echo write must
-                    # still have its requests cancelled.  (No logprobs on
-                    # this chunk: nothing was generated yet; emit()'s lp
-                    # slicing is for generated ids, hence the bare
-                    # envelope.)
+                if echo_ids is not None and lp_k is None:
+                    # plain echo: the prompt chunks go out immediately.
+                    # (echo+logprobs instead waits for each choice's
+                    # "prompt_lp" event, which precedes its token events.)
+                    # Inside the try — a client that disconnects during
+                    # the echo write must still have its requests
+                    # cancelled.
                     for i in range(n):
-                        choice: Dict[str, Any] = {
-                            "index": i, "token_ids": list(echo_ids),
-                            "finish_reason": None,
-                        }
-                        if accums[i] is not None:
-                            choice["text"] = echo_text
-                        chunk = json.dumps({
-                            "id": f"cmpl-{req_ids[0]}",
-                            "object": "text_completion",
-                            "model": model_name or server.model_id,
-                            "choices": [choice],
-                        })
-                        self.wfile.write(f"data: {chunk}\n\n".encode())
-                    self.wfile.flush()
+                        emit_echo(i)
                 while True:
                     i, (kind, val) = next_event()
                     if not live[i]:
@@ -1024,7 +1108,10 @@ def _make_handler(server: ServingServer):
                         # events must not re-emit a terminal chunk
                         continue
                     accum = accums[i]
-                    if kind == "lp":
+                    if kind == "prompt_lp":
+                        if echo_ids is not None:
+                            emit_echo(i, prompt_lps=val)
+                    elif kind == "lp":
                         lps[i].extend(val)
                     elif kind == "tokens":
                         if accum is None:
@@ -1052,6 +1139,10 @@ def _make_handler(server: ServingServer):
                             emit(i, accum.ids[ids_sent[i]:horizon], delta)
                             ids_sent[i] = horizon
                     elif kind == "error":
+                        # a post-submit failure (e.g. the scoring forward)
+                        # must not orphan already-admitted requests
+                        for rid in req_ids:
+                            server.cancel(rid)
                         err = json.dumps({"error": val})
                         self.wfile.write(f"data: {err}\n\n".encode())
                         done()

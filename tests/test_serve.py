@@ -929,6 +929,75 @@ def test_echo_contract(text_server):
     assert status == 400
 
 
+def test_echo_logprobs_scoring_contract(text_server):
+    """The OpenAI scoring idiom (echo + logprobs + max_tokens 0): the
+    response carries the PROMPT's own logprobs — null for position 0,
+    then the model's logprob of each actual next token — matching the
+    engine's scoring helper exactly, with nothing generated."""
+    eng = text_server.engine
+    want = eng.prompt_logprobs(PROMPT, k=2)
+    status, body = _post(text_server.port, {
+        "prompt": PROMPT, "max_tokens": 0, "temperature": 0,
+        "echo": True, "logprobs": 2,
+    })
+    assert status == 200, body
+    choice = body["choices"][0]
+    assert choice["token_ids"] == PROMPT  # echo only; nothing generated
+    assert body["usage"]["completion_tokens"] == 0
+    lp = choice["logprobs"]
+    assert len(lp["token_logprobs"]) == len(PROMPT)
+    assert lp["token_logprobs"][0] is None and lp["top_logprobs"][0] is None
+    for got, (chosen, top) in zip(lp["token_logprobs"][1:], want):
+        assert got == pytest.approx(chosen, abs=1e-5)
+    for got_top, (_, top) in zip(lp["top_logprobs"][1:], want):
+        assert len(got_top) == 2
+
+    # echo + logprobs WITH generation: prompt part + completion part
+    status, body = _post(text_server.port, {
+        "prompt": PROMPT, "max_tokens": 3, "temperature": 0,
+        "echo": True, "logprobs": 1,
+    })
+    assert status == 200, body
+    lp = body["choices"][0]["logprobs"]
+    assert len(lp["token_logprobs"]) == len(PROMPT) + 3
+    assert lp["token_logprobs"][0] is None
+    assert all(x is not None for x in lp["token_logprobs"][1:])
+
+    # streaming: the echo chunk carries the prompt logprobs
+    conn = http.client.HTTPConnection("127.0.0.1", text_server.port,
+                                      timeout=120)
+    conn.request("POST", "/v1/completions", json.dumps({
+        "prompt": PROMPT, "max_tokens": 2, "temperature": 0,
+        "echo": True, "logprobs": 1, "stream": True,
+    }), {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    assert resp.status == 200
+    first_lp, done = None, False
+    buf = b""
+    while not done:
+        chunk = resp.read1(65536)
+        if not chunk:
+            break
+        buf += chunk
+        while b"\n\n" in buf:
+            event, buf = buf.split(b"\n\n", 1)
+            payload = event[len(b"data: "):]
+            if payload == b"[DONE]":
+                done = True
+                break
+            c = json.loads(payload)["choices"][0]
+            if first_lp is None and c.get("logprobs"):
+                first_lp = c["logprobs"]
+    conn.close()
+    assert done and first_lp is not None
+    assert len(first_lp["token_logprobs"]) == len(PROMPT)
+    assert first_lp["token_logprobs"][0] is None
+
+    # max_tokens 0 without echo is still invalid
+    status, _ = _post(text_server.port, {"prompt": PROMPT, "max_tokens": 0})
+    assert status == 400
+
+
 def test_chat_completions(text_server):
     """OpenAI chat surface: messages are templated into a prompt (fallback
     role-tagged transcript for tokenizers without a chat template) and the
