@@ -183,11 +183,12 @@ def _flash_prefix_kernel(
         jnp.int32, (block_q, block_k), 1
     )
 
+    # boolean algebra, not jnp.where-of-bools: Mosaic can't lower select_n
+    # on i1 vectors (it truncates i8->i1, unsupported on TPU)
     in_prefix = ik * block_k < prefix_pad
-    live = jnp.where(
-        in_prefix,
-        ik * block_k < plen,
-        ik * block_k - prefix_pad <= iq * block_q + block_q - 1,
+    live = (in_prefix & (ik * block_k < plen)) | (
+        (~in_prefix)
+        & (ik * block_k - prefix_pad <= iq * block_q + block_q - 1)
     )
 
     @pl.when(live)
@@ -199,8 +200,9 @@ def _flash_prefix_kernel(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale
-        valid = jnp.where(
-            k_pos < prefix_pad, k_pos < plen, (k_pos - prefix_pad) <= q_idx
+        kp = k_pos < prefix_pad
+        valid = (kp & (k_pos < plen)) | (
+            (~kp) & ((k_pos - prefix_pad) <= q_idx)
         )
         s = jnp.where(valid, s, NEG_INF)
         _online_softmax_step(s, v, m_scr, l_scr, acc_scr)
