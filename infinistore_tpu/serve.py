@@ -6,10 +6,15 @@ OpenAI-compatible HTTP server; a standalone framework needs the same last
 mile.  Design (stdlib only, like the store's manage plane — server.py):
 
 * one **engine thread** owns the ``Scheduler`` and is the only thread that
-  touches it or the TPU; HTTP handler threads talk to it through a staging
-  list guarded by a condition variable (submissions, cancellations) and
-  per-request ``queue.Queue``s (token delivery), so JAX dispatch never runs
-  concurrently;
+  touches it; HTTP handler threads talk to it through a staging list
+  guarded by a condition variable (submissions, cancellations) and
+  per-request ``queue.Queue``s (token delivery).  ONE exception dispatches
+  device work off the engine thread: echo-request prompt scoring
+  (``_score_prompt``) runs its dense forward on the handler thread, so a
+  long scoring forward never head-of-line blocks in-flight decodes.  That
+  forward is stateless — no paged cache, no scheduler state, no donated
+  buffers — which is the invariant that makes the concurrency safe; any
+  future donation in the prefill/scoring jits would break it;
 * ``POST /v1/completions`` — body ``{"prompt": "text" | [token ids],
   "max_tokens", "temperature", "top_p", "top_k", "stop": "s" | [..],
   "stop_token_ids": [..], "stream"}``.  With a tokenizer attached
@@ -77,6 +82,12 @@ class ServingServer:
         self._stop = False
         self.stats = {"requests": 0, "completed": 0, "tokens": 0}
         self._score_memo: Optional[tuple] = None  # (key, records)
+        # scoring forwards run on HTTP handler threads (any of them), so the
+        # memo needs a lock; holding it across the compute also makes an
+        # n>1 scoring fan-out hit the memo instead of racing n dense
+        # forwards
+        self._score_lock = threading.Lock()
+        self._scoring = 0  # in-flight handler-thread scoring forwards
         self._engine_thread = threading.Thread(
             target=self._engine_loop, name="istpu-engine", daemon=True
         )
@@ -141,13 +152,93 @@ class ServingServer:
 
     def submit(self, body: Dict[str, Any]) -> "queue.Queue":
         """Stage a request; returns the queue its events arrive on.
-        Events: ("tokens", [ids]) then ("done", finish_reason)."""
+        Events: ("tokens", [ids]) then ("done", finish_reason).
+
+        Echo requests with ``max_tokens: 0`` (the OpenAI scoring idiom) are
+        answered entirely on THIS handler thread: they touch no scheduler
+        state, and a near-context-length dense scoring forward on the
+        engine thread would head-of-line block every in-flight request's
+        decode.  Echo+logprobs requests that DO generate get their prompt
+        scored here too, with the records handed to the engine thread for
+        ordered delivery after the id event."""
         q: queue.Queue = queue.Queue()
         with self._cv:
-            self._staged.append({"body": body, "q": q})
             self.stats["requests"] += 1
+        item: Dict[str, Any] = {"body": body, "q": q}
+        if body.get("echo") and not body.get("_chat"):
+            # scoring forwards are real TPU work: the admission limit must
+            # bound them like anything else.  Check-and-reserve is ONE _cv
+            # acquisition so concurrent scoring submissions can't all read
+            # the pre-increment depth and overshoot max_queue.
+            with self._cv:
+                if self._over_depth_locked():
+                    q.put(("busy", "server at capacity; retry later"))
+                    return q
+                self._scoring += 1
+            try:
+                # validation and scoring fail differently: ANY validation
+                # failure is a bad request (-> 400, matching the
+                # engine-thread path's catch-all), while ANY failure of
+                # the scoring forward itself is a server fault (-> 500)
+                try:
+                    kwargs = self._validate(body)
+                except Exception as e:  # noqa: BLE001 — bad request -> 400
+                    q.put(("error", str(e)))
+                    return q
+                item["kwargs"] = kwargs  # engine thread reuses, no re-parse
+                try:
+                    if kwargs["max_new_tokens"] == 0:
+                        # pure echo / pure scoring: nothing to generate —
+                        # no page allocation, no queue slot, no
+                        # engine-thread work.  Score BEFORE the id event
+                        # goes out: a scoring fault must be the FIRST
+                        # event (-> 500), not a stray second event after a
+                        # handler already saw the id.
+                        recs = (self._score_prompt(kwargs)
+                                if kwargs.get("logprobs") else None)
+                        q.put(("id", -1))
+                        if recs is not None:
+                            q.put(("prompt_lp", recs))
+                        q.put(("done", "length"))
+                        with self._cv:
+                            self.stats["completed"] += 1
+                        return q
+                    if kwargs.get("logprobs"):
+                        item["prompt_lp"] = self._score_prompt(kwargs)
+                except Exception as e:  # noqa: BLE001 — runtime -> 500
+                    q.put(("fault", f"scoring failed: {e!r}"))
+                    return q
+                # stage while still holding the reservation: the item is
+                # counted via _staged before _scoring drops, so the depth
+                # never dips mid-handoff
+                with self._cv:
+                    self._staged.append(item)
+                    self._cv.notify()
+                return q
+            finally:
+                with self._cv:
+                    self._scoring -= 1
+        with self._cv:
+            self._staged.append(item)
             self._cv.notify()
         return q
+
+    def _over_depth_locked(self) -> bool:
+        """Admission depth check; caller holds ``_cv``.  Counts the
+        scheduler lists (engine-thread-owned; len() reads are atomic
+        snapshots), staged-but-unprocessed submissions, and in-flight
+        handler-thread scoring forwards — TPU work the scheduler never
+        sees."""
+        if self.max_queue is None:
+            return False
+        depth = (len(self.sched.pending) + len(self.sched.active)
+                 + len(self.sched._prefilling) + len(self._staged)
+                 + self._scoring)
+        return depth >= self.max_queue
+
+    def _at_capacity(self) -> bool:
+        with self._cv:
+            return self._over_depth_locked()
 
     def cancel(self, req_id: int) -> None:
         with self._cv:
@@ -174,8 +265,12 @@ class ServingServer:
             if self.sched.has_work:
                 try:
                     for req in self.sched.step():
-                        self.stats["completed"] += 1
-                        self.stats["tokens"] += len(req.output)
+                        with self._cv:
+                            # handler threads increment completed too (the
+                            # echo shortcut), so the counter update needs
+                            # the lock
+                            self.stats["completed"] += 1
+                            self.stats["tokens"] += len(req.output)
                         self._queues.pop(req.req_id, None)
                 except Exception as e:
                     # last-resort fault path (validation keeps bad requests
@@ -420,17 +515,21 @@ class ServingServer:
         """Prompt-scoring records, memoized single-entry: an n>1 scoring
         request submits n identical bodies back to back (only the seed
         differs, which scoring ignores) — compute the dense forward once
-        and fan the records out."""
+        and fan the records out.  Runs on HTTP handler threads; the lock
+        spans the compute so identical concurrent requests coalesce."""
         key = (tuple(kwargs["tokens"]), kwargs.get("adapter_id", 0))
-        hit = self._score_memo
-        if hit is not None and hit[0] == key:
-            return hit[1]
-        recs = self.engine.prompt_logprobs(
-            kwargs["tokens"], k=Scheduler.LOGPROBS_K,
-            adapter_id=kwargs.get("adapter_id", 0),
-        )
-        self._score_memo = (key, recs)
-        return recs
+        # the caller (submit()'s echo branch) holds the _scoring
+        # reservation for the duration of this call
+        with self._score_lock:
+            hit = self._score_memo
+            if hit is not None and hit[0] == key:
+                return hit[1]
+            recs = self.engine.prompt_logprobs(
+                kwargs["tokens"], k=Scheduler.LOGPROBS_K,
+                adapter_id=kwargs.get("adapter_id", 0),
+            )
+            self._score_memo = (key, recs)
+            return recs
 
     def _submit_to_sched(self, item: Dict[str, Any]) -> None:
         body, q = item["body"], item["q"]
@@ -461,26 +560,19 @@ class ServingServer:
                     else "stop",
                 ))
 
-        if self.max_queue is not None:
-            depth = (len(self.sched.pending) + len(self.sched.active)
-                     + len(self.sched._prefilling))
-            if depth >= self.max_queue:
-                q.put(("busy", "server at capacity; retry later"))
-                return
+        if "kwargs" not in item and self._at_capacity():
+            # pre-scored echo items were admitted (and reserved) in
+            # submit(); busy-rejecting them HERE would throw away the dense
+            # forward the admission check exists to protect
+            q.put(("busy", "server at capacity; retry later"))
+            return
         try:
-            kwargs = self._validate(body)
+            # echo requests arrive pre-validated (submit() needed the
+            # kwargs for the scoring forward); everything else validates
+            # here on the engine thread
+            kwargs = item.get("kwargs") or self._validate(body)
             tally["budget"] = kwargs["max_new_tokens"]
             tally["eos_set"] = frozenset(kwargs["eos_ids"] or ())
-            want_score = (body.get("echo") and kwargs.get("logprobs")
-                          and not body.get("_chat"))
-            if want_score and kwargs["max_new_tokens"] == 0:
-                # pure scoring (the OpenAI max_tokens:0 idiom): nothing to
-                # generate, so skip the scheduler entirely — no second
-                # prefill, no page allocation, no queue slot
-                q.put(("id", -1))
-                q.put(("prompt_lp", self._score_prompt(kwargs)))
-                q.put(("done", "length"))
-                return
             req_id = self.sched.submit(on_token=on_token, **kwargs)
             if kwargs.get("logprobs"):
                 # the engine thread owns both this submit and every later
@@ -490,12 +582,12 @@ class ServingServer:
                 )
             self._queues[req_id] = q
             q.put(("id", req_id))
-            if want_score:
-                # OpenAI echo+logprobs scoring: the prompt's own logprobs,
-                # one dense scoring forward on THIS (engine) thread —
-                # queued right after the id, so handlers see it before
+            if item.get("prompt_lp") is not None:
+                # OpenAI echo+logprobs scoring alongside generation: the
+                # handler thread already computed the records (submit());
+                # queued right after the id, so handlers see them before
                 # any token event (no scheduler step has run yet)
-                q.put(("prompt_lp", self._score_prompt(kwargs)))
+                q.put(("prompt_lp", item["prompt_lp"]))
         except Exception as e:
             q.put(("error", str(e)))
 
@@ -831,20 +923,26 @@ def _make_handler(server: ServingServer):
                 )
                 for i in range(n)
             ]
-            req_ids, err, busy = [], None, None
+            req_ids, err, busy, fault = [], None, None, None
             for q in qs:
                 kind, val = q.get()
                 if kind == "error":
                     err = val
+                elif kind == "fault":
+                    # a runtime failure (e.g. the scoring forward), not a
+                    # bad request: server-error class
+                    fault = val
                 elif kind == "busy":
                     busy = val
                 else:
                     req_ids.append(val)
-            if err is not None or busy is not None:
+            if err is not None or busy is not None or fault is not None:
                 for rid in req_ids:
                     server.cancel(rid)
                 if busy is not None:
                     self._json(429, {"error": busy})
+                elif fault is not None:
+                    self._json(500, {"error": fault})
                 else:
                     self._json(400, {"error": err})
                 return
@@ -922,7 +1020,7 @@ def _make_handler(server: ServingServer):
                             # batch slot) instead of decoding to the budget
                             server.cancel(req_id)
                             break
-                    elif kind == "error":
+                    elif kind in ("error", "fault"):
                         for rid in req_ids:
                             server.cancel(rid)
                         self._json(500, {"error": val})
@@ -1015,7 +1113,7 @@ def _make_handler(server: ServingServer):
                     while True:
                         ev = qi.get()
                         merged.put((i, ev))
-                        if ev[0] in ("done", "error"):
+                        if ev[0] in ("done", "error", "fault"):
                             return
 
                 for i, qi in enumerate(qs):
@@ -1138,7 +1236,7 @@ def _make_handler(server: ServingServer):
                         if horizon > ids_sent[i] or delta:
                             emit(i, accum.ids[ids_sent[i]:horizon], delta)
                             ids_sent[i] = horizon
-                    elif kind == "error":
+                    elif kind in ("error", "fault"):
                         # a post-submit failure (e.g. the scoring forward)
                         # must not orphan already-admitted requests
                         for rid in req_ids:

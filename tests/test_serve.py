@@ -151,6 +151,49 @@ def test_max_queue_backpressure_429():
         srv.close()
 
 
+def test_scoring_respects_capacity_and_fault_class():
+    """Echo/scoring requests run their forward on the handler thread, but
+    (a) still answer 429 at capacity — the admission limit bounds scoring
+    forwards like anything else — and (b) a runtime failure inside the
+    scoring forward is a 500 (server fault), not a 400 (bad request)."""
+    eng = InferenceEngine(
+        PARAMS, CFG,
+        PagedCacheConfig(
+            n_layers=CFG.n_layers, n_kv_heads=CFG.n_kv_heads,
+            head_dim=CFG.head_dim, n_blocks=64, block_tokens=4,
+            dtype=CFG.dtype,
+        ),
+    )
+    srv = ServingServer(eng, port=0, max_batch=2, model_id="tiny-cap",
+                        max_queue=0)  # always at capacity
+    srv.start()
+    try:
+        status, body = _post(srv.port, {
+            "prompt": PROMPT, "max_tokens": 0, "temperature": 0,
+            "echo": True, "logprobs": 1,
+        })
+        assert status == 429, body
+    finally:
+        srv.close()
+
+    srv = ServingServer(eng, port=0, max_batch=2, model_id="tiny-fault")
+    srv.start()
+    try:
+        def boom(*a, **k):
+            raise RuntimeError("injected scoring fault")
+
+        srv.engine.prompt_logprobs = boom
+        status, body = _post(srv.port, {
+            "prompt": PROMPT, "max_tokens": 0, "temperature": 0,
+            "echo": True, "logprobs": 1,
+        })
+        assert status == 500, body
+        assert "scoring failed" in body["error"]
+    finally:
+        del srv.engine.prompt_logprobs  # instance attr; restore the method
+        srv.close()
+
+
 def test_logit_bias_contract(server):
     """OpenAI logit_bias: a -100 bias on the greedy token forces a
     different choice; a +100 bias forces its token; invalid maps are
@@ -927,6 +970,22 @@ def test_echo_contract(text_server):
         "echo": True,
     }, path="/v1/chat/completions")
     assert status == 400
+
+    # pure echo (max_tokens 0, no logprobs): the zero-work shortcut — the
+    # response is just the echoed prompt, no KV pages are touched, and the
+    # requests/completed counters stay balanced (no engine round-trip)
+    free_before = text_server.engine.free_pages
+    req_before = text_server.stats["requests"]
+    done_before = text_server.stats["completed"]
+    status, body = _post(text_server.port, {
+        "prompt": PROMPT, "max_tokens": 0, "temperature": 0, "echo": True,
+    })
+    assert status == 200, body
+    assert body["choices"][0]["token_ids"] == PROMPT
+    assert body["usage"]["completion_tokens"] == 0
+    assert text_server.engine.free_pages == free_before
+    assert text_server.stats["requests"] == req_before + 1
+    assert text_server.stats["completed"] == done_before + 1
 
 
 def test_echo_logprobs_scoring_contract(text_server):
