@@ -88,6 +88,7 @@ class ServingServer:
         # forwards
         self._score_lock = threading.Lock()
         self._scoring = 0  # in-flight handler-thread scoring forwards
+        self._submitting = 0  # popped from _staged, not yet in the scheduler
         self._engine_thread = threading.Thread(
             target=self._engine_loop, name="istpu-engine", daemon=True
         )
@@ -226,19 +227,31 @@ class ServingServer:
     def _over_depth_locked(self) -> bool:
         """Admission depth check; caller holds ``_cv``.  Counts the
         scheduler lists (engine-thread-owned; len() reads are atomic
-        snapshots), staged-but-unprocessed submissions, and in-flight
-        handler-thread scoring forwards — TPU work the scheduler never
-        sees."""
+        snapshots), staged-but-unprocessed submissions, items the engine
+        loop has popped but not yet handed to the scheduler
+        (``_submitting`` — without it a scoring request admitted in that
+        window overshoots ``max_queue``), and in-flight handler-thread
+        scoring forwards — TPU work the scheduler never sees."""
         if self.max_queue is None:
             return False
         depth = (len(self.sched.pending) + len(self.sched.active)
                  + len(self.sched._prefilling) + len(self._staged)
-                 + self._scoring)
+                 + self._submitting + self._scoring)
         return depth >= self.max_queue
 
-    def _at_capacity(self) -> bool:
+    def _sched_at_capacity(self) -> bool:
+        """Engine-side admission for a popped item.  Deliberately narrower
+        than ``_over_depth_locked``: counting ``_staged``/``_submitting``
+        here would charge an older request for submissions that arrived
+        AFTER it (non-FIFO 429s on an otherwise idle server); the popped
+        item competes only against work already admitted (scheduler lists)
+        and standing reservations (scoring forwards)."""
+        if self.max_queue is None:
+            return False
         with self._cv:
-            return self._over_depth_locked()
+            depth = (len(self.sched.pending) + len(self.sched.active)
+                     + len(self.sched._prefilling) + self._scoring)
+            return depth >= self.max_queue
 
     def cancel(self, req_id: int) -> None:
         with self._cv:
@@ -257,11 +270,18 @@ class ServingServer:
                     return
                 staged, self._staged = self._staged, []
                 cancels, self._cancels = self._cancels, []
+                # popped items keep counting toward the admission depth
+                # until the scheduler owns them (see _over_depth_locked)
+                self._submitting += len(staged)
             for rid in cancels:
                 self.sched.cancel(rid)
                 self._queues.pop(rid, None)
             for item in staged:
-                self._submit_to_sched(item)
+                try:
+                    self._submit_to_sched(item)
+                finally:
+                    with self._cv:
+                        self._submitting -= 1
             if self.sched.has_work:
                 try:
                     for req in self.sched.step():
@@ -560,7 +580,7 @@ class ServingServer:
                     else "stop",
                 ))
 
-        if "kwargs" not in item and self._at_capacity():
+        if "kwargs" not in item and self._sched_at_capacity():
             # pre-scored echo items were admitted (and reserved) in
             # submit(); busy-rejecting them HERE would throw away the dense
             # forward the admission check exists to protect

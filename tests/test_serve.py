@@ -151,6 +151,42 @@ def test_max_queue_backpressure_429():
         srv.close()
 
 
+def test_admission_depth_accounting():
+    """The two depth checks see the right state at each handoff stage:
+    items the engine loop popped from _staged but has not yet handed to
+    the scheduler still count against handler-side (echo) admission, while
+    the engine-side re-check for a popped item must NOT count later
+    arrivals in _staged (that would 429 an older request in favor of a
+    newer one on an idle server)."""
+    eng = InferenceEngine(
+        PARAMS, CFG,
+        PagedCacheConfig(
+            n_layers=CFG.n_layers, n_kv_heads=CFG.n_kv_heads,
+            head_dim=CFG.head_dim, n_blocks=64, block_tokens=4,
+            dtype=CFG.dtype,
+        ),
+    )
+    srv = ServingServer(eng, port=0, max_batch=2, model_id="tiny-depth",
+                        max_queue=2)  # NOT started: counters poked directly
+    # mid-handoff: two items popped from _staged, none in the scheduler yet
+    srv._submitting = 2
+    with srv._cv:
+        assert srv._over_depth_locked()   # echo admission sees them...
+    assert not srv._sched_at_capacity()   # ...but the popped items admit
+    srv._submitting = 0
+    # a newer request staged behind a popped one must not block it
+    srv._staged = [object(), object()]
+    with srv._cv:
+        assert srv._over_depth_locked()   # newcomers queue behind them
+    assert not srv._sched_at_capacity()   # the popped item itself admits
+    srv._staged = []
+    # standing scoring reservations DO block both sides
+    srv._scoring = 2
+    with srv._cv:
+        assert srv._over_depth_locked()
+    assert srv._sched_at_capacity()
+
+
 def test_scoring_respects_capacity_and_fault_class():
     """Echo/scoring requests run their forward on the handler thread, but
     (a) still answer 429 at capacity — the admission limit bounds scoring
