@@ -1,3 +1,4 @@
+from .. import jaxcfg as _jaxcfg  # noqa: F401 -- process-wide jax config
 from .connector import StoreConnector
 from .engine import InferenceEngine, SequenceState
 from .scheduler import Request, Scheduler

@@ -142,6 +142,73 @@ _KV_APPEND = jax.jit(
     donate_argnums=(0,),
 )
 
+# Tiny compiled helpers for the per-call host glue.  On TPU every eager op
+# is its own dispatch; on the tunneled single-chip setup an eager op can
+# stall for tens of ms behind queued bulk work, so the serving hot paths
+# (decode chunks, verify rounds, prefill epilogues) must stay dispatch-only:
+# one compiled program per step plus these stable-identity helpers.  Each
+# specializes per input arity/shape; all are trivial programs.
+_SPLIT2 = jax.jit(lambda k: tuple(jax.random.split(k)))
+_STACK_ROWS = jax.jit(lambda *xs: jnp.stack(xs))        # B x [V] -> [B, V]
+_UNSTACK_ROWS = jax.jit(lambda x: tuple(x))             # [B, V] -> B x [V]
+_ROW0 = jax.jit(lambda x: x[0])                         # [1, S, V] -> [S, V]
+_LAST_ROW = jax.jit(lambda l, i: l[0, i])               # dynamic row pick
+_ARGMAX_I32 = jax.jit(
+    lambda l: jnp.argmax(l, axis=-1).astype(jnp.int32)
+)
+_Q_COL0 = jax.jit(lambda p: p[:, 0, :])                 # [k, 1, V] -> [k, V]
+_SPLIT3 = jax.jit(lambda k: tuple(jax.random.split(k, 3)))
+
+
+@partial(jax.jit, donate_argnums=(0,), static_argnums=(3,))
+def _write_prefill_pages(cache, block_ids, kv, block_tokens):
+    """One dispatch for a prefill chunk's cache landing: [L, 2, B=1, S, H, D]
+    KV -> batch-0 pages -> scatter into the donated cache."""
+    n_pg = block_ids.shape[0]
+    return write_pages(
+        cache, block_ids, prefill_to_pages(kv[:, :, 0], n_pg, block_tokens)
+    )
+
+
+@partial(jax.jit, static_argnums=(1,))
+def _pad_seq_axis(kv, cap):
+    """Pad the sequence axis (index 3) of [L, 2, B, S, H, D] up to ``cap``
+    in one compiled dispatch (the bucketed prefix-buffer grow)."""
+    return jnp.pad(
+        kv, ((0, 0),) * 3 + ((0, cap - kv.shape[3]),) + ((0, 0),) * 2
+    )
+
+
+@jax.jit
+def _read_prefix_kv(cache, block_ids):
+    """Fused gather of a reused prefix: pages -> [L, 2, 1, n*T, H, D]."""
+    return pages_to_seq_kv(read_pages(cache, block_ids))
+
+
+@partial(jax.jit, donate_argnums=(0,), static_argnums=(4,))
+def _write_group_pages(cache, block_ids, kv, sel, block_tokens):
+    """Batched-prefill cache landing in one dispatch: per-row KV
+    [L, 2, B, S, H, D] -> all rows' bucket pages, then ``sel`` (flat
+    ``row * pages_per_bucket + page`` selectors, host-built) picks each
+    row's LEADING pages in ``block_ids`` order.  ``sel`` is a traced
+    array so the compile count stays bounded by (B, S) buckets — a static
+    per-row page-count tuple would compile one program per group
+    composition."""
+    L, two, B, S, H, D = kv.shape
+    full = S // block_tokens
+    pages = kv.reshape(L, two, B, full, block_tokens, H, D)
+    # -> [L, 2, H, B, full, T, D] -> [L, 2, H, B*full, T, D]
+    pages = jnp.transpose(pages, (0, 1, 5, 2, 3, 4, 6)).reshape(
+        L, two, H, B * full, block_tokens, D
+    )
+    return write_pages(cache, block_ids, pages[:, :, :, sel])
+
+
+# per-row last-position logits pick: [B(+pad), S, V] + idx [B] -> B x [V]
+_PICK_LAST = jax.jit(
+    lambda l, idx: tuple(l[jnp.arange(idx.shape[0]), idx])
+)
+
 
 class _StoreStreamer:
     """One background worker that pushes gathered KV pages to the store
@@ -491,8 +558,9 @@ class InferenceEngine:
                 reused = len(local_ids)
                 P = reused * T
         if reused:
-            pages = read_pages(self.cache, jnp.asarray(block_ids[:reused]))
-            prefix_kv = pages_to_seq_kv(pages)  # [L, 2, 1, n*T, H, D]
+            prefix_kv = _read_prefix_kv(
+                self.cache, jnp.asarray(block_ids[:reused])
+            )  # [L, 2, 1, n*T, H, D]
 
         # compute the tail; pad to a whole number of pages for paging.
         # ``prefill_chunk`` tokens per forward (chunked prefill): each chunk
@@ -519,10 +587,7 @@ class InferenceEngine:
         if single:
             buf, plen = prefix_kv, P  # exact buffer: no masking, flash OK
         elif prefix_kv is not None:
-            cap = cap_for(P)
-            buf = jnp.pad(
-                prefix_kv, ((0, 0),) * 3 + ((0, cap - P),) + ((0, 0),) * 2
-            )
+            buf = _pad_seq_axis(prefix_kv, cap_for(P))
             plen = P
         else:
             buf, plen = None, 0
@@ -553,10 +618,11 @@ class InferenceEngine:
                 prefix_len=jnp.asarray(pp.plen, dtype=jnp.int32), **lkw
             )
         n_pg = len(chunk) // T
-        self.cache = write_pages(
+        self.cache = _write_prefill_pages(
             self.cache,
             jnp.asarray(pp.block_ids[pp.done : pp.done + n_pg]),
-            prefill_to_pages(kv[:, :, 0], n_pg, T),
+            kv,
+            T,
         )
         prev_done, pp.done = pp.done, pp.done + n_pg
         pp.off_last = off
@@ -578,17 +644,10 @@ class InferenceEngine:
             need = pp.plen + len(chunk)
             ncap = _round_up_pow2(need, C)
             if pp.buf is None:
-                pp.buf = jnp.pad(
-                    kv, ((0, 0),) * 3 + ((0, ncap - len(chunk)),) + ((0, 0),) * 2
-                )
+                pp.buf = _pad_seq_axis(kv, ncap)
             else:
                 if ncap > pp.buf.shape[3]:
-                    pp.buf = jnp.pad(
-                        pp.buf,
-                        ((0, 0),) * 3
-                        + ((0, ncap - pp.buf.shape[3]),)
-                        + ((0, 0),) * 2,
-                    )
+                    pp.buf = _pad_seq_axis(pp.buf, ncap)
                 pp.buf = self._kv_append(
                     pp.buf, kv, jnp.asarray(pp.plen, dtype=jnp.int32)
                 )
@@ -613,7 +672,7 @@ class InferenceEngine:
             block_ids=pp.block_ids,
             chunk_keys=pp.keys,
             reused_chunks=pp.reused,
-            last_logits=pp.logits[0, (pp.S - 1) - pp.off_last],
+            last_logits=_LAST_ROW(pp.logits, (pp.S - 1) - pp.off_last),
             adapter_id=pp.adapter_id,
         )
         self._next_id += 1
@@ -742,12 +801,15 @@ class InferenceEngine:
         logits, kv = self._prefill_jit(
             self.params, tokens=jnp.asarray(tokens), **lkw
         )
-        parts = [
-            prefill_to_pages(kv[:, :, b], bucket // T, T)[:, :, :, :n_pg]
-            for b, n_pg in enumerate(n_pages_each)
-        ]
-        self.cache = write_pages(
-            self.cache, jnp.asarray(ids_all), jnp.concatenate(parts, axis=3)
+        full = bucket // T
+        sel = np.concatenate([
+            b * full + np.arange(n_pg) for b, n_pg in enumerate(n_pages_each)
+        ]).astype(np.int32)
+        self.cache = _write_group_pages(
+            self.cache, jnp.asarray(ids_all), kv, jnp.asarray(sel), T
+        )
+        last_rows = _PICK_LAST(
+            logits, jnp.asarray([len(p) - 1 for p in group], jnp.int32)
         )
         states = []
         off = 0
@@ -760,7 +822,7 @@ class InferenceEngine:
                 chunk_keys=chunk_keys(
                     p, self._adapter_model_id(aids[b]), chunk_tokens=T
                 ),
-                last_logits=logits[b, len(p) - 1],
+                last_logits=last_rows[b],
                 adapter_id=aids[b],
             )
             self.pages.register(st.chunk_keys, st.block_ids[: len(p) // T])
@@ -773,7 +835,8 @@ class InferenceEngine:
     # ---- decode ----
 
     def _decode_many(self, n_steps: int, variant: str, collect: bool = False,
-                     logprobs_k: int = 0, penalized: bool = False):
+                     logprobs_k: int = 0, penalized: bool = False,
+                     seeded: bool = False):
         """Compiled ``n_steps``-token decode: a ``lax.scan`` whose body
         samples on device (no per-token host sync) and derives the KV scatter
         slot from the device-resident block table.  Works for any batch of
@@ -817,7 +880,7 @@ class InferenceEngine:
         analog is one traced scan so XLA pipelines all ``n_steps`` steps
         without returning to Python (VERDICT round-1 weak #9)."""
         assert not (collect and logprobs_k), "collect and logprobs are exclusive"
-        cache_key = (n_steps, variant, collect, logprobs_k, penalized)
+        cache_key = (n_steps, variant, collect, logprobs_k, penalized, seeded)
         fn = self._decode_many_cache.get(cache_key)
         if fn is not None:
             return fn
@@ -826,7 +889,7 @@ class InferenceEngine:
         # engines with the same model family/config/paging share ONE
         # compiled scan (decode_fn identity is memoized by _shared_partial)
         global_key = ("decode_many", decode_fn, T, n_steps, variant, collect,
-                      logprobs_k, penalized)
+                      logprobs_k, penalized, seeded)
         fn = _JIT_CACHE.get(global_key)
         if fn is not None:
             self._decode_many_cache[cache_key] = fn
@@ -857,9 +920,9 @@ class InferenceEngine:
             tok = jnp.where(greedy_mask, am, samp)
             return tok, (jax.nn.softmax(l, axis=-1) if collect else None)
 
-        def many(params, logits0, start_pos, cache, block_table, rng,
-                 greedy_mask, temperature, top_k, top_p, lora, adapter_ids,
-                 pen):
+        def many(params, logits0, start_pos, cache, block_table, key,
+                 seeds, seeded_mask, greedy_mask, temperature, top_k, top_p,
+                 lora, adapter_ids, pen):
             # lora/adapter_ids are None for engines without a bank — the
             # Python branch below is static at trace time, so their
             # compiled programs are unchanged; same for pen (None unless
@@ -871,6 +934,16 @@ class InferenceEngine:
             if penalized:
                 (gen_counts0, prompt_seen, presence, frequency, repetition,
                  bias) = pen
+            # per-row base keys derived ON DEVICE (host-side eager splits
+            # were a measurable per-chunk cost): one key per call is enough
+            # because the scan folds each row key with the token's ABSOLUTE
+            # position, so draws never repeat across chunks or calls.
+            # Seeded rows swap in their fixed PRNGKey(seed) so their stream
+            # reproduces regardless of batchmates (vLLM per-request seed).
+            rng = jax.random.split(key, logits0.shape[0])
+            if seeded:
+                skeys = jax.vmap(jax.random.PRNGKey)(seeds)
+                rng = jnp.where(seeded_mask[:, None], skeys, rng)
 
             def step(carry, i):
                 if penalized:
@@ -1120,11 +1193,12 @@ class InferenceEngine:
         block_table = self._block_table(states)
         if rng is None:
             # advance the engine's own stream: repeated sampling calls must
-            # not replay the same draws
-            self._rng, rng = jax.random.split(self._rng)
+            # not replay the same draws (compiled split: eager ops stall
+            # behind queued device work on the tunneled platform)
+            self._rng, rng = _SPLIT2(self._rng)
 
         out: List[List[int]] = [[] for _ in range(B)]
-        logits = jnp.stack([st.last_logits for st in states])  # [B, V]
+        logits = _STACK_ROWS(*[st.last_logits for st in states])  # [B, V]
         pos = np.asarray([len(st.tokens) for st in states], dtype=np.int32)
         # constant across the chunk loop: upload the sampling vectors once
         greedy_d = jnp.asarray(greedy_mask)
@@ -1139,31 +1213,35 @@ class InferenceEngine:
         seeds = list(seed) if seed is not None else [None] * B
         assert len(seeds) == B, (len(seeds), B)
         seeded_mask = np.asarray([s is not None for s in seeds])
-        seeded_keys = seeded_mask_d = None
-        if seeded_mask.any():
-            seeded_keys = jnp.stack([
-                jax.random.PRNGKey(int(s) if s is not None else 0)
-                for s in seeds
-            ])
-            seeded_mask_d = jnp.asarray(seeded_mask)[:, None]
+        use_seeds = bool(seeded_mask.any())
+        seeds_d = mask_d = None
+        if use_seeds:
+            # PRNGKey construction happens inside the compiled program;
+            # only the raw seed ints and the row mask cross to the device.
+            # Masking to 32 bits preserves PRNGKey's tolerance of negative
+            # or wide seeds (uint32 upload would OverflowError on them)
+            seeds_d = jnp.asarray(
+                [int(s) & 0xFFFFFFFF if s is not None else 0 for s in seeds],
+                jnp.uint32,
+            )
+            mask_d = jnp.asarray(seeded_mask)
         lps: List[List[tuple]] = [[] for _ in range(B)]
         remaining = n_steps
         while remaining > 0:
             chunk = min(remaining, self.decode_chunk)
-            rng, sub = jax.random.split(rng)
-            # per-row base keys; seeded rows keep their FIXED key so the
-            # position fold reproduces the same stream in any batch
-            row_keys = jax.random.split(sub, B)
-            if seeded_keys is not None:
-                row_keys = jnp.where(seeded_mask_d, seeded_keys, row_keys)
+            # row keys derive from ``rng`` INSIDE the compiled program; one
+            # key serves every chunk of this call because the scan folds by
+            # absolute position (draws never repeat across chunks)
             res = self._decode_many(chunk, variant, logprobs_k=logprobs,
-                                    penalized=penalized)(
+                                    penalized=penalized, seeded=use_seeds)(
                 self.params,
                 logits,
                 jnp.asarray(pos),
                 self.cache,
                 block_table,
-                row_keys,
+                rng,
+                seeds_d,
+                mask_d,
                 greedy_d,
                 temp_d,
                 top_k_d,
@@ -1197,9 +1275,10 @@ class InferenceEngine:
                 out[b].extend(int(t) for t in host_toks[:, b])
             pos += chunk
             remaining -= chunk
+        rows = _UNSTACK_ROWS(logits)  # one dispatch, not B eager slices
         for b, st in enumerate(states):
             st.tokens.extend(out[b])
-            st.last_logits = logits[b]
+            st.last_logits = rows[b]
         if penalized and pen_cache is not None:
             # single-entry cache: one active batch composition at a time
             pen_cache.clear()
@@ -1231,17 +1310,19 @@ class InferenceEngine:
         if need > len(state.block_ids):
             state.block_ids.extend(self.pages.acquire(need - len(state.block_ids)))
         if rng is None:
-            self._rng, rng = jax.random.split(self._rng)
+            self._rng, rng = _SPLIT2(self._rng)
         variant = "filter" if (top_k > 0 or top_p < 1.0) else "plain"
         toks, probs, logits, self.cache = self._decode_many(
             k, variant, collect=True
         )(
             self.params,
-            state.last_logits[None],
+            _STACK_ROWS(state.last_logits),  # [1, V]
             jnp.asarray([len(state.tokens)], dtype=jnp.int32),
             self.cache,
             self._block_table([state]),
-            jax.random.split(rng, 1),  # [1, 2] per-row key
+            rng,
+            None,
+            None,
             jnp.zeros((B,), dtype=bool),
             jnp.full((B,), max(temperature, 1e-6), dtype=jnp.float32),
             jnp.full((B,), top_k, dtype=jnp.int32),
@@ -1253,8 +1334,11 @@ class InferenceEngine:
         )
         out = [int(t) for t in np.asarray(toks)[:, 0]]
         state.tokens.extend(out)
-        state.last_logits = logits[0]
-        return out, np.asarray(probs[:, 0, :])  # [k, V]
+        state.last_logits = _ROW0(logits)
+        # q stays ON DEVICE: the accept/reject test consumes it in a
+        # compiled decision step; downloading [k, V] floats per round was
+        # a dominant cost of categorical speculation on slow D2H links
+        return out, _Q_COL0(probs)  # device [k, V]
 
     def sampling_probs(
         self,
@@ -1323,7 +1407,7 @@ class InferenceEngine:
             slot_ids=jnp.asarray((poss % T)[None]),
             **self._lora_args([state.adapter_id]),
         )
-        return logits[0]
+        return _ROW0(logits)
 
     def _block_table(self, states: Sequence[SequenceState]) -> jax.Array:
         # logical pages can exceed the PHYSICAL pool under SWA reclamation

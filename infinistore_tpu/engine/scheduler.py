@@ -39,7 +39,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 
-from .engine import InferenceEngine, PartialPrefill, SequenceState
+from .engine import _SPLIT2, InferenceEngine, PartialPrefill, SequenceState
 
 
 @dataclass
@@ -426,7 +426,7 @@ class Scheduler:
         st_d = self._draft_state_for(req)
         if st_d is None:
             return False
-        self._rng, sub = jax.random.split(self._rng)
+        self._rng, sub = _SPLIT2(self._rng)
         try:
             toks = self.spec.decode(
                 req.state, st_d, chunk,
@@ -498,7 +498,7 @@ class Scheduler:
             # fills the MXU.  LoRA requests take the lockstep path (the
             # draft carries no adapters).
             return cancelled_prefill + self._retire()
-        self._rng, sub = jax.random.split(self._rng)
+        self._rng, sub = _SPLIT2(self._rng)
         # any row asking for logprobs switches the batch to the collecting
         # program (fixed top-LOGPROBS_K shape; rows slice to their own k);
         # any row with penalties switches to the count-carrying program

@@ -1,3 +1,4 @@
+from .. import jaxcfg as _jaxcfg  # noqa: F401 -- process-wide jax config
 from .cache import (
     BlockAllocator,
     BlockTable,

@@ -6,6 +6,8 @@ in ``models/attention.py``; kernels here are drop-in replacements validated
 against them in tests/test_ops.py.
 """
 
+from .. import jaxcfg as _jaxcfg  # noqa: F401 -- process-wide jax config
+
 from .pallas_attention import (  # noqa: F401
     flash_causal_attention_pallas,
     flash_prefix_attention_pallas,

@@ -10,6 +10,8 @@ annotate shardings (or go fully manual with ``shard_map`` where the
 schedule matters -- ring attention, pipelining), let XLA do the rest.
 """
 
+from .. import jaxcfg as _jaxcfg  # noqa: F401 -- process-wide jax config
+
 from .distributed import (
     dcn_aware_store_targets,
     initialize,

@@ -168,23 +168,28 @@ def test_admission_depth_accounting():
     )
     srv = ServingServer(eng, port=0, max_batch=2, model_id="tiny-depth",
                         max_queue=2)  # NOT started: counters poked directly
-    # mid-handoff: two items popped from _staged, none in the scheduler yet
-    srv._submitting = 2
-    with srv._cv:
-        assert srv._over_depth_locked()   # echo admission sees them...
-    assert not srv._sched_at_capacity()   # ...but the popped items admit
-    srv._submitting = 0
-    # a newer request staged behind a popped one must not block it
-    srv._staged = [object(), object()]
-    with srv._cv:
-        assert srv._over_depth_locked()   # newcomers queue behind them
-    assert not srv._sched_at_capacity()   # the popped item itself admits
-    srv._staged = []
-    # standing scoring reservations DO block both sides
-    srv._scoring = 2
-    with srv._cv:
-        assert srv._over_depth_locked()
-    assert srv._sched_at_capacity()
+    try:
+        # mid-handoff: two items popped from _staged, none in the scheduler
+        srv._submitting = 2
+        with srv._cv:
+            assert srv._over_depth_locked()   # echo admission sees them...
+        assert not srv._sched_at_capacity()   # ...but the popped items admit
+        srv._submitting = 0
+        # a newer request staged behind a popped one must not block it
+        srv._staged = [object(), object()]
+        with srv._cv:
+            assert srv._over_depth_locked()   # newcomers queue behind them
+        assert not srv._sched_at_capacity()   # the popped item itself admits
+        srv._staged = []
+        # standing scoring reservations DO block both sides
+        srv._scoring = 2
+        with srv._cv:
+            assert srv._over_depth_locked()
+        assert srv._sched_at_capacity()
+    finally:
+        # close() would join the never-started engine thread; just release
+        # the eagerly-bound HTTP socket
+        srv.httpd.server_close()
 
 
 def test_scoring_respects_capacity_and_fault_class():
@@ -1180,4 +1185,4 @@ def test_top_p_values_share_one_compiled_program():
                    rng=jax.random.PRNGKey(i))
         eng.release(st)
     keys = set(eng._decode_many_cache)
-    assert keys == {(2, "filter", False, 0, False)}, keys
+    assert keys == {(2, "filter", False, 0, False, False)}, keys

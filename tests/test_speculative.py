@@ -61,6 +61,34 @@ def test_speculative_equals_greedy():
     assert spec.rounds >= 1
 
 
+def test_fused_rounds_used_and_match_host_loop():
+    """The greedy path compiles whole rounds into one dispatch per R rounds
+    (_build_fused_rounds).  Pin that (a) the fused program actually engages
+    for an eligible request — not a silent fallback to the host loop — and
+    (b) its output is identical to the host round loop's."""
+    from infinistore_tpu.engine.engine import _JIT_CACHE
+
+    spec = SpeculativeDecoder(
+        make_engine(TARGET_PARAMS, CFG),
+        make_engine(DRAFT_PARAMS, DRAFT_CFG),
+        k=4,
+    )
+    got_fused = spec.generate(PROMPT, 24)
+    assert spec.rounds >= 1
+    assert any(
+        isinstance(key, tuple) and key and key[0] == "spec_fused"
+        for key in _JIT_CACHE
+    ), "fused-round program never compiled — fast path silently skipped"
+
+    host = SpeculativeDecoder(
+        make_engine(TARGET_PARAMS, CFG),
+        make_engine(DRAFT_PARAMS, DRAFT_CFG),
+        k=4,
+    )
+    host.fuse_rounds = False
+    assert host.generate(PROMPT, 24) == got_fused
+
+
 def test_speculative_self_draft_accepts_everything():
     """Draft == target: every proposal must be accepted (acceptance rate 1)
     and each round must emit k+1 tokens."""
