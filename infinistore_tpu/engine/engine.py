@@ -1410,12 +1410,18 @@ class InferenceEngine:
         return _ROW0(logits)
 
     def _block_table(self, states: Sequence[SequenceState]) -> jax.Array:
-        # logical pages can exceed the PHYSICAL pool under SWA reclamation
-        # (window-dead prefix pages recycle while their table slots live
-        # on, masked); widen in power-of-two buckets so the jit cache sees
-        # at most log2 extra table shapes
-        width = self.max_pages
+        # Width = the LONGEST active sequence's page count, in power-of-two
+        # buckets (at most log2 table shapes in the jit cache).  It must
+        # NOT default to the pool size: the XLA decode-attention path
+        # gathers width*T tokens of K and V per row per layer whatever
+        # seq_lens says, so a full-pool table made every decode step pay
+        # the whole pool's gather traffic (measured ~4x per-step cost at
+        # B=8/512 blocks; scaled linearly with n_blocks).  Logical pages
+        # may exceed the physical pool under SWA reclamation (window-dead
+        # prefix pages recycle while their table slots live on, masked) —
+        # ``need`` already counts those slots.
         need = max((len(st.block_ids) for st in states), default=0)
+        width = 8
         while width < need:
             width *= 2
         table = np.zeros((len(states), width), dtype=np.int32)
