@@ -453,6 +453,21 @@ def paged_decode_attention(
         and jax.default_backend() == "tpu"
         and not os.environ.get("ISTPU_NO_PALLAS")
     ):
+        if os.environ["ISTPU_PALLAS_DECODE"] == "jax":
+            # jax's bundled multi-page-per-program paged-attention kernel
+            # (per-(b, h) grid, looped double-buffered page copies); our
+            # cache layout IS its k_pages/v_pages layout, so the slices
+            # are free.  It applies no q scale internally.
+            from jax.experimental.pallas.ops.tpu.paged_attention import (
+                paged_attention as _jax_paged_attention,
+            )
+
+            D = q.shape[-1]
+            return _jax_paged_attention(
+                q * jnp.asarray(D ** -0.5, q.dtype),
+                layer_cache[0], layer_cache[1], seq_lens, block_table,
+                pages_per_compute_block=min(8, block_table.shape[1]),
+            )
         from ..ops.pallas_attention import paged_decode_attention_pallas
 
         return paged_decode_attention_pallas(q, layer_cache, block_table, seq_lens)
