@@ -359,14 +359,17 @@ class SpeculativeDecoder:
             return need - len(st.block_ids) <= eng.free_pages
 
         while len(out) < n_steps:
+            # TWO round-count buckets only ({8, 2}): each fused program
+            # inlines dozens of forwards, so every extra R bucket is a
+            # large compile; 8 is the steady-state program, 2 keeps tail
+            # calls from overshooting ~a full dispatch of work (rounds
+            # past the budget execute and get trimmed, like the host
+            # loop's overshoot).  Degrades below 2 only when a pool can't
+            # hold the rounds' growth (R=1 that still doesn't fit raises
+            # out of the acquire below — the host loop's "round can't
+            # fit" contract).
             remaining = n_steps - len(out)
-            # optimistic round count at full acceptance, pow2-bucketed,
-            # degraded to what BOTH pools can hold up front (R=1 that still
-            # doesn't fit raises out of the acquire below — the same
-            # "round can't fit" contract as the host loop)
-            R = 1
-            while R < min(8, -(-remaining // (k + 1))):
-                R *= 2
+            R = 8 if remaining > 2 * (k + 1) else 2
             while R > 1 and not (fits(self.target, st_t, R)
                                  and fits(self.draft, st_d, R)):
                 R //= 2
