@@ -1308,6 +1308,10 @@ def main(argv: Optional[List[str]] = None) -> None:
     ap.add_argument("--n-blocks", type=int, default=512)
     ap.add_argument("--block-tokens", type=int, default=16)
     ap.add_argument("--prefill-chunk", type=int, default=None)
+    ap.add_argument("--decode-chunk", type=int, default=32,
+                    help="tokens per compiled decode dispatch: 32 favors "
+                    "streaming granularity, 64/128 trade it for throughput "
+                    "on hosts with expensive device syncs")
     ap.add_argument("--draft-model", default=None,
                     help="'tiny' or a local HF checkpoint dir for a draft "
                          "model (same vocab as --model): turns on "
@@ -1384,7 +1388,7 @@ def main(argv: Optional[List[str]] = None) -> None:
         block_tokens=args.block_tokens, dtype=cfg.dtype,
     )
     engine = InferenceEngine(params, cfg, pc, prefill_chunk=args.prefill_chunk,
-                             **engine_fns)
+                             decode_chunk=args.decode_chunk, **engine_fns)
     draft_engine = None
     if args.draft_model is not None:
         # the draft proposes tokens the target verifies, so the vocabs must
