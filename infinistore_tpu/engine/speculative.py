@@ -65,9 +65,9 @@ _ROW_NEG1 = jax.jit(lambda l: l[-1])
 
 
 def _build_fused_rounds(target: InferenceEngine, draft: InferenceEngine,
-                        k: int, R: int):
+                        k: int, R: int, variant: str = "greedy"):
     """Compile ``R`` complete speculation rounds (draft k-token propose →
-    target verify → greedy accept → draft resync) into ONE dispatch.
+    target verify → accept/reject → draft resync) into ONE dispatch.
 
     The host speculation loop costs 2+ device syncs per round; on hardware
     where a sync that has to wait is expensive (tens of ms through a
@@ -76,6 +76,15 @@ def _build_fused_rounds(target: InferenceEngine, draft: InferenceEngine,
     rounds — the same batching trick as the decode scan, applied to the
     propose/verify/resync pipeline (VERDICT r3 weak #3: the decoder was
     host-looped).
+
+    ``variant``: "greedy" (accept while the draft matches the target's
+    argmax — output equals the target's greedy decode), or the stochastic
+    rejection-sampling modes "plain" / "filter" (the module-docstring
+    rule, with/without top-k/top-p truncation; identical math to
+    ``_spec_decide``, run inline).  Stochastic draws derive from a base
+    key folded with the token's ABSOLUTE position (draft samples) or the
+    round's accepted length (accept/resample draws), so a fixed key
+    reproduces its stream regardless of R bucketing or call boundaries.
 
     Device-side state per round: ``n`` (accepted length), a ``k+2``-token
     window of the newest accepted ids (enough to seed the next verify and
@@ -87,12 +96,14 @@ def _build_fused_rounds(target: InferenceEngine, draft: InferenceEngine,
     count); the host trims the overshoot exactly like the host loop does.
 
     Returns a jitted ``fn(t_params, d_params, t_cache, d_cache, t_table,
-    d_table, n0, win0, d_logits0) -> (outs [R, k+1], cnts [R], n_final,
-    t_logits, d_logits, t_cache, d_cache)`` with both caches donated.
+    d_table, n0, win0, d_logits0, key, temp, tk, tp) -> (outs [R, k+1],
+    cnts [R], n_final, t_logits, d_logits, t_cache, d_cache)`` with both
+    caches donated (key/temp/tk/tp are ignored under "greedy").
     """
+    assert variant in ("greedy", "plain", "filter"), variant
     key = ("spec_fused", target._decode_raw, draft._decode_raw,
            target._verify_jit, draft._verify_jit,
-           target.pc.block_tokens, k, R)
+           target.pc.block_tokens, k, R, variant)
     fn = _JIT_CACHE.get(key)
     if fn is not None:
         return fn
@@ -102,15 +113,42 @@ def _build_fused_rounds(target: InferenceEngine, draft: InferenceEngine,
     d_decode = draft._decode_raw
 
     def rounds(t_params, d_params, t_cache, d_cache, t_table, d_table,
-               n0, win0, d_logits0):
+               n0, win0, d_logits0, base_key, temp, tk, tp):
+        if variant != "greedy":
+            key_draft, key_acc = jax.random.split(base_key)
+
+        def trunc(logits):
+            """Post-truncation logits rows [S, V] — the same math as the
+            decode scan's pick(), so p and q match what plain decode
+            samples from."""
+            l = logits.astype(jnp.float32) / jnp.maximum(temp, 1e-6)
+            if variant == "filter":
+                S = l.shape[0]
+                l = _truncate_logits(
+                    l,
+                    jnp.full((S,), tk, jnp.int32),
+                    jnp.full((S,), tp, jnp.float32),
+                )
+            return l
+
         def round_body(carry, _):
             t_cache, d_cache, n, win, d_logits = carry
 
-            # 1. draft proposes k tokens greedily (inline scan)
+            # 1. draft proposes k tokens (inline scan): argmax under
+            # greedy, a categorical draw from its own post-truncation
+            # distribution q_i otherwise (collected for the accept test)
             def dstep(c, i):
                 d_cache, logits = c
-                tok = jnp.argmax(logits, -1).astype(jnp.int32)
                 pos = n + i
+                if variant == "greedy":
+                    tok = jnp.argmax(logits, -1).astype(jnp.int32)
+                    q_i = jnp.zeros((), jnp.float32)  # unused placeholder
+                else:
+                    l = trunc(logits[None])[0]
+                    tok = jax.random.categorical(
+                        jax.random.fold_in(key_draft, pos), l
+                    ).astype(jnp.int32)
+                    q_i = jax.nn.softmax(l)
                 blk = d_table[0, pos // T]
                 lg2, d_cache = d_decode(
                     d_params, tokens=tok[None], positions=pos[None],
@@ -118,9 +156,9 @@ def _build_fused_rounds(target: InferenceEngine, draft: InferenceEngine,
                     seq_lens=(pos + 1)[None], slot_block_ids=blk[None],
                     slot_ids=(pos % T)[None],
                 )
-                return (d_cache, lg2[0]), tok
+                return (d_cache, lg2[0]), (tok, q_i)
 
-            (d_cache, _), props = jax.lax.scan(
+            (d_cache, _), (props, qs) = jax.lax.scan(
                 dstep, (d_cache, d_logits), jnp.arange(k)
             )
 
@@ -133,17 +171,48 @@ def _build_fused_rounds(target: InferenceEngine, draft: InferenceEngine,
                 cache=t_cache, block_table=t_table,
                 slot_block_ids=blks[None], slot_ids=(poss % T)[None],
             )
-            choices = jnp.argmax(lgs[0], -1).astype(jnp.int32)  # [k+1]
 
-            # 3. greedy acceptance: longest agreeing prefix, then the
-            # target's own token
-            ok = props == choices[:k]
-            m = jnp.where(jnp.all(ok), k, jnp.argmin(ok))
-            e = jnp.where(
-                jnp.arange(k + 1) == m,
-                choices[m],
-                jnp.concatenate([props, props[-1:]]),
-            )
+            # 3. acceptance
+            if variant == "greedy":
+                choices = jnp.argmax(lgs[0], -1).astype(jnp.int32)  # [k+1]
+                ok = props == choices[:k]
+                m = jnp.where(jnp.all(ok), k, jnp.argmin(ok))
+                e = jnp.where(
+                    jnp.arange(k + 1) == m,
+                    choices[m],
+                    jnp.concatenate([props, props[-1:]]),
+                )
+            else:
+                # rejection sampling (the _spec_decide math, inline):
+                # accept x_i w.p. min(1, p_i(x_i)/q_i(x_i)); on the first
+                # rejection draw from norm(max(p_m - q_m, 0)); all-k
+                # accepted draws the bonus from p_{k+1} (q = 0 row)
+                p = jax.nn.softmax(trunc(lgs[0]), axis=-1)  # [k+1, V]
+                us = jax.random.uniform(
+                    jax.random.fold_in(key_acc, n), (k + 1,)
+                )
+                idx = jnp.arange(k)
+                px = p[idx, props]
+                qx = qs[idx, props]
+                acc = (qx > 0) & (us[:k] < jnp.minimum(1.0, px / qx))
+                all_acc = jnp.all(acc)
+                m = jnp.where(all_acc, k, jnp.argmin(acc))
+                pm = p[m]
+                qm = jnp.where(
+                    all_acc, jnp.zeros_like(pm), qs[jnp.minimum(m, k - 1)]
+                )
+                residual = jnp.maximum(pm - qm, 0.0)
+                dist = jnp.where(residual.sum() > 0, residual, pm)
+                cdf = jnp.cumsum(dist)
+                repl = jnp.clip(
+                    jnp.searchsorted(cdf, us[k] * cdf[-1], side="right"),
+                    0, dist.shape[0] - 1,
+                ).astype(jnp.int32)
+                e = jnp.where(
+                    jnp.arange(k + 1) == m,
+                    repl,
+                    jnp.concatenate([props, props[-1:]]),
+                )
             cnt = m + 1
             n2 = n + cnt
             # newest k+2 accepted ids: win ++ e[:cnt], last k+2 of them
@@ -293,8 +362,7 @@ class SpeculativeDecoder:
         sampling — see module docstring)."""
         assert sample in ("greedy", "categorical"), sample
         if (
-            sample == "greedy"
-            and self.fuse_rounds
+            self.fuse_rounds
             and self.target._has_verify
             and self.draft._has_verify
             and self.target.lora is None
@@ -302,7 +370,16 @@ class SpeculativeDecoder:
             and len(st_t.tokens) >= self.k + 2
             and st_t.tokens[-(self.k + 2):] == st_d.tokens[-(self.k + 2):]
         ):
-            return self._decode_fused(st_t, st_d, n_steps)
+            if sample == "greedy":
+                variant = "greedy"
+            else:
+                variant = "filter" if (top_k > 0 or top_p < 1.0) else "plain"
+            if rng is None and sample == "categorical":
+                self._rng, rng = _SPLIT2(self._rng)
+            return self._decode_fused(
+                st_t, st_d, n_steps, variant=variant,
+                temperature=temperature, top_k=top_k, top_p=top_p, rng=rng,
+            )
         if rng is None:
             self._rng, rng = _SPLIT2(self._rng)
         out: List[int] = []
@@ -346,13 +423,18 @@ class SpeculativeDecoder:
             st.block_ids.extend(eng.pages.acquire(need - len(st.block_ids)))
 
     def _decode_fused(self, st_t: SequenceState, st_d: SequenceState,
-                      n_steps: int) -> List[int]:
-        """Greedy speculation with whole rounds compiled on device: each
-        dispatch runs R rounds (R bucketed 1/2/4/8 to bound compiles) and
-        costs ONE host sync; the host loop only reconciles tokens and tops
-        up pages between dispatches."""
+                      n_steps: int, variant: str = "greedy",
+                      temperature: float = 1.0, top_k: int = 0,
+                      top_p: float = 1.0,
+                      rng: Optional[jax.Array] = None) -> List[int]:
+        """Speculation with whole rounds compiled on device (greedy or
+        stochastic — see _build_fused_rounds): each dispatch runs R rounds
+        and costs ONE host sync; the host loop only reconciles tokens and
+        tops up pages between dispatches."""
         k = self.k
         out: List[int] = []
+        if rng is None:
+            rng = jax.random.PRNGKey(0)  # unused under "greedy"
         def fits(eng: InferenceEngine, st: SequenceState, rounds: int) -> bool:
             T = eng.pc.block_tokens
             need = -(-(len(st.tokens) + rounds * (k + 1)) // T)
@@ -376,7 +458,7 @@ class SpeculativeDecoder:
             grow = R * (k + 1)
             self._acquire_for(self.target, st_t, grow)
             self._acquire_for(self.draft, st_d, grow)
-            fn = _build_fused_rounds(self.target, self.draft, k, R)
+            fn = _build_fused_rounds(self.target, self.draft, k, R, variant)
             outs, cnts, nF, t_lg, d_lg, t_cache, d_cache = fn(
                 self.target.params, self.draft.params,
                 self.target.cache, self.draft.cache,
@@ -385,6 +467,10 @@ class SpeculativeDecoder:
                 jnp.int32(len(st_t.tokens)),
                 jnp.asarray(st_t.tokens[-(k + 2):], jnp.int32),
                 st_d.last_logits,
+                rng,
+                jnp.float32(temperature),
+                jnp.int32(top_k),
+                jnp.float32(top_p),
             )
             self.target.cache = t_cache
             self.draft.cache = d_cache
