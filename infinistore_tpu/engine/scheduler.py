@@ -105,7 +105,8 @@ class Scheduler:
     def __init__(self, engine: InferenceEngine, max_batch: int = 8,
                  rng: Optional[jax.Array] = None,
                  draft_engine: Optional[InferenceEngine] = None,
-                 spec_k: int = 4, prefill_concurrency: int = 4):
+                 spec_k: int = 4, prefill_concurrency: int = 4,
+                 spec_batch: int = 1):
         self.engine = engine
         self.max_batch = max_batch
         self.pending: List[Request] = []
@@ -131,6 +132,12 @@ class Scheduler:
         # not scheduler
         self.draft = draft_engine
         self.spec = None
+        # speculation engages up to this many concurrent requests: 1 (the
+        # default) is the latency-bound fast path; >1 runs the rows in
+        # LOCKSTEP through the batched fused rounds
+        # (SpeculativeDecoder.decode_batch) when every active row is
+        # eligible and shares a sample mode
+        self.spec_batch = max(1, spec_batch)
         if draft_engine is not None:
             from .speculative import SpeculativeDecoder
 
@@ -448,6 +455,87 @@ class Scheduler:
         req.output.extend(toks)
         return True
 
+    def _spec_step_batch(self, reqs: List[Request], chunk: int) -> bool:
+        """Decode ``chunk`` tokens for up to ``spec_batch`` requests in
+        lockstep through the batched fused speculation rounds.  Returns
+        False when the fast path couldn't run this step (any row opted
+        out, too short for the fused window, or draft pages unavailable) —
+        the caller falls back to lockstep decode; partial progress is
+        reconciled from ``state.tokens`` as usual."""
+        if len(reqs) == 1:
+            # the single-request path keeps its host-loop fallback for
+            # prompts shorter than the fused window
+            return self._spec_step(reqs[0], chunk)
+        sp = self.spec
+        k = sp.k
+        # decode_batch has no host-loop fallback, so every graceful-
+        # fallback condition the single-row path checks inside decode()
+        # must be checked HERE (an ineligible config reaching decode_batch
+        # would assert and take the scheduler loop down)
+        if not (sp.fuse_rounds and sp.target._has_verify
+                and sp.draft._has_verify and sp.target.lora is None
+                and sp.draft.lora is None):
+            return False
+        if any(r._spec_off or len(r.state.tokens) < k + 2 for r in reqs):
+            return False
+        for r in reqs:
+            self.engine._reclaim_window_pages(r.state)
+        # a lockstep step in between (e.g. a round with draft pages
+        # unavailable) advances the target without the draft: those rows'
+        # drafts are stale and need a re-prefill.  Check that EVERY needed
+        # prefill fits before doing ANY of them — prefilling row by row
+        # would burn a full draft prefill per eligible row per step when
+        # one row can never fit (the thrash _draft_state_for warns about).
+        T = self.draft.pc.block_tokens
+        stale = [
+            r._draft_state is not None
+            and r._draft_state.tokens[-(k + 2):]
+            != r.state.tokens[-(k + 2):]
+            for r in reqs
+        ]
+        need = sum(
+            -(-(len(r.state.tokens) + k + 1) // T)
+            for r, s in zip(reqs, stale)
+            if s or r._draft_state is None
+        )
+        freed = sum(
+            len(r._draft_state.block_ids)
+            for r, s in zip(reqs, stale) if s
+        )
+        if need > self.draft.free_pages + freed:
+            return False
+        st_ds = []
+        for r, s in zip(reqs, stale):
+            if s:
+                self._drop_draft(r)
+            st_d = self._draft_state_for(r)
+            if st_d is None:
+                return False
+            st_ds.append(st_d)
+        self._rng, sub = _SPLIT2(self._rng)
+        try:
+            outs = self.spec.decode_batch(
+                [r.state for r in reqs], st_ds, chunk,
+                sample=reqs[0].sample,
+                temperature=[r.temperature for r in reqs],
+                top_k=[r.top_k for r in reqs],
+                top_p=[r.top_p for r in reqs],
+                rng=sub,
+            )
+        except MemoryError:
+            # an allocator ran dry: every row's state is decode-ready
+            # (the batched wrapper reconciles after each dispatch and
+            # acquires BEFORE the next); reconcile outputs and run these
+            # requests on the lockstep path from now on
+            for r in reqs:
+                r.output = list(r.state.tokens[len(r.tokens):])
+                self._drop_draft(r)
+                r._spec_off = True
+            return False
+        for r, toks in zip(reqs, outs):
+            r.output.extend(toks)
+        return True
+
     def step(self) -> List[Request]:
         """Admit, advance each in-flight chunked prefill by one chunk,
         decode one chunk for the whole batch, retire.  Returns the requests
@@ -484,19 +572,27 @@ class Scheduler:
         while chunk < shortest and chunk < self.engine.decode_chunk:
             chunk *= 2
         chunk = min(chunk, self.engine.decode_chunk)
-        if self.spec is not None and len(self.active) != 1:
-            # batch grew: speculation off, draft pages back to the pool
+        if self.spec is not None and len(self.active) > self.spec_batch:
+            # batch grew past the speculation window: draft pages back to
+            # the pool; lockstep decode already fills the MXU at depth
             for r in self.active:
                 self._drop_draft(r)
-        elif (self.spec is not None and self.active[0].adapter_id == 0
-                and self.active[0].logprobs == 0  # spec emits no logprobs
-                and not self._penalized(self.active[0])  # no penalty math
-                and self.active[0].seed is None  # spec has its own stream
-                and self._spec_step(self.active[0], chunk)):
-            # speculation pays exactly when the chip is latency-bound (one
-            # request in flight); with a batch, lockstep decode already
-            # fills the MXU.  LoRA requests take the lockstep path (the
-            # draft carries no adapters).
+        elif (self.spec is not None
+                and all(
+                    r.adapter_id == 0       # the draft carries no adapters
+                    and r.logprobs == 0     # spec emits no logprobs
+                    and not self._penalized(r)   # no penalty math
+                    and r.seed is None      # spec has its own stream
+                    for r in self.active
+                )
+                # the fused rounds are one compiled program: every row
+                # must share the sample mode (temps/top-k/top-p ride as
+                # per-row vectors)
+                and len({r.sample for r in self.active}) == 1
+                and self._spec_step_batch(self.active, chunk)):
+            # speculation pays when the chip is latency-bound: batch=1 by
+            # default; spec_batch > 1 runs a small batch in lockstep
+            # through the batched fused rounds (decode_batch)
             return cancelled_prefill + self._retire()
         self._rng, sub = _SPLIT2(self._rng)
         # any row asking for logprobs switches the batch to the collecting

@@ -56,7 +56,9 @@ from .engine import (
     _JIT_CACHE,
     _SPLIT2,
     _SPLIT3,
+    _STACK_ROWS,
     _truncate_logits,
+    _UNSTACK_ROWS,
     InferenceEngine,
     SequenceState,
 )
@@ -95,10 +97,13 @@ def _build_fused_rounds(target: InferenceEngine, draft: InferenceEngine,
     Rounds after the budget is met still execute (a scan has a fixed trip
     count); the host trims the overshoot exactly like the host loop does.
 
-    Returns a jitted ``fn(t_params, d_params, t_cache, d_cache, t_table,
-    d_table, n0, win0, d_logits0, key, temp, tk, tp) -> (outs [R, k+1],
-    cnts [R], n_final, t_logits, d_logits, t_cache, d_cache)`` with both
-    caches donated (key/temp/tk/tp are ignored under "greedy").
+    Returns a jitted ``fn(t_params, d_params, t_cache, d_cache,
+    t_table [B, W], d_table [B, W], n0 [B], win0 [B, k+2],
+    d_logits0 [B, V], key, temp [B], tk [B], tp [B]) ->
+    (outs [R, B, k+1], cnts [R, B], n_final [B], t_logits [B, V],
+    d_logits [B, V], t_cache, d_cache)`` with both caches donated
+    (key/temp/tk/tp are ignored under "greedy").  B is the lockstep
+    speculation batch; the program re-specializes per (B, table width).
     """
     assert variant in ("greedy", "plain", "filter"), variant
     key = ("spec_fused", target._decode_raw, draft._decode_raw,
@@ -114,110 +119,148 @@ def _build_fused_rounds(target: InferenceEngine, draft: InferenceEngine,
 
     def rounds(t_params, d_params, t_cache, d_cache, t_table, d_table,
                n0, win0, d_logits0, base_key, temp, tk, tp):
+        # Everything is BATCHED over B rows in lockstep: n/win/d_logits
+        # carry a leading [B]; the draft/verify forwards are the engines'
+        # ordinary batched steps; acceptance runs per row.  temp/tk/tp are
+        # per-row [B] vectors (ignored under "greedy").
+        B = win0.shape[0]
         if variant != "greedy":
             key_draft, key_acc = jax.random.split(base_key)
+            row_keys_d = jax.random.split(key_draft, B)
+            row_keys_a = jax.random.split(key_acc, B)
 
-        def trunc(logits):
-            """Post-truncation logits rows [S, V] — the same math as the
-            decode scan's pick(), so p and q match what plain decode
-            samples from."""
-            l = logits.astype(jnp.float32) / jnp.maximum(temp, 1e-6)
+        def trunc(logits, temp_r, tk_r, tp_r):
+            """Post-truncation logits rows [S, V] with per-row params —
+            the same math as the decode scan's pick(), so p and q match
+            what plain decode samples from."""
+            l = logits.astype(jnp.float32) / jnp.maximum(temp_r, 1e-6)[:, None]
             if variant == "filter":
-                S = l.shape[0]
-                l = _truncate_logits(
-                    l,
-                    jnp.full((S,), tk, jnp.int32),
-                    jnp.full((S,), tp, jnp.float32),
-                )
+                l = _truncate_logits(l, tk_r, tp_r)
             return l
+
+        def row_gather(table, idx):
+            # table [B, W], idx [B, S] -> [B, S]
+            return jnp.take_along_axis(table, idx, axis=1)
 
         def round_body(carry, _):
             t_cache, d_cache, n, win, d_logits = carry
 
-            # 1. draft proposes k tokens (inline scan): argmax under
-            # greedy, a categorical draw from its own post-truncation
-            # distribution q_i otherwise (collected for the accept test)
+            # 1. draft proposes k tokens per row (inline scan): argmax
+            # under greedy, a categorical draw from its own post-
+            # truncation distribution q_i otherwise (collected for the
+            # accept test)
             def dstep(c, i):
-                d_cache, logits = c
-                pos = n + i
+                d_cache, logits = c  # logits [B, V]
+                pos = n + i  # [B]
                 if variant == "greedy":
                     tok = jnp.argmax(logits, -1).astype(jnp.int32)
-                    q_i = jnp.zeros((), jnp.float32)  # unused placeholder
+                    q_i = jnp.zeros((B,), jnp.float32)  # placeholder
                 else:
-                    l = trunc(logits[None])[0]
-                    tok = jax.random.categorical(
-                        jax.random.fold_in(key_draft, pos), l
-                    ).astype(jnp.int32)
-                    q_i = jax.nn.softmax(l)
-                blk = d_table[0, pos // T]
+                    l = trunc(logits, temp, tk, tp)
+                    subs = jax.vmap(jax.random.fold_in)(row_keys_d, pos)
+                    tok = jax.vmap(jax.random.categorical)(subs, l).astype(
+                        jnp.int32
+                    )
+                    q_i = jax.nn.softmax(l, axis=-1)  # [B, V]
+                blk = row_gather(d_table, (pos // T)[:, None])[:, 0]
                 lg2, d_cache = d_decode(
-                    d_params, tokens=tok[None], positions=pos[None],
+                    d_params, tokens=tok, positions=pos,
                     cache=d_cache, block_table=d_table,
-                    seq_lens=(pos + 1)[None], slot_block_ids=blk[None],
-                    slot_ids=(pos % T)[None],
+                    seq_lens=pos + 1, slot_block_ids=blk,
+                    slot_ids=pos % T,
                 )
-                return (d_cache, lg2[0]), (tok, q_i)
+                return (d_cache, lg2), (tok, q_i)
 
-            (d_cache, _), (props, qs) = jax.lax.scan(
+            (d_cache, _), (props_kb, qs_kb) = jax.lax.scan(
                 dstep, (d_cache, d_logits), jnp.arange(k)
             )
+            props = jnp.transpose(props_kb)  # [B, k]
 
-            # 2. target scores [prev, p_1..p_k] in one verify forward
-            run = jnp.concatenate([win[-1:], props])
-            poss = n - 1 + jnp.arange(k + 1)
-            blks = t_table[0, poss // T]
+            # 2. target scores [prev, p_1..p_k] per row in one verify
+            run = jnp.concatenate([win[:, -1:], props], axis=1)  # [B, k+1]
+            poss = n[:, None] - 1 + jnp.arange(k + 1)[None]  # [B, k+1]
+            blks = row_gather(t_table, poss // T)
             lgs, t_cache = t_verify(
-                t_params, tokens=run[None], positions=poss[None],
+                t_params, tokens=run, positions=poss,
                 cache=t_cache, block_table=t_table,
-                slot_block_ids=blks[None], slot_ids=(poss % T)[None],
-            )
+                slot_block_ids=blks, slot_ids=poss % T,
+            )  # lgs [B, k+1, V]
 
-            # 3. acceptance
+            # 3. acceptance, per row
+            tail = jnp.concatenate([props, props[:, -1:]], axis=1)
             if variant == "greedy":
-                choices = jnp.argmax(lgs[0], -1).astype(jnp.int32)  # [k+1]
-                ok = props == choices[:k]
-                m = jnp.where(jnp.all(ok), k, jnp.argmin(ok))
+                choices = jnp.argmax(lgs, -1).astype(jnp.int32)  # [B, k+1]
+                ok = props == choices[:, :k]
+                m = jnp.where(
+                    jnp.all(ok, axis=1), k, jnp.argmin(ok, axis=1)
+                )  # [B]
+                picked = jnp.take_along_axis(
+                    choices, m[:, None], axis=1
+                )[:, 0]
                 e = jnp.where(
-                    jnp.arange(k + 1) == m,
-                    choices[m],
-                    jnp.concatenate([props, props[-1:]]),
+                    jnp.arange(k + 1)[None] == m[:, None],
+                    picked[:, None], tail,
                 )
             else:
-                # rejection sampling (the _spec_decide math, inline):
+                # rejection sampling (the _spec_decide math, per row):
                 # accept x_i w.p. min(1, p_i(x_i)/q_i(x_i)); on the first
                 # rejection draw from norm(max(p_m - q_m, 0)); all-k
                 # accepted draws the bonus from p_{k+1} (q = 0 row)
-                p = jax.nn.softmax(trunc(lgs[0]), axis=-1)  # [k+1, V]
-                us = jax.random.uniform(
-                    jax.random.fold_in(key_acc, n), (k + 1,)
-                )
-                idx = jnp.arange(k)
-                px = p[idx, props]
-                qx = qs[idx, props]
-                acc = (qx > 0) & (us[:k] < jnp.minimum(1.0, px / qx))
-                all_acc = jnp.all(acc)
-                m = jnp.where(all_acc, k, jnp.argmin(acc))
-                pm = p[m]
+                V = lgs.shape[-1]
+                p = jax.nn.softmax(
+                    trunc(
+                        lgs.reshape(B * (k + 1), V),
+                        jnp.repeat(temp, k + 1),
+                        jnp.repeat(tk, k + 1),
+                        jnp.repeat(tp, k + 1),
+                    ),
+                    axis=-1,
+                ).reshape(B, k + 1, V)
+                qs = jnp.transpose(qs_kb, (1, 0, 2))  # [B, k, V]
+                us = jax.vmap(
+                    lambda kb, nb: jax.random.uniform(
+                        jax.random.fold_in(kb, nb), (k + 1,)
+                    )
+                )(row_keys_a, n)  # [B, k+1]
+                px = jnp.take_along_axis(
+                    p[:, :k], props[..., None], axis=2
+                )[..., 0]  # [B, k]
+                qx = jnp.take_along_axis(
+                    qs, props[..., None], axis=2
+                )[..., 0]
+                acc = (qx > 0) & (us[:, :k] < jnp.minimum(1.0, px / qx))
+                all_acc = jnp.all(acc, axis=1)  # [B]
+                m = jnp.where(all_acc, k, jnp.argmin(acc, axis=1))
+                pm = jnp.take_along_axis(
+                    p, m[:, None, None], axis=1
+                )[:, 0]  # [B, V]
                 qm = jnp.where(
-                    all_acc, jnp.zeros_like(pm), qs[jnp.minimum(m, k - 1)]
+                    all_acc[:, None],
+                    jnp.zeros_like(pm),
+                    jnp.take_along_axis(
+                        qs, jnp.minimum(m, k - 1)[:, None, None], axis=1
+                    )[:, 0],
                 )
                 residual = jnp.maximum(pm - qm, 0.0)
-                dist = jnp.where(residual.sum() > 0, residual, pm)
-                cdf = jnp.cumsum(dist)
+                dist = jnp.where(
+                    residual.sum(axis=1, keepdims=True) > 0, residual, pm
+                )
+                cdf = jnp.cumsum(dist, axis=1)
+                r = us[:, k] * cdf[:, -1]
                 repl = jnp.clip(
-                    jnp.searchsorted(cdf, us[k] * cdf[-1], side="right"),
-                    0, dist.shape[0] - 1,
+                    jnp.sum(cdf <= r[:, None], axis=1), 0, dist.shape[1] - 1
                 ).astype(jnp.int32)
                 e = jnp.where(
-                    jnp.arange(k + 1) == m,
-                    repl,
-                    jnp.concatenate([props, props[-1:]]),
+                    jnp.arange(k + 1)[None] == m[:, None],
+                    repl[:, None], tail,
                 )
-            cnt = m + 1
+            cnt = m + 1  # [B]
             n2 = n + cnt
-            # newest k+2 accepted ids: win ++ e[:cnt], last k+2 of them
-            allw = jnp.concatenate([win, e])
-            win2 = jax.lax.dynamic_slice(allw, (cnt,), (k + 2,))
+            # newest k+2 accepted ids per row: win ++ e[:cnt], last k+2
+            allw = jnp.concatenate([win, e], axis=1)  # [B, 2k+3]
+            win2 = jnp.take_along_axis(
+                allw, cnt[:, None] + jnp.arange(k + 2)[None], axis=1
+            )
 
             # 4. draft resync: re-verify the last k+1 accepted tokens.
             # Fixed width on purpose — a lax.cond width-1 fast branch for
@@ -225,30 +268,31 @@ def _build_fused_rounds(target: InferenceEngine, draft: InferenceEngine,
             # MEASURED SLOWER here: branching on the carried paged cache
             # makes XLA materialize cache copies that dwarf the saved
             # forward.  Rewriting already-correct slots is harmless.
-            poss_d = n2 - 1 - k + jnp.arange(k + 1)
-            blks_d = d_table[0, poss_d // T]
+            poss_d = n2[:, None] - 1 - k + jnp.arange(k + 1)[None]
+            blks_d = row_gather(d_table, poss_d // T)
             dlgs, d_cache = d_verify(
-                d_params, tokens=win2[1:][None], positions=poss_d[None],
+                d_params, tokens=win2[:, 1:], positions=poss_d,
                 cache=d_cache, block_table=d_table,
-                slot_block_ids=blks_d[None], slot_ids=(poss_d % T)[None],
+                slot_block_ids=blks_d, slot_ids=poss_d % T,
             )
-            return (t_cache, d_cache, n2, win2, dlgs[0, -1]), (e, cnt)
+            return (t_cache, d_cache, n2, win2, dlgs[:, -1]), (e, cnt)
 
         carry0 = (t_cache, d_cache, n0, win0, d_logits0)
         (t_cache, d_cache, nF, winF, d_logitsF), (outs, cnts) = jax.lax.scan(
             round_body, carry0, None, length=R
         )
-        # leave the target decode-ready: logits after the last accepted
-        # token (its KV slot is rewritten in place — same contract as the
-        # host loop's final re-verify, but inside the same dispatch)
-        posF = nF - 1
+        # leave the target decode-ready: logits after each row's last
+        # accepted token (its KV slot is rewritten in place — same
+        # contract as the host loop's final re-verify, but inside the
+        # same dispatch)
+        posF = nF[:, None] - 1  # [B, 1]
         lgT, t_cache = t_verify(
-            t_params, tokens=winF[-1:][None], positions=posF[None][None],
+            t_params, tokens=winF[:, -1:], positions=posF,
             cache=t_cache, block_table=t_table,
-            slot_block_ids=t_table[0, posF // T][None][None],
-            slot_ids=(posF % T)[None][None],
+            slot_block_ids=row_gather(t_table, posF // T),
+            slot_ids=posF % T,
         )
-        return outs, cnts, nF, lgT[0, -1], d_logitsF, t_cache, d_cache
+        return outs, cnts, nF, lgT[:, -1], d_logitsF, t_cache, d_cache
 
     fn = jax.jit(rounds, donate_argnums=(2, 3))
     _JIT_CACHE[key] = fn
@@ -427,76 +471,150 @@ class SpeculativeDecoder:
                       temperature: float = 1.0, top_k: int = 0,
                       top_p: float = 1.0,
                       rng: Optional[jax.Array] = None) -> List[int]:
+        return self._decode_fused_batch(
+            [st_t], [st_d], n_steps, variant=variant,
+            temperature=temperature, top_k=top_k, top_p=top_p, rng=rng,
+        )[0]
+
+    def decode_batch(
+        self,
+        st_ts: List[SequenceState],
+        st_ds: List[SequenceState],
+        n_steps: int,
+        sample: str = "greedy",
+        temperature=1.0,
+        top_k=0,
+        top_p=1.0,
+        rng: Optional[jax.Array] = None,
+    ) -> List[List[int]]:
+        """Batched speculation: every row runs the fused propose/verify/
+        accept/resync rounds in LOCKSTEP (one dispatch covers all rows'
+        rounds), emitting exactly ``n_steps`` tokens per row.  Rows may
+        have different lengths and (in categorical mode) different
+        per-row temperature/top_k/top_p; ``sample`` is batch-wide.
+        Requires fused eligibility for every row (verify-capable engines,
+        no LoRA, len(tokens) >= k+2, draft in sync) — the host round loop
+        has no batched form, so this raises otherwise."""
+        assert sample in ("greedy", "categorical"), sample
+        assert len(st_ts) == len(st_ds) and st_ts, (len(st_ts), len(st_ds))
+        for st_t, st_d in zip(st_ts, st_ds):
+            assert len(st_t.tokens) >= self.k + 2, (
+                "batched speculation needs prompts of at least k+2 tokens"
+            )
+            assert (
+                st_t.tokens[-(self.k + 2):] == st_d.tokens[-(self.k + 2):]
+            ), "draft state out of sync with target"
+        assert self.target._has_verify and self.draft._has_verify
+        assert self.target.lora is None and self.draft.lora is None
+        if sample == "greedy":
+            variant = "greedy"
+        else:
+            tk_any = np.any(np.asarray(top_k) > 0)
+            tp_any = np.any(np.asarray(top_p) < 1.0)
+            variant = "filter" if (tk_any or tp_any) else "plain"
+            if rng is None:
+                self._rng, rng = _SPLIT2(self._rng)
+        return self._decode_fused_batch(
+            st_ts, st_ds, n_steps, variant=variant, temperature=temperature,
+            top_k=top_k, top_p=top_p, rng=rng,
+        )
+
+    def _decode_fused_batch(
+        self, st_ts: List[SequenceState], st_ds: List[SequenceState],
+        n_steps: int, variant: str = "greedy", temperature=1.0,
+        top_k=0, top_p=1.0, rng: Optional[jax.Array] = None,
+    ) -> List[List[int]]:
         """Speculation with whole rounds compiled on device (greedy or
-        stochastic — see _build_fused_rounds): each dispatch runs R rounds
-        and costs ONE host sync; the host loop only reconciles tokens and
-        tops up pages between dispatches."""
+        stochastic — see _build_fused_rounds), batched over rows in
+        lockstep: each dispatch runs R rounds for every row and costs ONE
+        host sync; the host loop only reconciles tokens and tops up pages
+        between dispatches.  Rows keep generating until the SLOWEST row
+        meets the budget (faster rows' overshoot is trimmed, same as the
+        host loop's)."""
         k = self.k
-        out: List[int] = []
+        B = len(st_ts)
+        outs_h: List[List[int]] = [[] for _ in range(B)]
         if rng is None:
             rng = jax.random.PRNGKey(0)  # unused under "greedy"
-        def fits(eng: InferenceEngine, st: SequenceState, rounds: int) -> bool:
-            T = eng.pc.block_tokens
-            need = -(-(len(st.tokens) + rounds * (k + 1)) // T)
-            return need - len(st.block_ids) <= eng.free_pages
+        temp_v = InferenceEngine._per_row(temperature, B, np.float32)
+        tk_v = InferenceEngine._per_row(top_k, B, np.int32)
+        tp_v = InferenceEngine._per_row(top_p, B, np.float32)
 
-        while len(out) < n_steps:
+        def fits(eng: InferenceEngine, sts: List[SequenceState],
+                 rounds: int) -> bool:
+            T = eng.pc.block_tokens
+            short = 0
+            for st in sts:
+                need = -(-(len(st.tokens) + rounds * (k + 1)) // T)
+                short += max(0, need - len(st.block_ids))
+            return short <= eng.free_pages
+
+        while min(len(o) for o in outs_h) < n_steps:
             # TWO round-count buckets only ({8, 2}): each fused program
             # inlines dozens of forwards, so every extra R bucket is a
             # large compile; 8 is the steady-state program, 2 keeps tail
             # calls from overshooting ~a full dispatch of work (rounds
             # past the budget execute and get trimmed, like the host
             # loop's overshoot).  Degrades below 2 only when a pool can't
-            # hold the rounds' growth (R=1 that still doesn't fit raises
+            # hold every row's growth (R=1 that still doesn't fit raises
             # out of the acquire below — the host loop's "round can't
             # fit" contract).
-            remaining = n_steps - len(out)
+            remaining = n_steps - min(len(o) for o in outs_h)
             R = 8 if remaining > 2 * (k + 1) else 2
-            while R > 1 and not (fits(self.target, st_t, R)
-                                 and fits(self.draft, st_d, R)):
+            while R > 1 and not (fits(self.target, st_ts, R)
+                                 and fits(self.draft, st_ds, R)):
                 R //= 2
             grow = R * (k + 1)
-            self._acquire_for(self.target, st_t, grow)
-            self._acquire_for(self.draft, st_d, grow)
+            for st in st_ts:
+                self._acquire_for(self.target, st, grow)
+            for st in st_ds:
+                self._acquire_for(self.draft, st, grow)
             fn = _build_fused_rounds(self.target, self.draft, k, R, variant)
             outs, cnts, nF, t_lg, d_lg, t_cache, d_cache = fn(
                 self.target.params, self.draft.params,
                 self.target.cache, self.draft.cache,
-                self.target._block_table([st_t]),
-                self.draft._block_table([st_d]),
-                jnp.int32(len(st_t.tokens)),
-                jnp.asarray(st_t.tokens[-(k + 2):], jnp.int32),
-                st_d.last_logits,
+                self.target._block_table(st_ts),
+                self.draft._block_table(st_ds),
+                jnp.asarray([len(st.tokens) for st in st_ts], jnp.int32),
+                jnp.asarray(
+                    [st.tokens[-(k + 2):] for st in st_ts], jnp.int32
+                ),
+                _STACK_ROWS(*[st.last_logits for st in st_ds]),
                 rng,
-                jnp.float32(temperature),
-                jnp.int32(top_k),
-                jnp.float32(top_p),
+                jnp.asarray(temp_v),
+                jnp.asarray(tk_v),
+                jnp.asarray(tp_v),
             )
             self.target.cache = t_cache
             self.draft.cache = d_cache
-            h_outs = np.asarray(outs)   # [R, k+1]; the call's one sync
-            h_cnts = np.asarray(cnts)   # [R]
-            new_toks: List[int] = []
-            for r in range(R):
-                cnt = int(h_cnts[r])
-                new_toks.extend(int(t) for t in h_outs[r, :cnt])
-                self.rounds += 1
-                self.proposed += k
-                self.accepted += cnt - 1
-            out.extend(new_toks)
-            st_t.tokens.extend(new_toks)
-            st_d.tokens = list(st_t.tokens)
-            st_t.last_logits = t_lg
-            st_d.last_logits = d_lg
-        excess = len(out) - n_steps
-        if excess:
-            del out[n_steps:]
-            del st_t.tokens[-excess:]
-            self._resync_draft(st_d, list(st_t.tokens))
-            st_t.last_logits = _ROW_NEG1(self.target.verify(
-                st_t, [st_t.tokens[-1]], len(st_t.tokens) - 1
-            ))
-        return out
+            t_rows = _UNSTACK_ROWS(t_lg)
+            d_rows = _UNSTACK_ROWS(d_lg)
+            h_outs = np.asarray(outs)   # [R, B, k+1]; the call's one sync
+            h_cnts = np.asarray(cnts)   # [R, B]
+            for b in range(B):
+                new_toks: List[int] = []
+                for r in range(R):
+                    cnt = int(h_cnts[r, b])
+                    new_toks.extend(int(t) for t in h_outs[r, b, :cnt])
+                outs_h[b].extend(new_toks)
+                st_ts[b].tokens.extend(new_toks)
+                st_ds[b].tokens = list(st_ts[b].tokens)
+                st_ts[b].last_logits = t_rows[b]
+                st_ds[b].last_logits = d_rows[b]
+            self.rounds += R * B
+            self.proposed += R * B * k
+            self.accepted += int(h_cnts.sum()) - R * B
+        for b in range(B):
+            excess = len(outs_h[b]) - n_steps
+            if excess:
+                del outs_h[b][n_steps:]
+                del st_ts[b].tokens[-excess:]
+                self._resync_draft(st_ds[b], list(st_ts[b].tokens))
+                st_ts[b].last_logits = _ROW_NEG1(self.target.verify(
+                    st_ts[b], [st_ts[b].tokens[-1]],
+                    len(st_ts[b].tokens) - 1,
+                ))
+        return outs_h
 
     def _rounds(self, st_t, st_d, n_steps, sample, temperature, top_k,
                 top_p, rng) -> List[int]:

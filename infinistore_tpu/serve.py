@@ -59,7 +59,7 @@ class ServingServer:
     def __init__(self, engine, host: str = "127.0.0.1", port: int = 8000,
                  max_batch: int = 8, model_id: str = "infinistore-tpu",
                  tokenizer=None, draft_engine=None, spec_k: int = 4,
-                 max_queue: Optional[int] = None):
+                 max_queue: Optional[int] = None, spec_batch: int = 1):
         """``tokenizer``: any object with ``encode(str) -> [int]`` and
         ``decode([int]) -> str`` (an HF tokenizer qualifies) — enables
         string prompts, text responses, and string stop sequences.
@@ -74,7 +74,8 @@ class ServingServer:
         # bound (None = unbounded)
         self.max_queue = max_queue
         self.sched = Scheduler(engine, max_batch=max_batch,
-                               draft_engine=draft_engine, spec_k=spec_k)
+                               draft_engine=draft_engine, spec_k=spec_k,
+                               spec_batch=spec_batch)
         self._cv = threading.Condition()
         self._staged: List[Dict[str, Any]] = []   # submissions from handlers
         self._cancels: List[int] = []
@@ -1321,6 +1322,10 @@ def main(argv: Optional[List[str]] = None) -> None:
                     help="draft KV pages (default: --n-blocks)")
     ap.add_argument("--spec-k", type=int, default=4,
                     help="draft tokens proposed per speculative round")
+    ap.add_argument("--spec-batch", type=int, default=1,
+                    help="speculate with up to this many concurrent "
+                    "requests in lockstep (batched fused rounds); 1 = the "
+                    "latency-bound fast path only")
     ap.add_argument("--log-level", default="info")
     args = ap.parse_args(argv)
     Logger.set_log_level(args.log_level)
@@ -1410,7 +1415,8 @@ def main(argv: Optional[List[str]] = None) -> None:
     srv = ServingServer(engine, host=args.host, port=args.port,
                         max_batch=args.max_batch, model_id=model_id,
                         tokenizer=tokenizer, draft_engine=draft_engine,
-                        spec_k=args.spec_k, max_queue=args.max_queue)
+                        spec_k=args.spec_k, max_queue=args.max_queue,
+                        spec_batch=args.spec_batch)
     srv.start()
     try:
         while True:

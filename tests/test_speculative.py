@@ -89,6 +89,67 @@ def test_fused_rounds_used_and_match_host_loop():
     assert host.generate(PROMPT, 24) == got_fused
 
 
+def test_batched_fused_rounds_match_per_row_greedy():
+    """decode_batch: B rows of different lengths run the fused rounds in
+    lockstep; every row's output must equal the target's own greedy
+    decode of that prompt."""
+    prompts = [PROMPT, PROMPT[:7], list(PROMPT) + [29, 31, 37]]
+    wants = []
+    ref = make_engine(TARGET_PARAMS, CFG)
+    for p in prompts:
+        wants.append(ref.generate(p, 18))
+
+    spec = SpeculativeDecoder(
+        make_engine(TARGET_PARAMS, CFG),
+        make_engine(DRAFT_PARAMS, DRAFT_CFG),
+        k=4,
+    )
+    st_ts, st_ds = zip(*[spec.prefill(p) for p in prompts])
+    outs = spec.decode_batch(list(st_ts), list(st_ds), 18)
+    assert outs == wants
+    assert spec.rounds >= 3  # every row's rounds counted
+
+
+def test_scheduler_spec_batch_matches_plain():
+    """Scheduler(spec_batch=3): three concurrent greedy requests ride the
+    batched fused rounds and must produce exactly the lockstep
+    scheduler's outputs; acceptance counters advance."""
+    sched = Scheduler(
+        make_engine(TARGET_PARAMS, CFG),
+        draft_engine=make_engine(DRAFT_PARAMS, DRAFT_CFG),
+        spec_k=4, spec_batch=3,
+    )
+    prompts = [PROMPT, PROMPT[:8], list(PROMPT) + [41, 43]]
+    rids = [sched.submit(p, max_new_tokens=16) for p in prompts]
+    got = sched.run()
+
+    plain = Scheduler(make_engine(TARGET_PARAMS, CFG))
+    prids = [plain.submit(p, max_new_tokens=16) for p in prompts]
+    want = plain.run()
+    assert [got[r] for r in rids] == [want[r] for r in prids]
+    assert sched.spec.rounds >= 1
+
+
+def test_scheduler_spec_batch_ineligible_falls_back():
+    """spec_batch > 1 with a decoder that can't fuse (fuse_rounds off)
+    must fall back to lockstep decode, not crash the scheduler loop —
+    decode_batch asserts its preconditions, so the gate must catch them."""
+    sched = Scheduler(
+        make_engine(TARGET_PARAMS, CFG),
+        draft_engine=make_engine(DRAFT_PARAMS, DRAFT_CFG),
+        spec_k=4, spec_batch=2,
+    )
+    sched.spec.fuse_rounds = False
+    prompts = [PROMPT, PROMPT[:8]]
+    rids = [sched.submit(p, max_new_tokens=8) for p in prompts]
+    got = sched.run()
+
+    plain = Scheduler(make_engine(TARGET_PARAMS, CFG))
+    prids = [plain.submit(p, max_new_tokens=8) for p in prompts]
+    want = plain.run()
+    assert [got[r] for r in rids] == [want[r] for r in prids]
+
+
 def test_speculative_self_draft_accepts_everything():
     """Draft == target: every proposal must be accepted (acceptance rate 1)
     and each round must emit k+1 tokens."""
