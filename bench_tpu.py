@@ -545,10 +545,10 @@ def leg_prefill_stream(out: dict) -> None:
     S, C = 1024, 256  # chunked prefill: 4 chunks, 3 of them streamed
     rng = np.random.RandomState(0)
 
-    def run(conn):
+    def run(conn, quant=None):
         eng = InferenceEngine(
-            params, cfg, epc, conn=conn, model_id=f"bench-{id(conn)}",
-            prefill_chunk=C,
+            params, cfg, epc, conn=conn, model_id=f"bench-{id(conn)}-{quant}",
+            prefill_chunk=C, kv_quant=quant,
         )
         prompt = [int(x) for x in rng.randint(1, cfg.vocab_size, size=S)]
         st = eng.prefill(prompt)  # compile
@@ -587,6 +587,9 @@ def leg_prefill_stream(out: dict) -> None:
         ))
         conn.connect()
         t_attached = run(conn)
+        # int8 page quantization halves the D2H + pool bytes; on transfer-
+        # bound links (this tunnel: ~16 MB/s D2H) the saving shows directly
+        t_attached_q8 = run(conn, quant="int8")
         conn.close()
     finally:
         proc.terminate()
@@ -597,6 +600,7 @@ def leg_prefill_stream(out: dict) -> None:
             proc.wait(timeout=10)
 
     out["prefill_ms_detached"] = round(t_detached * 1e3, 1)
+    out["prefill_ms_store_attached_q8"] = round(t_attached_q8 * 1e3, 1)
     out["prefill_ms_store_attached"] = round(t_attached * 1e3, 1)
     out["prefill_store_overhead"] = round(t_attached / t_detached, 3)
 
