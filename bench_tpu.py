@@ -332,14 +332,38 @@ def leg_serving(out: dict) -> None:
     submit_all(warm)
     warm.run()
     sched = mk_sched()
-    total = submit_all(sched)
+    t_submit: dict = {}
+    t_first: dict = {}
+
+    def mk_on_token(slot):
+        # called at chunk granularity; the first delivery marks the
+        # request's TTFT (queueing + prompt ingestion + first chunk)
+        def cb(toks, done):
+            if slot not in t_first and toks:
+                t_first[slot] = time.perf_counter()
+        return cb
+
+    total = 0
+    rng2 = np.random.RandomState(7)
     t0 = time.perf_counter()
+    for i in range(16):
+        S = int((48, 96, 160, 224)[i % 4])
+        n = int((64, 96)[i % 2])
+        total += n
+        rid = sched.submit(
+            [int(x) for x in rng2.randint(1, cfg.vocab_size, size=S)],
+            max_new_tokens=n, on_token=mk_on_token(i),
+        )
+        t_submit[i] = time.perf_counter()
     outs = sched.run()
     dt = time.perf_counter() - t0
     got = sum(len(v) for v in outs.values())
     assert got == total, (got, total)
+    ttfts = sorted(t_first[r] - t_submit[r] for r in t_submit)
     out["serving_tok_s_1b"] = round(got / dt, 1)
     out["serving_requests"] = 16
+    out["serving_ttft_p50_ms"] = round(ttfts[len(ttfts) // 2] * 1e3, 1)
+    out["serving_ttft_p99_ms"] = round(ttfts[-1] * 1e3, 1)
 
 
 def leg_speculative(out: dict) -> None:
