@@ -947,8 +947,10 @@ class InferenceEngine:
             # reproduces regardless of batchmates (vLLM per-request seed).
             rng = jax.random.split(key, logits0.shape[0])
             if seeded:
-                skeys = jax.vmap(jax.random.PRNGKey)(seeds)
-                rng = jnp.where(seeded_mask[:, None], skeys, rng)
+                # seeds is [B, 2] (hi, lo) uint32 — exactly the threefry
+                # key words PRNGKey(seed64) would produce, so the full
+                # 64-bit seed space maps to distinct streams
+                rng = jnp.where(seeded_mask[:, None], seeds, rng)
 
             def step(carry, i):
                 if penalized:
@@ -1222,11 +1224,14 @@ class InferenceEngine:
         seeds_d = mask_d = None
         if use_seeds:
             # PRNGKey construction happens inside the compiled program;
-            # only the raw seed ints and the row mask cross to the device.
-            # Masking to 32 bits preserves PRNGKey's tolerance of negative
-            # or wide seeds (uint32 upload would OverflowError on them)
+            # only the raw seed words and the row mask cross to the device.
+            # BOTH 64-bit halves ride up ([B, 2] hi/lo words): threefry
+            # seeds with the full 64-bit value, so negative and >32-bit
+            # seeds keep the distinct streams the host-side PRNGKey path
+            # gave them (s and s + 2**32 no longer collide)
             seeds_d = jnp.asarray(
-                [int(s) & 0xFFFFFFFF if s is not None else 0 for s in seeds],
+                [[(int(s) >> 32) & 0xFFFFFFFF, int(s) & 0xFFFFFFFF]
+                 if s is not None else [0, 0] for s in seeds],
                 jnp.uint32,
             )
             mask_d = jnp.asarray(seeded_mask)

@@ -487,10 +487,13 @@ class Scheduler:
         # would burn a full draft prefill per eligible row per step when
         # one row can never fit (the thrash _draft_state_for warns about).
         T = self.draft.pc.block_tokens
+        # length must match too: a repeated-token tail can make a SHORTER
+        # stale draft compare equal on values alone (advisor r4, medium)
         stale = [
             r._draft_state is not None
-            and r._draft_state.tokens[-(k + 2):]
-            != r.state.tokens[-(k + 2):]
+            and (len(r._draft_state.tokens) != len(r.state.tokens)
+                 or r._draft_state.tokens[-(k + 2):]
+                 != r.state.tokens[-(k + 2):])
             for r in reqs
         ]
         need = sum(

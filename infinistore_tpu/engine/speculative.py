@@ -412,6 +412,7 @@ class SpeculativeDecoder:
             and self.target.lora is None
             and self.draft.lora is None
             and len(st_t.tokens) >= self.k + 2
+            and len(st_t.tokens) == len(st_d.tokens)
             and st_t.tokens[-(self.k + 2):] == st_d.tokens[-(self.k + 2):]
         ):
             if sample == "greedy":
@@ -457,12 +458,16 @@ class SpeculativeDecoder:
         return out
 
     def _acquire_for(self, eng: InferenceEngine, st: SequenceState,
-                     n_new: int) -> None:
+                     n_new: int, base_len: Optional[int] = None) -> None:
         """Grow ``st``'s page list to cover ``n_new`` more tokens (raises
         MemoryError with the state untouched — fused calls reconcile after
-        every dispatch, so the state is always decode-ready here)."""
+        every dispatch, so the state is always decode-ready here).
+        ``base_len`` overrides ``len(st.tokens)`` as the starting length:
+        the fused batch path sizes DRAFT pages from the TARGET length so a
+        stale-shorter draft can never undersize its block table."""
         T = eng.pc.block_tokens
-        need = -(-(len(st.tokens) + n_new) // T)
+        need = -(-((base_len if base_len is not None
+                    else len(st.tokens)) + n_new) // T)
         if need > len(st.block_ids):
             st.block_ids.extend(eng.pages.acquire(need - len(st.block_ids)))
 
@@ -501,8 +506,13 @@ class SpeculativeDecoder:
             assert len(st_t.tokens) >= self.k + 2, (
                 "batched speculation needs prompts of at least k+2 tokens"
             )
+            # value equality alone is not enough: after a lockstep interlude
+            # a sequence tail of >= k+2 repeated tokens would let a SHORTER
+            # stale draft pass as synced, and draft page sizing below would
+            # then run off the end of the draft block table
             assert (
-                st_t.tokens[-(self.k + 2):] == st_d.tokens[-(self.k + 2):]
+                len(st_t.tokens) == len(st_d.tokens)
+                and st_t.tokens[-(self.k + 2):] == st_d.tokens[-(self.k + 2):]
             ), "draft state out of sync with target"
         assert self.target._has_verify and self.draft._has_verify
         assert self.target.lora is None and self.draft.lora is None
@@ -550,7 +560,7 @@ class SpeculativeDecoder:
             return short <= eng.free_pages
 
         while min(len(o) for o in outs_h) < n_steps:
-            # TWO round-count buckets only ({8, 2}): each fused program
+            # THREE round-count buckets only ({8, 2, 1}): each fused program
             # inlines dozens of forwards, so every extra R bucket is a
             # large compile; 8 is the steady-state program, 2 keeps tail
             # calls from overshooting ~a full dispatch of work (rounds
@@ -561,14 +571,18 @@ class SpeculativeDecoder:
             # fit" contract).
             remaining = n_steps - min(len(o) for o in outs_h)
             R = 8 if remaining > 2 * (k + 1) else 2
+            # memory-pressure degrade steps THROUGH the buckets (8 -> 2
+            # -> 1), never 4: each fused program is a large compile, so
+            # the bucket set stays exactly {8, 2, 1}
             while R > 1 and not (fits(self.target, st_ts, R)
                                  and fits(self.draft, st_ds, R)):
-                R //= 2
+                R = 2 if R == 8 else 1
             grow = R * (k + 1)
             for st in st_ts:
                 self._acquire_for(self.target, st, grow)
-            for st in st_ds:
-                self._acquire_for(self.draft, st, grow)
+            for st_t, st in zip(st_ts, st_ds):
+                self._acquire_for(self.draft, st, grow,
+                                  base_len=len(st_t.tokens))
             fn = _build_fused_rounds(self.target, self.draft, k, R, variant)
             outs, cnts, nF, t_lg, d_lg, t_cache, d_cache = fn(
                 self.target.params, self.draft.params,

@@ -13,6 +13,14 @@ also the one that shards cleanly under pjit (keys split identically on
 every device), which is what the tp/sp paths want.  Opt out with
 ``ISTPU_PARTITIONABLE_PRNG=0`` (changes sampled streams, not their
 distribution).
+
+Import side effect, bounded: this mutates process-global jax config, so
+a host application embedding this package would see its PRNG streams
+change.  Two escape hatches keep that from being silent: the env
+opt-out above, and — checked here — if the host already set the flag
+explicitly (``jax.config.update`` or ``JAX_THREEFRY_PARTITIONABLE``)
+before importing us, we leave their choice alone.  Called out in
+README.md and docs/api.md, not only here.
 """
 
 from __future__ import annotations
@@ -21,5 +29,23 @@ import os
 
 import jax
 
-if os.environ.get("ISTPU_PARTITIONABLE_PRNG", "1") != "0":
+
+def _host_already_chose() -> bool:
+    """True when the embedding application explicitly chose
+    ``jax_threefry_partitionable`` before this import — their choice
+    wins over our default.  jax keeps no "was explicitly set" bit, but
+    since jax 0.4.36 the flag DEFAULTS to True, so observing False at
+    import time can only mean an explicit env/host choice."""
+    if "JAX_THREEFRY_PARTITIONABLE" in os.environ:
+        return True
+    try:
+        ver = tuple(int(x) for x in jax.__version__.split(".")[:3])
+    except ValueError:  # dev/rc suffixes — assume modern
+        ver = (0, 4, 36)
+    defaults_true = ver >= (0, 4, 36)
+    return defaults_true and not jax.config.jax_threefry_partitionable
+
+
+if (os.environ.get("ISTPU_PARTITIONABLE_PRNG", "1") != "0"
+        and not _host_already_chose()):
     jax.config.update("jax_threefry_partitionable", True)

@@ -477,3 +477,44 @@ def test_scheduler_fault_reset_releases_everything():
     # the scheduler stays usable after the reset
     c = sched.submit(PROMPT, max_new_tokens=5)
     assert len(sched.run()[c]) == 5
+
+
+def test_stale_shorter_draft_with_repeated_tail_rejected():
+    """A draft whose tokens are SHORTER than the target's but whose last
+    k+2 values happen to match (repeated-token tail) must not pass the
+    fused-path sync gate: decode() has to fall back to the host loop
+    (which re-syncs), and decode_batch() has to refuse outright.
+    Regression for the advisor r4 medium finding — the value-only gate
+    let a stale draft undersize its block table."""
+    k = 4
+    tail = [9] * (k + 2)
+    prompt = [11, 42, 7] + tail
+    spec = SpeculativeDecoder(
+        make_engine(TARGET_PARAMS, CFG),
+        make_engine(DRAFT_PARAMS, DRAFT_CFG),
+        k=k,
+    )
+    st_t, st_d = spec.prefill(prompt)
+    # simulate a lockstep interlude: target advanced, draft did not —
+    # but the emitted tokens repeat the tail value, so the last k+2
+    # VALUES still compare equal
+    st_t.tokens = st_t.tokens + [9, 9, 9]
+    assert st_t.tokens[-(k + 2):] == st_d.tokens[-(k + 2):]
+    assert len(st_t.tokens) != len(st_d.tokens)
+
+    import pytest
+    with pytest.raises(AssertionError, match="out of sync"):
+        spec.decode_batch([st_t], [st_d], 4)
+
+    # decode()'s gate must ALSO reject the stale draft: with the same
+    # value-equal/length-unequal states it has to route to the host
+    # round loop (which resyncs the draft), never the fused path
+    class _HostLoop(Exception):
+        pass
+
+    def _sentinel(*a, **k):
+        raise _HostLoop
+
+    spec._rounds = _sentinel
+    with pytest.raises(_HostLoop):
+        spec.decode(st_t, st_d, 4)
