@@ -51,7 +51,9 @@ def build_engine(args, conn):
         dtype=cfg.dtype,
     )
     return InferenceEngine(params, cfg, pc, conn=conn,
-                           model_id=args.model_id)
+                           model_id=args.model_id,
+                           kv_quant=(None if args.kv_quant == "none"
+                                     else args.kv_quant))
 
 
 def add_common_args(ap: argparse.ArgumentParser) -> None:
@@ -67,6 +69,12 @@ def add_common_args(ap: argparse.ArgumentParser) -> None:
     ap.add_argument("--block-tokens", type=int, default=4)
     ap.add_argument("--dtype", default="float32",
                     help="float32 keeps the two nodes bit-identical")
+    ap.add_argument("--kv-quant", choices=["int8", "none"], default="none",
+                    help="store-hop page format.  This demo defaults to "
+                         "'none' (lossless) because its verification "
+                         "recipe is decode-node tokens == monolithic "
+                         "decode, which int8 noise can break; the library "
+                         "default is int8 (half the transfer bytes)")
 
 
 def connect(args) -> "ist.InfinityConnection":
@@ -87,7 +95,11 @@ def main() -> None:
 
     conn = connect(args)
     eng = build_engine(args, conn)
-    st = eng.prefill(prompt)  # KV streams to the store; flushed on return
+    st = eng.prefill(prompt)  # KV streams to the store chunk by chunk
+    # durability barrier before signaling hand-off: a no-op under the
+    # default strict mode, the REQUIRED join under store_durability=
+    # "relaxed" (decode nodes may only be pointed at flushed prefixes)
+    eng.store_flush()
     print(json.dumps({
         "model_id": args.model_id,
         "n_tokens": len(st.tokens),

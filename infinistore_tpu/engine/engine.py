@@ -224,17 +224,20 @@ class _StoreStreamer:
     hands them here; this thread does the D2H + pool writes.  A single
     worker serializes store ops (one connection, no interleaving), and
     ``flush()`` joins the queue so prefill still returns with every page
-    durably in the store.  The first push error parks, skips the rest, and
-    re-raises at flush."""
+    durably in the store.  The first push error parks, skips the rest
+    (fail-fast on a dead store), and re-raises at the next flush — which
+    also CLEARS it, so pushes resume afterwards (the serving layer
+    flushes whenever the batch drains)."""
 
-    def __init__(self, transfer: KVTransferEngine):
+    def __init__(self, transfer: KVTransferEngine, maxsize: int = 2):
         import queue
 
         self._transfer = transfer
         # bounded: each queued item pins a chunk's gathered pages in HBM,
-        # so a store slower than compute backpressures prefill at ~2 extra
-        # chunks of footprint instead of buffering the whole prompt's KV
-        self._q: "queue.Queue" = queue.Queue(maxsize=2)
+        # so a store slower than compute backpressures prefill at ~maxsize
+        # extra chunks of footprint instead of buffering without limit
+        # (relaxed-durability engines pass a deeper bound on purpose)
+        self._q: "queue.Queue" = queue.Queue(maxsize=maxsize)
         self._err: Optional[BaseException] = None
         self._started = False
 
@@ -255,7 +258,19 @@ class _StoreStreamer:
                 if self._err is None:
                     self._transfer.push_pages(pages, keys)
             except BaseException as e:  # noqa: BLE001 — reported at flush()
+                # park the first error and SKIP queued items until the
+                # next flush() consumes it: a dead store fails fast (one
+                # timeout, not one per queued chunk).  Persistence is not
+                # permanently lost — the serving layer's idle flush
+                # clears the error and later pushes resume; skipped pages
+                # are content-addressed, so the cost is a future miss.
                 self._err = e
+                import logging
+
+                logging.getLogger("infinistore_tpu").warning(
+                    "store push of %d page keys failed (queued pushes "
+                    "skipped until the next flush): %r", len(keys), e
+                )
             finally:
                 self._q.task_done()
 
@@ -319,12 +334,13 @@ class InferenceEngine:
         decode_fn=None,
         verify_fn=None,
         prefill_chunk: Optional[int] = None,
-        kv_quant: Optional[str] = None,
+        kv_quant: Optional[str] = "int8",
         mesh=None,
         param_specs=None,
         pallas_tp: bool = False,
         lora=None,
         decode_chunk: int = 32,
+        store_durability: str = "strict",
     ):
         """``prefill_fn``/``decode_fn`` plug in other model families with the
         same contracts as models.llama.prefill_forward / decode_forward
@@ -334,8 +350,25 @@ class InferenceEngine:
         (a multiple of ``pc.block_tokens``) instead of one full-sequence
         forward — bounds prefill attention memory for long prompts.
 
-        ``kv_quant="int8"``: store/retrieve KV pages quantized (kv/quant.py)
-        — half the bytes per hop; HBM pages stay full precision.
+        ``kv_quant``: store/retrieve KV pages quantized (kv/quant.py) —
+        half the bytes per hop; HBM pages stay full precision.  INT8 IS
+        THE DEFAULT store-hop format (the hop is bandwidth-bound
+        everywhere we've measured; per-(K|V, head) scales keep the
+        noise ~0.4% relative).  Pass ``kv_quant=None`` for the lossless
+        hop when bitwise-exact store round-trips matter more than
+        bytes (e.g. strict PD-disagg token equality).
+
+        ``store_durability``: ``"strict"`` (default) joins the store
+        streamer before ``prefill`` returns — every page durably in the
+        store, the reference's prefill-node contract.  ``"relaxed"``
+        returns as soon as the last chunk's pages are QUEUED: the pushes
+        ride behind decode, ``get_match_last_index`` simply won't match
+        chunks that haven't landed yet (content-addressed keys make late
+        arrival harmless), and push errors surface at the next
+        ``store_flush()``.  Use relaxed when the store hop is slower
+        than compute and TTFT matters more than immediate cross-host
+        visibility; PD-disagg prefill nodes must ``store_flush()``
+        before signaling hand-off either way.
 
         ``lora``: a ``models.lora.LoraBank`` enables multi-adapter serving —
         every prefill/decode/verify dispatch takes a per-row adapter-id
@@ -383,8 +416,24 @@ class InferenceEngine:
         self.transfer = (
             KVTransferEngine(conn, pc, quant=kv_quant) if conn is not None else None
         )
+        if store_durability not in ("strict", "relaxed"):
+            # a real error, not an assert: under python -O a typo would
+            # otherwise silently behave as relaxed and drop the strict
+            # durability contract
+            raise ValueError(
+                f"store_durability must be 'strict' or 'relaxed', "
+                f"got {store_durability!r}"
+            )
+        self.store_durability = store_durability
+        # relaxed mode must not backpressure prefill on a slow store, so
+        # its queue is deep enough to hold a long prompt's chunks; strict
+        # keeps the 2-chunk HBM-footprint bound (flush joins anyway)
         self._streamer = (
-            _StoreStreamer(self.transfer) if self.transfer is not None else None
+            _StoreStreamer(
+                self.transfer,
+                maxsize=(64 if store_durability == "relaxed" else 2),
+            )
+            if self.transfer is not None else None
         )
         self.max_seqs = max_seqs
         if prefill_chunk is not None:
@@ -659,10 +708,11 @@ class InferenceEngine:
             pp.plen = need
             return None
 
-        # finished: join the pusher so the pages are durably in the store
-        # before the state is visible (prefill-node contract), surfacing
-        # any push error here
-        if self.transfer is not None:
+        # finished.  Strict durability joins the pusher so the pages are
+        # durably in the store before the state is visible (the
+        # reference's prefill-node contract, design.rst); relaxed returns
+        # now — pushes drain behind decode, store_flush() is the barrier
+        if self.transfer is not None and self.store_durability == "strict":
             self._streamer.flush()
 
         # name this sequence's complete-chunk pages so later prefills can
@@ -684,15 +734,24 @@ class InferenceEngine:
         self.seqs[state.seq_id] = state
         return state
 
+    def store_flush(self) -> None:
+        """Durability barrier: wait until every queued store push has
+        landed, re-raising the first push error.  A no-op without a
+        store.  Under ``store_durability="relaxed"`` this is the point
+        where a prefill's pages become visible to ``check_exist`` /
+        ``get_match_last_index`` on other hosts — PD-disagg prefill
+        nodes call it before signaling hand-off."""
+        if self._streamer is not None:
+            self._streamer.flush()
+
     def abandon_prefill(self, pp: "PartialPrefill") -> None:
-        """Cancel a partial prefill: release its pages (pushed store pages
-        stay — they are content-addressed and reusable) and join the
-        streamer so no push still references the abandoned ids."""
-        if self.transfer is not None:
-            try:
-                self._streamer.flush()
-            except Exception:  # noqa: BLE001 — abandoning anyway
-                pass
+        """Cancel a partial prefill: release its pages.  No streamer join
+        is needed: queued pushes hold IMMUTABLE gathered snapshots (see
+        gather_pages), not references to the pool pages being released,
+        and their content-addressed keys still name correctly computed
+        chunks — a late-landing push is a valid future cache hit, not a
+        leak.  (An earlier flush here also swallowed parked relaxed-mode
+        push errors, breaking the next store_flush()'s contract.)"""
         self.pages.unpin(pp.block_ids)
         pp.block_ids = []
 

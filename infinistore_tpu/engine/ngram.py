@@ -1,0 +1,309 @@
+"""Draft-model-free speculative decoding: n-gram prompt-lookup proposals.
+
+The reference's serving stack (vLLM) ships a model-free speculative mode
+("prompt lookup" / `speculative_model="[ngram]"`): proposals come from
+matching the newest ``g`` tokens against the sequence's own history and
+replaying what followed the most recent match.  No draft model, no draft
+KV cache — the draft cost is a handful of vector compares — so ANY
+accepted token is pure profit; acceptance is simply a property of how
+repetitive the text is.  (Reference front door:
+``/root/reference/README.md:96-103`` — the vLLM cluster InfiniStore
+serves; technique: Saxena 2023 "prompt lookup decoding", the vLLM ngram
+speculator.)
+
+TPU-native shape: the matcher runs ON DEVICE inside the same
+fused-rounds program as model-draft speculation
+(``speculative._build_fused_rounds``) — the token history rides in a
+padded ``[B, L]`` device buffer, and one dispatch runs R complete
+propose/verify/accept rounds for every row with ONE host sync.  The
+proposal step is ~B*L*g integer compares per token, invisible next to
+the target's verify forward; there is no draft resync forward at all
+(the history write IS the resync).  This is the configuration where
+speculation actually beats plain decode on this platform: the
+self-draft bench ceiling is <1x by construction (draft cost == target
+cost), while here the draft is free and the win is
+``E[tokens/round] / (1 round-verify + overhead)``.
+
+Greedy decision rule only: the proposal distribution is a delta, so
+stochastic rejection sampling degenerates to "accept w.p. p(x)" —
+supportable, but the greedy contract (output EXACTLY equals the
+target's greedy decode; property-tested) is the serving-relevant one.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .engine import (
+    _JIT_CACHE,
+    _UNSTACK_ROWS,
+    InferenceEngine,
+    SequenceState,
+)
+
+_ROW_NEG1 = jax.jit(lambda l: l[-1])
+
+
+def _build_ngram_rounds(target: InferenceEngine, k: int, g: int, L: int,
+                        R: int):
+    """Compile ``R`` n-gram speculation rounds into ONE dispatch.
+
+    Per round, per row (all batched, all inside one ``lax.scan``):
+
+    1. propose ``k`` tokens: for proposal ``i`` at position ``p = n+i``,
+       gather the suffix ``hist[p-g:p]``, compare it against every
+       g-window of the history (static sliding windows — XLA folds the
+       stack of shifted slices into cheap vector compares), take the
+       MOST RECENT match ``j < p-g`` and propose ``hist[j+g]``;
+       fall back to repeating ``hist[p-1]`` when nothing matches.
+       Each proposal is written into ``hist`` provisionally so later
+       proposals can match through earlier ones (that is what makes a
+       period-2 tail propose k/2 full cycles, not one token).
+    2. ONE target verify forward scores ``[prev, p_1..p_k]``
+       (``k+1`` tokens, the same multi-token paged verify the
+       model-draft path uses).
+    3. greedy acceptance: accept while proposal == target argmax, then
+       append the target's own token — output is exactly the target's
+       greedy decode.
+    4. the accepted ``k+1`` window is written into ``hist`` (positions
+       past the accepted count hold provisional garbage that the
+       ``j < p-g`` mask excludes — ``n`` only advances by the accepted
+       count).
+
+    Returns a jitted ``fn(t_params, t_cache, t_table [B, W], n0 [B],
+    hist [B, L]) -> (outs [R, B, k+1], cnts [R, B], nF [B],
+    t_logits [B, V], t_cache, hist)`` with the cache and history buffer
+    donated.  Re-specializes per (B, table width, L bucket).
+    """
+    key = ("ngram_fused", target._verify_jit, target.pc.block_tokens,
+           k, g, L, R)
+    fn = _JIT_CACHE.get(key)
+    if fn is not None:
+        return fn
+    T = target.pc.block_tokens
+    t_verify = target._verify_jit
+
+    def rounds(t_params, t_cache, t_table, n0, hist):
+        B = hist.shape[0]
+        rows = jnp.arange(B)
+
+        def windows(h):
+            # [B, L-g, g]: window j holds h[:, j:j+g]; static slices so
+            # XLA lowers this to g shifted views, no gather
+            return jnp.stack([h[:, t:L - g + t] for t in range(g)], axis=2)
+
+        def propose_one(h, p):
+            # p [B]: 0-based position being proposed.  Guaranteed p >= g
+            # (host gate: prompts shorter than g+1 stay on plain decode).
+            suf = jnp.take_along_axis(
+                h, (p - g)[:, None] + jnp.arange(g)[None], axis=1
+            )  # [B, g]
+            ok = jnp.all(windows(h) == suf[:, None, :], axis=2)  # [B, L-g]
+            idx = jnp.arange(L - g)[None]
+            # strictly before the suffix itself; most recent match wins
+            ok = ok & (idx < (p - g)[:, None])
+            j = jnp.max(jnp.where(ok, idx, -1), axis=1)  # [B], -1 = none
+            hit = jnp.take_along_axis(
+                h, jnp.clip(j + g, 0, L - 1)[:, None], axis=1
+            )[:, 0]
+            last = jnp.take_along_axis(h, (p - 1)[:, None], axis=1)[:, 0]
+            return jnp.where(j >= 0, hit, last)
+
+        def round_body(carry, _):
+            t_cache, n, hist = carry
+
+            # 1. k proposals, each written provisionally at its position
+            def pstep(h, i):
+                p = n + i
+                tok = propose_one(h, p)
+                h = h.at[rows, p].set(tok)
+                return h, tok
+
+            hist2, props_kb = jax.lax.scan(
+                pstep, hist, jnp.arange(k)
+            )
+            props = jnp.transpose(props_kb)  # [B, k]
+
+            # 2. one verify forward over [prev, p_1..p_k]
+            poss = n[:, None] - 1 + jnp.arange(k + 1)[None]  # [B, k+1]
+            run = jnp.take_along_axis(hist2, poss, axis=1)
+            blks = jnp.take_along_axis(t_table, poss // T, axis=1)
+            lgs, t_cache = t_verify(
+                t_params, tokens=run, positions=poss,
+                cache=t_cache, block_table=t_table,
+                slot_block_ids=blks, slot_ids=poss % T,
+            )  # [B, k+1, V]
+
+            # 3. greedy acceptance (same rule as the model-draft path)
+            choices = jnp.argmax(lgs, -1).astype(jnp.int32)  # [B, k+1]
+            ok = props == choices[:, :k]
+            m = jnp.where(jnp.all(ok, axis=1), k, jnp.argmin(ok, axis=1))
+            picked = jnp.take_along_axis(choices, m[:, None], axis=1)[:, 0]
+            tail = jnp.concatenate([props, props[:, -1:]], axis=1)
+            e = jnp.where(
+                jnp.arange(k + 1)[None] == m[:, None], picked[:, None], tail
+            )  # [B, k+1]
+            cnt = m + 1
+            n2 = n + cnt
+
+            # 4. history absorbs the emitted window (positions past cnt
+            # hold garbage the position mask excludes until overwritten)
+            hist3 = hist2.at[
+                rows[:, None], n[:, None] + jnp.arange(k + 1)[None]
+            ].set(e)
+            return (t_cache, n2, hist3), (e, cnt)
+
+        (t_cache, nF, hist), (outs, cnts) = jax.lax.scan(
+            round_body, (t_cache, n0, hist), None, length=R
+        )
+        # leave the target decode-ready: logits after each row's last
+        # accepted token (slot rewrite is harmless/idempotent)
+        posF = nF[:, None] - 1
+        lgT, t_cache = t_verify(
+            t_params,
+            tokens=jnp.take_along_axis(hist, posF, axis=1),
+            positions=posF, cache=t_cache, block_table=t_table,
+            slot_block_ids=jnp.take_along_axis(t_table, posF // T, axis=1),
+            slot_ids=posF % T,
+        )
+        return outs, cnts, nF, lgT[:, -1], t_cache, hist
+
+    fn = jax.jit(rounds, donate_argnums=(1, 4))
+    _JIT_CACHE[key] = fn
+    return fn
+
+
+class NgramSpeculator:
+    """Model-free speculative decoder over a target ``InferenceEngine``.
+
+    Mirrors ``SpeculativeDecoder``'s surface (``prefill`` / ``decode`` /
+    ``decode_batch`` / ``generate`` / ``acceptance_rate``) minus the
+    draft engine: proposals come from the device-side n-gram matcher.
+    Greedy only — output is exactly the target's greedy decode.
+
+    ``k``: proposals per round (more pays off at high acceptance);
+    ``g``: match gram size (longer = fewer, higher-precision matches).
+    """
+
+    def __init__(self, target: InferenceEngine, k: int = 8, g: int = 3):
+        assert k >= 1 and g >= 1
+        self.target = target
+        self.k = k
+        self.g = g
+        self.rounds = 0
+        self.proposed = 0
+        self.accepted = 0
+
+    # -- lifecycle ---------------------------------------------------
+
+    def prefill(self, tokens: Sequence[int]) -> SequenceState:
+        return self.target.prefill(tokens)
+
+    def eligible(self, st: SequenceState) -> bool:
+        return (self.target._has_verify and self.target.lora is None
+                and len(st.tokens) >= self.g + 1)
+
+    # -- decode ------------------------------------------------------
+
+    def decode(self, st: SequenceState, n_steps: int) -> List[int]:
+        if not self.eligible(st):
+            return self.target.decode(st, n_steps)
+        return self.decode_batch([st], n_steps)[0]
+
+    def decode_batch(self, sts: List[SequenceState],
+                     n_steps: int) -> List[List[int]]:
+        """Lockstep batched n-gram speculation; every row's output equals
+        the target's own greedy decode of that row."""
+        assert sts
+        for st in sts:
+            assert self.eligible(st), "row not eligible for ngram spec"
+        k, g = self.k, self.g
+        eng = self.target
+        B = len(sts)
+        T = eng.pc.block_tokens
+        outs_h: List[List[int]] = [[] for _ in range(B)]
+
+        # history bucket: pow2 covering the longest row + WORST-CASE
+        # growth (static shape -> bounded compile variety).  Lockstep
+        # rows overshoot: the loop runs until the SLOWEST row meets the
+        # budget, so a fast row (accepting k+1/round) can emit up to
+        # ~n_steps*(k+1) tokens while a stalling batchmate crawls at
+        # 1/round — plus one final dispatch of up to 8*(k+1).  Sizing by
+        # n_steps alone overflowed the buffer exactly there: jit drops
+        # OOB scatters silently and the fast row's output went wrong.
+        max_len = max(len(st.tokens) for st in sts)
+        need_L = max_len + (n_steps + 8) * (k + 1) + k + 2
+        L = 256
+        while L < need_L:
+            L *= 2
+        hist_h = np.zeros((B, L), dtype=np.int32) - 1
+        for b, st in enumerate(sts):
+            hist_h[b, : len(st.tokens)] = st.tokens
+        hist = jnp.asarray(hist_h)
+
+        def fits(rounds: int) -> bool:
+            short = 0
+            for st in sts:
+                need = -(-(len(st.tokens) + rounds * (k + 1)) // T)
+                short += max(0, need - len(st.block_ids))
+            return short <= eng.free_pages
+
+        while min(len(o) for o in outs_h) < n_steps:
+            remaining = n_steps - min(len(o) for o in outs_h)
+            R = 8 if remaining > 2 * (k + 1) else 2
+            # same {8, 2, 1} bucket walk as the model-draft fused path
+            while R > 1 and not fits(R):
+                R = 2 if R == 8 else 1
+            grow = R * (k + 1)
+            for st in sts:
+                need = -(-(len(st.tokens) + grow) // T)
+                if need > len(st.block_ids):
+                    st.block_ids.extend(
+                        eng.pages.acquire(need - len(st.block_ids))
+                    )
+            # the bucket bound above is an invariant, not a hope: an OOB
+            # hist scatter would be DROPPED silently under jit
+            assert max(len(st.tokens) for st in sts) + R * (k + 1) <= L
+            fn = _build_ngram_rounds(eng, k, g, L, R)
+            outs, cnts, nF, lgT, eng.cache, hist = fn(
+                eng.params, eng.cache, eng._block_table(sts),
+                jnp.asarray([len(st.tokens) for st in sts], jnp.int32),
+                hist,
+            )
+            h_outs = np.asarray(outs)   # [R, B, k+1]; the one sync
+            h_cnts = np.asarray(cnts)   # [R, B]
+            lrows = _UNSTACK_ROWS(lgT)
+            for b in range(B):
+                new_toks: List[int] = []
+                for r in range(R):
+                    cnt = int(h_cnts[r, b])
+                    new_toks.extend(int(t) for t in h_outs[r, b, :cnt])
+                outs_h[b].extend(new_toks)
+                sts[b].tokens.extend(new_toks)
+                sts[b].last_logits = lrows[b]
+            self.rounds += R * B
+            self.proposed += R * B * k
+            self.accepted += int(h_cnts.sum()) - R * B
+        for b in range(B):
+            excess = len(outs_h[b]) - n_steps
+            if excess:
+                del outs_h[b][n_steps:]
+                del sts[b].tokens[-excess:]
+                sts[b].last_logits = _ROW_NEG1(self.target.verify(
+                    sts[b], [sts[b].tokens[-1]], len(sts[b].tokens) - 1
+                ))
+        return outs_h
+
+    def generate(self, tokens: Sequence[int], n_steps: int) -> List[int]:
+        st = self.prefill(tokens)
+        out = self.decode(st, n_steps)
+        self.target.release(st)
+        return out
+
+    @property
+    def acceptance_rate(self) -> float:
+        return self.accepted / max(1, self.proposed)

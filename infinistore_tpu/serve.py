@@ -59,13 +59,16 @@ class ServingServer:
     def __init__(self, engine, host: str = "127.0.0.1", port: int = 8000,
                  max_batch: int = 8, model_id: str = "infinistore-tpu",
                  tokenizer=None, draft_engine=None, spec_k: int = 4,
-                 max_queue: Optional[int] = None, spec_batch: int = 1):
+                 max_queue: Optional[int] = None, spec_batch: int = 1,
+                 ngram_spec: bool = False, spec_g: int = 2):
         """``tokenizer``: any object with ``encode(str) -> [int]`` and
         ``decode([int]) -> str`` (an HF tokenizer qualifies) — enables
         string prompts, text responses, and string stop sequences.
         ``draft_engine``: a second (smaller) ``InferenceEngine`` over the
         same vocab turns on speculative decoding as the scheduler's
-        batch=1 fast path (``--draft-model``)."""
+        batch=1 fast path (``--draft-model``).  ``ngram_spec``: model-
+        free speculation instead — proposals from the n-gram prompt-
+        lookup matcher (``--ngram-spec``), greedy requests only."""
         self.engine = engine
         self.model_id = model_id
         self.tokenizer = tokenizer
@@ -75,7 +78,8 @@ class ServingServer:
         self.max_queue = max_queue
         self.sched = Scheduler(engine, max_batch=max_batch,
                                draft_engine=draft_engine, spec_k=spec_k,
-                               spec_batch=spec_batch)
+                               spec_batch=spec_batch,
+                               ngram_spec=ngram_spec, spec_g=spec_g)
         self._cv = threading.Condition()
         self._staged: List[Dict[str, Any]] = []   # submissions from handlers
         self._cancels: List[int] = []
@@ -263,6 +267,17 @@ class ServingServer:
 
     def _engine_loop(self) -> None:
         while True:
+            if not self.sched.has_work and self.engine.transfer is not None:
+                # the batch just drained: join the store streamer so
+                # relaxed-durability pushes land and their errors SURFACE
+                # here (logged) instead of parking in the streamer until
+                # a flush nobody calls.  Outside the lock — a slow store
+                # must not block submissions from being STAGED (they are
+                # picked up right after the join).
+                try:
+                    self.engine.store_flush()
+                except Exception as e:  # noqa: BLE001
+                    Logger.warn(f"store flush failed: {e!r}")
             with self._cv:
                 while not (self._staged or self._cancels or self._stop
                            or self.sched.has_work):
@@ -625,6 +640,19 @@ class ServingServer:
             f"istpu_serve_tokens_total {s['tokens']}",
             "# TYPE istpu_serve_free_kv_pages gauge",
             f"istpu_serve_free_kv_pages {self.engine.free_pages}",
+        ]
+        lm = self.sched.latency_metrics
+        lines += [
+            # TTFT split (rolling window): queue-wait vs prefill/compute —
+            # says whether high TTFT is admission or compute
+            "# TYPE istpu_serve_queue_wait_p50_ms gauge",
+            f"istpu_serve_queue_wait_p50_ms {lm['queue_wait_p50_ms']}",
+            "# TYPE istpu_serve_queue_wait_p99_ms gauge",
+            f"istpu_serve_queue_wait_p99_ms {lm['queue_wait_p99_ms']}",
+            "# TYPE istpu_serve_prefill_p50_ms gauge",
+            f"istpu_serve_prefill_p50_ms {lm['prefill_p50_ms']}",
+            "# TYPE istpu_serve_prefill_p99_ms gauge",
+            f"istpu_serve_prefill_p99_ms {lm['prefill_p99_ms']}",
         ]
         if self.sched.spec is not None:
             sm = self.sched.spec_metrics
@@ -1326,6 +1354,32 @@ def main(argv: Optional[List[str]] = None) -> None:
                     help="speculate with up to this many concurrent "
                     "requests in lockstep (batched fused rounds); 1 = the "
                     "latency-bound fast path only")
+    ap.add_argument("--ngram-spec", action="store_true",
+                    help="model-free speculative decoding: proposals from "
+                         "the device-side n-gram prompt-lookup matcher "
+                         "(no draft model; greedy requests only; pays on "
+                         "repetitive text). Mutually exclusive with "
+                         "--draft-model")
+    ap.add_argument("--spec-g", type=int, default=2,
+                    help="n-gram match width for --ngram-spec")
+    ap.add_argument("--store-host", default=None,
+                    help="attach an infinistore-tpu KV store at this host: "
+                         "prefill KV streams to the store and prompts reuse "
+                         "store-resident prefixes across engine restarts "
+                         "and hosts (requires --store-service-port)")
+    ap.add_argument("--store-service-port", type=int, default=None)
+    ap.add_argument("--store-connection", choices=["tcp", "shm"],
+                    default="shm",
+                    help="shm = zero-copy, same host; tcp = cross-host DCN")
+    ap.add_argument("--kv-quant", choices=["int8", "none"], default="int8",
+                    help="store-hop page format (int8 halves the bytes; "
+                         "'none' = lossless)")
+    ap.add_argument("--store-durability", choices=["strict", "relaxed"],
+                    default="relaxed",
+                    help="relaxed (default): prefill returns when pages are "
+                         "queued, pushes drain behind decode — the TTFT-"
+                         "friendly mode; strict: every page durable before "
+                         "prefill returns (PD prefill-node contract)")
     ap.add_argument("--log-level", default="info")
     args = ap.parse_args(argv)
     Logger.set_log_level(args.log_level)
@@ -1392,8 +1446,27 @@ def main(argv: Optional[List[str]] = None) -> None:
         head_dim=cfg.head_dim, n_blocks=args.n_blocks,
         block_tokens=args.block_tokens, dtype=cfg.dtype,
     )
+    conn = None
+    if args.store_host is not None:
+        if args.store_service_port is None:
+            raise SystemExit("--store-host requires --store-service-port")
+        from . import lib as ist
+
+        conn = ist.InfinityConnection(ist.ClientConfig(
+            host_addr=args.store_host,
+            service_port=args.store_service_port,
+            connection_type=(ist.TYPE_SHM
+                             if args.store_connection == "shm"
+                             else ist.TYPE_TCP),
+        ))
+        conn.connect()
     engine = InferenceEngine(params, cfg, pc, prefill_chunk=args.prefill_chunk,
-                             decode_chunk=args.decode_chunk, **engine_fns)
+                             decode_chunk=args.decode_chunk, conn=conn,
+                             model_id=model_id,
+                             kv_quant=(None if args.kv_quant == "none"
+                                       else args.kv_quant),
+                             store_durability=args.store_durability,
+                             **engine_fns)
     draft_engine = None
     if args.draft_model is not None:
         # the draft proposes tokens the target verifies, so the vocabs must
@@ -1412,11 +1485,15 @@ def main(argv: Optional[List[str]] = None) -> None:
             block_tokens=args.block_tokens, dtype=dcfg.dtype,
         )
         draft_engine = InferenceEngine(dparams, dcfg, dpc, **dfns)
+    if args.ngram_spec and draft_engine is not None:
+        raise SystemExit("--ngram-spec and --draft-model are mutually "
+                         "exclusive speculation modes")
     srv = ServingServer(engine, host=args.host, port=args.port,
                         max_batch=args.max_batch, model_id=model_id,
                         tokenizer=tokenizer, draft_engine=draft_engine,
                         spec_k=args.spec_k, max_queue=args.max_queue,
-                        spec_batch=args.spec_batch)
+                        spec_batch=args.spec_batch,
+                        ngram_spec=args.ngram_spec, spec_g=args.spec_g)
     srv.start()
     try:
         while True:

@@ -377,6 +377,78 @@ def test_prefill_streams_kv_per_chunk(server):
     dec_conn.close()
 
 
+def test_relaxed_durability_prefill_returns_before_flush(server):
+    """store_durability="relaxed": prefill must return as soon as the
+    last chunk's pages are QUEUED — on a store slower than compute the
+    return time is compute-bound, not push-bound (the reference's <=1%
+    overlap design point, design.rst:57-58, without the strict
+    durability barrier).  Unflushed chunks are simply not visible to a
+    decode-side engine yet; ``store_flush()`` is the durability barrier
+    after which prefix reuse serves them byte-for-byte."""
+    import time as _time
+
+    conn = _conn(server)
+    eng = InferenceEngine(
+        PARAMS, CFG, make_pc(), conn=conn, model_id="relaxed-test",
+        prefill_chunk=T, store_durability="relaxed",
+    )
+    # warm the compiled paths so the timed prefill is dispatch-only
+    eng.release(eng.prefill(PROMPT))
+    eng.store_flush()
+
+    DELAY = 0.5
+    orig = eng.transfer.push_pages
+    done = []
+
+    def slow(pages, keys):
+        _time.sleep(DELAY)
+        done.append(list(keys))
+        return orig(pages, keys)
+
+    eng.transfer.push_pages = slow
+    t0 = _time.perf_counter()
+    st = eng.prefill([t + 1 for t in PROMPT])  # distinct prefix
+    dt = _time.perf_counter() - t0
+    n_chunks = len(PROMPT) // T
+    # two slow pushes (0.5 s each) were queued; a strict prefill would
+    # have waited for both.  Generous bound: well under ONE push delay.
+    assert dt < DELAY, f"relaxed prefill waited on the store ({dt:.2f}s)"
+    eng.store_flush()
+    assert len(done) == n_chunks  # the barrier drained every queued push
+
+    dec_conn = _conn(server)
+    dec = InferenceEngine(
+        PARAMS, CFG, make_pc(), conn=dec_conn, model_id="relaxed-test"
+    )
+    st2 = dec.prefill([t + 1 for t in PROMPT])
+    assert st2.reused_chunks == n_chunks  # flushed pages serve reuse
+    assert dec.decode(st2, 8) == dense_greedy([t + 1 for t in PROMPT], 8)
+    eng.release(st)
+    conn.close()
+    dec_conn.close()
+
+
+def test_relaxed_durability_push_error_surfaces_at_flush(server):
+    """A push failure under relaxed durability parks and re-raises at the
+    next store_flush() — never silently lost, never crashing prefill."""
+    conn = _conn(server)
+    eng = InferenceEngine(
+        PARAMS, CFG, make_pc(), conn=conn, model_id="relaxed-err",
+        prefill_chunk=T, store_durability="relaxed",
+    )
+
+    def boom(pages, keys):
+        raise RuntimeError("push failed")
+
+    eng.transfer.push_pages = boom
+    st = eng.prefill(PROMPT)  # must not raise here
+    with pytest.raises(RuntimeError, match="push failed"):
+        eng.store_flush()
+    eng.store_flush()  # error consumed; barrier is reusable
+    eng.release(st)
+    conn.close()
+
+
 def test_prefix_reuse_survives_partial_eviction(server):
     """The server LRU evicts per PAGE key, so a chunk can lose a middle
     layer while the layers lookup_prefix probes (first, last) survive:
@@ -393,7 +465,9 @@ def test_prefix_reuse_survives_partial_eviction(server):
     # evict ONE middle-layer page of the first chunk (layer 0 and the last
     # layer — the probed ones — stay resident)
     keys = ck_fn(PROMPT, "evict-test", chunk_tokens=T)
-    victim = layer_key(keys[0], CFG.n_layers // 2)
+    # the wire key carries the engine's quant-namespace suffix (int8 is
+    # the store-hop default)
+    victim = layer_key(keys[0], CFG.n_layers // 2) + a.transfer._key_suffix
     assert prefill_conn.delete_keys([victim]) == 1
 
     b = InferenceEngine(

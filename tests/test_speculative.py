@@ -518,3 +518,73 @@ def test_stale_shorter_draft_with_repeated_tail_rejected():
     spec._rounds = _sentinel
     with pytest.raises(_HostLoop):
         spec.decode(st_t, st_d, 4)
+
+
+def test_ngram_speculator_matches_greedy():
+    """Model-free n-gram speculation (engine/ngram.py): batched rows of
+    different lengths and repetitiveness must all emit EXACTLY the
+    target's greedy decode — acceptance only changes the dispatch
+    count.  (vLLM's [ngram] speculator / prompt-lookup decoding is the
+    reference-stack counterpart.)"""
+    from infinistore_tpu.engine.ngram import NgramSpeculator
+
+    prompts = [PROMPT, PROMPT[:7], [5, 6, 7, 8] * 6]
+    ref = make_engine(TARGET_PARAMS, CFG)
+    wants = [ref.generate(p, 30) for p in prompts]
+
+    spec = NgramSpeculator(make_engine(TARGET_PARAMS, CFG), k=6, g=2)
+    sts = [spec.prefill(p) for p in prompts]
+    outs = spec.decode_batch(sts, 30)
+    assert outs == wants
+    assert spec.rounds >= 3
+
+    # single-row convenience path + a different (k, g)
+    s2 = NgramSpeculator(make_engine(TARGET_PARAMS, CFG), k=4, g=3)
+    assert s2.generate(prompts[0], 18) == wants[0][:18]
+
+
+def test_ngram_speculator_short_prompt_falls_back():
+    """Prompts shorter than g+1 can't seed a match window: decode() must
+    fall back to plain target decode, still exact."""
+    from infinistore_tpu.engine.ngram import NgramSpeculator
+
+    ref = make_engine(TARGET_PARAMS, CFG)
+    want = ref.generate(PROMPT[:2], 10)
+    spec = NgramSpeculator(make_engine(TARGET_PARAMS, CFG), k=4, g=3)
+    st = spec.prefill(PROMPT[:2])
+    assert not spec.eligible(st)
+    assert spec.decode(st, 10) == want
+
+
+def test_scheduler_ngram_spec_matches_plain():
+    """Scheduler(ngram_spec=True): greedy requests ride the model-free
+    fused rounds and must produce exactly the plain scheduler's outputs;
+    acceptance counters advance; a sampled request makes the step fall
+    back to lockstep decode (identical streams — the ngram path never
+    consumes scheduler rng)."""
+    sched = Scheduler(
+        make_engine(TARGET_PARAMS, CFG),
+        ngram_spec=True, spec_k=4, spec_g=2, spec_batch=3,
+    )
+    prompts = [PROMPT, PROMPT[:8], [5, 6, 7, 8] * 5]
+    rids = [sched.submit(p, max_new_tokens=12) for p in prompts]
+    got = sched.run()
+
+    plain = Scheduler(make_engine(TARGET_PARAMS, CFG))
+    prids = [plain.submit(p, max_new_tokens=12) for p in prompts]
+    want = plain.run()
+    assert [got[r] for r in rids] == [want[r] for r in prids]
+    assert sched.spec.rounds >= 1
+    assert sched.spec_metrics["proposed"] > 0
+
+    # sampled request: ngram path refuses (delta proposals can't do
+    # rejection sampling), lockstep fallback still matches plain
+    s2 = Scheduler(make_engine(TARGET_PARAMS, CFG),
+                   ngram_spec=True, spec_k=4, spec_g=2)
+    r2 = s2.submit(PROMPT, max_new_tokens=8, sample="categorical",
+                   temperature=1.5, seed=3)
+    p2 = Scheduler(make_engine(TARGET_PARAMS, CFG))
+    r3 = p2.submit(PROMPT, max_new_tokens=8, sample="categorical",
+                   temperature=1.5, seed=3)
+    assert s2.run()[r2] == p2.run()[r3]
+    assert s2.spec.rounds == 0  # never engaged
