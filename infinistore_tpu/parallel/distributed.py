@@ -110,20 +110,29 @@ def make_hybrid_mesh(
         devs = mesh_utils.create_device_mesh(shape.as_tuple())
         return Mesh(devs, AXES)
     per_slice = (shape.dp, shape.pp, shape.sp, shape.tp)
-    if hasattr(jax.devices()[0], "slice_index"):
-        # real hardware: let mesh_utils align the DCN axis with physical
-        # slices — a mismatch here must raise, not silently degrade into
-        # slice-straddling dp groups
+    n_slices = len({
+        getattr(d, "slice_index", 0) for d in jax.devices()
+    })
+    if n_slices > 1:
+        # real multi-slice hardware: let mesh_utils align the DCN axis
+        # with physical slices — a mismatch here must raise, not
+        # silently degrade into slice-straddling dp groups
         devs = mesh_utils.create_hybrid_device_mesh(
             per_slice, (dcn_dp, 1, 1, 1)
         )  # dp outermost over DCN
     else:
-        # virtual devices (the 8-device CPU mesh of tests and the driver
-        # dryrun) carry no slice_index topology attribute: emulate the DCN
-        # axis with contiguous device groups, dp outermost — same mesh
-        # SHAPE and axis layout as the real hybrid mesh, so every sharding
-        # built on top compiles identically
-        devs = np.asarray(jax.devices()[:n_total]).reshape(
+        # single slice (or virtual CPU devices, which report slice 0 on
+        # newer jax): emulate the DCN axis with per-PROCESS contiguous
+        # device groups, dp outermost — the natural DCN boundary in a
+        # multi-process CPU launch, and the same mesh SHAPE and axis
+        # layout as the real hybrid mesh, so every sharding built on
+        # top compiles identically.  Sorting by process keeps each
+        # dp(DCN) group addressable by exactly one process.
+        ordered = sorted(
+            jax.devices()[:n_total],
+            key=lambda d: (getattr(d, "process_index", 0), d.id),
+        )
+        devs = np.asarray(ordered).reshape(
             (dcn_dp * shape.dp, shape.pp, shape.sp, shape.tp)
         )
     return Mesh(devs, AXES)
