@@ -111,7 +111,7 @@ def bench_conn(conn_type: str, port: int, rounds: int, tag: str,
     return gb / put_t, gb / get_t
 
 
-def bench_tpu_leg(timeout_s: int = 900) -> dict:
+def bench_tpu_leg(timeout_s: int = 1800) -> dict:
     """Run the TPU-in-the-loop leg (bench_tpu.py) in a subprocess with a hard
     timeout: a wedged TPU tunnel must never hang the driver bench.
 
