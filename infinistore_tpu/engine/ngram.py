@@ -189,7 +189,7 @@ class NgramSpeculator:
     ``g``: match gram size (longer = fewer, higher-precision matches).
     """
 
-    def __init__(self, target: InferenceEngine, k: int = 8, g: int = 3):
+    def __init__(self, target: InferenceEngine, k: int = 8, g: int = 2):
         assert k >= 1 and g >= 1
         self.target = target
         self.k = k

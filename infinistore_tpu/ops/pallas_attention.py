@@ -54,20 +54,30 @@ def _online_softmax_step(s, v, m_scr, l_scr, acc_scr):
 def _decode_kernel(
     table_ref,  # scalar prefetch: [B, max_pages] int32
     lens_ref,   # scalar prefetch: [B] int32
-    q_ref,      # [1, 1, R, D] current-token queries for this kv head group
-    k_ref,      # [1, 1, 1, T, D] one K page
-    v_ref,      # [1, 1, 1, T, D] one V page
-    o_ref,      # [1, 1, R, D]
+    q_ref,      # [..., R, D] current-token queries for this kv head group
+    k_ref,      # [..., T, D] one K page
+    v_ref,      # [..., T, D] one V page
+    o_ref,      # [..., R, D]
     m_scr,      # [R, 128] fp32 running max (col 0 used)
     l_scr,      # [R, 128] fp32 running denominator (col 0 used)
     acc_scr,    # [R, D] fp32 numerator
     *,
     scale: float,
+    b_axis: int = 0,
+    c_axis: int = 2,
 ):
-    b = pl.program_id(0)
-    c = pl.program_id(2)
-    n_chunks = pl.num_programs(2)
-    T = k_ref.shape[3]
+    """ONE kernel body for both grid layouts — (B, Hkv, pages) on the
+    model path and (L, B, Hkv, pages) on the all-layers instrument
+    (``b_axis``/``c_axis`` name the batch and page grid axes; block
+    shapes differ only in leading 1s, which the reshapes below drop).
+    Shared on purpose: the instrument exists to vary ONLY the invocation
+    count, so its masking/guard numerics must be the model kernel's by
+    construction."""
+    b = pl.program_id(b_axis)
+    c = pl.program_id(c_axis)
+    n_chunks = pl.num_programs(c_axis)
+    T, D = k_ref.shape[-2], k_ref.shape[-1]
+    R = q_ref.shape[-2]
 
     @pl.when(c == 0)
     def _init():
@@ -79,9 +89,9 @@ def _decode_kernel(
 
     @pl.when(c * T < seq_len)
     def _attend():
-        q = q_ref[0, 0].astype(jnp.float32)        # [R, D]
-        k = k_ref[0, 0, 0].astype(jnp.float32)     # [T, D]
-        v = v_ref[0, 0, 0].astype(jnp.float32)     # [T, D]
+        q = q_ref[...].reshape(R, D).astype(jnp.float32)
+        k = k_ref[...].reshape(T, D).astype(jnp.float32)
+        v = v_ref[...].reshape(T, D).astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -92,7 +102,11 @@ def _decode_kernel(
 
     @pl.when(c == n_chunks - 1)
     def _finish():
-        o_ref[0, 0] = (acc_scr[:] / l_scr[:, :1]).astype(o_ref.dtype)
+        o_ref[...] = (
+            (acc_scr[:] / l_scr[:, :1])
+            .astype(o_ref.dtype)
+            .reshape(o_ref.shape)
+        )
 
 
 def _flash_kernel(
@@ -453,3 +467,81 @@ def paged_decode_attention_pallas(
       cache_kl, cache_kl)
 
     return out[:, :, :n_rep].reshape(B, H, D)
+
+
+def paged_decode_attention_pallas_alllayers(
+    qs: jax.Array,
+    cache: jax.Array,
+    block_table: jax.Array,
+    seq_lens: jax.Array,
+    interpret: bool = False,
+) -> jax.Array:
+    """ALL layers' decode attention in ONE ``pallas_call``.
+
+    qs: [L, B, H, D]; cache: [L, 2, H_kv, n_blocks, T, D] (the full
+    serving cache); block_table/seq_lens as in
+    ``paged_decode_attention_pallas``.  Returns [L, B, H, D].
+
+    This is an INSTRUMENT, not a model path: inside a real forward,
+    layer l's query depends on layer l-1's output, so the layers cannot
+    actually run from one dispatch.  But the total HBM traffic and FLOPs
+    here are IDENTICAL to L back-to-back single-layer calls — the only
+    difference is 1 invocation instead of L — which is exactly the
+    controlled experiment VERDICT r4 next #5 asked for: if this runs
+    ~L times faster per-layer than the chained single-layer calls, the
+    per-``pallas_call`` overhead hypothesis is confirmed (and quantified
+    as the difference); if it doesn't, the kernels lose for some other
+    reason and the overhead theory dies."""
+    L, B, H, D = qs.shape
+    Lc, _, Hkv, _, T, Dc = cache.shape
+    assert Lc == L and Dc == D, (Lc, L, Dc, D)
+    n_rep = H // Hkv
+    min_sublane = 8 if qs.dtype == jnp.float32 else 16
+    R = max(n_rep, min_sublane)
+    max_pages = block_table.shape[1]
+    scale = 1.0 / np.sqrt(D)
+
+    qg = qs.reshape(L, B, Hkv, n_rep, D)
+    if R != n_rep:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, 0), (0, R - n_rep), (0, 0)))
+
+    grid = (L, B, Hkv, max_pages)
+
+    def q_map(l, b, h, c, table_ref, lens_ref):
+        return (l, b, h, 0, 0)
+
+    def _page(b, c, lens_ref):
+        last = jnp.maximum(lens_ref[b] - 1, 0) // T
+        return jnp.minimum(c, last)
+
+    def k_map(l, b, h, c, table_ref, lens_ref):
+        return (l, 0, h, table_ref[b, _page(b, c, lens_ref)], 0, 0)
+
+    def v_map(l, b, h, c, table_ref, lens_ref):
+        return (l, 1, h, table_ref[b, _page(b, c, lens_ref)], 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, 1, R, D), q_map),
+            pl.BlockSpec((1, 1, 1, 1, T, D), k_map),
+            pl.BlockSpec((1, 1, 1, 1, T, D), v_map),
+        ],
+        out_specs=pl.BlockSpec((1, 1, 1, R, D), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((R, 128), jnp.float32),
+            pltpu.VMEM((R, 128), jnp.float32),
+            pltpu.VMEM((R, D), jnp.float32),
+        ],
+    )
+
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, scale=scale, b_axis=1, c_axis=3),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((L, B, Hkv, R, D), qs.dtype),
+        interpret=interpret,
+    )(block_table.astype(jnp.int32), seq_lens.astype(jnp.int32), qg,
+      cache, cache)
+
+    return out[:, :, :, :n_rep].reshape(L, B, H, D)

@@ -237,3 +237,33 @@ def test_flash_prefix_kernel_mosaic_on_tpu():
         np.asarray(got, np.float32), np.asarray(want, np.float32),
         rtol=3e-2, atol=3e-2,
     )
+
+
+def test_alllayers_decode_kernel_matches_per_layer():
+    """The invocation-overhead instrument
+    (paged_decode_attention_pallas_alllayers) must compute EXACTLY what L
+    back-to-back single-layer kernel calls compute — it exists to vary
+    only the invocation count (bench leg_invocation_overhead)."""
+    from infinistore_tpu.ops.pallas_attention import (
+        paged_decode_attention_pallas_alllayers,
+    )
+
+    L, Hkv, n_rep, D, T = 3, 2, 4, 128, 16
+    B, max_pages, n_blocks = 2, 4, 16
+    rng = np.random.default_rng(3)
+    qs = jnp.asarray(rng.standard_normal((L, B, Hkv * n_rep, D)), jnp.float32)
+    cache = jnp.asarray(
+        rng.standard_normal((L, 2, Hkv, n_blocks, T, D)), jnp.float32
+    )
+    _, _, table, lens = _setup(
+        B, Hkv * n_rep, Hkv, D, T, n_blocks, max_pages, seed=3
+    )
+    want = jnp.stack([
+        paged_decode_attention_pallas(
+            qs[l], cache[l], table, lens, interpret=True)
+        for l in range(L)
+    ])
+    got = paged_decode_attention_pallas_alllayers(
+        qs, cache, table, lens, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=1e-6, atol=1e-6)

@@ -121,6 +121,37 @@ def test_spmd_pipeline_matches_sequential():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
 
 
+_TRAIN_REF_MEMO: dict = {}
+
+
+def _train_ref():
+    """The single-device reference trajectory, computed ONCE and shared
+    by all three mesh-shape parametrizations (it is identical for each:
+    same params, same tokens, same lr)."""
+    if "ref" not in _TRAIN_REF_MEMO:
+        params = init_params(CFG, jax.random.PRNGKey(4))
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(5), (4, 32), 0, CFG.vocab_size)
+        ref_loss = float(loss_fn(params, CFG, tokens))
+        from infinistore_tpu.models.llama import train_step_fn
+
+        ref_params, _ = train_step_fn(CFG, lr=1e-2)(params, tokens)
+        want = jax.device_get(ref_params["layers"]["wq"])
+        # HOST copies: the sharded steps donate their inputs and a
+        # replicated device_put can alias the source buffer, so handing
+        # the same jax arrays to three parametrizations would let run 1
+        # corrupt run 2's inputs
+        _TRAIN_REF_MEMO["ref"] = (
+            jax.tree.map(lambda x: np.asarray(x), params),
+            np.asarray(tokens), ref_loss, want,
+        )
+    np_params, np_tokens, ref_loss, want = _TRAIN_REF_MEMO["ref"]
+    return (
+        jax.tree.map(jnp.asarray, np_params),
+        jnp.asarray(np_tokens), ref_loss, want,
+    )
+
+
 @pytest.mark.parametrize(
     "shape", [MeshShape(pp=2, sp=2, tp=2), MeshShape(dp=2, sp=2, tp=2),
               MeshShape(dp=2, pp=2, sp=2)],
@@ -128,17 +159,10 @@ def test_spmd_pipeline_matches_sequential():
 )
 def test_train_step_matches_single_device(shape):
     mesh = make_mesh(shape)
-    B, S = 4, 32
-    key = jax.random.PRNGKey(4)
-    params = init_params(CFG, key)
-    tokens = jax.random.randint(jax.random.PRNGKey(5), (B, S), 0, CFG.vocab_size)
-
-    ref_loss = float(loss_fn(params, CFG, tokens))
-    # run the single-device reference first: the sharded step donates its
-    # inputs, and replicated device_put shards can alias the originals
-    from infinistore_tpu.models.llama import train_step_fn
-    ref_params, _ = train_step_fn(CFG, lr=1e-2)(params, tokens)
-    want = jax.device_get(ref_params["layers"]["wq"])
+    # the sharded step donates its inputs, and replicated device_put
+    # shards can alias the originals — the memoized reference was
+    # computed on untouched copies before any sharded run
+    params, tokens, ref_loss, want = _train_ref()
 
     with jax.set_mesh(mesh):
         step = make_train_step(CFG, mesh, lr=1e-2)
@@ -232,9 +256,11 @@ def test_sharded_engine_matches_unsharded():
 
     cfg = CFG  # fp32: sharded-vs-dense comparison must not drown in bf16
     params = init_params(cfg, jax.random.PRNGKey(11))
+    # the suite-standard (64, 4) pool shape: the unsharded REFERENCE
+    # engines then reuse programs other files already compiled
     pc = PagedCacheConfig(
         n_layers=cfg.n_layers, n_kv_heads=cfg.n_kv_heads,
-        head_dim=cfg.head_dim, n_blocks=32, block_tokens=4, dtype=jnp.float32)
+        head_dim=cfg.head_dim, n_blocks=64, block_tokens=4, dtype=jnp.float32)
     prompt = [int(t) for t in
               np.random.RandomState(3).randint(1, cfg.vocab_size, 11)]
 

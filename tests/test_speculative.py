@@ -243,7 +243,7 @@ def test_stochastic_speculative_matches_target_distribution():
         dtype=np.float64,
     )[0]
 
-    N = 400
+    N = 240  # deterministic (fixed seeds); smallest bin still ~5 expected
     counts: dict = {}
     for i in range(N):
         st_t.tokens, st_t.last_logits = list(base_t), logits_t
@@ -430,20 +430,22 @@ def test_scheduler_spec_windowed_target_reclaims_pages():
     wcfg = scaled(CFG, sliding_window=8)
     wparams = init_params(wcfg, jax.random.PRNGKey(21))
 
-    def weng(n_blocks):
-        pc = PagedCacheConfig(
-            n_layers=wcfg.n_layers, n_kv_heads=wcfg.n_kv_heads,
-            head_dim=wcfg.head_dim, n_blocks=n_blocks, block_tokens=T,
-            dtype=wcfg.dtype,
-        )
-        return InferenceEngine(wparams, wcfg, pc)
+    def weng():
+        # the STANDARD test pool shape (64 x T): a bespoke small pool
+        # would compile a whole second windowed program universe — pool
+        # pressure is created below by hoarding pages instead
+        return make_engine(wparams, wcfg)
 
-    plain = Scheduler(weng(64))
+    plain = Scheduler(weng())
     rid = plain.submit(PROMPT, max_new_tokens=60)
     want = plain.run()[rid]
 
-    # 11 + 60 tokens -> 18 pages un-reclaimed; pool of 12 forces reclaim
-    sched = Scheduler(weng(12), draft_engine=make_engine(
+    # 11 + 60 tokens -> 18 pages un-reclaimed; hoard pages until only 12
+    # remain so reclamation is forced WITHOUT a bespoke cache shape
+    pressured = weng()
+    hoard = pressured.pages.acquire(64 - 12)
+    assert pressured.free_pages == 12
+    sched = Scheduler(pressured, draft_engine=make_engine(
         DRAFT_PARAMS, DRAFT_CFG), spec_k=4)
     rid = sched.submit(PROMPT, max_new_tokens=60)
     results = {}
@@ -532,15 +534,20 @@ def test_ngram_speculator_matches_greedy():
     ref = make_engine(TARGET_PARAMS, CFG)
     wants = [ref.generate(p, 30) for p in prompts]
 
-    spec = NgramSpeculator(make_engine(TARGET_PARAMS, CFG), k=6, g=2)
+    # k=4, g=2 everywhere in this file: every distinct (k, g, B, L, R)
+    # tuple compiles its own fused program, so the correctness tests
+    # share ONE universe (the scheduler test below uses the same pair)
+    spec = NgramSpeculator(make_engine(TARGET_PARAMS, CFG), k=4, g=2)
     sts = [spec.prefill(p) for p in prompts]
     outs = spec.decode_batch(sts, 30)
     assert outs == wants
     assert spec.rounds >= 3
 
-    # single-row convenience path + a different (k, g)
-    s2 = NgramSpeculator(make_engine(TARGET_PARAMS, CFG), k=4, g=3)
-    assert s2.generate(prompts[0], 18) == wants[0][:18]
+    # single-row convenience path (B=1 specializes separately; 8 tokens
+    # keeps it inside the R=2 bucket — R=8 coverage comes from the
+    # batched run above)
+    s2 = NgramSpeculator(make_engine(TARGET_PARAMS, CFG), k=4, g=2)
+    assert s2.generate(prompts[0], 8) == wants[0][:8]
 
 
 def test_ngram_speculator_short_prompt_falls_back():
