@@ -60,7 +60,7 @@ def _build():
         )
 
 
-_ABI_VERSION = 2  # must match istpu_abi_version() in src/istpu_c.cpp
+_ABI_VERSION = 3  # must match istpu_abi_version() in src/istpu_c.cpp
 
 
 def _abi_ok(lib) -> bool:
@@ -108,7 +108,7 @@ def _load():
     lib.istpu_server_create.restype = ctypes.c_void_p
     lib.istpu_server_create.argtypes = [
         ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int,
-        ctypes.c_int, ctypes.c_char_p, ctypes.c_uint64,
+        ctypes.c_int, ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p,
     ]
     lib.istpu_server_start.argtypes = [ctypes.c_void_p]
     lib.istpu_server_stop.argtypes = [ctypes.c_void_p]
@@ -198,6 +198,7 @@ class NativeStoreServer:
             int(config.service_port),
             (getattr(config, "disk_tier_path", "") or "").encode(),
             int(getattr(config, "disk_tier_size", 64)) << 30,
+            (getattr(config, "allocator", "bitmap") or "bitmap").encode(),
         )
         if not self._h:
             raise RuntimeError("native server create failed")

@@ -98,6 +98,11 @@ class ServerConfig:
         # Empty = DRAM only.  Both backends.
         self.disk_tier_path = kwargs.get("disk_tier_path", "")
         self.disk_tier_size = kwargs.get("disk_tier_size", 64)  # GB
+        # allocator strategy (reference design.rst:52 "bitmap or
+        # jemalloc"): "bitmap" = uniform-block run allocator;
+        # "sizeclass" = pow2 size classes with lazily carved per-class
+        # pools (the jemalloc-shaped option for mixed page sizes)
+        self.allocator = kwargs.get("allocator", "bitmap")
 
     def __repr__(self):
         return (
@@ -126,3 +131,5 @@ class ServerConfig:
             raise Exception("minimal allocate size should be greater than 16")
         if self.backend not in ("auto", "native", "python"):
             raise Exception("backend should be auto, native or python")
+        if getattr(self, "allocator", "bitmap") not in ("bitmap", "sizeclass"):
+            raise Exception("allocator should be bitmap or sizeclass")

@@ -217,39 +217,118 @@ class Pool:
             pass
 
 
-class MM:
-    """Multi-pool manager (reference: src/mempool.h:54-91)."""
+def _pow2ceil(x: int) -> int:
+    return 1 << max(0, (x - 1).bit_length())
 
-    def __init__(self, pool_size: int, block_size: int, name_prefix: str = None):
+
+class MM:
+    """Multi-pool manager (reference: src/mempool.h:54-91).
+
+    Two allocators (the reference names "bitmap or jemalloc",
+    docs/source/design.rst:52):
+
+    * ``"bitmap"`` (default): every pool uses one block size; a request
+      takes a contiguous run of blocks.  Simple and fast for the
+      homogeneous case (all KV pages of one model/dtype are the same
+      size), but a mixed workload (int8 + bf16 namespaces, MoE + dense
+      models on one store) pays up to ``block_size - 1`` bytes of
+      internal fragmentation per small object and run-fragments the
+      large ones.
+    * ``"sizeclass"`` (the jemalloc-shaped option): requests round up to
+      a power-of-two CLASS (>= the configured block size) and each class
+      has its own pools, created lazily by carving the configured
+      budget.  Every allocation is exactly one block of its class — no
+      run search, no cross-size interleaving, internal fragmentation
+      bounded by 2x worst-case instead of unbounded run churn.
+      ``add_mempool`` (the auto-extend path) GROWS THE BUDGET; the next
+      allocation carves the class pool it actually needs.
+    """
+
+    # lazily-carved class pools come in chunks of budget/CARVE_DIVISOR
+    # (must match src/mempool.h kCarveDivisor — the two runtimes are
+    # parity-tested as equivalents)
+    CARVE_DIVISOR = 4
+    # reject absurd wire-controlled sizes before class math (mirrors
+    # src/mempool.h kMaxAllocSize)
+    MAX_ALLOC_SIZE = 1 << 50
+
+    def __init__(self, pool_size: int, block_size: int,
+                 name_prefix: str = None, allocator: str = "bitmap"):
+        if allocator not in ("bitmap", "sizeclass"):
+            raise ValueError(f"unknown allocator: {allocator!r}")
+        self.allocator = allocator
         self.block_size = block_size
         self.name_prefix = name_prefix or f"istpu_{os.getpid()}_{secrets.token_hex(4)}"
         self.pools: List[Pool] = []
         self.need_extend = False
         sweep_stale_segments()  # reclaim segments of SIGKILL'd servers
-        self.add_mempool(pool_size, block_size)
+        if allocator == "bitmap":
+            self.add_mempool(pool_size, block_size)
+        else:
+            # budget accounting: pools are carved per class on demand
+            self._budget = pool_size
+            self._carved = 0
 
     def _next_name(self) -> str:
         return f"{self.name_prefix}_p{len(self.pools)}"
 
-    def add_mempool(self, pool_size: int = EXTEND_POOL_SIZE, block_size: int = None) -> Pool:
+    def add_mempool(self, pool_size: int = EXTEND_POOL_SIZE, block_size: int = None) -> Optional[Pool]:
+        if self.allocator == "sizeclass":
+            # the auto-extend contract: grant more BUDGET; the class
+            # that hit the wall carves its pool on the retry
+            self._budget += pool_size
+            return None
         block_size = block_size or self.block_size
         pool = Pool(self._next_name(), _round_up(pool_size, block_size), block_size)
         self.pools.append(pool)
+        return pool
+
+    def _class_of(self, size: int) -> int:
+        return _pow2ceil(max(size, self.block_size))
+
+    def _carve(self, cls: int) -> Optional[Pool]:
+        """Create a pool of class ``cls`` from the remaining budget (a
+        chunk of budget/CARVE_DIVISOR, at least 64 blocks, at most what
+        is left).  None when the budget is exhausted."""
+        remaining = self._budget - self._carved
+        # at least one block, never a many-block floor: a large class
+        # would otherwise swallow the whole budget in one carve and
+        # wedge every other class
+        want = max(self._budget // self.CARVE_DIVISOR, cls)
+        take = min(want, remaining)
+        take -= take % cls  # whole blocks only
+        if take < cls:
+            return None
+        pool = Pool(self._next_name(), take, cls)
+        self.pools.append(pool)
+        self._carved += take
         return pool
 
     def allocate(self, size: int, n: int) -> Optional[List[Tuple[int, int]]]:
         """Allocate ``n`` regions of ``size`` bytes.  Returns a list of
         (pool_idx, offset) or None (all-or-nothing, like the reference's
         callback-per-region allocate, src/mempool.cpp MM::allocate)."""
+        if size == 0 or size > self.MAX_ALLOC_SIZE:  # wire-controlled
+            return None
+        cls = self._class_of(size) if self.allocator == "sizeclass" else None
         out: List[Tuple[int, int]] = []
         for _ in range(n):
             placed = False
             for pi, pool in enumerate(self.pools):
+                if cls is not None and pool.block_size != cls:
+                    continue
                 off = pool.allocate(size)
                 if off is not None:
                     out.append((pi, off))
                     placed = True
                     break
+            if not placed and cls is not None:
+                pool = self._carve(cls)
+                if pool is not None:
+                    off = pool.allocate(size)
+                    if off is not None:
+                        out.append((len(self.pools) - 1, off))
+                        placed = True
             if not placed:
                 self.need_extend = True
                 for pi, off in out:  # roll back
@@ -264,8 +343,13 @@ class MM:
         return self.pools[pool_idx].buf[offset : offset + size]
 
     def usage(self) -> float:
-        total = sum(p.total_blocks for p in self.pools)
-        used = sum(p.allocated_blocks for p in self.pools)
+        used = sum(p.allocated_blocks * p.block_size for p in self.pools)
+        if self.allocator == "sizeclass":
+            # uncarved budget is still capacity: eviction thresholds must
+            # not fire while whole classes remain uncarved
+            total = max(self._budget, self._carved)
+        else:
+            total = sum(p.pool_size for p in self.pools)
         return used / total if total else 0.0
 
     def pool_table(self) -> List[Tuple[str, int, int]]:

@@ -173,6 +173,13 @@ def parse_args():
                              "(both backends)")
     parser.add_argument("--disk-tier-size", required=False, default=64, type=int,
                         help="disk tier capacity in GB")
+    parser.add_argument("--allocator", required=False, default="bitmap",
+                        choices=["bitmap", "sizeclass"],
+                        help="pool allocator: 'bitmap' (uniform-block "
+                             "runs) or 'sizeclass' (pow2 classes with "
+                             "lazily carved per-class pools — less "
+                             "internal fragmentation for mixed page "
+                             "sizes, e.g. int8 + bf16 namespaces)")
     return parser.parse_args()
 
 

@@ -217,6 +217,7 @@ class Store:
             pool_size=config.prealloc_size << 30,
             block_size=config.minimal_allocate_size << 10,
             name_prefix=getattr(config, "shm_prefix", None) or None,
+            allocator=getattr(config, "allocator", "bitmap"),
         )
         # committed entries; OrderedDict doubles as the LRU queue (head = LRU)
         self.kv: "OrderedDict[bytes, Entry]" = OrderedDict()
@@ -304,12 +305,44 @@ class Store:
             return True
         return False
 
+    def _pressure_evict(self, n: int = 8) -> int:
+        """LRU pops that ignore the global usage gate.  The size-classed
+        allocator can be FULL in one class while global usage looks low
+        (the usage-threshold evict never fires), so allocation failure
+        pops LRU entries directly — eventually reaching the full class's
+        own entries — instead of answering OUT_OF_MEMORY while evictable
+        data sits in the way.  Leased entries are skipped; spill-to-disk
+        semantics match evict()."""
+        now = time.monotonic()
+        evicted = 0
+        skipped = 0
+        while evicted < n and self.kv and skipped < len(self.kv):
+            key, e = next(iter(self.kv.items()))
+            if e.lease > now:
+                self.kv.move_to_end(key)
+                skipped += 1
+                continue
+            del self.kv[key]
+            if self.disk is not None:
+                if self.disk.put(
+                    key, self.mm.view(e.pool_idx, e.offset, e.size)
+                ):
+                    self.stats.spilled += 1
+            self._free(e)
+            evicted += 1
+        self.stats.evicted += evicted
+        return evicted
+
     def _allocate(self, size: int, n: int):
-        """On-demand-evict + allocate + auto-extend-retry."""
+        """On-demand-evict + allocate + auto-extend-retry (+ class-
+        pressure eviction for the sizeclass allocator)."""
         self.evict(ON_DEMAND_MIN_THRESHOLD, ON_DEMAND_MAX_THRESHOLD)
         regions = self.mm.allocate(size, n)
         if regions is None and self.maybe_extend():
             regions = self.mm.allocate(size, n)
+        if regions is None and self.mm.allocator == "sizeclass":
+            while regions is None and self._pressure_evict() > 0:
+                regions = self.mm.allocate(size, n)
         return regions
 
     # ---- ops ----

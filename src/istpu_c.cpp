@@ -27,12 +27,12 @@ extern "C" {
 // Bump whenever any extern-C signature changes: _native.py refuses a
 // stale libistpu.so (existence-only checks would silently call an old
 // signature and drop the new arguments).
-int istpu_abi_version(void) { return 2; }
+int istpu_abi_version(void) { return 3; }
 
 void* istpu_server_create(const char* shm_prefix, uint64_t prealloc_bytes,
                           uint64_t block_bytes, int auto_increase, int port,
                           const char* disk_tier_path,
-                          uint64_t disk_tier_bytes) {
+                          uint64_t disk_tier_bytes, const char* allocator) {
   StoreConfig cfg;
   cfg.shm_prefix = shm_prefix ? shm_prefix : "";
   cfg.prealloc_bytes = prealloc_bytes;
@@ -40,6 +40,7 @@ void* istpu_server_create(const char* shm_prefix, uint64_t prealloc_bytes,
   cfg.auto_increase = auto_increase != 0;
   cfg.disk_tier_path = disk_tier_path ? disk_tier_path : "";
   cfg.disk_tier_bytes = disk_tier_bytes;
+  cfg.allocator = allocator ? allocator : "bitmap";
   try {
     return istpu::make_server(cfg, port);
   } catch (...) {

@@ -141,9 +141,20 @@ int sweep_stale_segments() {
   return removed;
 }
 
-MM::MM(uint64_t pool_size, uint64_t block_size, const std::string& name_prefix)
-    : block_size_(block_size), name_prefix_(name_prefix) {
+static uint64_t pow2ceil(uint64_t x) {
+  uint64_t p = 1;
+  while (p < x) p <<= 1;
+  return p;
+}
+
+MM::MM(uint64_t pool_size, uint64_t block_size, const std::string& name_prefix,
+       Allocator allocator)
+    : allocator_(allocator), block_size_(block_size), name_prefix_(name_prefix) {
   sweep_stale_segments();  // reclaim segments of SIGKILL'd servers
+  if (allocator_ == Allocator::kSizeClass) {
+    budget_ = pool_size;  // pools carve lazily per class
+    return;
+  }
   char buf[256];
   snprintf(buf, sizeof(buf), "%s_p0", name_prefix_.c_str());
   pools_.emplace_back(
@@ -151,6 +162,10 @@ MM::MM(uint64_t pool_size, uint64_t block_size, const std::string& name_prefix)
 }
 
 Pool* MM::add_pool(uint64_t pool_size) {
+  if (allocator_ == Allocator::kSizeClass) {
+    budget_ += pool_size;  // the auto-extend contract grants budget
+    return nullptr;
+  }
   char buf[256];
   snprintf(buf, sizeof(buf), "%s_p%zu", name_prefix_.c_str(), pools_.size());
   pools_.emplace_back(
@@ -158,16 +173,53 @@ Pool* MM::add_pool(uint64_t pool_size) {
   return pools_.back().get();
 }
 
+uint64_t MM::class_of(uint64_t size) const {
+  return pow2ceil(std::max(size, block_size_));
+}
+
+Pool* MM::carve(uint64_t cls) {
+  // a chunk of budget/kCarveDivisor (at least one block, at most what's
+  // left), whole blocks only — mirrors the Python MM._carve.  No
+  // many-block floor: a large class would otherwise swallow the whole
+  // budget in one carve and wedge every other class.
+  uint64_t remaining = budget_ - carved_;
+  uint64_t want = std::max(budget_ / kCarveDivisor, cls);
+  uint64_t take = std::min(want, remaining);
+  take -= take % cls;
+  if (take < cls) return nullptr;
+  char buf[256];
+  snprintf(buf, sizeof(buf), "%s_p%zu", name_prefix_.c_str(), pools_.size());
+  pools_.emplace_back(std::make_unique<Pool>(buf, take, cls));
+  carved_ += take;
+  return pools_.back().get();
+}
+
 bool MM::allocate(uint64_t size, size_t n, std::vector<Region>* out) {
+  if (size == 0 || size > kMaxAllocSize) return false;  // wire-controlled
+  const bool sized = allocator_ == Allocator::kSizeClass;
+  const uint64_t cls = sized ? class_of(size) : 0;
   size_t start = out->size();
   for (size_t i = 0; i < n; i++) {
     bool placed = false;
     for (uint32_t pi = 0; pi < pools_.size(); pi++) {
+      if (sized && pools_[pi]->block_size() != cls) continue;
       int64_t off = pools_[pi]->allocate(size);
       if (off >= 0) {
         out->push_back({pi, static_cast<uint64_t>(off)});
         placed = true;
         break;
+      }
+    }
+    if (!placed && sized) {
+      Pool* p = carve(cls);
+      if (p != nullptr) {
+        int64_t off = p->allocate(size);
+        if (off >= 0) {
+          out->push_back(
+              {static_cast<uint32_t>(pools_.size() - 1),
+               static_cast<uint64_t>(off)});
+          placed = true;
+        }
       }
     }
     if (!placed) {  // roll back: all-or-nothing
@@ -189,8 +241,13 @@ void MM::deallocate(uint32_t pool_idx, uint64_t offset, uint64_t size) {
 double MM::usage() const {
   uint64_t total = 0, used = 0;
   for (const auto& p : pools_) {
-    total += p->total_blocks();
-    used += p->allocated_blocks();
+    total += p->pool_size();
+    used += p->allocated_blocks() * p->block_size();
+  }
+  if (allocator_ == Allocator::kSizeClass) {
+    // uncarved budget is still capacity: eviction thresholds must not
+    // fire while whole classes remain uncarved
+    total = std::max(budget_, carved_);
   }
   return total ? static_cast<double>(used) / total : 0.0;
 }

@@ -19,6 +19,13 @@
 namespace istpu {
 
 constexpr uint64_t kExtendPoolSize = 10ULL << 30;  // reference: src/mempool.h:12
+// Size-class pools carve in chunks of budget/kCarveDivisor (MUST match
+// the Python MM.CARVE_DIVISOR — the two runtimes' carve behavior is
+// parity-tested as equivalents).
+constexpr uint64_t kCarveDivisor = 4;
+// Reject absurd wire-controlled sizes before class math: pow2ceil would
+// overflow (and loop) past 2^62, and no real store object approaches it.
+constexpr uint64_t kMaxAllocSize = 1ULL << 50;
 
 class Pool {
  public:
@@ -66,11 +73,20 @@ struct Region {
   uint64_t offset;
 };
 
+// Allocator strategy (reference design.rst:52 "bitmap or jemalloc"):
+// kBitmap = uniform-block run allocator; kSizeClass = pow2 size classes
+// with lazily carved per-class pools (the jemalloc-shaped option — less
+// internal fragmentation when mixed page sizes share one store).
+enum class Allocator { kBitmap, kSizeClass };
+
 class MM {
  public:
-  MM(uint64_t pool_size, uint64_t block_size, const std::string& name_prefix);
+  MM(uint64_t pool_size, uint64_t block_size, const std::string& name_prefix,
+     Allocator allocator = Allocator::kBitmap);
   ~MM() = default;
 
+  // Bitmap: adds a pool.  Size-class: grants BUDGET (returns nullptr);
+  // the class that hit the wall carves its pool on the retry.
   Pool* add_pool(uint64_t pool_size = kExtendPoolSize);
 
   // All-or-nothing batch allocate of n regions of `size` bytes each
@@ -88,9 +104,15 @@ class MM {
   bool need_extend = false;
 
  private:
+  Pool* carve(uint64_t cls);  // size-class pool from remaining budget
+  uint64_t class_of(uint64_t size) const;
+
+  Allocator allocator_;
   uint64_t block_size_;
   std::string name_prefix_;
   std::vector<std::unique_ptr<Pool>> pools_;
+  uint64_t budget_ = 0;  // size-class mode only
+  uint64_t carved_ = 0;
 };
 
 }  // namespace istpu

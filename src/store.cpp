@@ -144,7 +144,9 @@ size_t DiskTier::clear() {
 Store::Store(const StoreConfig& cfg)
     : cfg_(cfg),
       mm_(cfg.prealloc_bytes, cfg.block_bytes,
-          cfg.shm_prefix.empty() ? rand_prefix() : cfg.shm_prefix) {
+          cfg.shm_prefix.empty() ? rand_prefix() : cfg.shm_prefix,
+          cfg.allocator == "sizeclass" ? Allocator::kSizeClass
+                                       : Allocator::kBitmap) {
   // pre-size the hash tables: a serving round puts/gets thousands of page
   // keys and a mid-batch rehash stalls the single-threaded event loop
   kv_.reserve(1 << 15);
@@ -278,6 +280,42 @@ int64_t Store::evict(double min_threshold, double max_threshold) {
   return evicted;
 }
 
+int64_t Store::pressure_evict(size_t n) {
+  // LRU pops that ignore the global usage gate: the size-classed
+  // allocator can be FULL in one class while global usage looks low
+  // (the threshold evict never fires), so allocation failure pops LRU
+  // entries directly — eventually reaching the full class's own
+  // entries.  Leased entries rotate past; spill semantics match evict().
+  int64_t evicted = 0;
+  double t = now();
+  size_t rotated = 0;
+  while (static_cast<size_t>(evicted) < n && !lru_.empty() &&
+         rotated < kv_.size()) {
+    const std::string key = lru_.front();
+    auto it = kv_.find(key);
+    if (it == kv_.end()) {
+      lru_.pop_front();
+      continue;
+    }
+    if (it->second.e.lease > t) {
+      touch(it->second, key);
+      rotated++;
+      continue;
+    }
+    if (disk_) {
+      const Entry& e = it->second.e;
+      if (disk_->put(key, mm_.view(e.pool_idx, e.offset), e.size))
+        stats_.spilled++;
+    }
+    free_entry(it->second.e);
+    lru_.pop_front();
+    kv_.erase(it);
+    evicted++;
+  }
+  stats_.evicted += evicted;
+  return evicted;
+}
+
 bool Store::allocate(uint64_t size, size_t n, std::vector<Region>* out) {
   // on-demand evict + allocate + auto-extend retry (src/infinistore.cpp:437-452)
   evict(kOnDemandMin, kOnDemandMax);
@@ -285,7 +323,13 @@ bool Store::allocate(uint64_t size, size_t n, std::vector<Region>* out) {
   if (cfg_.auto_increase && mm_.need_extend) {
     mm_.add_pool();
     mm_.need_extend = false;
-    return mm_.allocate(size, n, out);
+    if (mm_.allocate(size, n, out)) return true;
+  }
+  if (cfg_.allocator == "sizeclass") {
+    // class-pressure eviction (see pressure_evict)
+    while (pressure_evict(8) > 0) {
+      if (mm_.allocate(size, n, out)) return true;
+    }
   }
   return false;
 }

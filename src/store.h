@@ -57,6 +57,9 @@ struct StoreConfig {
   // file-backed slab here and promote back on access.  Empty = DRAM only.
   std::string disk_tier_path;
   uint64_t disk_tier_bytes = 64ULL << 30;
+  // "bitmap" (uniform-block runs) or "sizeclass" (pow2 classes, lazily
+  // carved per-class pools) — see mempool.h Allocator
+  std::string allocator = "bitmap";
 };
 
 // File-backed slab for the cold half of the cache hierarchy (counterpart
@@ -166,6 +169,7 @@ class Store {
   void insert_committed(const std::string& key, const Entry& e);
   void touch(Slot& s, const std::string& key);
   bool allocate(uint64_t size, size_t n, std::vector<Region>* out);
+  int64_t pressure_evict(size_t n);  // class-blind LRU pops (sizeclass)
   static double now();
 
   StoreConfig cfg_;

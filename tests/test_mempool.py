@@ -139,3 +139,83 @@ def test_pool_creation_is_fast_and_prefaults_in_background():
         assert bytes(p.buf[off : off + 4]) == b"abcd"
     finally:
         p.close()
+
+
+def test_sizeclass_classes_and_lazy_carving():
+    """sizeclass MM: requests round to pow2 classes, each class carves
+    its pool lazily, and mixed sizes never share a pool (the jemalloc-
+    shaped option of reference design.rst:52)."""
+    mm = MM(pool_size=1 << 20, block_size=4096, allocator="sizeclass")
+    try:
+        assert mm.pool_table() == []  # nothing carved yet
+        a = mm.allocate(4096, 2)      # class 4096
+        b = mm.allocate(5000, 2)      # rounds to class 8192
+        c = mm.allocate(100, 1)       # below min -> class 4096
+        assert a and b and c
+        tbl = mm.pool_table()
+        assert len(tbl) == 2          # one pool per touched class
+        classes = sorted(bs for _, _, bs in tbl)
+        assert classes == [4096, 8192]
+        # same-class requests share a pool; cross-class never do
+        assert {pi for pi, _ in a} == {pi for pi, _ in c}
+        assert {pi for pi, _ in a}.isdisjoint({pi for pi, _ in b})
+        # free and the blocks return to their class
+        for pi, off in b:
+            mm.deallocate(pi, off, 5000)
+        b2 = mm.allocate(8000, 2)
+        assert {pi for pi, _ in b2} == {pi for pi, _ in b}
+    finally:
+        mm.close()
+
+
+def test_sizeclass_budget_and_extend():
+    """The class pools carve from ONE budget; exhaustion sets
+    need_extend, add_mempool grants budget (not a pool), and the retry
+    carves the class that hit the wall."""
+    mm = MM(pool_size=1 << 18, block_size=4096, allocator="sizeclass")
+    try:
+        # 64 blocks of 4 KB = the whole 256 KB budget
+        assert mm.allocate(4096, 64) is not None
+        assert mm.allocate(4096, 1) is None
+        assert mm.need_extend
+        assert mm.add_mempool(1 << 18) is None  # budget, not a pool
+        mm.need_extend = False
+        assert mm.allocate(4096, 1) is not None
+        assert not mm.need_extend
+    finally:
+        mm.close()
+
+
+def test_sizeclass_usage_counts_uncarved_budget():
+    """usage() must count the uncarved budget as capacity — otherwise
+    eviction thresholds would fire while whole classes remain unused."""
+    mm = MM(pool_size=1 << 20, block_size=4096, allocator="sizeclass")
+    try:
+        regions = mm.allocate(4096, 16)  # 64 KB of a 1 MB budget
+        assert regions is not None
+        assert mm.usage() == pytest.approx(16 * 4096 / (1 << 20))
+    finally:
+        mm.close()
+
+
+def test_sizeclass_large_class_does_not_swallow_budget():
+    """A large first allocation must not carve the whole budget into its
+    class: the carve chunk is budget/CARVE_DIVISOR (plus one-block
+    minimum), so later classes still fit."""
+    mm = MM(pool_size=1 << 20, block_size=4096, allocator="sizeclass")
+    try:
+        big = mm.allocate(100 << 10, 1)   # class 128 KB > budget/4
+        assert big is not None
+        small = mm.allocate(4096, 8)      # a different class must still fit
+        assert small is not None
+    finally:
+        mm.close()
+
+
+def test_sizeclass_rejects_absurd_sizes():
+    mm = MM(pool_size=1 << 20, block_size=4096, allocator="sizeclass")
+    try:
+        assert mm.allocate(0, 1) is None
+        assert mm.allocate((1 << 50) + 1, 1) is None  # no pow2 overflow path
+    finally:
+        mm.close()

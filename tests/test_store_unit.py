@@ -324,3 +324,35 @@ def test_disk_tier_mixed_batch_get_desc_promotes_safely(tmp_path):
     for k, (pool_idx, offset, size) in zip(batch, descs):
         assert bytes(s.mm.view(pool_idx, offset, size)) == data[k]
     s.close()
+
+
+def test_sizeclass_pressure_evict_frees_full_class():
+    """sizeclass mode: one class's pools can be FULL while global usage
+    is low, so the usage-gated evict never fires — allocation failure
+    must pop LRU entries (reaching the full class's own) instead of
+    answering OUT_OF_MEMORY while evictable data sits in the way."""
+    store = make_store(prealloc_mb=1, block_kb=16)
+    store.mm.close()
+    from infinistore_tpu.mempool import MM
+
+    store.mm = MM(pool_size=1 << 20, block_size=16 << 10,
+                  allocator="sizeclass")
+    try:
+        # fill the 16 KB class: 1 MB budget / 16 KB = 64 entries max;
+        # carve chunks mean the class saturates well before the budget
+        # is globally "full"
+        i = 0
+        while store.put_inline(f"k{i}".encode(), b"x" * (16 << 10)) == P.FINISH:
+            i += 1
+            if i > 80:
+                break
+        assert i >= 16  # several carves landed
+        # keep putting: pressure eviction must keep these succeeding
+        # (old entries of the same class evict, LRU first)
+        for j in range(10):
+            assert store.put_inline(
+                f"n{j}".encode(), b"y" * (16 << 10)) == P.FINISH
+        assert store.get_inline(b"n9") is not None
+        assert store.get_inline(b"k0") is None  # LRU victim
+    finally:
+        store.mm.close()
