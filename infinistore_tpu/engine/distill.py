@@ -1,0 +1,184 @@
+"""Draft distillation: train a cheap draft on the target's own outputs.
+
+The reference's serving stack exposes speculative decoding as a
+throughput feature (vLLM; reference README's cluster story), which
+presumes a draft that actually agrees with the target.  When no natural
+small checkpoint exists, the standard recipe is DISTILLATION: sample
+trajectories from the target, train the draft with cross-entropy on
+them (sequence-level knowledge distillation, Kim & Rush 2016; the same
+recipe behind most production draft models).  This module is that
+recipe over our engines:
+
+1. ``generate_corpus``: batched greedy trajectories from the target
+   engine (the scheduler's lockstep path, so corpus generation runs at
+   serving throughput);
+2. ``distill``: AdamW-free plain-SGD training of a draft ``LlamaConfig``
+   on the corpus via ``models.llama.train_step_fn`` (one jitted step,
+   static shapes, donated params);
+3. ``acceptance_probe``: measured greedy agreement between draft and
+   target on held-out prompts — the number that decides whether
+   speculation pays (``SpeculativeDecoder`` emits exactly the target's
+   tokens regardless; acceptance only sets the speedup).
+
+Used by the bench's distilled-draft leg and usable standalone:
+
+    python -m infinistore_tpu.engine.distill --steps 300   # CPU demo
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .engine import InferenceEngine
+
+
+def generate_corpus(
+    target: InferenceEngine,
+    n_seqs: int = 32,
+    prompt_len: int = 16,
+    gen_len: int = 48,
+    batch: int = 8,
+    seed: int = 0,
+) -> np.ndarray:
+    """[n_seqs, prompt_len + gen_len] int32: random prompts + the
+    target's GREEDY continuations (greedy: the draft must learn the
+    argmax function speculation verifies against)."""
+    rng = np.random.RandomState(seed)
+    V = target.cfg.vocab_size
+    rows: List[List[int]] = []
+    for lo in range(0, n_seqs, batch):
+        b = min(batch, n_seqs - lo)
+        prompts = [
+            [int(x) for x in rng.randint(1, V, size=prompt_len)]
+            for _ in range(b)
+        ]
+        sts = [target.prefill(p) for p in prompts]
+        outs = target.decode_batch(sts, gen_len)
+        for p, o, st in zip(prompts, outs, sts):
+            rows.append(p + o)
+            target.release(st)
+    return np.asarray(rows, dtype=np.int32)
+
+
+def distill(
+    draft_cfg,
+    corpus: np.ndarray,
+    steps: int = 300,
+    batch: int = 16,
+    lr: float = 3e-3,
+    seed: int = 0,
+    params=None,
+):
+    """Train ``draft_cfg`` on the corpus (next-token cross-entropy over
+    the full sequences — prompts included, they are context the draft
+    must condition on during speculation).  Returns (params, losses) —
+    params in ``draft_cfg``'s dtype.
+
+    Training always runs in float32 regardless of the serving dtype:
+    plain-SGD updates at distillation learning rates UNDERFLOW in bf16
+    (measured: the same 1200 steps reached loss 1.2 in f32 vs 5.3 in
+    bf16) — the master-weights rule, applied by casting once at the
+    end."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.llama import init_params, scaled, train_step_fn
+
+    cfg32 = scaled(draft_cfg, dtype=jnp.float32)
+    if params is None:
+        params = init_params(cfg32, jax.random.PRNGKey(seed))
+    else:
+        params = jax.tree.map(lambda x: x.astype(jnp.float32), params)
+    step = jax.jit(train_step_fn(cfg32, lr=lr))
+    rng = np.random.RandomState(seed + 1)
+    losses: List[float] = []
+    n = corpus.shape[0]
+    for i in range(steps):
+        idx = rng.randint(0, n, size=min(batch, n))
+        toks = jax.numpy.asarray(corpus[idx])
+        params, loss = step(params, toks)
+        if i % 20 == 0 or i == steps - 1:
+            losses.append(float(np.asarray(loss)))
+    out_dtype = draft_cfg.dtype
+    params = jax.tree.map(lambda x: x.astype(out_dtype), params)
+    return params, losses
+
+
+def acceptance_probe(
+    target: InferenceEngine,
+    draft: InferenceEngine,
+    prompts: Sequence[Sequence[int]],
+    gen_len: int = 48,
+    k: int = 4,
+) -> Tuple[float, float]:
+    """(acceptance_rate, tokens_per_round) of draft-vs-target greedy
+    agreement, measured by actually running ``SpeculativeDecoder``
+    rounds on held-out prompts.  tokens_per_round = 1 + k*acceptance is
+    the speculation speedup's numerator."""
+    from .speculative import SpeculativeDecoder
+
+    spec = SpeculativeDecoder(target, draft, k=k)
+    for p in prompts:
+        st_t, st_d = spec.prefill(p)
+        spec.decode(st_t, st_d, gen_len)
+        spec.target.release(st_t)
+        spec.draft.release(st_d)
+    acc = spec.acceptance_rate
+    per_round = (spec.accepted + spec.rounds) / max(1, spec.rounds)
+    return acc, per_round
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser("distill_draft")
+    ap.add_argument("--steps", type=int, default=300)
+    ap.add_argument("--n-seqs", type=int, default=32)
+    ap.add_argument("--gen-len", type=int, default=48)
+    ap.add_argument("--spec-k", type=int, default=4)
+    args = ap.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..kv import PagedCacheConfig
+    from ..models import TINY, init_params, scaled
+
+    tcfg = scaled(TINY, dtype=jnp.float32)
+    tparams = init_params(tcfg, jax.random.PRNGKey(0))
+
+    def engine(cfg, params):
+        return InferenceEngine(params, cfg, PagedCacheConfig(
+            n_layers=cfg.n_layers, n_kv_heads=cfg.n_kv_heads,
+            head_dim=cfg.head_dim, n_blocks=256, block_tokens=4,
+            dtype=cfg.dtype,
+        ))
+
+    target = engine(tcfg, tparams)
+    corpus = generate_corpus(target, n_seqs=args.n_seqs,
+                             gen_len=args.gen_len)
+    dcfg = scaled(TINY, dtype=jnp.float32, n_layers=1, dim=64, ffn_dim=128)
+    dparams, losses = distill(dcfg, corpus, steps=args.steps)
+    print("distill losses:", [round(x, 3) for x in losses])
+
+    held_out = [
+        [int(x) for x in np.random.RandomState(100 + i).randint(
+            1, tcfg.vocab_size, size=16)]
+        for i in range(4)
+    ]
+    base_acc, _ = acceptance_probe(
+        engine(tcfg, tparams),
+        engine(dcfg, init_params(dcfg, jax.random.PRNGKey(9))),
+        held_out, gen_len=args.gen_len, k=args.spec_k)
+    acc, per_round = acceptance_probe(
+        engine(tcfg, tparams), engine(dcfg, dparams),
+        held_out, gen_len=args.gen_len, k=args.spec_k)
+    print(f"acceptance: random-init draft {base_acc:.3f} -> "
+          f"distilled {acc:.3f} ({per_round:.2f} tokens/round at "
+          f"k={args.spec_k})")
+
+
+if __name__ == "__main__":
+    main()

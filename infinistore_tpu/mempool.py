@@ -112,6 +112,7 @@ class Pool:
         self.pool_size = pool_size
         self.block_size = block_size
         self.total_blocks = pool_size // block_size
+        self.reclassified = False
         self.allocated_blocks = 0
         self._rover = 0
         self._occ = 0  # bitmap: bit i set => block i in use
@@ -205,6 +206,22 @@ class Pool:
         self._occ &= ~run_mask
         self.allocated_blocks -= k
 
+    def reclassify(self, new_block_size: int) -> None:
+        """Repurpose an EMPTY pool for another size class (sizeclass
+        MM: carved budget never returns, so an idle class's segment must
+        be reusable by a starved one).  Floor division — a segment of
+        3 x 16 KB becoming a 32 KB-class pool holds 1 block and wastes
+        the 16 KB tail until reclassified again."""
+        assert self.allocated_blocks == 0, "reclassify of a live pool"
+        assert self.pool_size >= new_block_size
+        self.block_size = new_block_size
+        self.total_blocks = self.pool_size // new_block_size
+        self.allocated_blocks = 0
+        self._rover = 0
+        self._occ = 0
+        self._full_mask = (1 << self.total_blocks) - 1
+        self.reclassified = True
+
     def close(self) -> None:
         self._closing = True
         if self._prefault_thread is not None:
@@ -287,9 +304,16 @@ class MM:
         return _pow2ceil(max(size, self.block_size))
 
     def _carve(self, cls: int) -> Optional[Pool]:
-        """Create a pool of class ``cls`` from the remaining budget (a
-        chunk of budget/CARVE_DIVISOR, at least 64 blocks, at most what
-        is left).  None when the budget is exhausted."""
+        """A pool of class ``cls``: first by RECLASSIFYING an empty pool
+        of another class (budget once carved never returns, so without
+        reclassification one busy class could permanently starve the
+        others), else by carving a chunk of budget/CARVE_DIVISOR (at
+        least one block) from what is left.  None when neither works."""
+        for pool in self.pools:
+            if (pool.block_size != cls and pool.allocated_blocks == 0
+                    and pool.pool_size >= cls):
+                pool.reclassify(cls)
+                return pool
         remaining = self._budget - self._carved
         # at least one block, never a many-block floor: a large class
         # would otherwise swallow the whole budget in one carve and
@@ -338,6 +362,29 @@ class MM:
 
     def deallocate(self, pool_idx: int, offset: int, size: int) -> None:
         self.pools[pool_idx].deallocate(offset, size)
+
+    def eviction_could_satisfy(self, size: int, n: int) -> bool:
+        """sizeclass only: could freeing committed entries EVER make
+        ``allocate(size, n)`` succeed?  Guards the store's pressure-
+        evict loop — without it, one unsatisfiable request would drain
+        the whole cache and still fail.  Counts this class's existing
+        blocks, blocks reclassifiable from other classes' segments once
+        they empty, and uncarved budget."""
+        if self.allocator != "sizeclass":
+            return False
+        if size == 0 or size > self.MAX_ALLOC_SIZE:
+            return False
+        cls = self._class_of(size)
+        have = sum(
+            p.total_blocks for p in self.pools if p.block_size == cls
+        )
+        reclassifiable = sum(
+            p.pool_size // cls
+            for p in self.pools
+            if p.block_size != cls and p.pool_size >= cls
+        )
+        budget_blocks = (self._budget - self._carved) // cls
+        return n <= have + reclassifiable + budget_blocks
 
     def view(self, pool_idx: int, offset: int, size: int) -> memoryview:
         return self.pools[pool_idx].buf[offset : offset + size]

@@ -340,7 +340,10 @@ class Store:
         regions = self.mm.allocate(size, n)
         if regions is None and self.maybe_extend():
             regions = self.mm.allocate(size, n)
-        if regions is None and self.mm.allocator == "sizeclass":
+        if (regions is None and self.mm.allocator == "sizeclass"
+                and self.mm.eviction_could_satisfy(size, n)):
+            # the guard keeps one unsatisfiable request from draining
+            # the whole cache through the loop and failing anyway
             while regions is None and self._pressure_evict() > 0:
                 regions = self.mm.allocate(size, n)
         return regions

@@ -125,6 +125,17 @@ void Pool::deallocate(uint64_t offset, uint64_t size) {
   allocated_blocks_ -= k;
 }
 
+void Pool::reclassify(uint64_t new_block_size) {
+  // carved budget never returns to the MM, so an idle class's segment
+  // must be reusable by a starved one (mirrors Python Pool.reclassify)
+  if (allocated_blocks_ != 0 || pool_size_ < new_block_size) return;
+  block_size_ = new_block_size;
+  total_blocks_ = pool_size_ / new_block_size;  // floor; tail wasted
+  allocated_blocks_ = 0;
+  rover_ = 0;
+  bitmap_.assign((total_blocks_ + 63) / 64, 0);
+}
+
 int sweep_stale_segments() {
   int removed = 0;
   DIR* d = opendir("/dev/shm");
@@ -178,10 +189,18 @@ uint64_t MM::class_of(uint64_t size) const {
 }
 
 Pool* MM::carve(uint64_t cls) {
-  // a chunk of budget/kCarveDivisor (at least one block, at most what's
-  // left), whole blocks only — mirrors the Python MM._carve.  No
-  // many-block floor: a large class would otherwise swallow the whole
-  // budget in one carve and wedge every other class.
+  // first try RECLASSIFYING an empty pool of another class (carved
+  // budget never returns, so one busy class must not permanently starve
+  // the rest), then carve fresh budget: a chunk of budget/kCarveDivisor
+  // (at least one block, at most what's left), whole blocks only —
+  // mirrors the Python MM._carve.
+  for (auto& p : pools_) {
+    if (p->block_size() != cls && p->allocated_blocks() == 0 &&
+        p->pool_size() >= cls) {
+      p->reclassify(cls);
+      return p.get();
+    }
+  }
   uint64_t remaining = budget_ - carved_;
   uint64_t want = std::max(budget_ / kCarveDivisor, cls);
   uint64_t take = std::min(want, remaining);
@@ -236,6 +255,21 @@ bool MM::allocate(uint64_t size, size_t n, std::vector<Region>* out) {
 
 void MM::deallocate(uint32_t pool_idx, uint64_t offset, uint64_t size) {
   pools_[pool_idx]->deallocate(offset, size);
+}
+
+bool MM::eviction_could_satisfy(uint64_t size, size_t n) const {
+  if (allocator_ != Allocator::kSizeClass) return false;
+  if (size == 0 || size > kMaxAllocSize) return false;
+  uint64_t cls = class_of(size);
+  uint64_t have = 0, reclassifiable = 0;
+  for (const auto& p : pools_) {
+    if (p->block_size() == cls)
+      have += p->total_blocks();
+    else if (p->pool_size() >= cls)
+      reclassifiable += p->pool_size() / cls;
+  }
+  uint64_t budget_blocks = (budget_ - carved_) / cls;
+  return n <= have + reclassifiable + budget_blocks;
 }
 
 double MM::usage() const {

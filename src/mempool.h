@@ -37,6 +37,9 @@ class Pool {
   // Returns byte offset into the pool, or -1.  size is rounded up to blocks.
   int64_t allocate(uint64_t size);
   void deallocate(uint64_t offset, uint64_t size);
+  // Repurpose an EMPTY pool for another size class (sizeclass MM) —
+  // floor division; a non-multiple tail is wasted until reclassified.
+  void reclassify(uint64_t new_block_size);
 
   uint8_t* data() const { return base_; }
   const std::string& name() const { return name_; }
@@ -93,6 +96,10 @@ class MM {
   // (reference: src/mempool.cpp MM::allocate's callback-per-region loop).
   bool allocate(uint64_t size, size_t n, std::vector<Region>* out);
   void deallocate(uint32_t pool_idx, uint64_t offset, uint64_t size);
+
+  // sizeclass only: could freeing committed entries EVER make
+  // allocate(size, n) succeed?  Guards the store's pressure-evict loop.
+  bool eviction_could_satisfy(uint64_t size, size_t n) const;
 
   uint8_t* view(uint32_t pool_idx, uint64_t offset) const {
     return pools_[pool_idx]->data() + offset;

@@ -325,8 +325,9 @@ bool Store::allocate(uint64_t size, size_t n, std::vector<Region>* out) {
     mm_.need_extend = false;
     if (mm_.allocate(size, n, out)) return true;
   }
-  if (cfg_.allocator == "sizeclass") {
-    // class-pressure eviction (see pressure_evict)
+  if (cfg_.allocator == "sizeclass" && mm_.eviction_could_satisfy(size, n)) {
+    // class-pressure eviction (see pressure_evict); the guard keeps one
+    // unsatisfiable request from draining the whole cache and failing
     while (pressure_evict(8) > 0) {
       if (mm_.allocate(size, n, out)) return true;
     }

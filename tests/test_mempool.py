@@ -219,3 +219,38 @@ def test_sizeclass_rejects_absurd_sizes():
         assert mm.allocate((1 << 50) + 1, 1) is None  # no pow2 overflow path
     finally:
         mm.close()
+
+
+def test_sizeclass_reclassifies_empty_pools_across_classes():
+    """Carved budget never returns, so a fully-carved busy class must
+    not starve the others forever: once its pools empty, a different
+    class RECLASSIFIES the segments."""
+    mm = MM(pool_size=1 << 18, block_size=4096, allocator="sizeclass")
+    try:
+        # carve the whole 256 KB budget into the 4 KB class
+        a = mm.allocate(4096, 64)
+        assert a is not None
+        assert mm.allocate(8192, 1) is None  # no budget for a new class
+        for pi, off in a:
+            mm.deallocate(pi, off, 4096)
+        b = mm.allocate(8192, 4)  # empty 4 KB pools reclassify to 8 KB
+        assert b is not None
+        classes = {bs for _, _, bs in mm.pool_table()}
+        assert 8192 in classes
+    finally:
+        mm.close()
+
+
+def test_sizeclass_eviction_could_satisfy_guard():
+    """The store's pressure-evict loop must not run for requests no
+    amount of eviction can satisfy."""
+    mm = MM(pool_size=1 << 18, block_size=4096, allocator="sizeclass")
+    try:
+        assert mm.eviction_could_satisfy(4096, 1)
+        assert mm.eviction_could_satisfy(4096, 64)
+        assert not mm.eviction_could_satisfy(4096, 65)   # > whole budget
+        assert not mm.eviction_could_satisfy(1 << 20, 1)  # class > budget
+        assert not mm.eviction_could_satisfy(0, 1)
+        assert not mm.eviction_could_satisfy((1 << 50) + 1, 1)
+    finally:
+        mm.close()
