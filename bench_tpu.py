@@ -201,14 +201,16 @@ def leg_flash_kernel(out: dict) -> None:
     sizes = ((256, "2k"), (1024, "8k")) if smoke else (
         (2048, "2k"), (8192, "8k"))
     for S, tag in sizes:
-        flash_ms, flash_sp = bench_backend(S)
-        os.environ["ISTPU_NO_PALLAS"] = "1"
+        # flash is OPT-IN now (the r4-recorded number favored XLA and
+        # the default follows the bench); this leg measures both anyway
+        os.environ["ISTPU_PALLAS_PREFILL"] = "1"
         eng_mod._JIT_CACHE.clear()
         try:
-            xla_ms, xla_sp = bench_backend(S)
+            flash_ms, flash_sp = bench_backend(S)
         finally:
-            del os.environ["ISTPU_NO_PALLAS"]
+            del os.environ["ISTPU_PALLAS_PREFILL"]
             eng_mod._JIT_CACHE.clear()
+        xla_ms, xla_sp = bench_backend(S)  # the shipping default
         out[f"flash_prefill_{tag}_ms"] = round(flash_ms, 1)
         out[f"flash_prefill_{tag}_spread"] = flash_sp
         out[f"xla_prefill_{tag}_ms"] = round(xla_ms, 1)
@@ -625,7 +627,8 @@ def leg_prefill_breakdown(out: dict) -> None:
     @jax.jit
     def attn_step(q):
         def body(qc, _):
-            # same attention entry (and pallas/XLA default) prefill uses
+            # same attention entry AND the same default path prefill
+            # uses (flash is opt-in; env controls it here as there)
             o = causal_attention(qc, qc[:, :, : cfg.n_kv_heads],
                                  qc[:, :, : cfg.n_kv_heads],
                                  allow_pallas=True)
