@@ -202,15 +202,30 @@ def leg_flash_kernel(out: dict) -> None:
         (2048, "2k"), (8192, "8k"))
     for S, tag in sizes:
         # flash is OPT-IN now (the r4-recorded number favored XLA and
-        # the default follows the bench); this leg measures both anyway
+        # the default follows the bench); this leg measures both anyway.
+        # Save/RESTORE the operator's own flag value — deleting it
+        # outright would silently flip every later leg to XLA under
+        # `ISTPU_PALLAS_PREFILL=1 python bench_tpu.py`.
+        prior = os.environ.get("ISTPU_PALLAS_PREFILL")
         os.environ["ISTPU_PALLAS_PREFILL"] = "1"
         eng_mod._JIT_CACHE.clear()
         try:
             flash_ms, flash_sp = bench_backend(S)
         finally:
-            del os.environ["ISTPU_PALLAS_PREFILL"]
+            if prior is None:
+                del os.environ["ISTPU_PALLAS_PREFILL"]
+            else:
+                os.environ["ISTPU_PALLAS_PREFILL"] = prior
             eng_mod._JIT_CACHE.clear()
-        xla_ms, xla_sp = bench_backend(S)  # the shipping default
+        # the OTHER side must actually be XLA even if the operator set
+        # the opt-in globally
+        prior = os.environ.pop("ISTPU_PALLAS_PREFILL", None)
+        try:
+            xla_ms, xla_sp = bench_backend(S)  # the shipping default
+        finally:
+            if prior is not None:
+                os.environ["ISTPU_PALLAS_PREFILL"] = prior
+            eng_mod._JIT_CACHE.clear()
         out[f"flash_prefill_{tag}_ms"] = round(flash_ms, 1)
         out[f"flash_prefill_{tag}_spread"] = flash_sp
         out[f"xla_prefill_{tag}_ms"] = round(xla_ms, 1)

@@ -176,7 +176,9 @@ def causal_attention(
     False under a GSPMD-partitioned jit (same rule as
     ``paged_decode_attention`` below) — which is why the sharded callers
     in parallel/ use the default.  ``ISTPU_NO_PALLAS=1`` forces the XLA
-    path everywhere regardless.
+    path on hardware; the one exception is ``ISTPU_PALLAS_INTERPRET=1``
+    (the CPU-mesh test path), which runs the tp flash kernel in
+    interpret mode by explicit request.
 
     ``window``: sliding-window attention (Mistral) — a key is visible iff
     ``q_pos - window < k_pos <= q_pos`` (HF convention).  Forces the XLA

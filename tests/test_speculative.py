@@ -437,17 +437,17 @@ def test_scheduler_spec_windowed_target_reclaims_pages():
         return make_engine(wparams, wcfg)
 
     plain = Scheduler(weng())
-    rid = plain.submit(PROMPT, max_new_tokens=60)
+    rid = plain.submit(PROMPT, max_new_tokens=44)
     want = plain.run()[rid]
 
-    # 11 + 60 tokens -> 18 pages un-reclaimed; hoard pages until only 12
+    # 11 + 44 tokens -> 14 pages un-reclaimed; hoard pages until only 12
     # remain so reclamation is forced WITHOUT a bespoke cache shape
     pressured = weng()
     hoard = pressured.pages.acquire(64 - 12)
     assert pressured.free_pages == 12
     sched = Scheduler(pressured, draft_engine=make_engine(
         DRAFT_PARAMS, DRAFT_CFG), spec_k=4)
-    rid = sched.submit(PROMPT, max_new_tokens=60)
+    rid = sched.submit(PROMPT, max_new_tokens=44)
     results = {}
     reqs = []
     while sched.has_work:
