@@ -200,32 +200,37 @@ def leg_flash_kernel(out: dict) -> None:
     smoke = os.environ.get("ISTPU_BENCH_MODEL") == "tiny"
     sizes = ((256, "2k"), (1024, "8k")) if smoke else (
         (2048, "2k"), (8192, "8k"))
-    for S, tag in sizes:
-        # flash is OPT-IN now (the r4-recorded number favored XLA and
-        # the default follows the bench); this leg measures both anyway.
-        # Save/RESTORE the operator's own flag value — deleting it
-        # outright would silently flip every later leg to XLA under
-        # `ISTPU_PALLAS_PREFILL=1 python bench_tpu.py`.
-        prior = os.environ.get("ISTPU_PALLAS_PREFILL")
-        os.environ["ISTPU_PALLAS_PREFILL"] = "1"
+    import contextlib
+
+    @contextlib.contextmanager
+    def env_var(name: str, value):
+        """Set (value=str) or unset (value=None) ``name`` for the block,
+        restore the operator's own value after, and clear the jit cache
+        on BOTH transitions — trace-time env reads demand a retrace, and
+        a leaked override would silently flip every later leg."""
+        prior = os.environ.get(name)
+        if value is None:
+            os.environ.pop(name, None)
+        else:
+            os.environ[name] = value
         eng_mod._JIT_CACHE.clear()
         try:
-            flash_ms, flash_sp = bench_backend(S)
+            yield
         finally:
             if prior is None:
-                del os.environ["ISTPU_PALLAS_PREFILL"]
+                os.environ.pop(name, None)
             else:
-                os.environ["ISTPU_PALLAS_PREFILL"] = prior
+                os.environ[name] = prior
             eng_mod._JIT_CACHE.clear()
-        # the OTHER side must actually be XLA even if the operator set
-        # the opt-in globally
-        prior = os.environ.pop("ISTPU_PALLAS_PREFILL", None)
-        try:
-            xla_ms, xla_sp = bench_backend(S)  # the shipping default
-        finally:
-            if prior is not None:
-                os.environ["ISTPU_PALLAS_PREFILL"] = prior
-            eng_mod._JIT_CACHE.clear()
+
+    for S, tag in sizes:
+        # flash is OPT-IN now (the r4-recorded number favored XLA and
+        # the default follows the bench); this leg measures both sides
+        # regardless of how the operator set the flag globally
+        with env_var("ISTPU_PALLAS_PREFILL", "1"):
+            flash_ms, flash_sp = bench_backend(S)
+        with env_var("ISTPU_PALLAS_PREFILL", None):
+            xla_ms, xla_sp = bench_backend(S)
         out[f"flash_prefill_{tag}_ms"] = round(flash_ms, 1)
         out[f"flash_prefill_{tag}_spread"] = flash_sp
         out[f"xla_prefill_{tag}_ms"] = round(xla_ms, 1)

@@ -398,12 +398,59 @@ class InferenceEngine:
             assert pc.n_kv_heads % tp == 0, (
                 f"n_kv_heads={pc.n_kv_heads} must divide over tp={tp}"
             )
+            # pp axis (when the mesh carries one with size > 1): the
+            # STACKED layer axis shards across pipeline stages — each
+            # stage holds n_layers/pp layers' weights AND their KV, so a
+            # model that doesn't fit tp-sharded on one stage's chips
+            # still serves (the 70B-on-16GB-chips story).  Decode is
+            # inherently sequential through layers, so GSPMD lowers the
+            # layer scan to per-stage compute with activation transfers
+            # between stages — pipeline parallelism in its decode-shaped
+            # degenerate form (no microbatch overlap; prefill chunks and
+            # lockstep batches provide the parallel work instead).
+            pp = dict(mesh.shape).get("pp", 1)
+            layer_axis = None
+            if pp > 1:
+                assert cfg.n_layers % pp == 0, (
+                    f"n_layers={cfg.n_layers} must divide over pp={pp}"
+                )
+                layer_axis = "pp"
+                if param_specs is None:
+                    from ..parallel.sharding import llama_inference_specs
+
+                    param_specs = llama_inference_specs(params, cfg)
+                    param_specs["layers"] = {
+                        k: PartitionSpec("pp", *tuple(s)[1:])
+                        for k, s in param_specs["layers"].items()
+                    }
+                elif not any(
+                    "pp" in tuple(s)
+                    for s in jax.tree.leaves(
+                        param_specs.get("layers", {}),
+                        is_leaf=lambda x: isinstance(x, PartitionSpec),
+                    )
+                ):
+                    # caller-supplied specs are authoritative, but on a
+                    # pp mesh a layer stack with no pp axis REPLICATES
+                    # full weights on every stage while the cache
+                    # shards — the memory halving silently not
+                    # happening is exactly how the 70B case OOMs
+                    import warnings
+
+                    warnings.warn(
+                        "pp>1 mesh but param_specs shard no layer leaf "
+                        "over 'pp': weights will replicate per stage",
+                        stacklevel=2,
+                    )
             self.params = shard_params(params, mesh, param_specs)
             # cache [L, 2, H_kv, n_blocks, T, D]: KV-head axis over tp,
-            # matching the head-sharded wk/wv so decode stays head-local
+            # matching the head-sharded wk/wv so decode stays head-local;
+            # layer axis over pp when pipeline-sharded (each stage keeps
+            # its own layers' pages)
             self.cache = jax.device_put(
                 init_cache(pc),
-                NamedSharding(mesh, PartitionSpec(None, None, "tp")),
+                NamedSharding(mesh,
+                              PartitionSpec(layer_axis, None, "tp")),
             )
         else:
             self.params = params
