@@ -243,6 +243,7 @@ def leg_flash_kernel(out: dict) -> None:
 def leg_store_hop(out: dict) -> None:
     """HBM <-> store bandwidth through a live server (Llama-3-8B KV shapes,
     SURVEY §6 config 2; 64 KiB/page/layer, 128 MiB per round)."""
+    import jax
     import jax.numpy as jnp
 
     from infinistore_tpu import ClientConfig, InfinityConnection
@@ -309,6 +310,43 @@ def leg_store_hop(out: dict) -> None:
 
         out["hbm_put_gbps"] = round(chunk_bytes / t_put / 1e9, 2)
         out["hbm_get_gbps"] = round(chunk_bytes / t_get / 1e9, 2)
+
+        # RAW transfer floor alongside (VERDICT r4 weak #4: the
+        # "design-bound vs tunnel-bound" split must be IN the JSON, not
+        # asserted): plain device_get/device_put of a 64 MiB buffer —
+        # no store, no gather, no pool.  If hbm_*_gbps ≈ these floors,
+        # the store hop adds nothing and the bottleneck is the link.
+        import numpy as _np
+
+        raw = jnp.zeros((32 << 20,), jnp.uint16)  # 64 MiB
+        raw = (raw + 1).block_until_ready()
+        jax.device_get(raw)  # warm the d2h path
+        harr0 = _np.asarray(jax.device_get(raw))
+        _fetch(jax.device_put(harr0)[:8])  # warm h2d + the fetch program
+
+        def one_d2h() -> float:
+            # fresh buffer per repeat (trap 2), GROUND-TRUTHED before
+            # timing (trap 1: block_until_ready returns optimistically
+            # here, so the add must be proven done via a data fetch)
+            one_d2h.i = getattr(one_d2h, "i", 0) + 1
+            r = raw + one_d2h.i
+            _fetch(r[:8])
+            t0 = time.perf_counter()
+            jax.device_get(r)
+            return time.perf_counter() - t0
+
+        def one_h2d() -> float:
+            one_h2d.i = getattr(one_h2d, "i", 0) + 1
+            h = harr0 + one_h2d.i  # fresh host buffer per repeat
+            t0 = time.perf_counter()
+            dev = jax.device_put(h)
+            _fetch(dev[:8])
+            return time.perf_counter() - t0
+
+        t_d2h = min(one_d2h() for _ in range(2))
+        t_h2d = min(one_h2d() for _ in range(2))
+        out["raw_d2h_gbps"] = round(raw.nbytes / t_d2h / 1e9, 3)
+        out["raw_h2d_gbps"] = round(raw.nbytes / t_h2d / 1e9, 3)
         conn.close()
     finally:
         proc.terminate()

@@ -398,16 +398,22 @@ class InferenceEngine:
             assert pc.n_kv_heads % tp == 0, (
                 f"n_kv_heads={pc.n_kv_heads} must divide over tp={tp}"
             )
-            # pp axis (when the mesh carries one with size > 1): the
-            # STACKED layer axis shards across pipeline stages — each
-            # stage holds n_layers/pp layers' weights AND their KV, so a
-            # model that doesn't fit tp-sharded on one stage's chips
-            # still serves (the 70B-on-16GB-chips story).  Decode is
-            # inherently sequential through layers, so GSPMD lowers the
-            # layer scan to per-stage compute with activation transfers
-            # between stages — pipeline parallelism in its decode-shaped
-            # degenerate form (no microbatch overlap; prefill chunks and
-            # lockstep batches provide the parallel work instead).
+            # pp axis (when the mesh carries one with size > 1):
+            # LAYER-SHARDED serving, ZeRO-3-style weight streaming — the
+            # STACKED layer axis of params AND paged KV rests sharded
+            # across the pp group (each device holds n_layers/pp layers'
+            # weights and pages), and the forward's static layer loop
+            # makes GSPMD gather each layer's shard just-in-time and
+            # free it after use.  Peak memory ≈ resident/pp + one layer,
+            # which is what lets a model too big for tp alone serve at
+            # all (the 70B-on-16GB-chips story); the PRICE is per-step
+            # weight traffic ≈ model_bytes/tp over the pp links and
+            # compute replicated across the pp group — fitting traded
+            # against throughput.  This is NOT stage-pipelined serving
+            # (no per-stage compute/activation hand-off; that shape
+            # lives in parallel/pipeline.py for training and would need
+            # a shard_map'd serving loop to be worth building only if a
+            # real deployment hits this wall).
             pp = dict(mesh.shape).get("pp", 1)
             layer_axis = None
             if pp > 1:

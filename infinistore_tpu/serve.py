@@ -60,7 +60,8 @@ class ServingServer:
                  max_batch: int = 8, model_id: str = "infinistore-tpu",
                  tokenizer=None, draft_engine=None, spec_k: int = 4,
                  max_queue: Optional[int] = None, spec_batch: int = 1,
-                 ngram_spec: bool = False, spec_g: int = 2):
+                 ngram_spec: bool = False, spec_g: int = 2,
+                 prefill_concurrency: int = 4):
         """``tokenizer``: any object with ``encode(str) -> [int]`` and
         ``decode([int]) -> str`` (an HF tokenizer qualifies) — enables
         string prompts, text responses, and string stop sequences.
@@ -79,7 +80,8 @@ class ServingServer:
         self.sched = Scheduler(engine, max_batch=max_batch,
                                draft_engine=draft_engine, spec_k=spec_k,
                                spec_batch=spec_batch,
-                               ngram_spec=ngram_spec, spec_g=spec_g)
+                               ngram_spec=ngram_spec, spec_g=spec_g,
+                               prefill_concurrency=prefill_concurrency)
         self._cv = threading.Condition()
         self._staged: List[Dict[str, Any]] = []   # submissions from handlers
         self._cancels: List[int] = []
@@ -1337,6 +1339,10 @@ def main(argv: Optional[List[str]] = None) -> None:
     ap.add_argument("--n-blocks", type=int, default=512)
     ap.add_argument("--block-tokens", type=int, default=16)
     ap.add_argument("--prefill-chunk", type=int, default=None)
+    ap.add_argument("--prefill-concurrency", type=int, default=4,
+                    help="newcomers ingesting one prompt chunk each per "
+                         "scheduler step, interleaved with decode; raise "
+                         "it when TTFT queue-wait dominates /metrics")
     ap.add_argument("--decode-chunk", type=int, default=32,
                     help="tokens per compiled decode dispatch: 32 favors "
                     "streaming granularity, 64/128 trade it for throughput "
@@ -1493,7 +1499,8 @@ def main(argv: Optional[List[str]] = None) -> None:
                         tokenizer=tokenizer, draft_engine=draft_engine,
                         spec_k=args.spec_k, max_queue=args.max_queue,
                         spec_batch=args.spec_batch,
-                        ngram_spec=args.ngram_spec, spec_g=args.spec_g)
+                        ngram_spec=args.ngram_spec, spec_g=args.spec_g,
+                        prefill_concurrency=args.prefill_concurrency)
     srv.start()
     try:
         while True:
