@@ -1436,6 +1436,38 @@ def main() -> int:
 
     # final line includes any *_skipped markers written on the continue path
     print(json.dumps(out), flush=True)
+
+    # refresh the committed stale-fallback snapshot whenever a real-chip
+    # run completes (the tunnel can wedge for hours — capture evidence
+    # the moment it answers; bench.py merges this file marked stale if
+    # the tunnel is dead at bench time)
+    # success gate: a degraded run (tunnel wedged mid-run -> all legs
+    # errored/skipped) must NOT clobber the committed good capture that
+    # bench.py falls back on — that fallback exists precisely for the
+    # degraded case
+    ok_legs = sum(1 for name, _ in legs if f"{name}_s" in out)
+    bad_legs = sum(
+        1 for name, _ in legs
+        if f"{name}_error" in out or f"{name}_skipped" in out
+    )
+    healthy = ok_legs >= 5 and ok_legs > bad_legs
+    if (platform == "tpu" and healthy
+            and os.environ.get("ISTPU_WRITE_SNAPSHOT", "1") != "0"):
+        snap = {
+            "captured_utc": time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "note": "real-chip bench_tpu.py output (ground-truth "
+                    "timing); auto-refreshed on successful runs",
+            **out,
+        }
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_TPU_SNAPSHOT.json")
+        try:
+            with open(path, "w") as f:
+                json.dump(snap, f, indent=1)
+            print(f"# snapshot refreshed: {path}", file=sys.stderr)
+        except OSError as e:
+            print(f"# snapshot refresh failed: {e}", file=sys.stderr)
     return 0
 
 

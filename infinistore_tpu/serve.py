@@ -659,6 +659,8 @@ class ServingServer:
         if self.sched.spec is not None:
             sm = self.sched.spec_metrics
             lines += [
+                "# TYPE istpu_spec_kind gauge",
+                f'istpu_spec_kind{{kind="{self.sched.spec_kind}"}} 1',
                 "# TYPE istpu_spec_rounds_total counter",
                 f"istpu_spec_rounds_total {sm['rounds']}",
                 "# TYPE istpu_spec_proposed_tokens_total counter",
