@@ -36,8 +36,15 @@ def tp_layer_forward(
     tp: int,
     tp_axis: str = "tp",
     sp_axis: str = "sp",
-) -> jax.Array:
-    """One decoder layer, tp/sp-manual.  x: [B, S_loc, dim] local."""
+    return_kv: bool = False,
+) -> "jax.Array | tuple[jax.Array, tuple[jax.Array, jax.Array]]":
+    """One decoder layer, tp/sp-manual.  x: [B, S_loc, dim] local.
+
+    ``return_kv=True`` additionally returns this layer's (post-RoPE K,
+    V) local shards — the serving KV contract (models.llama
+    prefill_forward stores K after RoPE), used by
+    ``sharding.make_sp_prefill`` to page ring-attention prefill output
+    into the HBM cache."""
     B, S, _ = x.shape
     hd = cfg.head_dim
     h_loc = cfg.n_heads // tp
@@ -64,6 +71,8 @@ def tp_layer_forward(
     h = rmsnorm(x, layer["ln_mlp"], cfg.norm_eps)
     mlp = (jax.nn.silu(h @ layer["w_gate"]) * (h @ layer["w_up"])) @ layer["w_down"]
     x = x + lax.psum(mlp, tp_axis)
+    if return_kv:
+        return x, (k, v)
     return x
 
 
