@@ -33,6 +33,7 @@ from .sharding import (
     llama_inference_specs,
     shard_params,
     shardings_for,
+    make_sp_prefill,
     make_tp_prefill,
     make_tp_decode,
 )
@@ -62,6 +63,7 @@ __all__ = [
     "llama_inference_specs",
     "shard_params",
     "shardings_for",
+    "make_sp_prefill",
     "make_tp_prefill",
     "make_tp_decode",
     "init_sharded_params",

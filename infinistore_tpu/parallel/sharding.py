@@ -132,6 +132,10 @@ def make_sp_prefill(cfg: LlamaConfig, mesh: Mesh):
     assert cfg.sliding_window is None, "ring attention carries no window"
     assert cfg.attn_softcap is None and cfg.final_softcap is None
     assert not cfg.post_norms and not cfg.embed_scale
+    # tp_layer_forward hardcodes silu / no-offset rmsnorm / 1/sqrt(D)
+    # scale — reject configs it would silently miscompute
+    assert cfg.act == "silu" and not cfg.norm_offset
+    assert cfg.query_pre_attn_scalar is None
     sp = mesh.shape["sp"]
     tp = mesh.shape["tp"]
     assert cfg.n_kv_heads % tp == 0 and cfg.n_heads % tp == 0

@@ -1297,3 +1297,43 @@ def test_metrics_ttft_split(server):
     assert vals["istpu_serve_queue_wait_p50_ms"] >= 0.0
     lm = server.sched.latency_metrics
     assert lm["window"] >= 1
+
+
+def test_ngram_spec_http_matches_greedy():
+    """--ngram-spec over HTTP: draft-model-free speculation returns
+    exactly the plain greedy output; /metrics labels the mode and the
+    counters advance.  A sampled request on the same server falls back
+    to lockstep decode (still correct)."""
+    eng = InferenceEngine(
+        PARAMS, CFG,
+        PagedCacheConfig(
+            n_layers=CFG.n_layers, n_kv_heads=CFG.n_kv_heads,
+            head_dim=CFG.head_dim, n_blocks=64, block_tokens=4,
+            dtype=CFG.dtype,
+        ),
+    )
+    srv = ServingServer(eng, port=0, max_batch=2, model_id="ngram-test",
+                        ngram_spec=True, spec_k=4, spec_g=2)
+    srv.start()
+    try:
+        status, body = _post(srv.port, {
+            "prompt": PROMPT, "max_tokens": 10, "temperature": 0,
+        })
+        assert status == 200, body
+        assert body["choices"][0]["token_ids"] == dense_greedy(PROMPT, 10)
+
+        status, body = _post(srv.port, {
+            "prompt": PROMPT, "max_tokens": 6, "temperature": 1.2,
+        })
+        assert status == 200, body  # sampled: lockstep fallback, no crash
+
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=30)
+        conn.request("GET", "/metrics")
+        text = conn.getresponse().read().decode()
+        conn.close()
+        assert 'istpu_spec_kind{kind="ngram"} 1' in text
+        rounds = [line for line in text.splitlines()
+                  if line.startswith("istpu_spec_rounds_total")]
+        assert rounds and float(rounds[0].split()[1]) >= 1
+    finally:
+        srv.close()
