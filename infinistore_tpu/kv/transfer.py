@@ -57,13 +57,24 @@ class KVTransferEngine:
         # bytes of one page as it crosses the wire / sits in the pool
         self.wire_page_bytes = page_quant_bytes(cfg) if quant else cfg.page_bytes
         self._key_suffix = ":q8" if quant else ""
-        self._staging: Optional[np.ndarray] = None
+        # DOUBLE-buffered staging, alternated per load call: the banded
+        # load hands numpy views to jax.device_put (async H2D; on the
+        # CPU backend possibly a zero-copy alias), so the buffer a call
+        # used must not be rewritten by the NEXT call's pool reads while
+        # transfers could still be in flight — the alternation plus the
+        # end-of-call block makes reuse safe even on runtimes whose
+        # block_until_ready is optimistic (docs/tpu_perf_notes.md trap 1)
+        self._staging: list = [None, None]
+        self._staging_idx = 0
 
     def _ensure_staging(self, nbytes: int) -> np.ndarray:
-        if self._staging is None or self._staging.nbytes < nbytes:
-            self._staging = np.empty(nbytes, dtype=np.uint8)
-            self.conn.register_mr(self._staging.ctypes.data, self._staging.nbytes)
-        return self._staging
+        self._staging_idx ^= 1
+        buf = self._staging[self._staging_idx]
+        if buf is None or buf.nbytes < nbytes:
+            buf = np.empty(nbytes, dtype=np.uint8)
+            self.conn.register_mr(buf.ctypes.data, buf.nbytes)
+            self._staging[self._staging_idx] = buf
+        return buf
 
     def _page_blocks(
         self, chunk_keys_: Sequence[str], l0: int, l1: int
@@ -143,6 +154,13 @@ class KVTransferEngine:
     ) -> jax.Array:
         """Get pages from the store and scatter them into HBM.
 
+        Mirror image of ``push_pages``'s banding: the read splits into
+        layer bands, and each band's H2D upload (``jax.device_put`` is
+        asynchronous) overlaps the NEXT band's pool→staging read — the
+        socket/pool copy rides behind the host→device DMA instead of
+        serializing with it.  Bands write to DISTINCT staging offsets,
+        so an in-flight upload never races the next read.
+
         Returns the updated cache array.  Raises InfiniStoreKeyNotFound if
         any page is missing (reference read semantics).
         """
@@ -151,24 +169,44 @@ class KVTransferEngine:
         if n == 0:
             return cache
         pb = self.wire_page_bytes
-        blocks = self._page_blocks(chunk_keys_, 0, self.cfg.n_layers)
-        nbytes = len(blocks) * pb
-        staging = self._ensure_staging(nbytes)
-        self.conn.read_cache(blocks, pb, staging.ctypes.data)
         L = self.cfg.n_layers
+        nbytes = L * n * pb
+        staging = self._ensure_staging(nbytes)
+        G = max(1, min(self.pipeline_groups, L))
+        Lg = -(-L // G)
+        devs = []
+        for l0 in range(0, L, Lg):
+            l1 = min(l0 + Lg, L)
+            blocks = self._page_blocks(chunk_keys_, l0, l1)
+            off = l0 * n * pb
+            span = (l1 - l0) * n * pb
+            self.conn.read_cache(blocks, pb, staging.ctypes.data + off)
+            band = staging[off : off + span]
+            if self.quant:
+                host = band.reshape(l1 - l0, n, pb)
+            else:
+                host = (
+                    band.view(jnp.dtype(self.cfg.dtype))
+                    .reshape((l1 - l0, n) + self.cfg.page_shape)
+                )
+            # async H2D: returns immediately; the next band's read_cache
+            # (socket + pool memcpy) overlaps this band's DMA
+            devs.append(jax.device_put(host))
+        # single band: already [L, n, ...] — don't pay a concat copy
+        stacked = devs[0] if len(devs) == 1 else jnp.concatenate(devs, axis=0)
         if self.quant:
-            packed = jnp.asarray(staging[:nbytes].reshape(L, n, pb))
-            unpacked = dequantize_pages_jit(packed, self.cfg)  # [L, n, 2, H, T, D]
+            unpacked = dequantize_pages_jit(stacked, self.cfg)  # [L, n, 2, H, T, D]
             pages = jnp.transpose(unpacked, (0, 2, 3, 1, 4, 5))
         else:
-            host = (
-                staging[:nbytes]
-                .view(jnp.dtype(self.cfg.dtype))
-                .reshape((L, n) + self.cfg.page_shape)  # [L, n, 2, H, T, D]
-            )
-            pages = jnp.transpose(jnp.asarray(host), (0, 2, 3, 1, 4, 5))  # [L,2,H,n,T,D]
+            pages = jnp.transpose(stacked, (0, 2, 3, 1, 4, 5))  # [L,2,H,n,T,D]
         ids = jnp.asarray(np.asarray(block_ids, dtype=np.int32))
-        return write_pages(cache, ids, pages)
+        out = write_pages(cache, ids, pages)
+        # materialize before returning: every read of this call's staging
+        # buffer must complete before a LATER call can rewrite it (with
+        # the double buffer above, a stale optimistic sync would need two
+        # further loads to become dangerous)
+        jax.block_until_ready(out)
+        return out
 
     def lookup_prefix(self, chunk_keys_: Sequence[str]) -> int:
         """Longest store-resident prefix, in chunks.  Probes layer 0 keys

@@ -45,6 +45,14 @@ def tp_layer_forward(
     prefill_forward stores K after RoPE), used by
     ``sharding.make_sp_prefill`` to page ring-attention prefill output
     into the HBM cache."""
+    # this manual path hardcodes silu, offset-free rmsnorm, and the
+    # 1/sqrt(D) attention scale: reject configs it would silently
+    # miscompute (Gemma-style knobs) for EVERY caller, train or serve
+    assert cfg.act == "silu" and not cfg.norm_offset, (
+        "tp_layer_forward supports silu + plain rmsnorm only"
+    )
+    assert cfg.query_pre_attn_scalar is None and cfg.attn_softcap is None
+    assert not cfg.post_norms
     B, S, _ = x.shape
     hd = cfg.head_dim
     h_loc = cfg.n_heads // tp
