@@ -787,6 +787,47 @@ class InferenceEngine:
         self.seqs[state.seq_id] = state
         return state
 
+    def adopt_prefill(self, tokens: Sequence[int], kv: jax.Array,
+                      last_logits: jax.Array) -> SequenceState:
+        """Adopt prompt KV computed OUTSIDE this engine and return a
+        decode-ready ``SequenceState`` — the public ingestion point for
+        external prefill producers: ``parallel.sharding.make_sp_prefill``
+        (sequence-parallel long-context ingestion on a mesh), an offline
+        prefill job, or any source honoring ``prefill_forward``'s KV
+        contract (``kv`` [L, 2, 1, S, Hkv, D], K post-RoPE;
+        ``last_logits`` [V] — the last REAL position's row).
+
+        ``S`` must be a whole number of pages and >= ``len(tokens)``
+        (pad the prompt to the page bucket — causal masking keeps pad
+        KV out of real positions' attention, and the engine's
+        ``seq_lens`` masks it during decode; the first generated token
+        overwrites the first slack slot).
+
+        Unlike ``prefill()``, nothing registers in the prefix cache and
+        nothing streams to the store: external KV carries no
+        prefix-commitment chain, so it is private to this sequence."""
+        T = self.pc.block_tokens
+        assert kv.ndim == 6 and kv.shape[2] == 1, kv.shape
+        S = kv.shape[3]
+        if S % T != 0 or S < len(tokens):
+            raise ValueError(
+                f"adopted KV must cover the prompt in whole pages: "
+                f"S={S}, block_tokens={T}, len(tokens)={len(tokens)}"
+            )
+        ids = self.pages.acquire(S // T)
+        self.cache = _write_prefill_pages(
+            self.cache, jnp.asarray(ids, dtype=jnp.int32),
+            jnp.asarray(kv), T,
+        )
+        state = SequenceState(
+            seq_id=self._next_id, tokens=list(tokens),
+            block_ids=list(ids), chunk_keys=[],
+            last_logits=last_logits,
+        )
+        self._next_id += 1
+        self.seqs[state.seq_id] = state
+        return state
+
     def store_flush(self) -> None:
         """Durability barrier: wait until every queued store push has
         landed, re-raising the first push error.  A no-op without a

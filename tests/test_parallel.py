@@ -483,3 +483,47 @@ def test_sp_prefill_matches_dense():
         np.asarray(logits), np.asarray(ref_logits), atol=2e-4)
     np.testing.assert_allclose(
         np.asarray(kv), np.asarray(ref_kv), atol=2e-5)
+
+
+def test_sp_prefill_kv_pages_into_engine_decode():
+    """END-TO-END proof of make_sp_prefill's cache contract: its KV
+    lands in a paged engine cache through the PUBLIC ingestion API
+    (``InferenceEngine.adopt_prefill``) and a plain engine DECODES the
+    continuation from those pages — tokens identical to prefilling the
+    same prompt in the engine directly.  (The long-context serving
+    flow: sp-parallel prompt ingestion on a mesh, then single-chip
+    paged decode.)"""
+    from infinistore_tpu.engine.engine import InferenceEngine
+    from infinistore_tpu.kv.cache import PagedCacheConfig
+    from infinistore_tpu.parallel.sharding import (
+        llama_inference_specs,
+        make_sp_prefill,
+    )
+
+    cfg = CFG
+    T = 4
+    params = init_params(cfg, jax.random.PRNGKey(7))
+    prompt = [int(t) for t in
+              np.random.RandomState(9).randint(1, cfg.vocab_size, 32)]
+    pc = PagedCacheConfig(
+        n_layers=cfg.n_layers, n_kv_heads=cfg.n_kv_heads,
+        head_dim=cfg.head_dim, n_blocks=64, block_tokens=T,
+        dtype=jnp.float32)
+
+    ref = InferenceEngine(params, cfg, pc)
+    want = ref.decode(ref.prefill(prompt), 8)
+
+    mesh = make_mesh(MeshShape(sp=2, tp=2), devices=jax.devices()[:4])
+    with jax.set_mesh(mesh):
+        sharded = shard_params(params, mesh,
+                               specs=llama_inference_specs(cfg=cfg))
+        logits, kv = make_sp_prefill(cfg, mesh)(
+            sharded, jnp.asarray([prompt], jnp.int32))
+        jax.block_until_ready(kv)
+
+    eng = InferenceEngine(params, cfg, pc)
+    st = eng.adopt_prefill(prompt, jnp.asarray(kv),
+                           jnp.asarray(logits)[0, -1])
+    assert eng.decode(st, 8) == want
+    eng.release(st)
+    assert eng.free_pages == pc.n_blocks  # adoption releases cleanly
