@@ -303,17 +303,19 @@ class MM:
     def _class_of(self, size: int) -> int:
         return _pow2ceil(max(size, self.block_size))
 
-    def _carve(self, cls: int) -> Optional[Pool]:
+    def _carve(self, cls: int) -> Optional[int]:
         """A pool of class ``cls``: first by RECLASSIFYING an empty pool
         of another class (budget once carved never returns, so without
         reclassification one busy class could permanently starve the
         others), else by carving a chunk of budget/CARVE_DIVISOR (at
-        least one block) from what is left.  None when neither works."""
-        for pool in self.pools:
+        least one block) from what is left.  Returns the pool's INDEX
+        (a reclassified pool keeps its original slot — callers must not
+        assume the newest pool), or None when neither works."""
+        for pi, pool in enumerate(self.pools):
             if (pool.block_size != cls and pool.allocated_blocks == 0
                     and pool.pool_size >= cls):
                 pool.reclassify(cls)
-                return pool
+                return pi
         remaining = self._budget - self._carved
         # at least one block, never a many-block floor: a large class
         # would otherwise swallow the whole budget in one carve and
@@ -326,7 +328,7 @@ class MM:
         pool = Pool(self._next_name(), take, cls)
         self.pools.append(pool)
         self._carved += take
-        return pool
+        return len(self.pools) - 1
 
     def allocate(self, size: int, n: int) -> Optional[List[Tuple[int, int]]]:
         """Allocate ``n`` regions of ``size`` bytes.  Returns a list of
@@ -347,11 +349,15 @@ class MM:
                     placed = True
                     break
             if not placed and cls is not None:
-                pool = self._carve(cls)
-                if pool is not None:
-                    off = pool.allocate(size)
+                pi = self._carve(cls)
+                if pi is not None:
+                    # pi is the REAL index: a reclassified pool keeps
+                    # its original slot, so recording the newest index
+                    # here would point Store.view()/deallocate at the
+                    # wrong pool's bytes (cross-class corruption)
+                    off = self.pools[pi].allocate(size)
                     if off is not None:
-                        out.append((len(self.pools) - 1, off))
+                        out.append((pi, off))
                         placed = True
             if not placed:
                 self.need_extend = True

@@ -188,29 +188,31 @@ uint64_t MM::class_of(uint64_t size) const {
   return pow2ceil(std::max(size, block_size_));
 }
 
-Pool* MM::carve(uint64_t cls) {
+int64_t MM::carve(uint64_t cls) {
   // first try RECLASSIFYING an empty pool of another class (carved
   // budget never returns, so one busy class must not permanently starve
   // the rest), then carve fresh budget: a chunk of budget/kCarveDivisor
   // (at least one block, at most what's left), whole blocks only —
-  // mirrors the Python MM._carve.
-  for (auto& p : pools_) {
+  // mirrors the Python MM._carve.  Returns the pool INDEX: a
+  // reclassified pool keeps its original slot.
+  for (size_t pi = 0; pi < pools_.size(); pi++) {
+    auto& p = pools_[pi];
     if (p->block_size() != cls && p->allocated_blocks() == 0 &&
         p->pool_size() >= cls) {
       p->reclassify(cls);
-      return p.get();
+      return static_cast<int64_t>(pi);
     }
   }
   uint64_t remaining = budget_ - carved_;
   uint64_t want = std::max(budget_ / kCarveDivisor, cls);
   uint64_t take = std::min(want, remaining);
   take -= take % cls;
-  if (take < cls) return nullptr;
+  if (take < cls) return -1;
   char buf[256];
   snprintf(buf, sizeof(buf), "%s_p%zu", name_prefix_.c_str(), pools_.size());
   pools_.emplace_back(std::make_unique<Pool>(buf, take, cls));
   carved_ += take;
-  return pools_.back().get();
+  return static_cast<int64_t>(pools_.size() - 1);
 }
 
 bool MM::allocate(uint64_t size, size_t n, std::vector<Region>* out) {
@@ -230,13 +232,15 @@ bool MM::allocate(uint64_t size, size_t n, std::vector<Region>* out) {
       }
     }
     if (!placed && sized) {
-      Pool* p = carve(cls);
-      if (p != nullptr) {
-        int64_t off = p->allocate(size);
+      int64_t pi = carve(cls);
+      if (pi >= 0) {
+        // pi is the REAL index (reclassified pools keep their slot);
+        // recording pools_.size()-1 here pointed view()/deallocate at
+        // the wrong pool's bytes
+        int64_t off = pools_[pi]->allocate(size);
         if (off >= 0) {
           out->push_back(
-              {static_cast<uint32_t>(pools_.size() - 1),
-               static_cast<uint64_t>(off)});
+              {static_cast<uint32_t>(pi), static_cast<uint64_t>(off)});
           placed = true;
         }
       }

@@ -111,7 +111,10 @@ class MM {
   bool need_extend = false;
 
  private:
-  Pool* carve(uint64_t cls);  // size-class pool from remaining budget
+  // Size-class pool for `cls`: reclassify an empty pool (keeps its
+  // ORIGINAL index) or carve fresh budget (appends).  Returns the
+  // pool's index, or -1 — callers must use it, never pools_.size()-1.
+  int64_t carve(uint64_t cls);
   uint64_t class_of(uint64_t size) const;
 
   Allocator allocator_;

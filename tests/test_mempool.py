@@ -254,3 +254,39 @@ def test_sizeclass_eviction_could_satisfy_guard():
         assert not mm.eviction_could_satisfy((1 << 50) + 1, 1)
     finally:
         mm.close()
+
+
+def test_sizeclass_reclassify_records_correct_pool_index():
+    """REGRESSION (review r5): when a request is satisfied by
+    RECLASSIFYING an empty pool, the recorded (pool_idx, offset) must
+    point at THAT pool — recording the newest index sent view()/
+    deallocate at the wrong pool's bytes (cross-class corruption)."""
+    mm = MM(pool_size=1 << 18, block_size=4096, allocator="sizeclass")
+    try:
+        # carve pool 0 (4 KB class, 64 KB chunk) and pool 1 (8 KB class)
+        a = mm.allocate(4096, 1)
+        b = mm.allocate(8192, 1)
+        assert a and b
+        tbl = mm.pool_table()
+        assert len(tbl) == 2
+        # drain the 4 KB class; burn the REMAINING budget so the next
+        # 16 KB class can only be served by reclassifying pool 0
+        for pi, off in a:
+            mm.deallocate(pi, off, 4096)
+        filler = mm.allocate(4096, (1 << 18) // 4096)  # soak leftovers
+        c = mm.allocate(16 << 10, 1)
+        assert c is not None
+        (pi, off) = c[0]
+        # the reclassified pool is a REAL index whose block_size matches
+        assert mm.pools[pi].block_size == 16 << 10
+        # write/read through the recorded region: bytes must land in
+        # that pool and never alias another pool's regions
+        view = mm.view(pi, off, 16 << 10)
+        view[:8] = b"REGRTEST"
+        others = [bytes(mm.view(opi, ooff, 8)) for opi, ooff in (b or [])]
+        del view  # release exported memoryviews before pool close
+        assert all(o != b"REGRTEST" for o in others)
+        mm.deallocate(pi, off, 16 << 10)
+        assert mm.pools[pi].allocated_blocks == 0
+    finally:
+        mm.close()
