@@ -1370,6 +1370,15 @@ def main(argv: Optional[List[str]] = None) -> None:
                          "--draft-model")
     ap.add_argument("--spec-g", type=int, default=2,
                     help="n-gram match width for --ngram-spec")
+    ap.add_argument("--tp", type=int, default=1,
+                    help="tensor-parallel degree: shard the engine over "
+                         "a tp mesh (Megatron-sharded params, head-"
+                         "sharded paged cache, GSPMD steps)")
+    ap.add_argument("--pp", type=int, default=1,
+                    help="layer-sharding degree (ZeRO-3-style weight "
+                         "streaming over a pp axis): fits models too "
+                         "big for tp alone, at a per-step weight-"
+                         "traffic cost — see docs/design.md")
     ap.add_argument("--store-host", default=None,
                     help="attach an infinistore-tpu KV store at this host: "
                          "prefill KV streams to the store and prompts reuse "
@@ -1454,6 +1463,29 @@ def main(argv: Optional[List[str]] = None) -> None:
         head_dim=cfg.head_dim, n_blocks=args.n_blocks,
         block_tokens=args.block_tokens, dtype=cfg.dtype,
     )
+    mesh = None
+    if args.tp < 1 or args.pp < 1:
+        raise SystemExit("--tp and --pp must be >= 1")
+    if args.tp * args.pp > 1:
+        if engine_fns:
+            # reject BEFORE building meshes/connections: mesh serving
+            # covers the built-in dense families (MoE scales via expert
+            # parallelism, parallel/moe.py)
+            raise SystemExit("--tp/--pp mesh serving supports the "
+                             "built-in dense families")
+        from .parallel import MeshShape, make_mesh
+
+        n = args.tp * args.pp
+        if len(jax.devices()) < n:
+            raise SystemExit(
+                f"--tp {args.tp} x --pp {args.pp} needs {n} devices, "
+                f"have {len(jax.devices())}"
+            )
+        mesh = make_mesh(MeshShape(tp=args.tp, pp=args.pp),
+                         devices=jax.devices()[:n])
+        # no ambient set_mesh needed: the engine pins every sharding
+        # explicitly (NamedSharding embeds the mesh), and set_mesh is
+        # thread-local anyway — the engine thread would never see it
     conn = None
     if args.store_host is not None:
         if args.store_service_port is None:
@@ -1470,7 +1502,7 @@ def main(argv: Optional[List[str]] = None) -> None:
         conn.connect()
     engine = InferenceEngine(params, cfg, pc, prefill_chunk=args.prefill_chunk,
                              decode_chunk=args.decode_chunk, conn=conn,
-                             model_id=model_id,
+                             model_id=model_id, mesh=mesh,
                              kv_quant=(None if args.kv_quant == "none"
                                        else args.kv_quant),
                              store_durability=args.store_durability,

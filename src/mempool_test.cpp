@@ -1,0 +1,74 @@
+// Native-runtime mempool unit checks, mirrored from tests/test_mempool.py
+// (the two MMs are parity-tested as equivalents; this binary keeps the
+// C++ side honest where the wire tests can't reach — e.g. the
+// carve-index-after-reclassify regression).  Run by
+// tests/test_mempool.py::test_native_mempool_unit via `make test` (the
+// Makefile builds it next to the library).
+#include <cassert>
+#include <cstdio>
+#include <cstring>
+
+#include "mempool.h"
+
+using istpu::Allocator;
+using istpu::MM;
+using istpu::Region;
+
+static void test_sizeclass_reclassify_index() {
+  // 256 KB budget, 4 KB min class
+  MM mm(1 << 18, 4096, "istpu_0_mmtest_a", Allocator::kSizeClass);
+  std::vector<Region> a, b, filler, c;
+  assert(mm.allocate(4096, 1, &a));           // pool 0: 4 KB class
+  assert(mm.allocate(8192, 1, &b));           // pool 1: 8 KB class
+  assert(mm.pools().size() == 2);
+  mm.deallocate(a[0].pool_idx, a[0].offset, 4096);
+  // soak every remaining 4 KB block so fresh budget is gone
+  while (mm.allocate(4096, 1, &filler)) {
+  }
+  mm.need_extend = false;
+  // drain pool 0 again so it is EMPTY and reclassifiable
+  for (const auto& r : filler) mm.deallocate(r.pool_idx, r.offset, 4096);
+  filler.clear();
+  // 16 KB class: only satisfiable by reclassifying an EMPTY pool —
+  // the recorded index must be that pool's REAL slot
+  assert(mm.allocate(16 << 10, 1, &c));
+  const Region& r = c[0];
+  assert(mm.pools()[r.pool_idx]->block_size() == (16u << 10));
+  // bytes written through the recorded region must not alias pool 1
+  std::memcpy(mm.view(r.pool_idx, r.offset), "REGRTEST", 8);
+  assert(std::memcmp(mm.view(b[0].pool_idx, b[0].offset), "REGRTEST", 8) !=
+         0);
+  mm.deallocate(r.pool_idx, r.offset, 16 << 10);
+  assert(mm.pools()[r.pool_idx]->allocated_blocks() == 0);
+}
+
+static void test_sizeclass_guards() {
+  MM mm(1 << 18, 4096, "istpu_0_mmtest_b", Allocator::kSizeClass);
+  std::vector<Region> out;
+  assert(!mm.allocate(0, 1, &out));                 // zero size
+  assert(!mm.allocate((1ULL << 50) + 1, 1, &out));  // absurd size
+  assert(mm.eviction_could_satisfy(4096, 64));
+  assert(!mm.eviction_could_satisfy(4096, 65));     // beyond budget
+  assert(!mm.eviction_could_satisfy(1 << 20, 1));   // class > budget
+}
+
+static void test_bitmap_roundtrip() {
+  MM mm(1 << 18, 4096, "istpu_0_mmtest_c", Allocator::kBitmap);
+  std::vector<Region> out;
+  assert(mm.allocate(10000, 3, &out));  // rounds to 3 blocks each
+  assert(out.size() == 3);
+  std::memcpy(mm.view(out[1].pool_idx, out[1].offset), "bitmapOK", 8);
+  assert(std::memcmp(mm.view(out[1].pool_idx, out[1].offset), "bitmapOK",
+                     8) == 0);
+  for (const auto& r : out) mm.deallocate(r.pool_idx, r.offset, 10000);
+  assert(mm.usage() == 0.0);
+}
+
+int main() {
+  setenv("ISTPU_NO_PREFAULT", "1", 1);  // tiny pools; skip the pin thread
+  test_sizeclass_reclassify_index();
+  test_sizeclass_guards();
+  test_bitmap_roundtrip();
+  std::printf("mempool_test: OK\n");
+  return 0;
+}

@@ -290,3 +290,21 @@ def test_sizeclass_reclassify_records_correct_pool_index():
         assert mm.pools[pi].allocated_blocks == 0
     finally:
         mm.close()
+
+
+def test_native_mempool_unit():
+    """The C++ MM's unit checks (src/mempool_test.cpp): the mirrored
+    carve-index-after-reclassify regression, size guards, and a bitmap
+    round-trip — parity coverage the wire tests can't reach."""
+    import subprocess
+
+    binary = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "src", "mempool_test")
+    if not os.path.exists(binary):
+        r = subprocess.run(
+            ["make", "-C", os.path.dirname(binary), "mempool_test"],
+            capture_output=True)
+        assert r.returncode == 0, r.stderr.decode()[-500:]
+    r = subprocess.run([binary], capture_output=True, timeout=60)
+    assert r.returncode == 0, (r.stdout.decode(), r.stderr.decode())
+    assert b"OK" in r.stdout
