@@ -267,3 +267,31 @@ def test_alllayers_decode_kernel_matches_per_layer():
         qs, cache, table, lens, interpret=True)
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(want), rtol=1e-6, atol=1e-6)
+
+
+@requires_tpu
+def test_alllayers_decode_kernel_mosaic_on_tpu():
+    """interpret=False: Mosaic must accept the all-layers instrument
+    kernel (the invocation-overhead experiment's fused side) and match
+    L back-to-back single-layer kernel calls at serving shapes."""
+    from infinistore_tpu.ops.pallas_attention import (
+        paged_decode_attention_pallas_alllayers,
+    )
+
+    L, Hkv, D, T = 4, 8, 128, 16
+    rng = np.random.default_rng(11)
+    qs = jnp.asarray(
+        rng.standard_normal((L, 4, 32, D)), jnp.bfloat16)
+    cache = jnp.asarray(
+        rng.standard_normal((L, 2, Hkv, 64, T, D)), jnp.bfloat16)
+    _, _, table, lens = _setup(4, 32, Hkv, D, T, 64, 8, seed=11,
+                               dtype=jnp.bfloat16)
+    want = jnp.stack([
+        paged_decode_attention_pallas(qs[l], cache[l], table, lens)
+        for l in range(L)
+    ])
+    got = paged_decode_attention_pallas_alllayers(qs, cache, table, lens)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32),
+        rtol=3e-2, atol=3e-2,
+    )
