@@ -31,6 +31,12 @@ from .llama import LlamaConfig, Params, rmsnorm, _attn_qkv, _layer
 class MoEConfig(LlamaConfig):
     n_experts: int = 8
     top_k: int = 2
+    # DeepSeek-MoE-style SHARED experts: always-on FFN capacity added to
+    # the routed output ungated (n shared experts of ffn_dim each,
+    # implemented as one fused dense FFN of width n * ffn_dim — the sum
+    # of n independent FFNs of the same input is exactly that).  0 =
+    # Mixtral-style pure routing (param structure unchanged).
+    n_shared_experts: int = 0
 
 
 MIXTRAL_8X7B = MoEConfig(
@@ -76,6 +82,12 @@ def init_moe_params(cfg: MoEConfig, key: jax.Array) -> Params:
                 "ln_mlp": jnp.ones((cfg.dim,), cfg.dtype),
             }
         )
+        if cfg.n_shared_experts > 0:
+            ks = jax.random.split(k[8], 3)
+            sf = cfg.n_shared_experts * cfg.ffn_dim
+            layers[-1]["ws_gate"] = dense(ks[0], (cfg.dim, sf), cfg.dim)
+            layers[-1]["ws_up"] = dense(ks[1], (cfg.dim, sf), cfg.dim)
+            layers[-1]["ws_down"] = dense(ks[2], (sf, cfg.dim), sf)
     stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *layers)
     return {
         "embed": dense(keys[-2], (cfg.vocab_size, cfg.dim), cfg.dim),
@@ -96,7 +108,12 @@ def top_k_gates(router_logits: jax.Array, top_k: int) -> jax.Array:
 
 
 def moe_ffn(layer: Params, x: jax.Array, top_k: int) -> jax.Array:
-    """Dense-compute MoE FFN.  x: [B, S, dim] -> [B, S, dim]."""
+    """Dense-compute MoE FFN.  x: [B, S, dim] -> [B, S, dim].
+
+    When the layer carries shared-expert weights (``ws_*``,
+    DeepSeek-MoE style), their always-on FFN output adds to the routed
+    sum UNGATED — the branch is static at trace time (pytree
+    structure), so Mixtral-style layers compile exactly as before."""
     gates = top_k_gates(
         x.astype(jnp.float32) @ layer["router"], top_k
     )  # [B, S, E] fp32
@@ -104,7 +121,23 @@ def moe_ffn(layer: Params, x: jax.Array, top_k: int) -> jax.Array:
     h = jax.nn.silu(jnp.einsum("bsd,edf->bsef", x, layer["w_gate"]))
     h = h * jnp.einsum("bsd,edf->bsef", x, layer["w_up"])
     out = jnp.einsum("bsef,efd->bsed", h, layer["w_down"])  # [B, S, E, dim]
-    return jnp.einsum("bsed,bse->bsd", out, gates.astype(x.dtype))
+    routed = jnp.einsum("bsed,bse->bsd", out, gates.astype(x.dtype))
+    if "ws_gate" in layer:
+        routed = routed + _shared_expert_ffn(layer, x)
+    return routed
+
+
+def _shared_expert_ffn(layer: Params, x: jax.Array) -> jax.Array:
+    """The always-on shared-expert SwiGLU — ONE definition reused by the
+    dense and expert-parallel paths (llama's ``_mlp`` over the ws_*
+    leaves), so the two can never silently diverge."""
+    from .llama import _mlp
+
+    return _mlp(
+        {"w_gate": layer["ws_gate"], "w_up": layer["ws_up"],
+         "w_down": layer["ws_down"]},
+        x,
+    )
 
 
 def moe_prefill_forward(

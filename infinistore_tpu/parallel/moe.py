@@ -33,7 +33,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.llama import rmsnorm, _attn_qkv, _layer
 from ..models.attention import causal_attention
-from ..models.moe import MoEConfig, init_moe_params, top_k_gates
+from ..models.moe import (
+    MoEConfig,
+    _shared_expert_ffn,
+    init_moe_params,
+    top_k_gates,
+)
 from .sharding import shardings_for
 
 MOE_AXES = ("dp", "ep")
@@ -51,7 +56,10 @@ def make_moe_mesh(dp: int = 1, ep: int = 1):
 def moe_param_specs(cfg: MoEConfig) -> dict:
     """Experts shard over ep on the stacked leaves' axis 1 ([L, E, ...]);
     attention, router, norms, embeddings stay replicated (their grads psum
-    over dp x ep via the shard_map transpose)."""
+    over dp x ep via the shard_map transpose).  Shared-expert weights
+    (n_shared_experts > 0) shard their HIDDEN dim over ep — SwiGLU is
+    tensor-parallel along it, so each device's partial folds into the
+    same psum the routed experts already pay."""
     layer_specs = {
         "wq": P(), "wk": P(), "wv": P(), "wo": P(),
         "router": P(),
@@ -59,6 +67,10 @@ def moe_param_specs(cfg: MoEConfig) -> dict:
         "w_up": P(None, "ep", None, None),
         "w_down": P(None, "ep", None, None),
         "ln_attn": P(), "ln_mlp": P(),
+        **({"ws_gate": P(None, None, "ep"),
+            "ws_up": P(None, None, "ep"),
+            "ws_down": P(None, "ep", None)}
+           if cfg.n_shared_experts > 0 else {}),
     }
     return {"embed": P(), "layers": layer_specs, "ln_out": P(), "lm_head": P()}
 
@@ -80,6 +92,12 @@ def _local_moe_ffn(layer, x, cfg: MoEConfig, ep: int):
     h = h * jnp.einsum("bsd,edf->bsef", x, layer["w_up"])
     out = jnp.einsum("bsef,efd->bsed", h, layer["w_down"])
     part = jnp.einsum("bsed,bse->bsd", out, gates_loc.astype(x.dtype))
+    if "ws_gate" in layer:
+        # shared experts shard their HIDDEN dim over ep (SwiGLU is
+        # tensor-parallel along it): each device computes a partial
+        # from its ws_* shards and the existing psum completes the sum
+        # — 1/ep the shared FLOPs, zero extra collectives
+        part = part + _shared_expert_ffn(layer, x)
     return lax.psum(part, "ep")
 
 

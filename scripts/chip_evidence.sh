@@ -15,7 +15,7 @@
 #  2. Mosaic acceptance (the reshaped shared kernel body + the new
 #     all-layers instrument need real-Mosaic validation);
 #  3. the full suite stays OFF this path (CPU-only, run separately).
-set -uo pipefail
+set -euo pipefail
 cd "$(dirname "$0")/.."
 
 echo "== probe =="

@@ -144,3 +144,65 @@ def test_moe_windowed_paged_decode_matches_dense():
     wl, _ = moe_prefill_forward(params, wcfg, jnp.asarray(prompt, jnp.int32)[None])
     assert not np.allclose(np.asarray(fl[0, -1]), np.asarray(wl[0, -1]),
                            rtol=1e-4, atol=1e-4)
+
+
+def test_shared_experts_forward_and_serving():
+    """DeepSeek-MoE-style shared experts (n_shared_experts > 0): the
+    always-on FFN adds ungated capacity — the output must differ from
+    the pure-routed model with identical routed weights, the paged
+    serving engine must decode it consistently with the dense forward,
+    and n_shared_experts=0 keeps the param pytree unchanged."""
+    from conftest import make_dense_greedy
+    from infinistore_tpu.engine import InferenceEngine
+    from infinistore_tpu.kv import PagedCacheConfig
+    from infinistore_tpu.models.moe import (
+        moe_decode_forward,
+        moe_verify_forward,
+    )
+
+    scfg = scaled_moe(CFG, n_shared_experts=2)
+    sparams = init_moe_params(scfg, jax.random.PRNGKey(0))
+    assert "ws_gate" in sparams["layers"]
+    # same seed, no shared experts: routed weights identical, output not
+    params0 = init_moe_params(CFG, jax.random.PRNGKey(0))
+    assert "ws_gate" not in params0["layers"]
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(2), (2, 12), 0, scfg.vocab_size)
+    lg_s, _ = moe_prefill_forward(sparams, scfg, tokens)
+    lg_0, _ = moe_prefill_forward(params0, CFG, tokens)
+    assert not np.allclose(np.asarray(lg_s), np.asarray(lg_0))
+
+    # serving: paged decode must follow the dense greedy trajectory
+    pc = PagedCacheConfig(
+        n_layers=scfg.n_layers, n_kv_heads=scfg.n_kv_heads,
+        head_dim=scfg.head_dim, n_blocks=32, block_tokens=4,
+        dtype=scfg.dtype,
+    )
+    eng = InferenceEngine(
+        sparams, scfg, pc,
+        prefill_fn=moe_prefill_forward, decode_fn=moe_decode_forward,
+        verify_fn=moe_verify_forward,
+    )
+    dense = make_dense_greedy(sparams, scfg, forward=moe_prefill_forward)
+    prompt = [int(t) for t in tokens[0][:8]]
+    assert eng.generate(prompt, 10) == dense(prompt, 10)
+
+
+def test_shared_experts_expert_parallel_matches_dense():
+    """ep sharding with shared experts: routed experts shard over ep,
+    shared weights replicate and must be added OUTSIDE the psum —
+    logits must equal the single-device dense forward exactly."""
+    scfg = scaled_moe(CFG, n_shared_experts=1)
+    mesh = make_moe_mesh(dp=2, ep=4)
+    params = init_moe_params(scfg, jax.random.PRNGKey(1))
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(4), (4, 16), 0, scfg.vocab_size)
+
+    ref_logits, _ = moe_prefill_forward(params, scfg, tokens)
+    sharded = jax.device_put(
+        params, shardings_for(mesh, moe_param_specs(scfg)))
+    tok_sharded = jax.device_put(
+        tokens, NamedSharding(mesh, P("dp", None)))
+    got = make_moe_forward(scfg, mesh)(sharded, tok_sharded)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref_logits), rtol=2e-5, atol=2e-5)
