@@ -152,6 +152,18 @@ def main():
     print(f"transport={conn_type} src={args.src_device} "
           f"blocks={n_blocks}x{args.block_size}KB x{args.iteration}")
     print(f"put: {gb / put_t:.2f} GB/s   get: {gb / get_t:.2f} GB/s")
+    # per-op / per-stage client latency (python client; the native client
+    # keeps its timings in the C runtime).  The alloc/copy/commit split is
+    # what makes the next data-plane regression diagnosable from bench
+    # output alone: a slow `copy` is memcpy-bound, a slow `alloc` is the
+    # server allocator, a slow `commit`/`desc` is round-trip overhead.
+    stats = conn.latency_stats()
+    if stats:
+        print("client op/stage latency (ms):")
+        for name in sorted(stats):
+            s = stats[name]
+            print(f"  {name:24s} count={s['count']:<5} avg={s['avg_ms']:<9} "
+                  f"p50={s['p50_ms']:<9} p99={s['p99_ms']:<9} max={s['max_ms']}")
     conn.close()
 
 

@@ -111,13 +111,30 @@ class KVTransferEngine:
             pages = quantize_pages(pages)  # [L, n, wire_page_bytes] uint8
         return pages
 
+    @staticmethod
+    def _band_host(p: jax.Array):
+        """Just-in-time host materialization of one band: ``np.asarray``
+        waits only for THIS band's D2H, and the extra
+        ``ascontiguousarray`` re-copy is paid only when the runtime hands
+        back a strided view (the common case is already contiguous)."""
+
+        def mat() -> np.ndarray:
+            host = np.asarray(p)
+            if not host.flags["C_CONTIGUOUS"]:
+                host = np.ascontiguousarray(host)
+            return host
+
+        return mat
+
     def push_pages(self, pages: jax.Array, chunk_keys_: Sequence[str]) -> int:
         """Host-side half of a save: move gathered pages D2H and put them
         into the store.  Split into layer bands, start every band's D2H up
-        front (copy_to_host_async), then write band i into the pool while
-        bands i+1.. are still streaming device->host.  Each band's host
-        array pointer goes straight to the put, so the only synchronous
-        host copy is the client->pool write (the RDMA-WRITE analog)."""
+        front (copy_to_host_async), then hand the bands to the
+        connection's pipelined put: band i's pool copy overlaps band
+        i+1's D2H *and* its ALLOC_PUT round-trip, and one COMMIT_PUT
+        publishes the whole save.  Each band's host array pointer goes
+        straight to the put, so the only synchronous host copy is the
+        client->pool write (the RDMA-WRITE analog)."""
         L = self.cfg.n_layers
         pb = self.wire_page_bytes
         G = max(1, min(self.pipeline_groups, L))
@@ -125,11 +142,17 @@ class KVTransferEngine:
         parts = [pages[l0 : l0 + Lg] for l0 in range(0, L, Lg)]
         for p in parts:
             p.copy_to_host_async()
-        total = 0
+        bands = []
         for gi, p in enumerate(parts):
-            host = np.ascontiguousarray(np.asarray(p))  # waits for this band
             l0 = gi * Lg
             blocks = self._page_blocks(chunk_keys_, l0, l0 + p.shape[0])
+            bands.append((blocks, pb, self._band_host(p)))
+        writer = getattr(self.conn, "write_cache_pipelined", None)
+        if writer is not None:
+            return writer(bands)
+        total = 0
+        for blocks, _pb, mat in bands:  # native client: per-band puts
+            host = mat()
             self.conn.write_cache(blocks, pb, host.ctypes.data)
             total += host.nbytes
         return total
@@ -174,24 +197,37 @@ class KVTransferEngine:
         staging = self._ensure_staging(nbytes)
         G = max(1, min(self.pipeline_groups, L))
         Lg = -(-L // G)
-        devs = []
+        bands = []
+        meta = []  # (staging offset, span, n_layers) per band
         for l0 in range(0, L, Lg):
             l1 = min(l0 + Lg, L)
             blocks = self._page_blocks(chunk_keys_, l0, l1)
             off = l0 * n * pb
-            span = (l1 - l0) * n * pb
-            self.conn.read_cache(blocks, pb, staging.ctypes.data + off)
+            bands.append((blocks, pb, staging.ctypes.data + off))
+            meta.append((off, (l1 - l0) * n * pb, l1 - l0))
+        devs: list = [None] * len(bands)
+
+        def upload(i: int) -> None:
+            off, span, nl = meta[i]
             band = staging[off : off + span]
             if self.quant:
-                host = band.reshape(l1 - l0, n, pb)
+                host = band.reshape(nl, n, pb)
             else:
                 host = (
                     band.view(jnp.dtype(self.cfg.dtype))
-                    .reshape((l1 - l0, n) + self.cfg.page_shape)
+                    .reshape((nl, n) + self.cfg.page_shape)
                 )
-            # async H2D: returns immediately; the next band's read_cache
-            # (socket + pool memcpy) overlaps this band's DMA
-            devs.append(jax.device_put(host))
+            # async H2D: returns immediately; the next band's pool copy
+            # (and its prefetched GET_DESC) overlaps this band's DMA
+            devs[i] = jax.device_put(host)
+
+        reader = getattr(self.conn, "read_cache_pipelined", None)
+        if reader is not None:
+            reader(bands, on_band=upload)
+        else:  # native client: per-band reads, same upload overlap
+            for i, (blocks, _pb, ptr) in enumerate(bands):
+                self.conn.read_cache(blocks, pb, ptr)
+                upload(i)
         # single band: already [L, n, ...] — don't pay a concat copy
         stacked = devs[0] if len(devs) == 1 else jnp.concatenate(devs, axis=0)
         if self.quant:

@@ -25,6 +25,7 @@ import mmap
 import os
 import socket
 import threading
+import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
@@ -98,6 +99,49 @@ def _ptr_view(ptr: int, size: int) -> memoryview:
     return memoryview((ctypes.c_char * size).from_address(ptr)).cast("B")
 
 
+# data-plane knobs (shm zero-copy path).  ISTPU_NO_COALESCE=1 pins the
+# legacy per-page copy loop — kept as the byte-parity reference and as an
+# escape hatch; the coalesced path is the default.
+_COALESCE = not os.environ.get("ISTPU_NO_COALESCE")
+# total time write_cache keeps re-asking after RETRY (another writer is
+# actively streaming one of these keys) before giving up with a clear error
+_RETRY_DEADLINE_S = float(os.environ.get("ISTPU_RETRY_DEADLINE_S", "10"))
+# stripe run copies across a few workers once the batch is large enough to
+# amortize the handoff (one core's memcpy tops out below DRAM bandwidth;
+# np.copyto releases the GIL, so the workers genuinely overlap)
+_COPY_WORKERS = int(os.environ.get("ISTPU_COPY_WORKERS", "0")) or max(
+    1, min(4, (os.cpu_count() or 1) - 1)
+)
+_PAR_MIN_BYTES = 8 << 20
+# runs below this copy via buffer-protocol slice assignment (memoryview →
+# plain memcpy, no ufunc dispatch); at/above it np.copyto wins AND releases
+# the GIL, which is what lets the worker striping overlap
+_VEC_MIN_BYTES = 1 << 20
+
+
+def _merge_runs(
+    descs: Sequence[Tuple[int, int, int]], offsets: Sequence[int]
+) -> List[list]:
+    """Merge adjacent descriptors — same pool, contiguous pool offsets AND
+    contiguous client offsets — into copy runs ``[pool_idx, pool_off,
+    client_off, nbytes]`` (order-preserving single pass).  With the
+    server's contiguous-run allocation a whole batch collapses into one
+    run; a fragmented desc list degrades gracefully toward per-page."""
+    runs: List[list] = []
+    for (pool_idx, pool_off, size), cli_off in zip(descs, offsets):
+        if runs:
+            r = runs[-1]
+            if (
+                r[0] == pool_idx
+                and r[1] + r[3] == pool_off
+                and r[2] + r[3] == cli_off
+            ):
+                r[3] += size
+                continue
+        runs.append([pool_idx, pool_off, cli_off, size])
+    return runs
+
+
 class _MappedPool:
     def __init__(self, name: str, size: int):
         self.name = name
@@ -112,10 +156,19 @@ class _MappedPool:
         # this is the server's pool -- the write fallback would zero it.
         _prefault(self.mm, size, write=False)
         self.buf = memoryview(self.mm)
+        # ndarray alias of the same mapping: run copies go through
+        # np.copyto, which is one GIL-releasing memcpy per run
+        self.arr = np.frombuffer(self.mm, dtype=np.uint8)
 
     def close(self):
+        self.arr = None
         self.buf.release()
-        self.mm.close()
+        try:
+            self.mm.close()
+        except BufferError:
+            # a stray numpy view still pins the mapping; dropping our refs
+            # above is what matters — the OS unmaps at process exit
+            pass
 
 
 class _Slot:
@@ -171,13 +224,17 @@ class _Channel:
 
     # -- pipelined exchange --
 
-    def request(
+    def submit(
         self,
         op: int,
         body: bytes,
         payload: Sequence[memoryview] = (),
         consumer: Optional[Callable] = None,
-    ) -> Tuple[int, object]:
+    ) -> _Slot:
+        """Put one request on the wire without waiting (the pipelined
+        banded ops overlap the next band's round-trip with this band's
+        pool copy).  FIFO response matching holds because the send lock
+        orders the frame and the pending-queue append together."""
         slot = _Slot(consumer)
         with self._send_lock:
             if self._err is not None:
@@ -189,10 +246,23 @@ class _Channel:
             self.sock.sendall(P.pack_header(op, len(body)) + body)
             for view in payload:
                 self.sock.sendall(view)
+        return slot
+
+    @staticmethod
+    def wait(slot: _Slot) -> Tuple[int, object]:
         slot.ev.wait()
         if slot.error is not None:
             raise InfiniStoreConnectionError(f"request failed: {slot.error!r}")
         return slot.status, slot.result
+
+    def request(
+        self,
+        op: int,
+        body: bytes,
+        payload: Sequence[memoryview] = (),
+        consumer: Optional[Callable] = None,
+    ) -> Tuple[int, object]:
+        return self.wait(self.submit(op, body, payload, consumer))
 
     def _read_loop(self) -> None:
         try:
@@ -259,6 +329,10 @@ class Connection:
         self._registered: Dict[int, int] = {}  # base ptr -> size
         self._pool_lock = threading.Lock()
         self._stripe_pool: Optional[ThreadPoolExecutor] = None
+        self._copy_pool: Optional[ThreadPoolExecutor] = None
+        # coalesced bulk copies by default; tests pin the legacy per-page
+        # loop here (or via ISTPU_NO_COALESCE) for byte-parity checks
+        self.coalesce = _COALESCE
         self.latency = LatencyStats()
 
     def latency_stats(self) -> Dict[str, Dict[str, float]]:
@@ -319,6 +393,9 @@ class Connection:
         if self._stripe_pool is not None:
             self._stripe_pool.shutdown(wait=False)
             self._stripe_pool = None
+        if self._copy_pool is not None:
+            self._copy_pool.shutdown(wait=True)  # copies touch the pools
+            self._copy_pool = None
         for ch in self.channels:
             ch.close()
         self.channels.clear()
@@ -340,6 +417,98 @@ class Connection:
                     self._refresh_pools()
         return self.pools[pool_idx].buf[offset : offset + size]
 
+    def _pool_arr(self, pool_idx: int) -> np.ndarray:
+        if pool_idx >= len(self.pools):
+            with self._pool_lock:
+                if pool_idx >= len(self.pools):
+                    self._refresh_pools()
+        return self.pools[pool_idx].arr
+
+    def _copy_exec(self) -> ThreadPoolExecutor:
+        if self._copy_pool is None:
+            self._copy_pool = ThreadPoolExecutor(
+                max_workers=_COPY_WORKERS, thread_name_prefix="istpu-copy"
+            )
+        return self._copy_pool
+
+    def _copy_descs(
+        self,
+        descs: Sequence[Tuple[int, int, int]],
+        offsets: Sequence[int],
+        client_view: memoryview,
+        to_pool: bool,
+    ) -> None:
+        """Move descriptor payloads between the client buffer and the
+        mapped pools.  Coalesced mode merges adjacent descriptors into
+        runs and issues one GIL-releasing ``np.copyto`` per run, striped
+        across a small worker pool when the batch is large; legacy mode
+        (``coalesce=False``) is the per-page loop, kept as the
+        byte-parity reference."""
+        if not self.coalesce:
+            for (pool_idx, pool_off, size), off in zip(descs, offsets):
+                if to_pool:
+                    dst = self._pool_view(pool_idx, pool_off, size)
+                    dst[:] = client_view[off : off + size]
+                else:
+                    src = self._pool_view(pool_idx, pool_off, size)
+                    client_view[off : off + size] = src
+            return
+        runs = _merge_runs(descs, offsets)
+        cli = np.frombuffer(client_view, dtype=np.uint8)
+
+        def copy_one(run):
+            pool_idx, pool_off, cli_off, length = run
+            if length < _VEC_MIN_BYTES:
+                # small run: buffer-protocol memcpy beats ufunc dispatch
+                if to_pool:
+                    dst = self._pool_view(pool_idx, pool_off, length)
+                    dst[:] = client_view[cli_off : cli_off + length]
+                else:
+                    client_view[cli_off : cli_off + length] = self._pool_view(
+                        pool_idx, pool_off, length
+                    )
+                return
+            pool = self._pool_arr(pool_idx)
+            if to_pool:
+                np.copyto(
+                    pool[pool_off : pool_off + length],
+                    cli[cli_off : cli_off + length],
+                )
+            else:
+                np.copyto(
+                    cli[cli_off : cli_off + length],
+                    pool[pool_off : pool_off + length],
+                )
+
+        total = sum(r[3] for r in runs)
+        if len(runs) > 1 and total >= _PAR_MIN_BYTES and _COPY_WORKERS > 1:
+            list(self._copy_exec().map(copy_one, runs))
+        else:
+            for run in runs:
+                copy_one(run)
+
+    def _alloc_put_retrying(self, keys: Sequence[bytes], block_size: int) -> bytes:
+        """ALLOC_PUT with exponential backoff on RETRY (another writer is
+        actively streaming one of these keys) and a hard deadline that
+        turns a wedged peer into a clear error instead of an unbounded
+        fixed-interval spin."""
+        req = P.pack_alloc_put(keys, block_size)
+        status, body = self._request(P.OP_ALLOC_PUT, req)
+        delay = 0.002
+        deadline = time.monotonic() + _RETRY_DEADLINE_S
+        while status == P.RETRY:
+            if time.monotonic() >= deadline:
+                raise InfiniStoreException(
+                    f"alloc_put: server kept answering RETRY for "
+                    f"{_RETRY_DEADLINE_S:.0f}s (a concurrent writer is "
+                    f"streaming these keys); giving up"
+                )
+            time.sleep(delay)
+            delay = min(delay * 2, 0.256)
+            status, body = self._request(P.OP_ALLOC_PUT, req)
+        _raise_for_status(status, "alloc_put")
+        return body
+
     def _stripe(self, blocks: Sequence[Tuple[str, int]]) -> List[Tuple[int, List]]:
         """Partition a batch across channels: [(channel_idx, sub_blocks)]."""
         n = len(self.channels)
@@ -356,25 +525,20 @@ class Connection:
     def write_cache(self, blocks: Sequence[Tuple[str, int]], block_size: int, ptr: int) -> int:
         """Batched put: key i's payload is ``block_size`` bytes at
         ``ptr + offset_i`` (reference: lib.py:425-481)."""
+        if not blocks:
+            return P.FINISH  # nothing to allocate, copy, or commit
         keys = P.encode_keys([k for k, _ in blocks])
         offsets = [off for _, off in blocks]
-        src = _ptr_view(ptr, max(offsets) + block_size if offsets else 0)
+        src = _ptr_view(ptr, max(offsets) + block_size)
         if self.shm_mode:
-            status, body = self._request(P.OP_ALLOC_PUT, P.pack_alloc_put(keys, block_size))
-            for _ in range(20):  # RETRY: another writer is streaming these keys
-                if status != P.RETRY:
-                    break
-                __import__("time").sleep(0.05)
-                status, body = self._request(
-                    P.OP_ALLOC_PUT, P.pack_alloc_put(keys, block_size)
-                )
-            _raise_for_status(status, "alloc_put")
+            with self.latency.timed("write_cache.alloc"):
+                body = self._alloc_put_retrying(keys, block_size)
             descs = P.unpack_descs(memoryview(body))
-            for (pool_idx, pool_off, size), src_off in zip(descs, offsets):
-                dst = self._pool_view(pool_idx, pool_off, block_size)
-                dst[:] = src[src_off : src_off + block_size]
-            status, body = self._request(P.OP_COMMIT_PUT, P.pack_keys(keys))
-            _raise_for_status(status, "commit_put")
+            with self.latency.timed("write_cache.copy"):
+                self._copy_descs(descs, offsets, src, to_pool=True)
+            with self.latency.timed("write_cache.commit"):
+                status, _ = self._request(P.OP_COMMIT_PUT, P.pack_keys(keys))
+                _raise_for_status(status, "commit_put")
         else:
 
             def _put(chunk):
@@ -400,16 +564,20 @@ class Connection:
     @_timed_op("read_cache")
     def read_cache(self, blocks: Sequence[Tuple[str, int]], block_size: int, ptr: int) -> int:
         """Batched get into ``ptr + offset_i`` (reference: lib.py:483-542)."""
+        if not blocks:
+            return P.FINISH  # nothing to fetch
         offsets = [off for _, off in blocks]
-        dst = _ptr_view(ptr, max(offsets) + block_size if offsets else 0)
+        dst = _ptr_view(ptr, max(offsets) + block_size)
         if self.shm_mode:
             keys = P.encode_keys([k for k, _ in blocks])
-            status, body = self._request(P.OP_GET_DESC, P.pack_alloc_put(keys, block_size))
-            _raise_for_status(status, "get_desc")
+            with self.latency.timed("read_cache.desc"):
+                status, body = self._request(
+                    P.OP_GET_DESC, P.pack_alloc_put(keys, block_size)
+                )
+                _raise_for_status(status, "get_desc")
             descs = P.unpack_descs(memoryview(body))
-            for (pool_idx, pool_off, size), dst_off in zip(descs, offsets):
-                src = self._pool_view(pool_idx, pool_off, size)
-                dst[dst_off : dst_off + size] = src
+            with self.latency.timed("read_cache.copy"):
+                self._copy_descs(descs, offsets, dst, to_pool=False)
         else:
 
             def _get(chunk):
@@ -446,6 +614,110 @@ class Connection:
             for st in statuses:
                 _raise_for_status(st, "get_inline_batch")
         return P.FINISH
+
+    # -- pipelined banded ops (the prefill-save / restore hot path) --
+
+    @staticmethod
+    def _band_ptr(src):
+        """Materialize a band's host buffer: an int pointer, a numpy
+        array, or a zero-arg callable returning either (called
+        just-in-time so a band's D2H can complete while earlier bands
+        copy).  Returns (ptr, keepalive)."""
+        obj = src() if callable(src) else src
+        if isinstance(obj, (int, np.integer)):
+            return int(obj), None
+        return obj.ctypes.data, obj
+
+    @_timed_op("write_cache_pipelined")
+    def write_cache_pipelined(self, bands) -> int:
+        """Pipelined multi-band put (shm fast path): band i+1's ALLOC_PUT
+        round-trip is already in flight while band i's pool copy runs,
+        and ONE COMMIT_PUT publishes the whole save (vs one per band).
+
+        ``bands``: sequence of ``(blocks, block_size, src)`` with ``src``
+        an int pointer, numpy array, or zero-arg callable returning
+        either.  Off the shm path this degrades to sequential per-band
+        ``write_cache``.  Returns bytes written."""
+        bands = [b for b in bands if b[0]]
+        if not bands:
+            return 0
+        total = 0
+        if not self.shm_mode:
+            for blocks, block_size, src in bands:
+                ptr, keep = self._band_ptr(src)
+                self.write_cache(blocks, block_size, ptr)
+                total += block_size * len(blocks)
+                del keep
+            return total
+        ch = self.channels[0]
+        enc = [P.encode_keys([k for k, _ in blocks]) for blocks, _, _ in bands]
+        all_keys: List[bytes] = []
+        slot = ch.submit(P.OP_ALLOC_PUT, P.pack_alloc_put(enc[0], bands[0][1]))
+        for i, (blocks, block_size, src) in enumerate(bands):
+            with self.latency.timed("write_cache.alloc"):
+                status, body = ch.wait(slot)
+                if status == P.RETRY:
+                    # rare contention path: synchronous backoff for THIS band
+                    body = self._alloc_put_retrying(enc[i], block_size)
+                else:
+                    _raise_for_status(status, "alloc_put")
+            if i + 1 < len(bands):
+                slot = ch.submit(
+                    P.OP_ALLOC_PUT, P.pack_alloc_put(enc[i + 1], bands[i + 1][1])
+                )
+            descs = P.unpack_descs(memoryview(body))
+            offsets = [off for _, off in blocks]
+            ptr, keep = self._band_ptr(src)
+            view = _ptr_view(ptr, max(offsets) + block_size)
+            with self.latency.timed("write_cache.copy"):
+                self._copy_descs(descs, offsets, view, to_pool=True)
+            del keep
+            all_keys.extend(enc[i])
+            total += block_size * len(blocks)
+        with self.latency.timed("write_cache.commit"):
+            status, _ = self._request(P.OP_COMMIT_PUT, P.pack_keys(all_keys))
+            _raise_for_status(status, "commit_put")
+        return total
+
+    @_timed_op("read_cache_pipelined")
+    def read_cache_pipelined(self, bands, on_band: Optional[Callable] = None) -> int:
+        """Mirror image of ``write_cache_pipelined``: band i+1's GET_DESC
+        round-trip rides behind band i's pool copy.  ``bands``: sequence
+        of ``(blocks, block_size, ptr)``.  ``on_band(i)`` fires once band
+        i's bytes are in place (the KV load path hands each band to an
+        async H2D there).  Returns bytes read."""
+        live = [(i, b) for i, b in enumerate(bands) if b[0]]
+        if not live:
+            return 0
+        total = 0
+        if not self.shm_mode:
+            for i, (blocks, block_size, ptr) in live:
+                self.read_cache(blocks, block_size, ptr)
+                total += block_size * len(blocks)
+                if on_band is not None:
+                    on_band(i)
+            return total
+        ch = self.channels[0]
+        enc = [P.encode_keys([k for k, _ in b[0]]) for _, b in live]
+        slot = ch.submit(P.OP_GET_DESC, P.pack_alloc_put(enc[0], live[0][1][1]))
+        for j, (i, (blocks, block_size, ptr)) in enumerate(live):
+            with self.latency.timed("read_cache.desc"):
+                status, body = ch.wait(slot)
+                _raise_for_status(status, "get_desc")
+            if j + 1 < len(live):
+                slot = ch.submit(
+                    P.OP_GET_DESC,
+                    P.pack_alloc_put(enc[j + 1], live[j + 1][1][1]),
+                )
+            descs = P.unpack_descs(memoryview(body))
+            offsets = [off for _, off in blocks]
+            view = _ptr_view(ptr, max(offsets) + block_size)
+            with self.latency.timed("read_cache.copy"):
+                self._copy_descs(descs, offsets, view, to_pool=False)
+            total += sum(s for _, _, s in descs)
+            if on_band is not None:
+                on_band(i)
+        return total
 
     # -- inline single-key ops (reference: w_tcp/r_tcp) --
 
@@ -666,6 +938,36 @@ class InfinityConnection:
 
     def read_cache(self, blocks: Sequence[Tuple[str, int]], block_size: int, ptr: int) -> int:
         return self._call("read_cache", blocks, block_size, ptr)
+
+    def write_cache_pipelined(self, bands) -> int:
+        """Banded put with alloc/copy overlap and ONE commit per save
+        (python shm client); clients without the entry point (native)
+        fall back to sequential per-band ``write_cache``."""
+        if hasattr(self.conn, "write_cache_pipelined"):
+            return self._call("write_cache_pipelined", bands)
+        total = 0
+        for blocks, block_size, src in bands:
+            if not blocks:
+                continue
+            obj = src() if callable(src) else src
+            ptr = int(obj) if isinstance(obj, (int, np.integer)) else obj.ctypes.data
+            self.write_cache(blocks, block_size, ptr)
+            total += block_size * len(blocks)
+        return total
+
+    def read_cache_pipelined(self, bands, on_band=None) -> int:
+        """Banded get with desc-prefetch overlap; ``on_band(i)`` fires as
+        each band's bytes land (same fallback rule as the write side)."""
+        if hasattr(self.conn, "read_cache_pipelined"):
+            return self._call("read_cache_pipelined", bands, on_band)
+        total = 0
+        for i, (blocks, block_size, ptr) in enumerate(bands):
+            if blocks:
+                self.read_cache(blocks, block_size, ptr)
+                total += block_size * len(blocks)
+            if on_band is not None:
+                on_band(i)
+        return total
 
     def _io_pool(self):
         # One shared bounded executor per connection: asyncio's loop-default

@@ -168,15 +168,23 @@ class Pool:
     # -- allocation --
 
     def _find_run(self, k: int) -> int:
-        """Return first block index of a free run of k blocks, or -1."""
+        """Return first block index of a free run of k blocks, or -1.
+
+        Doubling AND-chain: after the loop, bit i of ``r`` is set iff
+        blocks i..i+k-1 are all free — O(log k) big-int ops instead of
+        O(k), which is what makes whole-batch contiguous runs (k in the
+        thousands) as cheap to probe as single regions."""
         free = ~self._occ & self._full_mask
         if free == 0:
             return -1
         r = free
-        for i in range(1, k):
-            r &= free >> i
+        span = 1
+        while span < k:
+            step = min(span, k - span)
+            r &= r >> step
             if r == 0:
                 return -1
+            span += step
         # prefer positions at/after the rover to reduce fragmentation churn
         hi = r >> self._rover
         if hi:
@@ -365,6 +373,36 @@ class MM:
                     self.pools[pi].deallocate(off, size)
                 return None
         return out
+
+    def allocate_contiguous(self, size: int, n: int) -> Optional[List[Tuple[int, int]]]:
+        """Best-effort: ``n`` regions of ``size`` bytes as ONE contiguous run
+        inside one pool, so a batch put's descriptors merge into a single
+        bulk memcpy client-side (the RDMA-WR-chain analog of the design).
+
+        Region i sits at ``base + i * stride`` where stride is ``size``
+        rounded up to the pool's block size — every region starts on a
+        block boundary, so per-entry ``deallocate(offset, size)`` frees
+        exactly its own blocks.  Returns None on failure WITHOUT setting
+        ``need_extend``; callers fall back to the per-region ``allocate``.
+        """
+        if n <= 0 or size == 0 or size > self.MAX_ALLOC_SIZE:
+            return None
+        cls = self._class_of(size) if self.allocator == "sizeclass" else None
+        for pi, pool in enumerate(self.pools):
+            if cls is not None and pool.block_size != cls:
+                continue
+            stride = _round_up(size, pool.block_size)
+            off = pool.allocate(stride * n)
+            if off is not None:
+                return [(pi, off + i * stride) for i in range(n)]
+        if cls is not None:
+            # carve (or reclassify) a class pool and retry the run there
+            pi = self._carve(cls)
+            if pi is not None:
+                off = self.pools[pi].allocate(cls * n)
+                if off is not None:
+                    return [(pi, off + i * cls) for i in range(n)]
+        return None
 
     def deallocate(self, pool_idx: int, offset: int, size: int) -> None:
         self.pools[pool_idx].deallocate(offset, size)

@@ -24,6 +24,21 @@ from .utils.logging import Logger
 MAX_INLINE_BODY = 1 << 30
 
 
+def _merge_desc_runs(descs):
+    """Merge adjacent descriptors (same pool, contiguous offsets) into
+    ``(pool_idx, offset, length)`` runs.  With the store's contiguous-run
+    batch allocation a whole inline batch streams through ONE pool view
+    instead of one per block (fewer Python-level iterations and larger
+    socket writes); order is preserved, so payload layout is unchanged."""
+    runs = []
+    for pool_idx, offset, size in descs:
+        if runs and runs[-1][0] == pool_idx and runs[-1][1] + runs[-1][2] == offset:
+            runs[-1][2] += size
+        else:
+            runs.append([pool_idx, offset, size])
+    return runs
+
+
 class StoreServer:
     def __init__(self, config, store: Optional[Store] = None):
         self.config = config
@@ -210,7 +225,7 @@ class StoreServer:
             for key in keys:
                 st.pending[key].busy = True
             try:
-                for (pool_idx, offset, size) in descs:
+                for (pool_idx, offset, size) in _merge_desc_runs(descs):
                     dst = st.mm.view(pool_idx, offset, size)
                     got = 0
                     while got < size:
@@ -239,7 +254,7 @@ class StoreServer:
             sizes = b"".join(P._U32.pack(size) for (_, _, size) in descs)
             writer.write(P.RESP.pack(P.FINISH, len(sizes) + total))
             writer.write(sizes)
-            for (pool_idx, offset, size) in descs:
+            for (pool_idx, offset, size) in _merge_desc_runs(descs):
                 writer.write(bytes(st.mm.view(pool_idx, offset, size)))
                 await writer.drain()
             return None

@@ -72,6 +72,7 @@ class Stats:
     bytes_out: int = 0
     spilled: int = 0    # DRAM -> disk tier
     promoted: int = 0   # disk tier -> DRAM
+    contig_batches: int = 0  # batch allocs served as one contiguous run
 
 
 class DiskTier:
@@ -335,11 +336,25 @@ class Store:
 
     def _allocate(self, size: int, n: int):
         """On-demand-evict + allocate + auto-extend-retry (+ class-
-        pressure eviction for the sizeclass allocator)."""
+        pressure eviction for the sizeclass allocator).
+
+        Batches (n > 1) first try ONE contiguous run so a batch put's
+        descriptors coalesce into bulk memcpys client-side; a fragmented
+        pool falls back to the per-region allocator, which only costs the
+        batch its mergeability, never the allocation."""
         self.evict(ON_DEMAND_MIN_THRESHOLD, ON_DEMAND_MAX_THRESHOLD)
-        regions = self.mm.allocate(size, n)
+
+        def _try_alloc():
+            if n > 1:
+                regions = self.mm.allocate_contiguous(size, n)
+                if regions is not None:
+                    self.stats.contig_batches += 1
+                    return regions
+            return self.mm.allocate(size, n)
+
+        regions = _try_alloc()
         if regions is None and self.maybe_extend():
-            regions = self.mm.allocate(size, n)
+            regions = _try_alloc()
         if (regions is None and self.mm.allocator == "sizeclass"
                 and self.mm.eviction_could_satisfy(size, n)):
             # the guard keeps one unsatisfiable request from draining
@@ -561,6 +576,7 @@ class Store:
             "evicted": s.evicted,
             "bytes_in": s.bytes_in,
             "bytes_out": s.bytes_out,
+            "contig_batches": s.contig_batches,
         }
         if self.disk is not None:
             d.update({

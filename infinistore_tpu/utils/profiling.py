@@ -34,18 +34,23 @@ class LatencyStats:
         try:
             yield
         finally:
-            dt = time.perf_counter() - t0
-            with self._lock:
-                rec = self._ops.setdefault(name, [0, 0.0, 0.0, [], 0])
-                rec[0] += 1
-                rec[1] += dt
-                rec[2] = max(rec[2], dt)
-                ring = rec[3]
-                if len(ring) < self.SAMPLES:
-                    ring.append(dt)
-                else:  # write at cursor, then advance: oldest-first overwrite
-                    ring[rec[4]] = dt
-                    rec[4] = (rec[4] + 1) % self.SAMPLES
+            self.record(name, time.perf_counter() - t0)
+
+    def record(self, name: str, seconds: float) -> None:
+        """Accumulate one externally-timed sample (the data plane's
+        per-stage alloc/copy/commit breakdown records sub-spans this way
+        where a context manager doesn't fit)."""
+        with self._lock:
+            rec = self._ops.setdefault(name, [0, 0.0, 0.0, [], 0])
+            rec[0] += 1
+            rec[1] += seconds
+            rec[2] = max(rec[2], seconds)
+            ring = rec[3]
+            if len(ring) < self.SAMPLES:
+                ring.append(seconds)
+            else:  # write at cursor, then advance: oldest-first overwrite
+                ring[rec[4]] = seconds
+                rec[4] = (rec[4] + 1) % self.SAMPLES
 
     @staticmethod
     def _pct(sorted_samples: list, q: float) -> float:

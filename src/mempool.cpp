@@ -257,6 +257,38 @@ bool MM::allocate(uint64_t size, size_t n, std::vector<Region>* out) {
   return true;
 }
 
+bool MM::allocate_contiguous(uint64_t size, size_t n, std::vector<Region>* out) {
+  if (size == 0 || n == 0 || size > kMaxAllocSize || size > kMaxAllocSize / n)
+    return false;
+  const bool sized = allocator_ == Allocator::kSizeClass;
+  const uint64_t cls = sized ? class_of(size) : 0;
+  for (uint32_t pi = 0; pi < pools_.size(); pi++) {
+    Pool* p = pools_[pi].get();
+    if (sized && p->block_size() != cls) continue;
+    uint64_t stride = round_up(size, p->block_size());
+    int64_t off = p->allocate(stride * n);
+    if (off >= 0) {
+      for (size_t i = 0; i < n; i++)
+        out->push_back({pi, static_cast<uint64_t>(off) + i * stride});
+      return true;
+    }
+  }
+  if (sized) {
+    // carve (or reclassify) a class pool and retry the run there
+    int64_t pi = carve(cls);
+    if (pi >= 0) {
+      int64_t off = pools_[pi]->allocate(cls * n);
+      if (off >= 0) {
+        for (size_t i = 0; i < n; i++)
+          out->push_back({static_cast<uint32_t>(pi),
+                          static_cast<uint64_t>(off) + i * cls});
+        return true;
+      }
+    }
+  }
+  return false;
+}
+
 void MM::deallocate(uint32_t pool_idx, uint64_t offset, uint64_t size) {
   pools_[pool_idx]->deallocate(offset, size);
 }

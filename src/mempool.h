@@ -95,6 +95,13 @@ class MM {
   // All-or-nothing batch allocate of n regions of `size` bytes each
   // (reference: src/mempool.cpp MM::allocate's callback-per-region loop).
   bool allocate(uint64_t size, size_t n, std::vector<Region>* out);
+
+  // Best-effort: n regions of `size` bytes as ONE contiguous run in one
+  // pool (region i at base + i*stride, stride = size rounded up to the
+  // pool's block size), so batch-put descriptors merge into bulk memcpys
+  // client-side.  Never sets need_extend; false = caller falls back to
+  // the per-region allocate().
+  bool allocate_contiguous(uint64_t size, size_t n, std::vector<Region>* out);
   void deallocate(uint32_t pool_idx, uint64_t offset, uint64_t size);
 
   // sizeclass only: could freeing committed entries EVER make

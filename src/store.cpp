@@ -317,12 +317,22 @@ int64_t Store::pressure_evict(size_t n) {
 }
 
 bool Store::allocate(uint64_t size, size_t n, std::vector<Region>* out) {
-  // on-demand evict + allocate + auto-extend retry (src/infinistore.cpp:437-452)
+  // on-demand evict + allocate + auto-extend retry (src/infinistore.cpp:437-452).
+  // Batches first try ONE contiguous run so descriptors coalesce into
+  // bulk memcpys client-side; fragmentation falls back per-region.
   evict(kOnDemandMin, kOnDemandMax);
+  if (n > 1 && mm_.allocate_contiguous(size, n, out)) {
+    stats_.contig_batches++;
+    return true;
+  }
   if (mm_.allocate(size, n, out)) return true;
   if (cfg_.auto_increase && mm_.need_extend) {
     mm_.add_pool();
     mm_.need_extend = false;
+    if (n > 1 && mm_.allocate_contiguous(size, n, out)) {
+      stats_.contig_batches++;
+      return true;
+    }
     if (mm_.allocate(size, n, out)) return true;
   }
   if (cfg_.allocator == "sizeclass" && mm_.eviction_could_satisfy(size, n)) {
@@ -535,7 +545,8 @@ std::string Store::stats_json() const {
            "{\"kvmap_len\": %zu, \"pending\": %zu, \"usage\": %.6f, "
            "\"pools\": %zu, \"block_size\": %llu, \"puts\": %llu, "
            "\"gets\": %llu, \"hits\": %llu, \"misses\": %llu, "
-           "\"evicted\": %llu, \"bytes_in\": %llu, \"bytes_out\": %llu",
+           "\"evicted\": %llu, \"bytes_in\": %llu, \"bytes_out\": %llu, "
+           "\"contig_batches\": %llu",
            kv_.size(), pending_.size(), mm_.usage(), mm_.pools().size(),
            static_cast<unsigned long long>(mm_.block_size()),
            static_cast<unsigned long long>(stats_.puts),
@@ -544,7 +555,8 @@ std::string Store::stats_json() const {
            static_cast<unsigned long long>(stats_.misses),
            static_cast<unsigned long long>(stats_.evicted),
            static_cast<unsigned long long>(stats_.bytes_in),
-           static_cast<unsigned long long>(stats_.bytes_out));
+           static_cast<unsigned long long>(stats_.bytes_out),
+           static_cast<unsigned long long>(stats_.contig_batches));
   if (disk_) {
     n += snprintf(buf + n, sizeof(buf) - n,
                   ", \"disk_entries\": %zu, \"disk_bytes\": %llu, "

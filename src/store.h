@@ -45,6 +45,7 @@ struct StoreStats {
   uint64_t puts = 0, gets = 0, hits = 0, misses = 0, evicted = 0;
   uint64_t bytes_in = 0, bytes_out = 0;
   uint64_t spilled = 0, promoted = 0;  // DRAM <-> disk tier traffic
+  uint64_t contig_batches = 0;  // batch allocs served as one contiguous run
 };
 
 struct StoreConfig {

@@ -384,13 +384,25 @@ class Client {
       size_t nd = resp.size() / sizeof(Desc);
       if (nd != n) return INTERNAL_ERROR;
       const Desc* descs = reinterpret_cast<const Desc*>(resp.data());
-      std::vector<uint8_t*> dsts(n);
+      // merge adjacent descriptors (contiguous pool bytes AND contiguous
+      // client bytes) into runs: one large memcpy per run instead of one
+      // per block — the payoff of the server's contiguous-run allocation
+      struct Run { uint8_t* dst; const uint8_t* src; uint64_t len; };
+      std::vector<Run> runs;
+      runs.reserve(n);
       for (size_t i = 0; i < n; i++) {
-        dsts[i] = pool_ptr(descs[i].pool_idx, descs[i].offset);
-        if (!dsts[i]) return INTERNAL_ERROR;
+        uint8_t* dst = pool_ptr(descs[i].pool_idx, descs[i].offset);
+        if (!dst) return INTERNAL_ERROR;
+        const uint8_t* src = base + offsets[i];
+        if (!runs.empty() && runs.back().dst + runs.back().len == dst &&
+            runs.back().src + runs.back().len == src) {
+          runs.back().len += block_size;
+        } else {
+          runs.push_back({dst, src, block_size});
+        }
       }
-      striped_copy(n, n * block_size, [&](size_t i) {
-        std::memcpy(dsts[i], base + offsets[i], block_size);
+      striped_copy(runs.size(), n * block_size, [&](size_t i) {
+        std::memcpy(runs[i].dst, runs[i].src, runs[i].len);
       });
       std::string commit;
       Writer w(&commit);
@@ -449,15 +461,24 @@ class Client {
       size_t nd = resp.size() / sizeof(Desc);
       if (nd != n) return INTERNAL_ERROR;
       const Desc* descs = reinterpret_cast<const Desc*>(resp.data());
-      std::vector<uint8_t*> srcs(n);
+      struct Run { uint8_t* dst; const uint8_t* src; uint64_t len; };
+      std::vector<Run> runs;
+      runs.reserve(n);
       uint64_t total = 0;
       for (size_t i = 0; i < n; i++) {
-        srcs[i] = pool_ptr(descs[i].pool_idx, descs[i].offset);
-        if (!srcs[i]) return INTERNAL_ERROR;
+        const uint8_t* src = pool_ptr(descs[i].pool_idx, descs[i].offset);
+        if (!src) return INTERNAL_ERROR;
+        uint8_t* dst = base + offsets[i];
         total += descs[i].size;
+        if (!runs.empty() && runs.back().src + runs.back().len == src &&
+            runs.back().dst + runs.back().len == dst) {
+          runs.back().len += descs[i].size;
+        } else {
+          runs.push_back({dst, src, descs[i].size});
+        }
       }
-      striped_copy(n, total, [&](size_t i) {
-        std::memcpy(base + offsets[i], srcs[i], descs[i].size);
+      striped_copy(runs.size(), total, [&](size_t i) {
+        std::memcpy(runs[i].dst, runs[i].src, runs[i].len);
       });
       return FINISH;
     }

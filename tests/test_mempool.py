@@ -100,6 +100,79 @@ def test_mm_usage():
         mm.close()
 
 
+def test_mm_allocate_contiguous_run():
+    """Batch allocs come back as ONE run (region i at base + i*stride) so
+    batch-put descriptors merge into bulk memcpys; per-entry deallocate
+    frees exactly its own blocks."""
+    mm = MM(pool_size=1 << 20, block_size=4096)
+    try:
+        regions = mm.allocate_contiguous(4096, 32)
+        assert regions is not None and len(regions) == 32
+        pis = {pi for pi, _ in regions}
+        assert len(pis) == 1
+        offs = [off for _, off in regions]
+        assert offs == [offs[0] + i * 4096 for i in range(32)]
+        # per-entry frees release only that entry's blocks
+        for pi, off in regions[:16]:
+            mm.deallocate(pi, off, 4096)
+        assert mm.usage() == pytest.approx(16 * 4096 / (1 << 20))
+        # sub-block sizes stride at the rounded-up block footprint
+        r2 = mm.allocate_contiguous(100, 4)
+        assert r2 is not None
+        o2 = [off for _, off in r2]
+        assert o2 == [o2[0] + i * 4096 for i in range(4)]
+    finally:
+        mm.close()
+
+
+def test_mm_allocate_contiguous_fragmented_falls_back_to_none():
+    """No run big enough -> None, WITHOUT setting need_extend (the store
+    falls back to the per-region allocator, which still succeeds)."""
+    mm = MM(pool_size=64 * 4096, block_size=4096)
+    try:
+        offs = [mm.allocate(4096, 1)[0] for _ in range(64)]
+        for i in range(0, 64, 2):  # free every other block: no run of 2
+            mm.deallocate(*offs[i], 4096)
+        assert mm.allocate_contiguous(4096, 2) is None
+        assert not mm.need_extend
+        # the per-region path still places 2 regions in the holes
+        assert mm.allocate(4096, 2) is not None
+    finally:
+        mm.close()
+
+
+def test_mm_allocate_contiguous_sizeclass():
+    """sizeclass mode: the run lives inside one class pool, striding at
+    the class size; carving happens on demand."""
+    mm = MM(pool_size=1 << 20, block_size=4096, allocator="sizeclass")
+    try:
+        regions = mm.allocate_contiguous(5000, 8)  # class 8192
+        assert regions is not None
+        offs = [off for _, off in regions]
+        assert offs == [offs[0] + i * 8192 for i in range(8)]
+        pi = regions[0][0]
+        assert mm.pools[pi].block_size == 8192
+        for _pi, off in regions:
+            mm.deallocate(_pi, off, 5000)
+        assert mm.pools[pi].allocated_blocks == 0
+    finally:
+        mm.close()
+
+
+def test_find_run_doubling_matches_sequential(pool):
+    """The O(log k) doubling run-finder must agree with first-fit for
+    mixed run lengths under fragmentation."""
+    offs = [pool.allocate(4096) for _ in range(256)]
+    # carve holes of length 1, 3, 7 at known positions
+    for i in (10, 20, 21, 22, 40, 41, 42, 43, 44, 45, 46):
+        pool.deallocate(offs[i], 4096)
+    pool._rover = 0
+    assert pool.allocate(3 * 4096) == offs[20]   # first run of >=3
+    assert pool.allocate(7 * 4096) == offs[40]
+    assert pool.allocate(4096) == offs[10]
+    assert pool.allocate(4096) is None
+
+
 def test_sweep_stale_segments(tmp_path):
     import os
 

@@ -1,0 +1,123 @@
+"""Data-plane perf floor: a cheap guard against re-serializing the put path.
+
+Three guards, each catching a different way the coalesced data plane
+(contiguous-run server allocation + client run merging + bulk copies)
+could silently regress to the old per-page loop:
+
+* STRUCTURAL, server: a batch ALLOC_PUT on a fresh pool must be served
+  as a contiguous run (``contig_batches`` stat increments) — guards the
+  allocator fast path, whose per-region predecessor cost ~14 ms per
+  2048-key batch.
+* STRUCTURAL, client: a contiguous desc list must collapse to ONE copy
+  run in ``_merge_runs`` — guards the client half of coalescing.
+* TIMING: end-to-end shm put bandwidth (64 KB pages, 128 MB, best of 4)
+  clears a floor the old per-page stack cannot reach.  Calibrated on the
+  1-vCPU reference host: old stack 1.86 GB/s, coalesced stack ~4.0 GB/s,
+  host memcpy wall ~5.8 GB/s; the 2.4 floor sits ~30% above old and
+  ~40% below new, so it survives moderate load spikes while still
+  failing on any real re-serialization.
+"""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import infinistore_tpu as ist
+from infinistore_tpu.lib import _merge_runs
+
+pytestmark = pytest.mark.perf
+
+PUT_FLOOR_GBPS = 2.4
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture(scope="module")
+def server():
+    port, mport = _free_port(), _free_port()
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "infinistore_tpu.server",
+         "--service-port", str(port), "--manage-port", str(mport),
+         "--prealloc-size", "1", "--minimal-allocate-size", "16",
+         "--log-level", "warning", "--backend", "python"],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    deadline = time.time() + 25
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            pytest.fail("perf server failed to start")
+        try:
+            socket.create_connection(("127.0.0.1", port), timeout=0.5).close()
+            break
+        except OSError:
+            time.sleep(0.1)
+    yield port
+    proc.send_signal(signal.SIGINT)
+    try:
+        proc.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+
+
+def test_merge_runs_collapses_contiguous_batch():
+    """2048 contiguous descriptors must merge into ONE bulk-copy run, and
+    a pool/client discontinuity must split exactly there."""
+    bs = 64 << 10
+    descs = [(0, i * bs, bs) for i in range(2048)]
+    offsets = [i * bs for i in range(2048)]
+    runs = _merge_runs(descs, offsets)
+    assert len(runs) == 1 and runs[0] == [0, 0, 0, 2048 * bs]
+    # a hole on the pool side splits the run
+    descs[1024] = (0, 1025 * bs, bs)
+    runs = _merge_runs(descs, offsets)
+    assert len(runs) == 3
+    # different pool splits too
+    descs[1024] = (1, 1024 * bs, bs)
+    assert len(_merge_runs(descs, offsets)) == 3
+
+
+def test_put_clears_floor_old_loop_cannot(server, monkeypatch):
+    monkeypatch.setenv("ISTPU_CLIENT", "python")
+    blk = 64 << 10
+    nbytes = 128 << 20
+    buf = np.random.randint(0, 256, nbytes, dtype=np.uint8)
+    conn = ist.InfinityConnection(ist.ClientConfig(
+        host_addr="127.0.0.1", service_port=server,
+        connection_type=ist.TYPE_SHM, log_level="warning"))
+    conn.connect()
+    conn.register_mr(buf)
+    n = nbytes // blk
+    best = float("inf")
+    for it in range(4):
+        blocks = [(f"perf-{it}-{i}", i * blk) for i in range(n)]
+        t0 = time.perf_counter()
+        conn.write_cache(blocks, blk, buf.ctypes.data)
+        best = min(best, time.perf_counter() - t0)
+        conn.delete_keys([k for k, _ in blocks])
+    stats = conn.stats()
+    stages = conn.latency_stats()
+    conn.close()
+
+    # structural: the server really served contiguous runs
+    assert stats.get("contig_batches", 0) >= 1, stats
+    put_gbps = nbytes / 1e9 / best
+    breakdown = {
+        k: v["p50_ms"] for k, v in stages.items() if k.startswith("write_cache")
+    }
+    assert put_gbps >= PUT_FLOOR_GBPS, (
+        f"shm put {put_gbps:.2f} GB/s under the {PUT_FLOOR_GBPS} GB/s floor "
+        f"(the old per-page stack measured 1.86 on the reference host) — "
+        f"stage p50s: {breakdown}"
+    )

@@ -880,6 +880,135 @@ def test_client_reconnects_after_server_restart(client_mode, monkeypatch):
             srv.kill()
 
 
+# ---- coalesced vs legacy data-plane byte parity ----
+
+
+def _py_shm_conn(monkeypatch, coalesce: bool):
+    """A python-client shm connection with the copy strategy pinned."""
+    monkeypatch.setenv("ISTPU_CLIENT", "python")
+    conn = make_conn(ist.TYPE_SHM)
+    conn.conn.coalesce = coalesce
+    return conn
+
+
+def test_coalesced_vs_legacy_write_parity(server, monkeypatch):
+    """The coalesced bulk-copy put and the legacy per-page put must leave
+    IDENTICAL pool contents: the same payload written both ways reads back
+    byte-equal through the legacy path, the coalesced path, AND the TCP
+    inline path (which streams straight out of the pool server-side)."""
+    ccon = _py_shm_conn(monkeypatch, True)
+    lcon = _py_shm_conn(monkeypatch, False)
+    tcon = make_conn(ist.TYPE_TCP)
+    nb, blk = 24, 16 << 10
+    src = np.random.randint(0, 256, nb * blk, dtype=np.uint8)
+    for c in (ccon, lcon):
+        c.register_mr(src)
+    c_blocks = [(f"par-c-{i}", i * blk) for i in range(nb)]
+    l_blocks = [(f"par-l-{i}", i * blk) for i in range(nb)]
+    ccon.write_cache(c_blocks, blk, src.ctypes.data)
+    lcon.write_cache(l_blocks, blk, src.ctypes.data)
+    for reader in (ccon, lcon):
+        for blocks in (c_blocks, l_blocks):
+            dst = np.zeros_like(src)
+            reader.register_mr(dst)
+            reader.read_cache(blocks, blk, dst.ctypes.data)
+            np.testing.assert_array_equal(src, dst)
+    # the TCP view of the pool bytes agrees too
+    for key, off in c_blocks[:4] + l_blocks[:4]:
+        got = np.asarray(tcon.tcp_read_cache(key))
+        np.testing.assert_array_equal(got, src[off : off + blk])
+    ccon.close()
+    lcon.close()
+    tcon.close()
+
+
+def test_coalesced_read_parity_with_mixed_sizes(server, monkeypatch):
+    """Reads over a desc list that CANNOT fully merge (stored sizes below
+    the read block size, interleaved pools/offsets) must restore the same
+    bytes coalesced and legacy — the degrades-to-per-page path."""
+    ccon = _py_shm_conn(monkeypatch, True)
+    lcon = _py_shm_conn(monkeypatch, False)
+    rng = np.random.RandomState(11)
+    blk = 16 << 10
+    sizes = [blk, blk // 2, blk, 100, blk, blk // 4]
+    payloads = [rng.randint(0, 256, s).astype(np.uint8) for s in sizes]
+    keys = [f"mix-{i}" for i in range(len(sizes))]
+    for k, p in zip(keys, payloads):
+        ccon.conn.w_tcp_bytes(k, p.tobytes())
+    blocks = [(k, i * blk) for i, k in enumerate(keys)]
+    outs = []
+    for reader in (ccon, lcon):
+        dst = np.zeros(len(keys) * blk, dtype=np.uint8)
+        reader.register_mr(dst)
+        reader.read_cache(blocks, blk, dst.ctypes.data)
+        outs.append(dst)
+    np.testing.assert_array_equal(outs[0], outs[1])
+    for i, p in enumerate(payloads):
+        np.testing.assert_array_equal(outs[0][i * blk : i * blk + len(p)], p)
+    ccon.close()
+    lcon.close()
+
+
+def test_pipelined_write_read_parity(server, monkeypatch):
+    """write_cache_pipelined (banded alloc/copy overlap + single commit)
+    must be byte-identical to per-band write_cache, and
+    read_cache_pipelined must restore the same bytes in band order."""
+    conn = _py_shm_conn(monkeypatch, True)
+    nb, blk, nbands = 32, 16 << 10, 4
+    src = np.random.randint(0, 256, nb * blk, dtype=np.uint8)
+    conn.register_mr(src)
+    per = nb // nbands
+    bands = []
+    for b in range(nbands):
+        blocks = [(f"pipe-{b}-{i}", i * blk) for i in range(per)]
+        base = b * per * blk
+        # exercise every src spelling: ptr, array slice, and thunk
+        if b % 3 == 0:
+            src_spec = src.ctypes.data + base
+        elif b % 3 == 1:
+            src_spec = src[base : base + per * blk]
+        else:
+            src_spec = (lambda lo=base, hi=base + per * blk: src[lo:hi])
+        bands.append((blocks, blk, src_spec))
+    total = conn.write_cache_pipelined(bands)
+    assert total == nb * blk
+    dst = np.zeros_like(src)
+    conn.register_mr(dst)
+    order = []
+    rbands = [
+        (bands[b][0], blk, dst.ctypes.data + b * per * blk)
+        for b in range(nbands)
+    ]
+    got = conn.read_cache_pipelined(rbands, on_band=order.append)
+    assert got == nb * blk and order == list(range(nbands))
+    np.testing.assert_array_equal(src, dst)
+    # legacy reader agrees (pool contents, not just client copy, are right)
+    lcon = _py_shm_conn(monkeypatch, False)
+    dst2 = np.zeros_like(src)
+    lcon.register_mr(dst2)
+    for b in range(nbands):
+        lcon.read_cache(rbands[b][0], blk, dst2.ctypes.data + b * per * blk)
+    np.testing.assert_array_equal(src, dst2)
+    lcon.close()
+    conn.close()
+
+
+def test_empty_batch_is_a_noop(server, monkeypatch):
+    """Empty block lists return FINISH without a wire round-trip."""
+    conn = _py_shm_conn(monkeypatch, True)
+    from infinistore_tpu import protocol as P
+
+    assert conn.write_cache([], 4096, 0) == P.FINISH
+    assert conn.read_cache([], 4096, 0) == P.FINISH
+    assert conn.write_cache_pipelined([]) == 0
+    assert conn.read_cache_pipelined([]) == 0
+    stats = conn.latency_stats()
+    # no alloc/desc round-trip was recorded for the empty calls
+    assert stats.get("write_cache.alloc", {}).get("count", 0) == 0
+    assert stats.get("read_cache.desc", {}).get("count", 0) == 0
+    conn.close()
+
+
 # ---- disk spill tier, end to end over the wire ----
 
 

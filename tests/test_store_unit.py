@@ -197,6 +197,33 @@ def test_purge_leased_key_defers_free(store):
     assert len(store._deferred) == 1
 
 
+def test_alloc_put_batch_is_contiguous(store):
+    """Batch ALLOC_PUT on an unfragmented pool returns descs that form one
+    ascending contiguous run in one pool (what the client's run merge and
+    the pyserver's streaming merge rely on for bulk copies), and the
+    contig_batches stat counts it."""
+    keys = [f"cg{i}".encode() for i in range(16)]
+    status, descs = store.alloc_put(keys, 16 << 10)
+    assert status == P.FINISH
+    assert len({p for p, _, _ in descs}) == 1
+    base = descs[0][1]
+    assert [off for _, off, _ in descs] == [
+        base + i * (16 << 10) for i in range(16)
+    ]
+    assert store.stats_dict()["contig_batches"] == 1
+    store.commit_put(keys)
+    # fragmented pool (64 blocks total): no contiguous run of 50 exists
+    # (largest is the 48-block tail), so the batch falls back to the
+    # per-region allocator and still succeeds
+    for k in keys[::2]:
+        store.delete_keys([k])
+    status, descs2 = store.alloc_put(
+        [f"fr{i}".encode() for i in range(50)], 16 << 10
+    )
+    assert status == P.FINISH and len(descs2) == 50
+    assert store.stats_dict()["contig_batches"] == 1  # unchanged
+
+
 # ---- disk spill tier ("Historical KVCache in DRAM and SSD") ----
 
 
