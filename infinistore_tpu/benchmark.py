@@ -16,12 +16,14 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import contextlib
 import time
 import uuid
 
 import numpy as np
 
 from . import ClientConfig, InfinityConnection, TYPE_SHM, TYPE_TCP
+from .utils import tracing
 
 
 def parse_args():
@@ -44,6 +46,11 @@ def parse_args():
                          "(TINY model; no server needed)")
     ap.add_argument("--serving-batch", type=int, default=4)
     ap.add_argument("--serving-steps", type=int, default=128)
+    ap.add_argument("--trace-out", default=None, metavar="FILE",
+                    help="dump a Chrome trace-event JSON of the run "
+                         "(one trace per iteration, spans nested down to "
+                         "the pool copy) — load it in Perfetto "
+                         "(ui.perfetto.dev) or chrome://tracing")
     return ap.parse_args()
 
 
@@ -121,31 +128,38 @@ def main():
 
     put_t = get_t = 0.0
     for it in range(args.iteration):
-        blocks = [(f"bench-{run}-{it}-{i}", i * bs) for i in range(n_blocks)]
-        if args.simulate_layers:
-            per = -(-n_blocks // args.simulate_layers)  # ceil: cover all blocks
-            layer_blocks = [
-                blocks[li * per : (li + 1) * per]
-                for li in range(args.simulate_layers)
-            ]
+        # one request-scoped trace per iteration when tracing: the put/get
+        # ops and their alloc/copy/commit stages nest under it sharing one
+        # trace id — exactly the timeline --trace-out dumps
+        cm = (tracing.trace("bench.iteration", iteration=it)
+              if args.trace_out else contextlib.nullcontext())
+        with cm:
+            blocks = [(f"bench-{run}-{it}-{i}", i * bs)
+                      for i in range(n_blocks)]
+            if args.simulate_layers:
+                per = -(-n_blocks // args.simulate_layers)  # ceil: cover all blocks
+                layer_blocks = [
+                    blocks[li * per : (li + 1) * per]
+                    for li in range(args.simulate_layers)
+                ]
 
-            async def flood():
-                await asyncio.gather(*[
-                    conn.write_cache_async(lb, bs, buf.ctypes.data)
-                    for lb in layer_blocks if lb
-                ])
+                async def flood():
+                    await asyncio.gather(*[
+                        conn.write_cache_async(lb, bs, buf.ctypes.data)
+                        for lb in layer_blocks if lb
+                    ])
 
+                t0 = time.perf_counter()
+                asyncio.run(flood())
+                put_t += time.perf_counter() - t0
+            else:
+                t0 = time.perf_counter()
+                conn.write_cache(blocks, bs, buf.ctypes.data)
+                put_t += time.perf_counter() - t0
             t0 = time.perf_counter()
-            asyncio.run(flood())
-            put_t += time.perf_counter() - t0
-        else:
-            t0 = time.perf_counter()
-            conn.write_cache(blocks, bs, buf.ctypes.data)
-            put_t += time.perf_counter() - t0
-        t0 = time.perf_counter()
-        conn.read_cache(blocks, bs, dst.ctypes.data)
-        get_t += time.perf_counter() - t0
-        conn.delete_keys([k for k, _ in blocks])
+            conn.read_cache(blocks, bs, dst.ctypes.data)
+            get_t += time.perf_counter() - t0
+            conn.delete_keys([k for k, _ in blocks])
 
     assert np.array_equal(buf, dst), "data mismatch"
     gb = args.iteration * total / 1e9
@@ -164,6 +178,11 @@ def main():
             s = stats[name]
             print(f"  {name:24s} count={s['count']:<5} avg={s['avg_ms']:<9} "
                   f"p50={s['p50_ms']:<9} p99={s['p99_ms']:<9} max={s['max_ms']}")
+    if args.trace_out:
+        with open(args.trace_out, "w") as f:
+            f.write(tracing.TRACER.export_chrome_json())
+        print(f"trace written to {args.trace_out} "
+              f"(load in https://ui.perfetto.dev)")
     conn.close()
 
 

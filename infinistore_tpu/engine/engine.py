@@ -45,6 +45,7 @@ from ..models.llama import (
     prefill_forward,
     verify_forward,
 )
+from ..utils import tracing
 
 
 def _truncate_logits(l: jax.Array, top_k: jax.Array, top_p: jax.Array) -> jax.Array:
@@ -256,7 +257,11 @@ class _StoreStreamer:
             pages, keys = self._q.get()
             try:
                 if self._err is None:
-                    self._transfer.push_pages(pages, keys)
+                    # own trace: this thread has no request context, but
+                    # async pushes should still show up in /debug/traces
+                    # (kv.push_pages and the write_cache stages nest here)
+                    with tracing.trace("store.push_async", chunks=len(keys)):
+                        self._transfer.push_pages(pages, keys)
             except BaseException as e:  # noqa: BLE001 — reported at flush()
                 # park the first error and SKIP queued items until the
                 # next flush() consumes it: a dead store fails fast (one
@@ -600,11 +605,12 @@ class InferenceEngine:
         ``adapter_id`` picks a LoRA adapter from the engine's bank (0 =
         base model); adapter KV is key-namespaced so prefix reuse never
         crosses adapters."""
-        pp = self.prefill_start(tokens, adapter_id=adapter_id)
-        while True:
-            st = self.prefill_step(pp)
-            if st is not None:
-                return st
+        with tracing.span("engine.prefill", tokens=len(tokens)):
+            pp = self.prefill_start(tokens, adapter_id=adapter_id)
+            while True:
+                st = self.prefill_step(pp)
+                if st is not None:
+                    return st
 
     def prefill_start(
         self, tokens: Sequence[int], adapter_id: int = 0

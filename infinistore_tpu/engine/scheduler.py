@@ -40,6 +40,8 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 
+from ..utils import tracing
+from ..utils.metrics import MetricsRegistry, default_registry, nearest_rank
 from .engine import _SPLIT2, InferenceEngine, PartialPrefill, SequenceState
 
 
@@ -116,8 +118,29 @@ class Scheduler:
                  draft_engine: Optional[InferenceEngine] = None,
                  spec_k: int = 4, prefill_concurrency: int = 4,
                  spec_batch: int = 1,
-                 ngram_spec: bool = False, spec_g: int = 2):
+                 ngram_spec: bool = False, spec_g: int = 2,
+                 metrics: Optional[MetricsRegistry] = None):
         self.engine = engine
+        # latency histograms (log-spaced buckets -> rate()-able and
+        # replica-aggregatable, unlike the rolling-window p50 gauges the
+        # latency_metrics property still offers as a convenience view).
+        # ``metrics``: the owning server's registry (ServingServer passes
+        # its own so two servers in one process never mix); library
+        # callers default to the process registry.
+        self.metrics = metrics if metrics is not None else default_registry()
+        self._h_queue_wait = self.metrics.histogram(
+            "istpu_serve_queue_wait_seconds",
+            "Per-request wait from submit to prefill start",
+        )
+        self._h_prefill = self.metrics.histogram(
+            "istpu_serve_prefill_seconds",
+            "Per-request prefill-start to first visible token "
+            "(the compute half of TTFT)",
+        )
+        self._h_decode_step = self.metrics.histogram(
+            "istpu_serve_decode_step_seconds",
+            "One decode dispatch: the whole batch advancing one chunk",
+        )
         self.max_batch = max_batch
         self.pending: List[Request] = []
         self.active: List[Request] = []
@@ -530,9 +553,16 @@ class Scheduler:
         return True
 
     def _spec_dispatch(self, reqs: List[Request], chunk: int) -> bool:
-        if self.spec_kind == "ngram":
-            return self._ngram_step_batch(reqs, chunk)
-        return self._spec_step_batch(reqs, chunk)
+        t0 = time.perf_counter()
+        with tracing.span("sched.decode_chunk", batch=len(reqs),
+                          chunk=chunk, spec=self.spec_kind):
+            if self.spec_kind == "ngram":
+                ok = self._ngram_step_batch(reqs, chunk)
+            else:
+                ok = self._spec_step_batch(reqs, chunk)
+        if ok:
+            self._h_decode_step.observe(time.perf_counter() - t0)
+        return ok
 
     def _spec_step_batch(self, reqs: List[Request], chunk: int) -> bool:
         """Decode ``chunk`` tokens for up to ``spec_batch`` requests in
@@ -633,7 +663,8 @@ class Scheduler:
                 self._stream(req, done=True)
                 cancelled_prefill.append(req)
                 continue
-            st = self.engine.prefill_step(pp)  # ONE chunk per step each
+            with tracing.span("sched.prefill_step", req=req.req_id):
+                st = self.engine.prefill_step(pp)  # ONE chunk per step each
             if st is not None:
                 req.state = st
                 self.active.append(req)
@@ -682,6 +713,7 @@ class Scheduler:
         # any row with penalties switches to the count-carrying program
         want_lp = any(r.logprobs for r in self.active)
         want_pen = any(self._penalized(r) for r in self.active)
+        t_decode = time.perf_counter()
         try:
             outs = self.engine.decode_batch(
                 [r.state for r in self.active], chunk,
@@ -723,6 +755,9 @@ class Scheduler:
             self._enqueue(victim, front=True)
             self._admission_hold = True
             return cancelled_prefill
+        self._h_decode_step.observe(time.perf_counter() - t_decode)
+        tracing.add_stage("sched.decode_chunk", time.perf_counter() - t_decode,
+                          batch=len(self.active), chunk=chunk)
         if want_lp:
             outs, lps = outs
             for req, lp in zip(self.active, lps):
@@ -770,11 +805,14 @@ class Scheduler:
 
     def record_latency(self, req: Request) -> None:
         """Fold a finished request's stamps into the rolling latency
-        window (called at retirement by run()/the serving layer)."""
+        window (called at retirement by run()/the serving layer) and into
+        the queue-wait / prefill histograms."""
         if req.t_submit and req.t_admit and req.t_first:
-            self._latencies.append(
-                (req.t_admit - req.t_submit, req.t_first - req.t_admit)
-            )
+            queue_wait = req.t_admit - req.t_submit
+            prefill = req.t_first - req.t_admit
+            self._latencies.append((queue_wait, prefill))
+            self._h_queue_wait.observe(queue_wait)
+            self._h_prefill.observe(prefill)
 
     @property
     def latency_metrics(self) -> Dict[str, float]:
@@ -787,18 +825,13 @@ class Scheduler:
             return {"queue_wait_p50_ms": 0.0, "queue_wait_p99_ms": 0.0,
                     "prefill_p50_ms": 0.0, "prefill_p99_ms": 0.0,
                     "window": 0}
-
-        def pct(xs, q):
-            xs = sorted(xs)
-            return xs[min(len(xs) - 1, int(q * len(xs)))]
-
-        qs = [q for q, _ in self._latencies]
-        ps = [p for _, p in self._latencies]
+        qs = sorted(q for q, _ in self._latencies)
+        ps = sorted(p for _, p in self._latencies)
         return {
-            "queue_wait_p50_ms": round(pct(qs, 0.50) * 1e3, 2),
-            "queue_wait_p99_ms": round(pct(qs, 0.99) * 1e3, 2),
-            "prefill_p50_ms": round(pct(ps, 0.50) * 1e3, 2),
-            "prefill_p99_ms": round(pct(ps, 0.99) * 1e3, 2),
+            "queue_wait_p50_ms": round(nearest_rank(qs, 0.50) * 1e3, 2),
+            "queue_wait_p99_ms": round(nearest_rank(qs, 0.99) * 1e3, 2),
+            "prefill_p50_ms": round(nearest_rank(ps, 0.50) * 1e3, 2),
+            "prefill_p99_ms": round(nearest_rank(ps, 0.99) * 1e3, 2),
             "window": len(self._latencies),
         }
 

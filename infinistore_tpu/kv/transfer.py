@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils import tracing
 from .cache import PagedCacheConfig, read_pages, write_pages
 from .hashing import layer_key
 from .quant import dequantize_pages_jit, page_quant_bytes, quantize_pages
@@ -137,25 +138,27 @@ class KVTransferEngine:
         client->pool write (the RDMA-WRITE analog)."""
         L = self.cfg.n_layers
         pb = self.wire_page_bytes
-        G = max(1, min(self.pipeline_groups, L))
-        Lg = -(-L // G)
-        parts = [pages[l0 : l0 + Lg] for l0 in range(0, L, Lg)]
-        for p in parts:
-            p.copy_to_host_async()
-        bands = []
-        for gi, p in enumerate(parts):
-            l0 = gi * Lg
-            blocks = self._page_blocks(chunk_keys_, l0, l0 + p.shape[0])
-            bands.append((blocks, pb, self._band_host(p)))
-        writer = getattr(self.conn, "write_cache_pipelined", None)
-        if writer is not None:
-            return writer(bands)
-        total = 0
-        for blocks, _pb, mat in bands:  # native client: per-band puts
-            host = mat()
-            self.conn.write_cache(blocks, pb, host.ctypes.data)
-            total += host.nbytes
-        return total
+        with tracing.span("kv.push_pages", pages=len(chunk_keys_) * L,
+                          bytes=len(chunk_keys_) * L * pb):
+            G = max(1, min(self.pipeline_groups, L))
+            Lg = -(-L // G)
+            parts = [pages[l0 : l0 + Lg] for l0 in range(0, L, Lg)]
+            for p in parts:
+                p.copy_to_host_async()
+            bands = []
+            for gi, p in enumerate(parts):
+                l0 = gi * Lg
+                blocks = self._page_blocks(chunk_keys_, l0, l0 + p.shape[0])
+                bands.append((blocks, pb, self._band_host(p)))
+            writer = getattr(self.conn, "write_cache_pipelined", None)
+            if writer is not None:
+                return writer(bands)
+            total = 0
+            for blocks, _pb, mat in bands:  # native client: per-band puts
+                host = mat()
+                self.conn.write_cache(blocks, pb, host.ctypes.data)
+                total += host.nbytes
+            return total
 
     def save_pages(
         self, cache: jax.Array, block_ids: Sequence[int], chunk_keys_: Sequence[str]
@@ -191,6 +194,16 @@ class KVTransferEngine:
         n = len(block_ids)
         if n == 0:
             return cache
+        pb = self.wire_page_bytes
+        L = self.cfg.n_layers
+        nbytes = L * n * pb
+        with tracing.span("kv.load_pages", pages=L * n, bytes=nbytes):
+            return self._load_pages_banded(cache, block_ids, chunk_keys_, n)
+
+    def _load_pages_banded(
+        self, cache: jax.Array, block_ids: Sequence[int],
+        chunk_keys_: Sequence[str], n: int
+    ) -> jax.Array:
         pb = self.wire_page_bytes
         L = self.cfg.n_layers
         nbytes = L * n * pb

@@ -45,8 +45,25 @@ from .config import (  # noqa: F401 - re-exported for parity
     LINK_IB,
 )
 from .mempool import SHM_DIR, _prefault
+from .utils import metrics as _metrics
 from .utils.logging import Logger
 from .utils.profiling import LatencyStats
+
+# one shared client-side histogram for every connection in the process:
+# the op label carries both whole ops (write_cache, read_cache, w_tcp ...)
+# and their stages (write_cache.alloc/.copy/.commit, read_cache.desc/.copy),
+# so /metrics can answer "is the put slow because of the allocator round-
+# trip or the pool memcpy" with rate()-able series instead of the
+# point-in-time p50s in latency_stats()
+_CLIENT_OPS = _metrics.default_registry().histogram(
+    "istpu_client_op_seconds",
+    "Client-side latency of store data-plane ops and their stages",
+    labelnames=("op",),
+)
+
+
+def _observe_client_op(name: str, seconds: float) -> None:
+    _CLIENT_OPS.labels(name).observe(seconds)
 
 
 def _timed_op(name: str):
@@ -333,7 +350,7 @@ class Connection:
         # coalesced bulk copies by default; tests pin the legacy per-page
         # loop here (or via ISTPU_NO_COALESCE) for byte-parity checks
         self.coalesce = _COALESCE
-        self.latency = LatencyStats()
+        self.latency = LatencyStats(sink=_observe_client_op)
 
     def latency_stats(self) -> Dict[str, Dict[str, float]]:
         """Client-side per-op latency counters (count/avg/max ms)."""

@@ -25,7 +25,7 @@ import os
 import re
 import secrets
 import threading
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 EXTEND_POOL_SIZE = 10 << 30  # reference: src/mempool.h:12
 SHM_DIR = "/dev/shm"
@@ -213,6 +213,45 @@ class Pool:
         assert self._occ & run_mask == run_mask, "double free"
         self._occ &= ~run_mask
         self.allocated_blocks -= k
+
+    def largest_free_run(self) -> int:
+        """Largest run of contiguous free blocks, by exponential + binary
+        search over the doubling AND-chain (O(log^2 n) big-int ops — cheap
+        enough for every /metrics scrape)."""
+        free = ~self._occ & self._full_mask
+        if free == 0:
+            return 0
+
+        def has_run(k: int) -> bool:
+            r = free
+            span = 1
+            while span < k:
+                step = min(span, k - span)
+                r &= r >> step
+                if r == 0:
+                    return False
+                span += step
+            return r != 0
+
+        lo = 1  # free != 0 guarantees a run of 1
+        hi = 2
+        limit = self.total_blocks - self.allocated_blocks
+        while hi <= limit and has_run(hi):
+            lo, hi = hi, hi * 2
+        hi = min(hi, limit)
+        while lo < hi:  # invariant: has_run(lo), not has_run(hi + 1)
+            mid = (lo + hi + 1) // 2
+            if has_run(mid):
+                lo = mid
+            else:
+                hi = mid - 1
+        return lo
+
+    def free_run_count(self) -> int:
+        """Number of maximal free runs: bits set in ``free & ~(free >> 1)``
+        (each run contributes exactly its highest bit)."""
+        free = ~self._occ & self._full_mask
+        return bin(free & ~(free >> 1)).count("1")
 
     def reclassify(self, new_block_size: int) -> None:
         """Repurpose an EMPTY pool for another size class (sizeclass
@@ -445,6 +484,34 @@ class MM:
 
     def pool_table(self) -> List[Tuple[str, int, int]]:
         return [(p.name, p.pool_size, p.block_size) for p in self.pools]
+
+    def frag_stats(self) -> Dict[str, float]:
+        """Allocator-shape observability: how usable the free space is.
+        ``fragmentation`` = 1 - largest_free_run / free_blocks (0 = one
+        perfect run, -> 1 as free space shatters; 0 when nothing is free).
+        This is the number that explains a batch ALLOC_PUT falling off the
+        contiguous-run fast path (PR 1's read-lease bench trap) without
+        attaching a debugger."""
+        free_blocks = sum(
+            p.total_blocks - p.allocated_blocks for p in self.pools
+        )
+        largest = max(
+            (p.largest_free_run() for p in self.pools), default=0
+        )
+        runs = sum(p.free_run_count() for p in self.pools)
+        frag = 1.0 - largest / free_blocks if free_blocks else 0.0
+        return {
+            "free_bytes": float(sum(
+                (p.total_blocks - p.allocated_blocks) * p.block_size
+                for p in self.pools
+            )),
+            "largest_free_run_bytes": float(max(
+                (p.largest_free_run() * p.block_size for p in self.pools),
+                default=0,
+            )),
+            "free_runs": float(runs),
+            "fragmentation": frag,
+        }
 
     def close(self) -> None:
         for p in self.pools:

@@ -19,7 +19,9 @@ from typing import Optional
 
 from . import protocol as P
 from .store import Store
+from .utils import tracing
 from .utils.logging import Logger
+from .utils.metrics import MetricsRegistry, stats_to_prometheus
 
 MAX_INLINE_BODY = 1 << 30
 
@@ -50,6 +52,39 @@ class StoreServer:
         # the asyncio loop updates (native parity: mu_ in stats_json_full)
         self._op_lat: dict = {}
         self._lat_lock = threading.Lock()
+        # the store half of the unified observability plane: per-instance
+        # registry (tests run several servers per process) exposed by the
+        # manage plane's /metrics.  Gauges are exposition-time callbacks
+        # into live store state; the op histogram is fed by the dispatch
+        # loop next to the legacy avg/max accumulators.
+        self.metrics = MetricsRegistry()
+        self._h_op = self.metrics.histogram(
+            "istpu_store_op_seconds",
+            "Server-side latency per wire op (dispatch to response built)",
+            labelnames=("op",),
+        )
+        st = self.store
+        reg = self.metrics
+        reg.gauge("istpu_store_pool_usage",
+                  "Fraction of pool capacity allocated (occupancy)",
+                  fn=st.usage)
+        reg.gauge("istpu_store_fragmentation",
+                  "1 - largest_free_run/free_blocks: how shattered the "
+                  "free space is (0 = one contiguous run)",
+                  fn=lambda: st.mm.frag_stats()["fragmentation"])
+        reg.gauge("istpu_store_active_read_leases",
+                  "Committed entries under a live GET_DESC read lease",
+                  fn=st.active_leases)
+        reg.gauge("istpu_store_kvmap_len", "Committed entries",
+                  fn=st.kvmap_len)
+        reg.gauge("istpu_store_pending_puts",
+                  "Allocated-but-uncommitted put regions",
+                  fn=lambda: len(st.pending))
+        reg.counter("istpu_store_evicted_total", "Entries evicted by LRU",
+                    fn=lambda: st.stats.evicted)
+        reg.counter("istpu_store_contig_batches_total",
+                    "Batch allocs served as one contiguous run",
+                    fn=lambda: st.stats.contig_batches)
 
     def stats_dict(self) -> dict:
         """Store stats + the server-side per-op latency section (native
@@ -66,6 +101,18 @@ class StoreServer:
             for o, (c, total, mx) in snap.items()
         }
         return stats
+
+    def metrics_text(self) -> str:
+        """Prometheus exposition for the manage plane's /metrics: the
+        registry families (occupancy, fragmentation, leases, eviction,
+        contig_batches, per-op latency histograms) plus the flat
+        ``stats_dict`` counters under their long-standing
+        ``infinistore_tpu_`` names (the /metrics.prom schema, kept so
+        existing scrapes keep working)."""
+        lines = stats_to_prometheus(
+            self.store.stats_dict(), "infinistore_tpu_", Store.STATS_GAUGES
+        )
+        return self.metrics.to_prometheus_text() + "\n".join(lines) + "\n"
 
     async def start(self, host: str = "0.0.0.0") -> None:
         self._server = await asyncio.start_server(
@@ -119,13 +166,15 @@ class StoreServer:
                     break
                 body = memoryview(await reader.readexactly(body_len)) if body_len else memoryview(b"")
                 t0 = time.perf_counter()
-                resp = await self._dispatch(op, body, reader, writer, conn_pending)
+                with tracing.span(f"store.{P.op_name(op)}", body=body_len):
+                    resp = await self._dispatch(op, body, reader, writer, conn_pending)
                 dt = time.perf_counter() - t0
                 with self._lat_lock:
                     rec = self._op_lat.setdefault(op, [0, 0.0, 0.0])
                     rec[0] += 1
                     rec[1] += dt
                     rec[2] = max(rec[2], dt)
+                self._h_op.labels(P.op_name(op)).observe(dt)
                 if resp is not None:  # streaming ops write directly
                     writer.write(resp)
                 await writer.drain()

@@ -50,7 +50,10 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional
 
 from .engine import Scheduler
+from .utils import metrics as _metrics
+from .utils import tracing
 from .utils.logging import Logger
+from .utils.metrics import MetricsRegistry, PROMETHEUS_CONTENT_TYPE
 
 
 class ServingServer:
@@ -77,11 +80,17 @@ class ServingServer:
         # system, new submissions answer 429 instead of queueing without
         # bound (None = unbounded)
         self.max_queue = max_queue
+        # per-instance registry (tests run several servers per process):
+        # the scheduler's queue-wait/prefill/decode histograms land here,
+        # next to this server's own request counters
+        self.metrics = MetricsRegistry()
         self.sched = Scheduler(engine, max_batch=max_batch,
                                draft_engine=draft_engine, spec_k=spec_k,
                                spec_batch=spec_batch,
                                ngram_spec=ngram_spec, spec_g=spec_g,
-                               prefill_concurrency=prefill_concurrency)
+                               prefill_concurrency=prefill_concurrency,
+                               metrics=self.metrics)
+        self._register_metrics()
         self._cv = threading.Condition()
         self._staged: List[Dict[str, Any]] = []   # submissions from handlers
         self._cancels: List[int] = []
@@ -170,7 +179,10 @@ class ServingServer:
         scored here too, with the records handed to the engine thread for
         ordered delivery after the id event."""
         q: queue.Queue = queue.Queue()
-        with self._cv:
+        # stats counters are mutated from handler threads AND the engine
+        # thread; the registry lock is the one lock /metrics reads under,
+        # so increments behind it can never expose a torn scrape
+        with self.metrics.lock:
             self.stats["requests"] += 1
         item: Dict[str, Any] = {"body": body, "q": q}
         if body.get("echo") and not body.get("_chat"):
@@ -208,7 +220,7 @@ class ServingServer:
                         if recs is not None:
                             q.put(("prompt_lp", recs))
                         q.put(("done", "length"))
-                        with self._cv:
+                        with self.metrics.lock:
                             self.stats["completed"] += 1
                         return q
                     if kwargs.get("logprobs"):
@@ -277,7 +289,8 @@ class ServingServer:
                 # must not block submissions from being STAGED (they are
                 # picked up right after the join).
                 try:
-                    self.engine.store_flush()
+                    with tracing.trace("engine.store_flush"):
+                        self.engine.store_flush()
                 except Exception as e:  # noqa: BLE001
                     Logger.warn(f"store flush failed: {e!r}")
             with self._cv:
@@ -302,8 +315,13 @@ class ServingServer:
                         self._submitting -= 1
             if self.sched.has_work:
                 try:
-                    for req in self.sched.step():
-                        with self._cv:
+                    # one trace per scheduler step: the prefill/decode
+                    # spans (and any store-hop spans under them) group
+                    # into a step-granular timeline in /debug/traces
+                    with tracing.trace("engine.step"):
+                        retired = self.sched.step()
+                    for req in retired:
+                        with self.metrics.lock:
                             # handler threads increment completed too (the
                             # echo shortcut), so the counter update needs
                             # the lock
@@ -631,46 +649,71 @@ class ServingServer:
 
     # -- metrics --
 
-    def metrics_text(self) -> str:
-        s = self.stats
-        lines = [
-            "# TYPE istpu_serve_requests_total counter",
-            f"istpu_serve_requests_total {s['requests']}",
-            "# TYPE istpu_serve_completed_total counter",
-            f"istpu_serve_completed_total {s['completed']}",
-            "# TYPE istpu_serve_tokens_total counter",
-            f"istpu_serve_tokens_total {s['tokens']}",
-            "# TYPE istpu_serve_free_kv_pages gauge",
-            f"istpu_serve_free_kv_pages {self.engine.free_pages}",
-        ]
-        lm = self.sched.latency_metrics
-        lines += [
-            # TTFT split (rolling window): queue-wait vs prefill/compute —
-            # says whether high TTFT is admission or compute
-            "# TYPE istpu_serve_queue_wait_p50_ms gauge",
-            f"istpu_serve_queue_wait_p50_ms {lm['queue_wait_p50_ms']}",
-            "# TYPE istpu_serve_queue_wait_p99_ms gauge",
-            f"istpu_serve_queue_wait_p99_ms {lm['queue_wait_p99_ms']}",
-            "# TYPE istpu_serve_prefill_p50_ms gauge",
-            f"istpu_serve_prefill_p50_ms {lm['prefill_p50_ms']}",
-            "# TYPE istpu_serve_prefill_p99_ms gauge",
-            f"istpu_serve_prefill_p99_ms {lm['prefill_p99_ms']}",
-        ]
+    def _register_metrics(self) -> None:
+        """Declare this server's metric families on its registry.  Every
+        pre-registry metric name is preserved verbatim; the counters are
+        exposition-time callbacks into ``self.stats`` (mutated under the
+        registry's lock) and live scheduler/engine state, so a scrape is
+        always a consistent read with no double bookkeeping."""
+        reg = self.metrics
+
+        def stat(name):
+            return lambda: self.stats[name]
+
+        def lat(name):
+            return lambda: self.sched.latency_metrics[name]
+
+        reg.counter("istpu_serve_requests_total",
+                    "Requests submitted", fn=stat("requests"))
+        reg.counter("istpu_serve_completed_total",
+                    "Requests completed", fn=stat("completed"))
+        reg.counter("istpu_serve_tokens_total",
+                    "Tokens generated", fn=stat("tokens"))
+        reg.gauge("istpu_serve_free_kv_pages", "Free KV cache pages",
+                  fn=lambda: self.engine.free_pages)
+        # TTFT split (rolling window): queue-wait vs prefill/compute —
+        # says whether high TTFT is admission or compute.  Point-in-time
+        # convenience views; the rate()-able truth is the
+        # istpu_serve_queue_wait/prefill_seconds histograms next to them.
+        reg.gauge("istpu_serve_queue_wait_p50_ms",
+                  "Rolling-window queue-wait p50",
+                  fn=lat("queue_wait_p50_ms"))
+        reg.gauge("istpu_serve_queue_wait_p99_ms",
+                  "Rolling-window queue-wait p99",
+                  fn=lat("queue_wait_p99_ms"))
+        reg.gauge("istpu_serve_prefill_p50_ms",
+                  "Rolling-window prefill p50", fn=lat("prefill_p50_ms"))
+        reg.gauge("istpu_serve_prefill_p99_ms",
+                  "Rolling-window prefill p99", fn=lat("prefill_p99_ms"))
         if self.sched.spec is not None:
-            sm = self.sched.spec_metrics
-            lines += [
-                "# TYPE istpu_spec_kind gauge",
-                f'istpu_spec_kind{{kind="{self.sched.spec_kind}"}} 1',
-                "# TYPE istpu_spec_rounds_total counter",
-                f"istpu_spec_rounds_total {sm['rounds']}",
-                "# TYPE istpu_spec_proposed_tokens_total counter",
-                f"istpu_spec_proposed_tokens_total {sm['proposed']}",
-                "# TYPE istpu_spec_accepted_tokens_total counter",
-                f"istpu_spec_accepted_tokens_total {sm['accepted']}",
-                "# TYPE istpu_spec_acceptance_rate gauge",
-                f"istpu_spec_acceptance_rate {sm['rate']}",
-            ]
-        return "\n".join(lines) + "\n"
+            def spec(name):
+                return lambda: self.sched.spec_metrics[name]
+
+            reg.gauge("istpu_spec_kind", "Active speculation mode",
+                      labelnames=("kind",)).labels(
+                          self.sched.spec_kind).set(1)
+            reg.counter("istpu_spec_rounds_total",
+                        "Speculative rounds run", fn=spec("rounds"))
+            reg.counter("istpu_spec_proposed_tokens_total",
+                        "Draft tokens proposed", fn=spec("proposed"))
+            reg.counter("istpu_spec_accepted_tokens_total",
+                        "Draft tokens accepted", fn=spec("accepted"))
+            reg.gauge("istpu_spec_acceptance_rate",
+                      "accepted/proposed", fn=spec("rate"))
+
+    def metrics_text(self) -> str:
+        """Prometheus exposition: this server's registry plus the
+        process-global one (the client data plane's
+        ``istpu_client_op_seconds`` stage histograms live there, because
+        connections are created deep inside engines)."""
+        text = self.metrics.to_prometheus_text()
+        client = _metrics.default_registry()
+        if client is not self.metrics:
+            # skip families this server already owns (a library-default
+            # Scheduler elsewhere in the process may have registered the
+            # same names globally): one TYPE line per family per scrape
+            text += client.to_prometheus_text(exclude=self.metrics.names())
+        return text
 
 
 SCORING_MAX_PROMPT = 8192  # echo+logprobs runs ONE dense forward (see
@@ -923,7 +966,17 @@ def _make_handler(server: ServingServer):
             elif self.path == "/metrics":
                 data = server.metrics_text().encode()
                 self.send_response(200)
-                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Type", PROMETHEUS_CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+            elif self.path == "/debug/traces":
+                # recent completed request/step traces as Chrome trace-
+                # event JSON: save the body to a file and load it in
+                # Perfetto (https://ui.perfetto.dev) or chrome://tracing
+                data = tracing.TRACER.export_chrome_json().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
                 self.wfile.write(data)
@@ -934,6 +987,14 @@ def _make_handler(server: ServingServer):
             if self.path not in ("/v1/completions", "/v1/chat/completions"):
                 self._json(404, {"error": "not found"})
                 return
+            # request-scoped trace on the handler thread: covers prep,
+            # submit, and the wait/stream phases.  Engine-thread compute
+            # shows up in the per-step "engine.step" traces next to it in
+            # /debug/traces (same ring, own trace ids).
+            with tracing.trace("http.request", path=self.path):
+                self._handle_completions()
+
+        def _handle_completions(self):
             chat = self.path == "/v1/chat/completions"
             try:
                 n = int(self.headers.get("Content-Length", 0))

@@ -90,41 +90,58 @@ def _manage_handler(server_ref):
             self.end_headers()
             self.wfile.write(body)
 
+        def _prom(self, text: str) -> None:
+            from .utils.metrics import PROMETHEUS_CONTENT_TYPE
+
+            body = text.encode()
+            self.send_response(200)
+            self.send_header("Content-Type", PROMETHEUS_CONTENT_TYPE)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _metrics_text(self) -> str:
+            """Prometheus exposition: the python server's registry-backed
+            ``metrics_text`` (occupancy, fragmentation, leases, eviction,
+            contig_batches, per-op histograms + the flat counters); the
+            native runtime — whose histograms live in C — falls back to
+            the flat ``stats_dict`` exposition under the same names."""
+            from .store import Store
+            from .utils.metrics import stats_to_prometheus
+
+            srv = server_ref()
+            if srv is not None and hasattr(srv, "metrics_text"):
+                return srv.metrics_text()
+            store = srv.store if srv else None
+            stats = store.stats_dict() if store else {}
+            lines = stats_to_prometheus(
+                stats, "infinistore_tpu_", Store.STATS_GAUGES
+            )
+            return ("\n".join(lines) + "\n") if lines else ""
+
         def do_GET(self):
             store = server_ref().store if server_ref() else None
             if self.path == "/selftest":
+                self._json({"status": "ok"})
+            elif self.path == "/healthz":
+                # liveness for probes/load-balancers (reference parity
+                # with InfiniStore's FastAPI manage plane)
                 self._json({"status": "ok"})
             elif self.path == "/kvmap_len":
                 self._json({"len": store.kvmap_len() if store else 0})
             elif self.path == "/usage":
                 self._json({"usage": store.usage() if store else 0.0})
-            elif self.path == "/metrics":
-                # server-level stats when available (adds the per-op
-                # latency section); bare-store stats otherwise
+            elif self.path == "/stats":
+                # the JSON stats view (server-level when available: adds
+                # the per-op latency section); /metrics is Prometheus now
                 srv = server_ref()
                 if srv is not None and hasattr(srv, "stats_dict"):
                     self._json(srv.stats_dict())
                 else:
                     self._json(store.stats_dict() if store else {})
-            elif self.path == "/metrics.prom":
-                # Prometheus text exposition of the same counters, for
-                # scrape-based monitoring of serving clusters
-                from .store import Store
-
-                stats = store.stats_dict() if store else {}
-                lines = []
-                for k, v in stats.items():
-                    if isinstance(v, bool) or not isinstance(v, (int, float)):
-                        continue
-                    kind = "gauge" if k in Store.STATS_GAUGES else "counter"
-                    lines.append(f"# TYPE infinistore_tpu_{k} {kind}")
-                    lines.append(f"infinistore_tpu_{k} {v}")
-                body = ("\n".join(lines) + "\n").encode()
-                self.send_response(200)
-                self.send_header("Content-Type", "text/plain; version=0.0.4")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+            elif self.path in ("/metrics", "/metrics.prom"):
+                # /metrics.prom predates the unified plane; kept as alias
+                self._prom(self._metrics_text())
             else:
                 self._json({"error": "not found"}, 404)
 

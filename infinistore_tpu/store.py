@@ -266,6 +266,15 @@ class Store:
     def usage(self) -> float:
         return self.mm.usage()
 
+    def active_leases(self) -> int:
+        """Committed entries under a live GET_DESC read lease (an shm
+        client may still be memcpying from their regions).  Leased entries
+        are skipped by the evictor and their frees deferred — the exact
+        state behind PR 1's 'back-to-back runs fragment allocation' bench
+        trap, now observable."""
+        now = time.monotonic()
+        return sum(1 for e in self.kv.values() if e.lease > now)
+
     def kvmap_len(self) -> int:
         return len(self.kv)
 
@@ -559,6 +568,8 @@ class Store:
     STATS_GAUGES = frozenset({
         "kvmap_len", "pending", "usage", "pools", "block_size",
         "disk_entries", "disk_bytes",
+        "active_read_leases", "deferred_frees", "fragmentation",
+        "free_bytes", "largest_free_run_bytes", "free_runs",
     })
 
     def stats_dict(self) -> dict:
@@ -577,7 +588,10 @@ class Store:
             "bytes_in": s.bytes_in,
             "bytes_out": s.bytes_out,
             "contig_batches": s.contig_batches,
+            "active_read_leases": self.active_leases(),
+            "deferred_frees": len(self._deferred),
         }
+        d.update(self.mm.frag_stats())
         if self.disk is not None:
             d.update({
                 "disk_entries": len(self.disk),

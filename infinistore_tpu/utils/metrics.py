@@ -1,0 +1,376 @@
+"""Thread-safe metrics registry with Prometheus text exposition.
+
+One registry serves every tier of the stack: the serving front-end
+(``serve.py /metrics``), the store's manage plane (``server.py
+/metrics``), and the client data plane (``lib.py`` stage timers feed the
+``istpu_client_op_seconds`` histogram through ``LatencyStats``'s sink).
+Histograms use FIXED log-spaced buckets rather than rolling-window
+percentile gauges: bucket counters are monotone, so they can be
+``rate()``d and aggregated across replicas, which point-in-time p50/p99
+gauges fundamentally cannot (the old percentile gauges are kept only as
+convenience views next to the histograms).
+
+Mutation goes through one registry lock (``MetricsRegistry.lock``), so a
+metric update is safe from any thread — HTTP handler threads, the engine
+thread, channel reader threads, and copy workers all share it.  The lock
+is re-entrant: exposition-time callback gauges may read state that other
+code mutates under the same lock.
+"""
+
+from __future__ import annotations
+
+import bisect
+import math
+import threading
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+
+def nearest_rank(samples: Sequence[float], q: float) -> float:
+    """Nearest-rank percentile over **sorted** ``samples``: the
+    ``ceil(q*n)``-th smallest value (1-indexed), i.e.
+    ``samples[ceil(q*n) - 1]``, clamped to the valid index range.  The one
+    percentile definition shared by ``LatencyStats.snapshot`` and
+    ``Scheduler.latency_metrics`` (previously two copy-pasted variants
+    with off-by-one-rank disagreement)."""
+    n = len(samples)
+    if n == 0:
+        return 0.0
+    i = min(n - 1, max(0, math.ceil(q * n) - 1))
+    return samples[i]
+
+
+# default histogram bounds (seconds): 12 log-spaced buckets, x4 apart,
+# 20 us .. ~84 s — wide enough to cover a single pool memcpy stage and a
+# whole long-prompt request in the same schema
+DEFAULT_BUCKETS: Tuple[float, ...] = tuple(2e-05 * 4 ** i for i in range(12))
+
+
+def _escape_label(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _fmt_value(v: float) -> str:
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _fmt_bound(b: float) -> str:
+    return "+Inf" if math.isinf(b) else f"{b:.10g}"
+
+
+def _labels_text(names: Sequence[str], values: Sequence[str],
+                 extra: Sequence[Tuple[str, str]] = ()) -> str:
+    pairs = [f'{n}="{_escape_label(v)}"' for n, v in zip(names, values)]
+    pairs += [f'{n}="{_escape_label(v)}"' for n, v in extra]
+    return "{" + ",".join(pairs) + "}" if pairs else ""
+
+
+class _Metric:
+    """One metric family: name + TYPE + children keyed by label values."""
+
+    kind = "untyped"
+
+    def __init__(self, registry: "MetricsRegistry", name: str, help_: str,
+                 labelnames: Sequence[str]):
+        self._reg = registry
+        self.name = name
+        self.help = help_
+        self.labelnames = tuple(labelnames)
+        self._children: Dict[Tuple[str, ...], object] = {}
+
+    def labels(self, *values, **kw):
+        if kw:
+            if values:
+                raise ValueError("pass label values positionally OR by name")
+            values = tuple(str(kw[n]) for n in self.labelnames)
+        else:
+            values = tuple(str(v) for v in values)
+        if len(values) != len(self.labelnames):
+            raise ValueError(
+                f"{self.name} takes labels {self.labelnames}, got {values}"
+            )
+        with self._reg.lock:
+            child = self._children.get(values)
+            if child is None:
+                child = self._make_child()
+                self._children[values] = child
+            return child
+
+    def _default_child(self):
+        if self.labelnames:
+            raise ValueError(f"{self.name} requires labels {self.labelnames}")
+        return self.labels()
+
+    def _emit_header(self, out: List[str]) -> None:
+        if self.help:
+            out.append(f"# HELP {self.name} {self.help}")
+        out.append(f"# TYPE {self.name} {self.kind}")
+
+    def emit(self, out: List[str]) -> None:  # caller holds the lock
+        raise NotImplementedError
+
+
+class _Value:
+    __slots__ = ("v",)
+
+    def __init__(self):
+        self.v = 0.0
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def __init__(self, registry, name, help_, labelnames=(), fn=None):
+        super().__init__(registry, name, help_, labelnames)
+        # fn-backed counters read an externally-owned monotone value at
+        # exposition time (e.g. serve.py's stats dict, the speculative
+        # decoder's round counters) instead of double-counting state
+        self._fn: Optional[Callable[[], float]] = fn
+
+    def _make_child(self):
+        return _CounterChild(self._reg)
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._default_child().inc(amount)
+
+    @property
+    def value(self) -> float:
+        with self._reg.lock:
+            if self._fn is not None:
+                return float(self._fn())
+            child = self._children.get(())
+            return child.value.v if child is not None else 0.0
+
+    def emit(self, out: List[str]) -> None:
+        self._emit_header(out)
+        if self._fn is not None:
+            out.append(f"{self.name} {_fmt_value(self._fn())}")
+            return
+        for lv, child in self._children.items():
+            out.append(
+                f"{self.name}{_labels_text(self.labelnames, lv)} "
+                f"{_fmt_value(child.value.v)}"
+            )
+
+
+class _CounterChild:
+    __slots__ = ("_reg", "value")
+
+    def __init__(self, reg):
+        self._reg = reg
+        self.value = _Value()
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError("counters only go up")
+        with self._reg.lock:
+            self.value.v += amount
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def __init__(self, registry, name, help_, labelnames=(), fn=None):
+        super().__init__(registry, name, help_, labelnames)
+        self._fn: Optional[Callable[[], float]] = fn
+
+    def _make_child(self):
+        return _GaugeChild(self._reg)
+
+    def set(self, value: float) -> None:
+        self._default_child().set(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._default_child().inc(amount)
+
+    def dec(self, amount: float = 1.0) -> None:
+        self._default_child().inc(-amount)
+
+    def emit(self, out: List[str]) -> None:
+        self._emit_header(out)
+        if self._fn is not None:
+            out.append(f"{self.name} {_fmt_value(self._fn())}")
+            return
+        for lv, child in self._children.items():
+            out.append(
+                f"{self.name}{_labels_text(self.labelnames, lv)} "
+                f"{_fmt_value(child.value.v)}"
+            )
+
+
+class _GaugeChild:
+    __slots__ = ("_reg", "value")
+
+    def __init__(self, reg):
+        self._reg = reg
+        self.value = _Value()
+
+    def set(self, value: float) -> None:
+        with self._reg.lock:
+            self.value.v = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._reg.lock:
+            self.value.v += amount
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, registry, name, help_, labelnames=(),
+                 buckets: Sequence[float] = DEFAULT_BUCKETS):
+        super().__init__(registry, name, help_, labelnames)
+        bounds = tuple(sorted(float(b) for b in buckets))
+        if not bounds or any(
+            b2 <= b1 for b1, b2 in zip(bounds, bounds[1:])
+        ):
+            raise ValueError("buckets must be distinct and non-empty")
+        self.bounds = bounds
+
+    def _make_child(self):
+        return _HistogramChild(self._reg, self.bounds)
+
+    def observe(self, value: float) -> None:
+        self._default_child().observe(value)
+
+    def emit(self, out: List[str]) -> None:
+        self._emit_header(out)
+        for lv, child in self._children.items():
+            running = 0
+            for b, c in zip(self.bounds, child.counts):
+                running += c
+                out.append(
+                    f"{self.name}_bucket"
+                    f"{_labels_text(self.labelnames, lv, [('le', _fmt_bound(b))])}"
+                    f" {running}"
+                )
+            out.append(
+                f"{self.name}_bucket"
+                f"{_labels_text(self.labelnames, lv, [('le', '+Inf')])}"
+                f" {child.count}"
+            )
+            base = _labels_text(self.labelnames, lv)
+            out.append(f"{self.name}_sum{base} {_fmt_value(child.sum)}")
+            out.append(f"{self.name}_count{base} {child.count}")
+
+
+class _HistogramChild:
+    __slots__ = ("_reg", "_bounds", "counts", "sum", "count")
+
+    def __init__(self, reg, bounds):
+        self._reg = reg
+        self._bounds = bounds
+        self.counts = [0] * (len(bounds) + 1)  # last slot: > max bound
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        i = bisect.bisect_left(self._bounds, value)  # le semantics
+        with self._reg.lock:
+            self.counts[i] += 1
+            self.sum += value
+            self.count += 1
+
+
+class MetricsRegistry:
+    """Insertion-ordered metric family registry.
+
+    ``counter``/``gauge``/``histogram`` are get-or-create: asking for an
+    existing name returns the existing family (so modules can declare the
+    metrics they feed without coordinating creation order), but asking
+    with a different type is an error.  Passing ``fn=`` to an existing
+    fn-backed counter/gauge REBINDS the callback — a re-created server
+    (tests tear servers down and build new ones) takes over its metric
+    names instead of exposing a dead object's state.
+    """
+
+    def __init__(self):
+        self.lock = threading.RLock()
+        self._metrics: Dict[str, _Metric] = {}
+
+    def _get_or_make(self, cls, name, help_, labelnames, **kw):
+        with self.lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                if type(m) is not cls:
+                    raise ValueError(
+                        f"metric {name!r} already registered as {m.kind}"
+                    )
+                if tuple(labelnames) != m.labelnames:
+                    raise ValueError(
+                        f"metric {name!r} already registered with labels "
+                        f"{m.labelnames}"
+                    )
+                fn = kw.get("fn")
+                if fn is not None and hasattr(m, "_fn"):
+                    m._fn = fn
+                return m
+            m = cls(self, name, help_, labelnames, **kw)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name: str, help_: str = "", labelnames=(),
+                fn: Optional[Callable[[], float]] = None) -> Counter:
+        return self._get_or_make(Counter, name, help_, labelnames, fn=fn)
+
+    def gauge(self, name: str, help_: str = "", labelnames=(),
+              fn: Optional[Callable[[], float]] = None) -> Gauge:
+        return self._get_or_make(Gauge, name, help_, labelnames, fn=fn)
+
+    def histogram(self, name: str, help_: str = "", labelnames=(),
+                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
+        return self._get_or_make(
+            Histogram, name, help_, labelnames, buckets=buckets
+        )
+
+    def names(self) -> frozenset:
+        with self.lock:
+            return frozenset(self._metrics)
+
+    def to_prometheus_text(self, exclude=frozenset()) -> str:
+        """Prometheus text exposition (version 0.0.4) of every family.
+        ``exclude``: family names to skip — a server concatenating the
+        process registry after its own uses this to keep one TYPE line
+        per family when a library-default scheduler registered the same
+        names globally."""
+        with self.lock:
+            out: List[str] = []
+            for name, m in self._metrics.items():
+                if name in exclude:
+                    continue
+                m.emit(out)
+        return "\n".join(out) + "\n" if out else ""
+
+
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4"
+
+_DEFAULT = MetricsRegistry()
+
+
+def default_registry() -> MetricsRegistry:
+    """The process-global registry.  Client-side data-plane metrics
+    (``istpu_client_op_seconds``) land here because connections are
+    created deep inside engines; servers with their own lifecycle
+    (ServingServer, StoreServer) keep per-instance registries and
+    concatenate this one into their exposition."""
+    return _DEFAULT
+
+
+def stats_to_prometheus(stats: dict, prefix: str,
+                        gauges: frozenset) -> List[str]:
+    """Exposition lines for a flat numeric stats dict (the store's
+    ``stats_dict``): one TYPE line per key, gauge vs counter decided by
+    membership in ``gauges``.  Non-numeric values (nested sections like
+    ``op_latency``) are skipped — they have richer registry metrics."""
+    lines: List[str] = []
+    for k, v in stats.items():
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            continue
+        kind = "gauge" if k in gauges else "counter"
+        lines.append(f"# TYPE {prefix}{k} {kind}")
+        lines.append(f"{prefix}{k} {_fmt_value(v)}")
+    return lines

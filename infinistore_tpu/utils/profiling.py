@@ -5,6 +5,15 @@
 the server's ``/metrics``), and ``device_trace`` wraps ``jax.profiler`` so a
 serving run can capture a TPU trace (HBM/MXU utilization, per-op timings)
 for TensorBoard/xprof without importing profiler plumbing at call sites.
+
+``LatencyStats`` is one leg of the unified observability plane: every
+sample it takes is simultaneously (a) accumulated into its own
+count/avg/percentile snapshot, (b) forwarded to an optional ``sink``
+(lib.py feeds the ``istpu_client_op_seconds`` Prometheus histogram this
+way), and (c) recorded as a span in the active request trace
+(``utils.tracing``) — so one ``timed()`` block shows up in
+``latency_stats()``, ``/metrics``, and ``/debug/traces`` without being
+timed three times.
 """
 
 from __future__ import annotations
@@ -12,7 +21,10 @@ from __future__ import annotations
 import contextlib
 import threading
 import time
-from typing import Dict
+from typing import Callable, Dict, Optional
+
+from . import tracing
+from .metrics import nearest_rank
 
 
 class LatencyStats:
@@ -23,23 +35,32 @@ class LatencyStats:
 
     SAMPLES = 512  # recent-sample ring per op (percentile window)
 
-    def __init__(self):
+    def __init__(self, sink: Optional[Callable[[str, float], None]] = None):
         self._lock = threading.Lock()
         # name -> [count, total_s, max_s, ring list, ring cursor]
         self._ops: Dict[str, list] = {}
+        # called (name, seconds) per sample OUTSIDE the lock; lib.py wires
+        # the shared Prometheus histogram here
+        self._sink = sink
 
     @contextlib.contextmanager
     def timed(self, name: str):
         t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            self.record(name, time.perf_counter() - t0)
+        with tracing.span(name):
+            try:
+                yield
+            finally:
+                self._record(name, time.perf_counter() - t0)
 
     def record(self, name: str, seconds: float) -> None:
         """Accumulate one externally-timed sample (the data plane's
         per-stage alloc/copy/commit breakdown records sub-spans this way
-        where a context manager doesn't fit)."""
+        where a context manager doesn't fit).  Also lands in the active
+        trace as a stage that ended now."""
+        tracing.add_stage(name, seconds)
+        self._record(name, seconds)
+
+    def _record(self, name: str, seconds: float) -> None:
         with self._lock:
             rec = self._ops.setdefault(name, [0, 0.0, 0.0, [], 0])
             rec[0] += 1
@@ -51,11 +72,8 @@ class LatencyStats:
             else:  # write at cursor, then advance: oldest-first overwrite
                 ring[rec[4]] = seconds
                 rec[4] = (rec[4] + 1) % self.SAMPLES
-
-    @staticmethod
-    def _pct(sorted_samples: list, q: float) -> float:
-        i = min(int(q * len(sorted_samples)), len(sorted_samples) - 1)
-        return sorted_samples[i]
+        if self._sink is not None:
+            self._sink(name, seconds)
 
     def snapshot(self) -> Dict[str, Dict[str, float]]:
         with self._lock:
@@ -66,8 +84,8 @@ class LatencyStats:
                     "count": c,
                     "total_ms": round(total * 1e3, 3),
                     "avg_ms": round(total / c * 1e3, 3) if c else 0.0,
-                    "p50_ms": round(self._pct(s, 0.50) * 1e3, 3) if s else 0.0,
-                    "p99_ms": round(self._pct(s, 0.99) * 1e3, 3) if s else 0.0,
+                    "p50_ms": round(nearest_rank(s, 0.50) * 1e3, 3) if s else 0.0,
+                    "p99_ms": round(nearest_rank(s, 0.99) * 1e3, 3) if s else 0.0,
                     "max_ms": round(mx * 1e3, 3),
                 }
             return out

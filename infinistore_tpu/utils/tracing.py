@@ -1,0 +1,214 @@
+"""Request-scoped tracing: contextvars-propagated trace ids, nested spans,
+and Chrome trace-event export.
+
+One trace covers one request (an HTTP completion, a benchmark iteration, a
+store save): ``trace()`` opens the root span and binds the trace to the
+current context, ``span()`` nests under whatever trace is active — the
+trace id propagates through plain calls and ``async`` code via
+``contextvars``, so the client library and transfer layer record into the
+request's trace without any plumbing.  With NO active trace every ``span``
+is a no-op costing one contextvar read, which is what keeps the data plane
+within its perf floor when nobody is tracing (tests/test_perf_smoke.py).
+
+Completed traces land in a bounded ring (newest ``TRACE_RING`` kept) and
+export as Chrome trace-event JSON (``ph: "X"`` complete events with
+``ts``/``dur`` in microseconds) — loadable directly in Perfetto
+(https://ui.perfetto.dev) or ``chrome://tracing``.  Spans record absolute
+``perf_counter`` stamps, so externally-timed stages (``LatencyStats``'s
+alloc/copy/commit breakdown) and cross-thread stamps (the scheduler's
+queue-wait/prefill split) can be added to a trace after the fact and still
+nest correctly in the timeline.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import itertools
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+TRACE_RING = 64           # completed traces kept for /debug/traces
+MAX_EVENTS_PER_TRACE = 4096  # a runaway loop must not grow one trace forever
+
+_CURRENT: contextvars.ContextVar[Optional["Trace"]] = contextvars.ContextVar(
+    "istpu_trace", default=None
+)
+_ids = itertools.count(1)
+
+
+class Trace:
+    """One request's spans.  Appends are lock-guarded: channel reader
+    threads and copy workers may complete spans concurrently with the
+    request thread."""
+
+    __slots__ = ("trace_id", "name", "args", "t_start", "t_end",
+                 "events", "_lock", "dropped")
+
+    def __init__(self, name: str, args: Dict):
+        self.trace_id = f"{os.getpid():x}-{next(_ids):x}"
+        self.name = name
+        self.args = args
+        self.t_start = time.perf_counter()
+        self.t_end: Optional[float] = None
+        # (name, t0, t1, thread_ident, args) with perf_counter stamps
+        self.events: List[tuple] = []
+        self._lock = threading.Lock()
+        self.dropped = 0
+
+    def add(self, name: str, t0: float, t1: float, args: Optional[Dict] = None
+            ) -> None:
+        with self._lock:
+            if len(self.events) >= MAX_EVENTS_PER_TRACE:
+                self.dropped += 1
+                return
+            self.events.append(
+                (name, t0, t1, threading.get_ident(), args or {})
+            )
+
+
+class Tracer:
+    """Owns the ring of completed traces and the context binding."""
+
+    def __init__(self, ring: int = TRACE_RING):
+        self._lock = threading.Lock()
+        self._done: deque = deque(maxlen=ring)
+
+    # -- recording --
+
+    @contextlib.contextmanager
+    def trace(self, name: str, **args):
+        """Open a request-scoped root span.  Nested calls degrade to plain
+        spans inside the enclosing trace (one request = one trace)."""
+        parent = _CURRENT.get()
+        if parent is not None:
+            with self.span(name, **args):
+                yield parent
+            return
+        tr = Trace(name, args)
+        token = _CURRENT.set(tr)
+        t0 = time.perf_counter()
+        try:
+            yield tr
+        finally:
+            t1 = time.perf_counter()
+            _CURRENT.reset(token)
+            tr.add(name, t0, t1, args)
+            tr.t_end = t1
+            with self._lock:
+                self._done.append(tr)
+
+    @contextlib.contextmanager
+    def span(self, name: str, **args):
+        """A nested span inside the active trace; no-op without one."""
+        tr = _CURRENT.get()
+        if tr is None:
+            yield None
+            return
+        t0 = time.perf_counter()
+        try:
+            yield tr
+        finally:
+            tr.add(name, t0, time.perf_counter(), args)
+
+    def add_stage(self, name: str, seconds: float, **args) -> None:
+        """Record an externally-timed stage that ended *now* (the
+        ``LatencyStats.record`` integration: the caller measured the
+        duration itself)."""
+        tr = _CURRENT.get()
+        if tr is None:
+            return
+        t1 = time.perf_counter()
+        tr.add(name, t1 - seconds, t1, args)
+
+    def add_span_abs(self, name: str, t0: float, t1: float, **args) -> None:
+        """Record a span from absolute ``perf_counter`` stamps taken on ANY
+        thread (the scheduler's queue-wait/prefill stamps are folded into
+        the request's trace this way when the request completes)."""
+        tr = _CURRENT.get()
+        if tr is None or not (t0 and t1) or t1 < t0:
+            return
+        tr.add(name, t0, t1, args)
+
+    def current(self) -> Optional[Trace]:
+        return _CURRENT.get()
+
+    def current_trace_id(self) -> Optional[str]:
+        tr = _CURRENT.get()
+        return tr.trace_id if tr is not None else None
+
+    # -- export --
+
+    def recent(self) -> List[Trace]:
+        with self._lock:
+            return list(self._done)
+
+    def export_chrome(self, traces: Optional[List[Trace]] = None) -> dict:
+        """Chrome trace-event JSON for ``traces`` (default: the ring).
+        Every event carries the owning trace's id in ``args.trace_id``;
+        ``ts``/``dur`` are microseconds relative to the earliest exported
+        span, so Perfetto's timeline starts at ~0."""
+        traces = self.recent() if traces is None else traces
+        events: List[dict] = []
+        if not traces:
+            return {"traceEvents": [], "displayTimeUnit": "ms"}
+        base = min(
+            (t0 for tr in traces for (_n, t0, _t1, _tid, _a) in tr.events),
+            default=0.0,
+        )
+        pid = os.getpid()
+        tids: Dict[int, int] = {}
+        for tr in traces:
+            with tr._lock:
+                evs = list(tr.events)
+            for name, t0, t1, tident, args in evs:
+                tid = tids.setdefault(tident, len(tids) + 1)
+                events.append({
+                    "name": name,
+                    "cat": "istpu",
+                    "ph": "X",
+                    "ts": (t0 - base) * 1e6,
+                    "dur": max(0.0, (t1 - t0) * 1e6),
+                    "pid": pid,
+                    "tid": tid,
+                    "args": {"trace_id": tr.trace_id, **args},
+                })
+        # stable render order: Perfetto nests by containment per tid; sort
+        # outer-before-inner so equal-start parents precede their children
+        events.sort(key=lambda e: (e["ts"], -e["dur"]))
+        for tident, tid in tids.items():
+            events.append({
+                "name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+                "args": {"name": f"thread-{tident}"},
+            })
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def export_chrome_json(self, traces: Optional[List[Trace]] = None) -> str:
+        return json.dumps(self.export_chrome(traces))
+
+
+TRACER = Tracer()
+
+
+def trace(name: str, **args):
+    return TRACER.trace(name, **args)
+
+
+def span(name: str, **args):
+    return TRACER.span(name, **args)
+
+
+def add_stage(name: str, seconds: float, **args) -> None:
+    TRACER.add_stage(name, seconds, **args)
+
+
+def add_span_abs(name: str, t0: float, t1: float, **args) -> None:
+    TRACER.add_span_abs(name, t0, t1, **args)
+
+
+def current_trace_id() -> Optional[str]:
+    return TRACER.current_trace_id()

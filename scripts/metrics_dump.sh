@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# Quick-eyeball dump of both /metrics endpoints of a running stack.
+#
+#   scripts/metrics_dump.sh [serve_host:port] [store_manage_host:port]
+#
+# Defaults match the CLIs' defaults: serve.py on :8000, the store manage
+# plane on :18080.  Either endpoint being down prints a warning instead
+# of failing the other.
+
+set -u
+SERVE="${1:-127.0.0.1:8000}"
+STORE="${2:-127.0.0.1:18080}"
+
+fetch() {
+    local label="$1" url="$2"
+    echo "===== $label ($url) ====="
+    if ! curl -fsS --max-time 5 "$url"; then
+        echo "  [unreachable: $url]" >&2
+    fi
+    echo
+}
+
+fetch "serving /metrics" "http://$SERVE/metrics"
+fetch "store /metrics" "http://$STORE/metrics"
+fetch "store /healthz" "http://$STORE/healthz"

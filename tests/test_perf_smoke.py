@@ -121,3 +121,58 @@ def test_put_clears_floor_old_loop_cannot(server, monkeypatch):
         f"(the old per-page stack measured 1.86 on the reference host) — "
         f"stage p50s: {breakdown}"
     )
+
+
+def test_instrumentation_overhead_within_5pct(server, monkeypatch):
+    """The observability plane must not give back the coalescing win:
+    put bandwidth with tracing ACTIVE (every op/stage recorded as span
+    events) and the metrics histograms fed stays within 5% of the PR 1
+    floor.  Metrics are always on (the LatencyStats sink); this test
+    additionally opens a live trace so the span path is exercised, then
+    checks the trace and histogram actually captured the run."""
+    from infinistore_tpu.utils import metrics as m
+    from infinistore_tpu.utils import tracing
+
+    monkeypatch.setenv("ISTPU_CLIENT", "python")
+    blk = 64 << 10
+    nbytes = 128 << 20
+    buf = np.random.randint(0, 256, nbytes, dtype=np.uint8)
+    dst = np.zeros_like(buf)
+    conn = ist.InfinityConnection(ist.ClientConfig(
+        host_addr="127.0.0.1", service_port=server,
+        connection_type=ist.TYPE_SHM, log_level="warning"))
+    conn.connect()
+    conn.register_mr(buf)
+    conn.register_mr(dst)
+    n = nbytes // blk
+    tracer = tracing.TRACER
+    best_put = best_get = float("inf")
+    for it in range(4):
+        blocks = [(f"ovh-{it}-{i}", i * blk) for i in range(n)]
+        with tracer.trace("perf.request", iteration=it):
+            t0 = time.perf_counter()
+            conn.write_cache(blocks, blk, buf.ctypes.data)
+            best_put = min(best_put, time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            conn.read_cache(blocks, blk, dst.ctypes.data)
+            best_get = min(best_get, time.perf_counter() - t0)
+        conn.delete_keys([k for k, _ in blocks])
+    conn.close()
+    assert np.array_equal(buf, dst)
+
+    # instrumentation proof: the trace recorded the op and stage spans...
+    last = tracer.recent()[-1]
+    names = {ev[0] for ev in last.events}
+    assert {"perf.request", "write_cache", "write_cache.copy"} <= names, names
+    # ...and the client histogram family saw the same ops
+    text = m.default_registry().to_prometheus_text()
+    assert 'istpu_client_op_seconds_count{op="write_cache"}' in text
+
+    floor = PUT_FLOOR_GBPS * 0.95
+    put_gbps = nbytes / 1e9 / best_put
+    get_gbps = nbytes / 1e9 / best_get
+    assert put_gbps >= floor, (
+        f"instrumented shm put {put_gbps:.2f} GB/s fell below 95% of the "
+        f"{PUT_FLOOR_GBPS} GB/s floor — observability overhead regression "
+        f"(get measured {get_gbps:.2f})"
+    )

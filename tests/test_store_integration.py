@@ -321,9 +321,11 @@ def test_overwrite_tcp(server):
     conn.close()
 
 
-def test_manage_plane(server):
+def test_manage_plane(server, request):
     import json
     import urllib.request
+
+    backend = request.node.callspec.params["server"]
 
     with urllib.request.urlopen(
         f"http://127.0.0.1:{MANAGE_PORT}/selftest", timeout=30
@@ -334,10 +336,18 @@ def test_manage_plane(server):
     ) as r:
         assert json.load(r)["len"] >= 0
     with urllib.request.urlopen(
-        f"http://127.0.0.1:{MANAGE_PORT}/metrics", timeout=30
+        f"http://127.0.0.1:{MANAGE_PORT}/healthz", timeout=30
+    ) as r:
+        assert json.load(r)["status"] == "ok"
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{MANAGE_PORT}/stats", timeout=30
     ) as r:
         m = json.load(r)
     assert "usage" in m and "puts" in m
+    if backend == "python":
+        # allocator-shape observability (fragmentation, leases) lives in
+        # the python store core; the C runtime keeps the reference schema
+        assert "fragmentation" in m and "active_read_leases" in m
     # server-side per-op latency accumulators (both backends): earlier
     # tests in this module already drove puts/gets through this server
     lat = m.get("op_latency", {})
@@ -346,14 +356,15 @@ def test_manage_plane(server):
         v.get("count", 0) > 0 and v.get("avg_ms", -1) >= 0
         for v in lat.values()
     ), lat
-    # Prometheus exposition of the same counters
-    with urllib.request.urlopen(
-        f"http://127.0.0.1:{MANAGE_PORT}/metrics.prom", timeout=30
-    ) as r:
-        assert r.headers["Content-Type"].startswith("text/plain")
-        text = r.read().decode()
-    assert "# TYPE infinistore_tpu_usage gauge" in text
-    assert "infinistore_tpu_puts" in text
+    # Prometheus exposition (/metrics.prom is the back-compat alias)
+    for path in ("/metrics", "/metrics.prom"):
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{MANAGE_PORT}{path}", timeout=30
+        ) as r:
+            assert r.headers["Content-Type"].startswith("text/plain")
+            text = r.read().decode()
+        assert "# TYPE infinistore_tpu_usage gauge" in text
+        assert "infinistore_tpu_puts" in text
 
 
 def test_purge_via_manage_plane(server):
@@ -529,7 +540,7 @@ def test_client_death_mid_stream_reclaims_pending(server):
     deadline = time.time() + 10
     while time.time() < deadline:
         with urllib.request.urlopen(
-            f"http://127.0.0.1:{MANAGE_PORT}/metrics", timeout=5
+            f"http://127.0.0.1:{MANAGE_PORT}/stats", timeout=5
         ) as r:
             if json.load(r).get("pending", 1) == 0:
                 break
@@ -1054,7 +1065,7 @@ def test_disk_tier_survives_eviction_over_wire(tiered_server):
     # entries spill instead of vanishing
     conn.evict(0.0, 0.0)
     stats = json.loads(urllib.request.urlopen(
-        f"http://127.0.0.1:{manage}/metrics", timeout=10).read())
+        f"http://127.0.0.1:{manage}/stats", timeout=10).read())
     assert stats["kvmap_len"] == 0          # DRAM fully drained
     assert stats["disk_entries"] == n       # ...onto the disk tier
     assert stats["disk_spilled"] == n
@@ -1067,7 +1078,7 @@ def test_disk_tier_survives_eviction_over_wire(tiered_server):
                     out.ctypes.data)
     assert np.array_equal(out, buf)
     stats = json.loads(urllib.request.urlopen(
-        f"http://127.0.0.1:{manage}/metrics", timeout=10).read())
+        f"http://127.0.0.1:{manage}/stats", timeout=10).read())
     assert stats["disk_promoted"] == n
     assert stats["disk_entries"] == 0
     conn.close()
