@@ -24,8 +24,10 @@ from .config import (
 from .lib import (
     Connection,
     InfinityConnection,
+    InfiniStoreConnectionError,
     InfiniStoreException,
     InfiniStoreKeyNotFound,
+    InfiniStoreTimeoutError,
 )
 from .server import (
     evict_cache,
@@ -55,5 +57,7 @@ __all__ = [
     "get_kvmap_len",
     "InfiniStoreException",
     "InfiniStoreKeyNotFound",
+    "InfiniStoreConnectionError",
+    "InfiniStoreTimeoutError",
     "evict_cache",
 ]

@@ -47,6 +47,16 @@ class ClientConfig:
         # re-establish it (remapping pools / re-registering MRs) and retry
         # the op once — the client side of SURVEY §5's failure handling
         self.auto_reconnect = kwargs.get("auto_reconnect", True)
+        # per-op deadline (seconds): a wire op with no response within this
+        # window tears the channel down and surfaces a reconnectable
+        # transport failure — a HUNG server (which raises no socket error)
+        # becomes as survivable as a dead one.  None/0 = unbounded (the
+        # legacy behavior); ISTPU_OP_TIMEOUT_S sets a process default.
+        env_to = os.environ.get("ISTPU_OP_TIMEOUT_S")
+        raw_to = kwargs.get(
+            "op_timeout_s", float(env_to) if env_to else None
+        )
+        self.op_timeout_s = float(raw_to) if raw_to else None
 
     def __repr__(self):
         return (
@@ -70,6 +80,8 @@ class ClientConfig:
             raise Exception(f"link type should be one of {_LINKS}")
         if not (1 <= int(self.num_streams) <= 64):
             raise Exception("num_streams must be in [1, 64]")
+        if self.op_timeout_s is not None and self.op_timeout_s <= 0:
+            raise Exception("op_timeout_s must be positive (or None)")
 
 
 class ServerConfig:

@@ -18,6 +18,7 @@ import jax
 from ..kv.cache import PagedCacheConfig
 from ..kv.hashing import chunk_keys, matched_token_count
 from ..kv.transfer import KVTransferEngine
+from ..utils import resilience as _resilience
 
 
 class StoreConnector:
@@ -25,12 +26,21 @@ class StoreConnector:
 
     ``quant="int8"`` stores pages quantized (kv/quant.py): half the bytes on
     every store/retrieve hop, with per-head scales embedded in the payload.
+
+    Failure contract (the LMCache rule the reference is built around): a
+    cache-tier outage degrades to recompute, never to an engine-visible
+    error.  ``lookup``/``retrieve_kv`` ride the transfer's breaker-guarded
+    hops (miss on failure, hop skipped while the circuit is open);
+    ``store_kv`` counts a failed push as a dropped hop and returns 0.
+    ``breaker=`` shares one circuit across connectors on the same store.
     """
 
     def __init__(
-        self, conn, pc: PagedCacheConfig, model_id: str, quant: Optional[str] = None
+        self, conn, pc: PagedCacheConfig, model_id: str,
+        quant: Optional[str] = None, breaker=None,
     ):
-        self.transfer = KVTransferEngine(conn, pc, quant=quant)
+        self.transfer = KVTransferEngine(conn, pc, quant=quant, breaker=breaker)
+        self.breaker = self.transfer.breaker
         self.pc = pc
         self.model_id = model_id
 
@@ -38,8 +48,9 @@ class StoreConnector:
         return chunk_keys(tokens, self.model_id, chunk_tokens=self.pc.block_tokens)
 
     def lookup(self, tokens: Sequence[int]) -> int:
-        """How many leading tokens of ``tokens`` are store-resident."""
-        n_chunks = self.transfer.lookup_prefix(self._keys(tokens))
+        """How many leading tokens of ``tokens`` are store-resident.
+        Reports 0 (miss) when the store is down or the circuit is open."""
+        n_chunks = self.transfer.guarded_lookup_prefix(self._keys(tokens))
         return matched_token_count(n_chunks - 1, self.pc.block_tokens)
 
     def store_kv(
@@ -48,28 +59,48 @@ class StoreConnector:
         """Push the pages holding ``tokens``'s complete chunks.
 
         ``block_ids[i]`` must hold chunk ``i`` of the sequence.  Returns
-        bytes written.
+        bytes written — 0 when the store is unreachable or the circuit is
+        open (a counted drop; content-addressed keys make the lost write
+        a future miss, not corruption).
         """
         keys = self._keys(tokens)
         n = min(len(keys), len(block_ids))
-        return self.transfer.save_pages(cache, list(block_ids[:n]), keys[:n])
+        if not self.breaker.allow():
+            _resilience.count_push_dropped("circuit_open")
+            return 0
+        try:
+            written = self.transfer.save_pages(
+                cache, list(block_ids[:n]), keys[:n]
+            )
+        except _resilience.transport_errors():
+            self.breaker.record_failure()
+            _resilience.count_push_dropped("push_error")
+            return 0
+        self.breaker.record_success()
+        return written
 
     def retrieve_kv(
         self, tokens: Sequence[int], cache: jax.Array, block_ids: Sequence[int]
     ) -> Tuple[jax.Array, int]:
         """Pull the longest store-resident prefix into ``block_ids``.
 
-        Returns (updated cache, number of tokens retrieved).
+        Returns (updated cache, number of tokens retrieved) — ``(cache,
+        0)`` when the store degrades mid-retrieve (the engine recomputes).
         """
         keys = self._keys(tokens)
-        n_chunks = min(self.transfer.lookup_prefix(keys), len(block_ids))
+        n_chunks = min(self.transfer.guarded_lookup_prefix(keys), len(block_ids))
         if n_chunks == 0:
             return cache, 0
-        cache = self.transfer.load_pages(cache, list(block_ids[:n_chunks]), keys[:n_chunks])
+        cache, ok = self.transfer.guarded_load(
+            cache, list(block_ids[:n_chunks]), keys[:n_chunks]
+        )
+        if not ok:
+            return cache, 0
         return cache, n_chunks * self.pc.block_tokens
 
     def invalidate(self, tokens: Sequence[int]) -> int:
         """Delete all of this sequence's chunks from the store."""
         keys = self._keys(tokens)
         page_keys = self.transfer._page_keys(keys)
-        return self.transfer.conn.delete_keys(page_keys)
+        # reconnect-aware dispatch, raw count semantics
+        return self.transfer._call("delete_keys", page_keys)

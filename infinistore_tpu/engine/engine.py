@@ -228,18 +228,30 @@ class _StoreStreamer:
     durably in the store.  The first push error parks, skips the rest
     (fail-fast on a dead store), and re-raises at the next flush — which
     also CLEARS it, so pushes resume afterwards (the serving layer
-    flushes whenever the batch drains)."""
+    flushes whenever the batch drains).
 
-    def __init__(self, transfer: KVTransferEngine, maxsize: int = 2):
+    Failure semantics (docs/robustness.md): every skipped or failed push
+    is COUNTED (``istpu_store_push_dropped_total{reason=}``) and the
+    flush-time re-raise carries the dropped-chunk count; transport
+    failures feed the transfer's circuit breaker, and while the circuit
+    is open pushes are skipped without touching the wire.  Strict
+    durability gets ONE bounded retry per push before the error parks
+    (a blip shouldn't break the prefill-node contract); relaxed mode
+    fails straight to the counted-drop path."""
+
+    def __init__(self, transfer: KVTransferEngine, maxsize: int = 2,
+                 durability: str = "strict"):
         import queue
 
         self._transfer = transfer
+        self._durability = durability
         # bounded: each queued item pins a chunk's gathered pages in HBM,
         # so a store slower than compute backpressures prefill at ~maxsize
         # extra chunks of footprint instead of buffering without limit
         # (relaxed-durability engines pass a deeper bound on purpose)
         self._q: "queue.Queue" = queue.Queue(maxsize=maxsize)
         self._err: Optional[BaseException] = None
+        self._dropped = 0  # chunks dropped since the last flush
         self._started = False
 
     def submit(self, pages, chunk_keys_) -> None:
@@ -253,37 +265,81 @@ class _StoreStreamer:
         self._q.put((pages, chunk_keys_))
 
     def _run(self) -> None:
+        from ..utils import resilience as _res
+
         while True:
             pages, keys = self._q.get()
             try:
-                if self._err is None:
-                    # own trace: this thread has no request context, but
-                    # async pushes should still show up in /debug/traces
-                    # (kv.push_pages and the write_cache stages nest here)
-                    with tracing.trace("store.push_async", chunks=len(keys)):
-                        self._transfer.push_pages(pages, keys)
+                if self._err is not None:
+                    # parked error: skip queued items until the next
+                    # flush() consumes it — a dead store fails fast (one
+                    # timeout, not one per queued chunk).  Persistence is
+                    # not permanently lost: the serving layer's idle
+                    # flush clears the error and later pushes resume;
+                    # skipped pages are content-addressed, so the cost is
+                    # a future miss.
+                    self._dropped += 1
+                    _res.count_push_dropped("parked_error")
+                elif not self._transfer.breaker.allow():
+                    # open circuit: don't even touch the wire
+                    self._dropped += 1
+                    _res.count_push_dropped("circuit_open")
+                else:
+                    self._push_one(pages, keys, _res)
+            finally:
+                self._q.task_done()
+
+    def _push_one(self, pages, keys, _res) -> None:
+        breaker = self._transfer.breaker
+        attempts = 2 if self._durability == "strict" else 1
+        for attempt in range(attempts):
+            try:
+                # own trace: this thread has no request context, but
+                # async pushes should still show up in /debug/traces
+                # (kv.push_pages and the write_cache stages nest here)
+                with tracing.trace("store.push_async", chunks=len(keys)):
+                    self._transfer.push_pages(pages, keys)
+                breaker.record_success()
+                return
             except BaseException as e:  # noqa: BLE001 — reported at flush()
-                # park the first error and SKIP queued items until the
-                # next flush() consumes it: a dead store fails fast (one
-                # timeout, not one per queued chunk).  Persistence is not
-                # permanently lost — the serving layer's idle flush
-                # clears the error and later pushes resume; skipped pages
-                # are content-addressed, so the cost is a future miss.
+                if isinstance(e, _res.transport_errors()):
+                    breaker.record_failure()
+                last = attempt == attempts - 1
+                if not last and breaker.allow():
+                    # strict durability: one bounded retry before the
+                    # error parks — the push may have died mid-write and
+                    # content-addressed keys make a replay harmless
+                    import time as _time
+
+                    _time.sleep(0.05)
+                    continue
                 self._err = e
+                self._dropped += 1
+                _res.count_push_dropped("push_error")
                 import logging
 
                 logging.getLogger("infinistore_tpu").warning(
                     "store push of %d page keys failed (queued pushes "
                     "skipped until the next flush): %r", len(keys), e
                 )
-            finally:
-                self._q.task_done()
+                return
 
     def flush(self) -> None:
-        """Wait for every submitted push; re-raise the first push error."""
+        """Wait for every submitted push; re-raise the first push error
+        (its message carries how many queued chunks were dropped with
+        it).  Clears the parked state, so pushes resume afterwards."""
         self._q.join()
         err, self._err = self._err, None
+        dropped, self._dropped = self._dropped, 0
         if err is not None:
+            if dropped > 1:
+                # the count covers the failed push itself plus everything
+                # skipped behind it — operators see the blast radius in
+                # the exception, not just the first symptom
+                err.args = (
+                    f"{err} [{dropped} queued store pushes dropped "
+                    f"with this error]",
+                )
             raise err
 
 
@@ -483,6 +539,11 @@ class InferenceEngine:
                 f"got {store_durability!r}"
             )
         self.store_durability = store_durability
+        # the store-outage contract (docs/robustness.md): every store hop
+        # this engine makes rides the transfer's circuit breaker, so a
+        # dead or hung store degrades to recompute instead of faulting
+        # requests; serve.py reads this for /healthz
+        self.breaker = self.transfer.breaker if self.transfer else None
         # relaxed mode must not backpressure prefill on a slow store, so
         # its queue is deep enough to hold a long prompt's chunks; strict
         # keeps the 2-chunk HBM-footprint bound (flush joins anyway)
@@ -490,6 +551,7 @@ class InferenceEngine:
             _StoreStreamer(
                 self.transfer,
                 maxsize=(64 if store_durability == "relaxed" else 2),
+                durability=store_durability,
             )
             if self.transfer is not None else None
         )
@@ -638,7 +700,12 @@ class InferenceEngine:
         local_ids = self.pages.match_prefix(keys[:max_reuse])  # pins hits
         reused = len(local_ids)
         if self.transfer is not None and keys and reused < max_reuse:
-            reused = max(reused, min(self.transfer.lookup_prefix(keys), max_reuse))
+            # breaker-guarded: a dead/hung store (or an open circuit)
+            # reports 0 — a prefix-cache miss, never a failed request
+            reused = max(
+                reused,
+                min(self.transfer.guarded_lookup_prefix(keys), max_reuse),
+            )
         P = reused * T
 
         # pages for the rest of the sequence (incl. a partial tail page)
@@ -652,22 +719,18 @@ class InferenceEngine:
 
         prefix_kv = None
         if reused > len(local_ids):  # store hop for the non-local part
-            from ..lib import InfiniStoreKeyNotFound
-
-            try:
-                self.cache = self.transfer.load_pages(
-                    self.cache,
-                    block_ids[len(local_ids):reused],
-                    keys[len(local_ids):reused],
-                )
-            except InfiniStoreKeyNotFound:
-                # a matched page was evicted between lookup_prefix and the
-                # load: the server LRU evicts per PAGE key (store.py), so a
-                # chunk can lose a middle layer while the probed layers
-                # survive.  Reads are all-or-nothing (reference 404
-                # semantics), so the cache is untouched — fall back to the
-                # locally-resident prefix and recompute the rest instead of
-                # failing the request (VERDICT r2 missing #4).
+            # guarded: BOTH the eviction race (a matched page vanished
+            # between lookup_prefix and the load — reads are
+            # all-or-nothing, reference 404 semantics, VERDICT r2 missing
+            # #4) and a transport failure mid-load leave the cache
+            # untouched; fall back to the locally-resident prefix and
+            # recompute the rest instead of failing the request
+            self.cache, ok = self.transfer.guarded_load(
+                self.cache,
+                block_ids[len(local_ids):reused],
+                keys[len(local_ids):reused],
+            )
+            if not ok:
                 reused = len(local_ids)
                 P = reused * T
         if reused:

@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils import resilience as _resilience
 from ..utils import tracing
 from .cache import PagedCacheConfig, read_pages, write_pages
 from .hashing import layer_key
@@ -43,10 +44,24 @@ class KVTransferEngine:
         cfg: PagedCacheConfig,
         pipeline_groups: int = 4,
         quant: Optional[str] = None,
+        breaker: Optional[_resilience.CircuitBreaker] = None,
     ):
-        # accept the public InfinityConnection or the raw wire Connection
-        self.conn = getattr(conn, "conn", conn)
+        # accept the public InfinityConnection or the raw wire Connection.
+        # The SOURCE is kept (not unwrapped): the public wrapper owns the
+        # auto-reconnect machinery, and pinning its raw connection here
+        # would leave every transfer hop dead after the first transport
+        # failure — the store tier could then never recover without
+        # rebuilding the engine.  ``self.conn`` resolves the CURRENT raw
+        # connection; ``_call`` dispatches reconnect-aware when possible.
+        self._src = conn
         self.cfg = cfg
+        # circuit breaker over the store transport: the guarded_* hops
+        # below (and the engine's streamer) report transport failures
+        # here, and skip the store outright while it is open — a dead or
+        # hung store degrades to recompute instead of taxing every
+        # request with a timeout.  Shared when the caller passes one
+        # (serving engine + draft engine on one store, connector pools).
+        self.breaker = breaker or _resilience.CircuitBreaker()
         # save_pages splits the D2H transfer into this many layer bands and
         # overlaps each band's pool write with the next band's transfer
         # (the role the reference's async RDMA WR chains play on the GPU
@@ -68,12 +83,32 @@ class KVTransferEngine:
         self._staging: list = [None, None]
         self._staging_idx = 0
 
+    @property
+    def conn(self):
+        """The CURRENT raw wire connection (fresh after a wrapper
+        reconnect — a cached unwrap would go permanently dead with the
+        first torn-down channel)."""
+        return getattr(self._src, "conn", self._src)
+
+    def _call(self, name: str, *args):
+        """Dispatch a connection op reconnect-aware: through the public
+        wrapper's ``_call`` (tear down + reconnect + one retry on
+        transport failure) when the source is one, directly otherwise.
+        Raw-connection SEMANTICS either way (``check_exist`` returns the
+        wire int, ``get_match_last_index`` returns -1 instead of
+        raising)."""
+        call = getattr(self._src, "_call", None)
+        if call is not None:
+            return call(name, *args)
+        return getattr(self._src, name)(*args)
+
     def _ensure_staging(self, nbytes: int) -> np.ndarray:
         self._staging_idx ^= 1
         buf = self._staging[self._staging_idx]
         if buf is None or buf.nbytes < nbytes:
             buf = np.empty(nbytes, dtype=np.uint8)
-            self.conn.register_mr(buf.ctypes.data, buf.nbytes)
+            # register on the SOURCE: the wrapper replays MRs on reconnect
+            self._src.register_mr(buf.ctypes.data, buf.nbytes)
             self._staging[self._staging_idx] = buf
         return buf
 
@@ -150,13 +185,16 @@ class KVTransferEngine:
                 l0 = gi * Lg
                 blocks = self._page_blocks(chunk_keys_, l0, l0 + p.shape[0])
                 bands.append((blocks, pb, self._band_host(p)))
-            writer = getattr(self.conn, "write_cache_pipelined", None)
+            # the public wrapper always exposes the pipelined entry point
+            # (with its own per-band fallback); only a bare native client
+            # lacks it
+            writer = getattr(self._src, "write_cache_pipelined", None)
             if writer is not None:
                 return writer(bands)
             total = 0
-            for blocks, _pb, mat in bands:  # native client: per-band puts
+            for blocks, _pb, mat in bands:  # bare native client: per-band
                 host = mat()
-                self.conn.write_cache(blocks, pb, host.ctypes.data)
+                self._call("write_cache", blocks, pb, host.ctypes.data)
                 total += host.nbytes
             return total
 
@@ -234,12 +272,12 @@ class KVTransferEngine:
             # (and its prefetched GET_DESC) overlaps this band's DMA
             devs[i] = jax.device_put(host)
 
-        reader = getattr(self.conn, "read_cache_pipelined", None)
+        reader = getattr(self._src, "read_cache_pipelined", None)
         if reader is not None:
             reader(bands, on_band=upload)
-        else:  # native client: per-band reads, same upload overlap
+        else:  # bare native client: per-band reads, same upload overlap
             for i, (blocks, _pb, ptr) in enumerate(bands):
-                self.conn.read_cache(blocks, pb, ptr)
+                self._call("read_cache", blocks, pb, ptr)
                 upload(i)
         # single band: already [L, n, ...] — don't pay a concat copy
         stacked = devs[0] if len(devs) == 1 else jnp.concatenate(devs, axis=0)
@@ -265,10 +303,68 @@ class KVTransferEngine:
             return 0
         sfx = self._key_suffix
         probe = [layer_key(ck, 0) + sfx for ck in chunk_keys_]
-        idx = self.conn.get_match_last_index(probe)
+        idx = self._call("get_match_last_index", probe)
         while idx >= 0:
             last = layer_key(chunk_keys_[idx], self.cfg.n_layers - 1) + sfx
-            if self.conn.check_exist(last) == 0:  # 0 => exists (wire semantics)
+            # 0 => exists (wire semantics)
+            if self._call("check_exist", last) == 0:
                 break
             idx -= 1
         return idx + 1
+
+    # -- breaker-guarded hops (the degraded-serving contract) --
+    #
+    # A store failure must cost a cache MISS, never a request.  These
+    # wrappers are the one place that rule lives; the engine's prefill
+    # path and the LMCache-style connector both ride them.  Transport
+    # failures (socket dead, channel torn down, op deadline fired) feed
+    # the breaker; while it is open the hop is skipped outright — no
+    # timeout tax per request.  KeyNotFound is a normal protocol answer
+    # (eviction race) and neither trips nor counts against the circuit.
+
+    def guarded_lookup_prefix(self, chunk_keys_: Sequence[str]) -> int:
+        """``lookup_prefix`` degraded to 0 (miss) on store failure or an
+        open circuit."""
+        if not self.breaker.allow():
+            _resilience.count_degraded("lookup")
+            return 0
+        try:
+            n = self.lookup_prefix(chunk_keys_)
+        except _resilience.transport_errors():
+            self.breaker.record_failure()
+            _resilience.count_degraded("lookup")
+            return 0
+        except Exception:  # noqa: BLE001 — a lookup is an optimization
+            _resilience.count_degraded("lookup")
+            return 0
+        self.breaker.record_success()
+        return n
+
+    def guarded_load(
+        self, cache: jax.Array, block_ids: Sequence[int],
+        chunk_keys_: Sequence[str],
+    ) -> Tuple[jax.Array, bool]:
+        """``load_pages`` degraded to ``(cache-unchanged, False)`` on any
+        failure.  Loads are all-or-nothing (``write_pages`` runs after
+        every byte landed), so a mid-load transport failure leaves the
+        HBM cache untouched and the caller falls back to recompute."""
+        if not self.breaker.allow():
+            _resilience.count_degraded("load")
+            return cache, False
+        from ..lib import InfiniStoreKeyNotFound
+
+        try:
+            out = self.load_pages(cache, block_ids, chunk_keys_)
+        except InfiniStoreKeyNotFound:
+            # a matched page was evicted between lookup and load (the
+            # server LRU evicts per PAGE key, so a chunk can lose a
+            # middle layer while the probed layers survive) — a healthy
+            # miss, not a store fault
+            _resilience.count_degraded("load")
+            return cache, False
+        except _resilience.transport_errors():
+            self.breaker.record_failure()
+            _resilience.count_degraded("load")
+            return cache, False
+        self.breaker.record_success()
+        return out, True

@@ -46,6 +46,7 @@ from .config import (  # noqa: F401 - re-exported for parity
 )
 from .mempool import SHM_DIR, _prefault
 from .utils import metrics as _metrics
+from .utils import resilience as _resilience
 from .utils.logging import Logger
 from .utils.profiling import LatencyStats
 
@@ -93,6 +94,13 @@ class InfiniStoreKeyNotFound(InfiniStoreException):
 class InfiniStoreConnectionError(InfiniStoreException):
     """The transport itself failed (socket died, channel torn down, server
     unreachable) — the only class of error worth a reconnect."""
+
+
+class InfiniStoreTimeoutError(InfiniStoreConnectionError):
+    """No response within ``ClientConfig.op_timeout_s``: the server is hung
+    (alive but not answering), which no socket error would ever surface.
+    Subclasses the connection error because the remedy is the same — the
+    channel is torn down and the op rides the reconnect machinery."""
 
 
 _STATUS_EXC = {
@@ -212,9 +220,30 @@ class _Channel:
     (reference: src/libinfinistore.cpp:103 cq_handler, :596 w_rdma_async).
     """
 
-    def __init__(self, host: str, port: int):
+    def __init__(self, host: str, port: int,
+                 op_timeout: Optional[float] = None):
         self.sock = socket.create_connection((host, port), timeout=30)
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.op_timeout = op_timeout
+        if op_timeout:
+            # bound the synchronous bootstrap (HELLO) too: a server that
+            # hangs mid-handshake must fail within the op deadline, not
+            # the 30s connect default.  start_reader() lifts this back to
+            # blocking mode for the pipelined phase.
+            self.sock.settimeout(op_timeout)
+            # kernel-level SEND timeout: a stalled server with full socket
+            # buffers must not wedge sendall forever.  SO_SNDTIMEO (not
+            # settimeout) because the Python-level timeout is per-socket
+            # and would make the reader thread's idle recv spuriously
+            # expire; the kernel option bounds sends alone.
+            import struct
+
+            sec = int(op_timeout)
+            usec = int((op_timeout - sec) * 1e6)
+            self.sock.setsockopt(
+                socket.SOL_SOCKET, socket.SO_SNDTIMEO,
+                struct.pack("ll", sec, usec),
+            )
         self._send_lock = threading.Lock()
         self._pending: deque = deque()
         self._pending_lock = threading.Lock()
@@ -265,12 +294,50 @@ class _Channel:
                 self.sock.sendall(view)
         return slot
 
-    @staticmethod
-    def wait(slot: _Slot) -> Tuple[int, object]:
-        slot.ev.wait()
+    def wait(self, slot: _Slot,
+             timeout: Optional[float] = None) -> Tuple[int, object]:
+        """Block for a slot's response, bounded by ``timeout`` (default:
+        the channel's ``op_timeout``).  A fired deadline KILLS the whole
+        channel — every in-flight slot fails, so FIFO response matching
+        can never desynchronize — and surfaces a timeout error that rides
+        the reconnect machinery like any other transport failure."""
+        t = self.op_timeout if timeout is None else timeout
+        if not slot.ev.wait(t if t and t > 0 else None):
+            self.kill(InfiniStoreTimeoutError(
+                f"no response within {t:.3g}s (op deadline); "
+                f"channel torn down"
+            ))
+            slot.ev.wait()  # kill() resolves every in-flight slot
         if slot.error is not None:
+            if isinstance(slot.error, InfiniStoreConnectionError):
+                raise slot.error
             raise InfiniStoreConnectionError(f"request failed: {slot.error!r}")
         return slot.status, slot.result
+
+    def kill(self, exc: Exception) -> None:
+        """Tear the channel down: future submits fail fast, the socket is
+        shut (unblocking the reader), and every in-flight slot resolves
+        with ``exc``.  Idempotent; safe from any thread."""
+        if self._err is None:
+            self._err = exc
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._fail_pending(exc)
+
+    def _fail_pending(self, exc: Exception,
+                      current: Optional[_Slot] = None) -> None:
+        with self._pending_lock:
+            pending = list(self._pending)
+            self._pending.clear()
+        if current is not None:
+            pending.insert(0, current)
+        for slot in pending:
+            if not slot.ev.is_set():
+                if slot.error is None:
+                    slot.error = exc
+                slot.ev.set()
 
     def request(
         self,
@@ -282,6 +349,7 @@ class _Channel:
         return self.wait(self.submit(op, body, payload, consumer))
 
     def _read_loop(self) -> None:
+        slot: Optional[_Slot] = None
         try:
             while True:
                 hdr = bytearray(P.RESP_SIZE)
@@ -298,14 +366,14 @@ class _Channel:
                         self._recv_exact_into(memoryview(body))
                     slot.result = bytes(body)
                 slot.ev.set()
+                slot = None
         except Exception as e:  # noqa: BLE001 - fail all in-flight requests
-            self._err = e
-            with self._pending_lock:
-                pending = list(self._pending)
-                self._pending.clear()
-            for slot in pending:
-                slot.error = e
-                slot.ev.set()
+            if self._err is None:  # a kill()'s deadline error wins the race
+                self._err = e
+            # the popped slot (mid-body when the socket died) must fail
+            # too, or its waiter hangs forever — it left the pending queue
+            # before the failure
+            self._fail_pending(self._err, current=slot)
 
     def _recv_exact_into(self, view: memoryview) -> None:
         got = 0
@@ -350,6 +418,7 @@ class Connection:
         # coalesced bulk copies by default; tests pin the legacy per-page
         # loop here (or via ISTPU_NO_COALESCE) for byte-parity checks
         self.coalesce = _COALESCE
+        self.op_timeout = getattr(config, "op_timeout_s", None)
         self.latency = LatencyStats(sink=_observe_client_op)
 
     def latency_stats(self) -> Dict[str, Dict[str, float]]:
@@ -365,7 +434,8 @@ class Connection:
     def connect(self) -> None:
         if self.channels:
             raise InfiniStoreException("Already connected to remote instance")
-        ch0 = _Channel(self.config.host_addr, self.config.service_port)
+        ch0 = _Channel(self.config.host_addr, self.config.service_port,
+                       op_timeout=self.op_timeout)
         status, body = ch0.exchange(P.OP_HELLO, P.pack_hello(os.getpid()))
         _raise_for_status(status, "hello")
         ch0.start_reader()
@@ -384,7 +454,8 @@ class Connection:
             # cross-host: stripe data ops over extra sockets (the role the
             # reference's batched RDMA WR chains play for throughput)
             for _ in range(int(self.config.num_streams) - 1):
-                ch = _Channel(self.config.host_addr, self.config.service_port)
+                ch = _Channel(self.config.host_addr, self.config.service_port,
+                              op_timeout=self.op_timeout)
                 st, _b = ch.exchange(P.OP_HELLO, P.pack_hello(os.getpid()))
                 _raise_for_status(st, "hello")
                 ch.start_reader()
@@ -511,18 +582,24 @@ class Connection:
         fixed-interval spin."""
         req = P.pack_alloc_put(keys, block_size)
         status, body = self._request(P.OP_ALLOC_PUT, req)
-        delay = 0.002
-        deadline = time.monotonic() + _RETRY_DEADLINE_S
-        while status == P.RETRY:
-            if time.monotonic() >= deadline:
+        if status == P.RETRY:
+            # full jitter so many writers contending on one key set don't
+            # re-collide in lockstep; unlimited attempts under the budget
+            policy = _resilience.RetryPolicy(
+                max_attempts=0, base_delay_s=0.002, max_delay_s=0.256,
+                budget_s=_RETRY_DEADLINE_S,
+            )
+            for delay in policy.backoff():
+                time.sleep(delay)
+                status, body = self._request(P.OP_ALLOC_PUT, req)
+                if status != P.RETRY:
+                    break
+            if status == P.RETRY:
                 raise InfiniStoreException(
                     f"alloc_put: server kept answering RETRY for "
                     f"{_RETRY_DEADLINE_S:.0f}s (a concurrent writer is "
                     f"streaming these keys); giving up"
                 )
-            time.sleep(delay)
-            delay = min(delay * 2, 0.256)
-            status, body = self._request(P.OP_ALLOC_PUT, req)
         _raise_for_status(status, "alloc_put")
         return body
 
@@ -802,8 +879,18 @@ def _make_connection(config: ClientConfig):
     """Native C++ client when built (GIL-free IO), Python fallback otherwise.
 
     ``ISTPU_CLIENT=python`` forces the fallback; ``=native`` makes a missing
-    native build a hard error."""
+    native build a hard error.  ``op_timeout_s`` pins the Python client:
+    per-op deadlines live in its channel layer (the C client's calls block
+    without one), and silently dropping a configured deadline would
+    reintroduce exactly the unbounded hang the knob exists to kill."""
     mode = os.environ.get("ISTPU_CLIENT", "auto")
+    if getattr(config, "op_timeout_s", None):
+        if mode == "native":
+            raise InfiniStoreException(
+                "op_timeout_s is not supported by the native client "
+                "(ISTPU_CLIENT=native); unset one of the two"
+            )
+        return Connection(config)
     if mode != "python":
         try:
             from . import _native

@@ -13,9 +13,10 @@ from __future__ import annotations
 
 import asyncio
 import json
+import os
 import threading
 import time
-from typing import Optional
+from typing import List, Optional
 
 from . import protocol as P
 from .store import Store
@@ -24,6 +25,101 @@ from .utils.logging import Logger
 from .utils.metrics import MetricsRegistry, stats_to_prometheus
 
 MAX_INLINE_BODY = 1 << 30
+
+# a stalled connection un-stalls when its rule is cleared; this cap is the
+# backstop so a forgotten rule can never wedge a CI run past its timeout
+_STALL_CAP_S = 120.0
+
+_FAULT_ACTIONS = ("drop_conn", "delay", "error", "stall")
+
+
+class FaultInjector:
+    """Deterministic fault injection for the store data plane.
+
+    Every failure mode the resilience layer claims to survive must be
+    reproducible on demand: rules armed here make the server kill a
+    connection mid-op (``drop_conn``), answer late (``delay``), answer a
+    chosen error status (``error``), or simply never answer (``stall`` —
+    the hang that no socket error surfaces, which is what the client's
+    per-op deadline exists for).  Armed via the manage plane's ``POST
+    /faults`` or the ``ISTPU_FAULTS`` env (JSON list of rules).
+
+    A rule: ``{"op": "GET_DESC" | "*", "action": one of drop_conn/delay/
+    error/stall, "delay_s": float, "error_status": int, "times": int
+    (-1 = until cleared), "after": int (skip the first N matching ops)}``.
+    Rules are evaluated first-match in arm order.  Thread-safe: the manage
+    plane arms/clears from HTTP threads while the asyncio loop matches;
+    stalled connections poll rule liveness, so ``clear()`` releases them.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rules: List[dict] = []
+        self._next_id = 1
+
+    def arm(self, rules) -> int:
+        """Replace the active rule set; returns how many rules are armed.
+        An empty list clears (and releases any stalled connections)."""
+        norm = []
+        for r in rules or []:
+            if not isinstance(r, dict):
+                raise ValueError(f"fault rule must be an object: {r!r}")
+            action = r.get("action")
+            if action not in _FAULT_ACTIONS:
+                raise ValueError(
+                    f"fault action must be one of {_FAULT_ACTIONS}, "
+                    f"got {action!r}"
+                )
+            norm.append({
+                "id": 0,  # assigned under the lock below
+                "op": str(r.get("op", "*")).upper(),
+                "action": action,
+                "delay_s": float(r.get("delay_s", 0.1)),
+                "error_status": int(r.get("error_status", P.SYSTEM_ERROR)),
+                "times": int(r.get("times", -1)),
+                "after": int(r.get("after", 0)),
+            })
+        with self._lock:
+            for r in norm:
+                r["id"] = self._next_id
+                self._next_id += 1
+            self._rules = norm
+            return len(norm)
+
+    def clear(self) -> None:
+        self.arm([])
+
+    def snapshot(self) -> List[dict]:
+        with self._lock:
+            return [dict(r) for r in self._rules]
+
+    @property
+    def armed(self) -> bool:
+        with self._lock:
+            return bool(self._rules)
+
+    def match(self, op_name: str) -> Optional[dict]:
+        """First active rule matching ``op_name``; consumes one ``after``
+        skip or one ``times`` charge.  Returns a copy (the caller acts on
+        it outside the lock)."""
+        with self._lock:
+            for r in self._rules:
+                if r["op"] not in ("*", op_name) or r["times"] == 0:
+                    continue
+                if r["after"] > 0:
+                    r["after"] -= 1
+                    return None
+                if r["times"] > 0:
+                    r["times"] -= 1
+                return dict(r)
+            return None
+
+    def active(self, rule_id: int) -> bool:
+        """Is the rule still armed?  Stalled connections poll this, so a
+        ``clear()`` (or re-arm) releases them."""
+        with self._lock:
+            return any(r["id"] == rule_id and r["times"] != 0
+                       for r in self._rules)
 
 
 def _merge_desc_runs(descs):
@@ -85,6 +181,34 @@ class StoreServer:
         reg.counter("istpu_store_contig_batches_total",
                     "Batch allocs served as one contiguous run",
                     fn=lambda: st.stats.contig_batches)
+        # resilience plane: the periodic-evict loop counts its failures
+        # here instead of dying silently, and the fault injector counts
+        # every injected fault so chaos tests can assert determinism
+        self._c_evict_err = reg.counter(
+            "istpu_store_evict_errors_total",
+            "Periodic-evict iterations that raised (loop keeps running)")
+        self._c_faults = reg.counter(
+            "istpu_store_faults_injected_total",
+            "Faults injected into the data plane, by op and action",
+            labelnames=("op", "action"))
+        self.faults = FaultInjector()
+        env_faults = os.environ.get("ISTPU_FAULTS")
+        if env_faults:
+            try:
+                self.faults.arm(json.loads(env_faults))
+                Logger.warn(
+                    f"ISTPU_FAULTS armed {len(self.faults.snapshot())} "
+                    f"fault rule(s)"
+                )
+            except (ValueError, TypeError) as e:
+                raise ValueError(f"bad ISTPU_FAULTS: {e}") from e
+
+    def degraded(self) -> bool:
+        """The store manage plane's /healthz degraded signal: armed fault
+        rules (the server is deliberately misbehaving) or a failing
+        eviction loop both mean operators should not trust this instance
+        to behave normally."""
+        return self.faults.armed or self._c_evict_err.value > 0
 
     def stats_dict(self) -> dict:
         """Store stats + the server-side per-op latency section (native
@@ -129,9 +253,20 @@ class StoreServer:
     def start_periodic_evict(self) -> None:
         async def _loop():
             while True:
-                self.store.evict(
-                    self.config.evict_min_threshold, self.config.evict_max_threshold
-                )
+                try:
+                    self.store.evict(
+                        self.config.evict_min_threshold,
+                        self.config.evict_max_threshold,
+                    )
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:  # noqa: BLE001 — loop must survive
+                    # a single bad evict pass (disk-tier IO error, a
+                    # transiently inconsistent lease) must not silently
+                    # kill eviction for the rest of the process — that
+                    # failure mode ends in a full pool and RETRY storms
+                    Logger.error(f"periodic evict failed: {e!r}")
+                    self._c_evict_err.inc()
                 await asyncio.sleep(self.config.evict_interval)
 
         self._evict_task = asyncio.get_running_loop().create_task(_loop())
@@ -165,6 +300,12 @@ class StoreServer:
                     Logger.error(f"body too large: {body_len}")
                     break
                 body = memoryview(await reader.readexactly(body_len)) if body_len else memoryview(b"")
+                act = self.faults.match(P.op_name(op)) if self.faults.armed else None
+                if act is not None:
+                    if not await self._inject_fault(op, act, writer):
+                        break  # drop_conn: die without answering
+                    if act["action"] == "error":
+                        continue  # error already written; next frame
                 t0 = time.perf_counter()
                 with tracing.span(f"store.{P.op_name(op)}", body=body_len):
                     resp = await self._dispatch(op, body, reader, writer, conn_pending)
@@ -190,6 +331,37 @@ class StoreServer:
                 await writer.wait_closed()
             except Exception:
                 pass
+
+    async def _inject_fault(self, op: int, act: dict, writer) -> bool:
+        """Apply one matched fault rule.  Returns False when the
+        connection must die (``drop_conn``); True continues — after a
+        ``delay``/``stall`` the op proceeds normally, after ``error`` the
+        caller skips dispatch (the error response is already written)."""
+        name = P.op_name(op)
+        self._c_faults.labels(name, act["action"]).inc()
+        Logger.warn(f"fault injected: {act['action']} on {name}")
+        if act["action"] == "drop_conn":
+            try:
+                writer.transport.abort()  # RST, mid-op — no goodbye
+            except Exception:
+                pass
+            return False
+        if act["action"] == "delay":
+            await asyncio.sleep(act["delay_s"])
+        elif act["action"] == "stall":
+            # never answer while the rule stays armed: the hang that no
+            # socket error surfaces — exactly what the client-side op
+            # deadline must convert into a reconnectable failure.
+            # Releasing is polling-based so the manage plane's clear()
+            # (an HTTP thread) needs no cross-thread asyncio signaling.
+            t0 = time.monotonic()
+            while (self.faults.active(act["id"])
+                   and time.monotonic() - t0 < _STALL_CAP_S):
+                await asyncio.sleep(0.02)
+        elif act["action"] == "error":
+            writer.write(P.pack_resp(act["error_status"]))
+            await writer.drain()
+        return True
 
     async def _dispatch(
         self,

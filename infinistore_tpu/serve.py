@@ -51,6 +51,7 @@ from typing import Any, Dict, List, Optional
 
 from .engine import Scheduler
 from .utils import metrics as _metrics
+from .utils import resilience as _resilience
 from .utils import tracing
 from .utils.logging import Logger
 from .utils.metrics import MetricsRegistry, PROMETHEUS_CONTENT_TYPE
@@ -97,6 +98,11 @@ class ServingServer:
         self._queues: Dict[int, "queue.Queue"] = {}  # live req_id -> events
         self._stop = False
         self.stats = {"requests": 0, "completed": 0, "tokens": 0}
+        # degraded-mode flag for /healthz: set when a store flush fails
+        # (operators must see a silently-degrading cache tier without
+        # reading logs), cleared by the next clean flush.  The breaker
+        # state (engine.breaker) is the other /healthz input.
+        self._degraded_reason: Optional[str] = None
         self._score_memo: Optional[tuple] = None  # (key, records)
         # scoring forwards run on HTTP handler threads (any of them), so the
         # memo needs a lock; holding it across the compute also makes an
@@ -291,8 +297,20 @@ class ServingServer:
                 try:
                     with tracing.trace("engine.store_flush"):
                         self.engine.store_flush()
+                    self._degraded_reason = None
                 except Exception as e:  # noqa: BLE001
+                    # not just a log line: the failure must reach the
+                    # breaker (so sustained failures open the circuit and
+                    # stop taxing requests) and the /healthz degraded
+                    # flag (so operators see it without reading logs)
                     Logger.warn(f"store flush failed: {e!r}")
+                    self._degraded_reason = f"store flush failed: {e!r}"
+                    _resilience.count_degraded("flush")
+                    br = getattr(self.engine, "breaker", None)
+                    if br is not None and isinstance(
+                        e, _resilience.transport_errors()
+                    ):
+                        br.record_failure()
             with self._cv:
                 while not (self._staged or self._cancels or self._stop
                            or self.sched.has_work):
@@ -701,6 +719,24 @@ class ServingServer:
             reg.gauge("istpu_spec_acceptance_rate",
                       "accepted/proposed", fn=spec("rate"))
 
+    def health(self) -> Dict[str, Any]:
+        """The /healthz payload: ``degraded`` while the store circuit is
+        not closed or the last store flush failed — serving keeps
+        answering (recompute path), but prefix reuse and KV durability
+        are impaired and operators should look at the store tier."""
+        br = getattr(self.engine, "breaker", None)
+        circuit = br.state if br is not None else None
+        degraded = (circuit not in (None, "closed")
+                    or self._degraded_reason is not None)
+        out: Dict[str, Any] = {
+            "status": "degraded" if degraded else "ok",
+        }
+        if circuit is not None:
+            out["store_circuit"] = circuit
+        if self._degraded_reason is not None:
+            out["reason"] = self._degraded_reason
+        return out
+
     def metrics_text(self) -> str:
         """Prometheus exposition: this server's registry plus the
         process-global one (the client data plane's
@@ -970,6 +1006,11 @@ def _make_handler(server: ServingServer):
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
                 self.wfile.write(data)
+            elif self.path == "/healthz":
+                # liveness + store-tier degradation (docs/robustness.md):
+                # always 200 — the serving plane is up either way; the
+                # body says whether the cache tier behind it is
+                self._json(200, server.health())
             elif self.path == "/debug/traces":
                 # recent completed request/step traces as Chrome trace-
                 # event JSON: save the body to a file and load it in
@@ -1446,6 +1487,11 @@ def main(argv: Optional[List[str]] = None) -> None:
                          "store-resident prefixes across engine restarts "
                          "and hosts (requires --store-service-port)")
     ap.add_argument("--store-service-port", type=int, default=None)
+    ap.add_argument("--store-op-timeout", type=float, default=30.0,
+                    help="per-op deadline (s) on the store connection: a "
+                         "HUNG store op fails (and reconnects) within "
+                         "this window instead of stalling serving "
+                         "forever; 0 = unbounded")
     ap.add_argument("--store-connection", choices=["tcp", "shm"],
                     default="shm",
                     help="shm = zero-copy, same host; tcp = cross-host DCN")
@@ -1559,6 +1605,7 @@ def main(argv: Optional[List[str]] = None) -> None:
             connection_type=(ist.TYPE_SHM
                              if args.store_connection == "shm"
                              else ist.TYPE_TCP),
+            op_timeout_s=args.store_op_timeout or None,
         ))
         conn.connect()
     engine = InferenceEngine(params, cfg, pc, prefill_chunk=args.prefill_chunk,

@@ -125,8 +125,27 @@ def _manage_handler(server_ref):
                 self._json({"status": "ok"})
             elif self.path == "/healthz":
                 # liveness for probes/load-balancers (reference parity
-                # with InfiniStore's FastAPI manage plane)
-                self._json({"status": "ok"})
+                # with InfiniStore's FastAPI manage plane), plus the
+                # degraded signal: armed fault rules / a failing evict
+                # loop mean the instance is deliberately or silently
+                # misbehaving (docs/robustness.md)
+                srv = server_ref()
+                degraded = bool(
+                    srv is not None
+                    and getattr(srv, "degraded", None)
+                    and srv.degraded()
+                )
+                payload = {"status": "degraded" if degraded else "ok"}
+                if srv is not None and hasattr(srv, "faults"):
+                    payload["faults_armed"] = len(srv.faults.snapshot())
+                self._json(payload)
+            elif self.path == "/faults":
+                srv = server_ref()
+                if srv is None or not hasattr(srv, "faults"):
+                    self._json({"error": "fault injection requires the "
+                                         "python backend"}, 501)
+                else:
+                    self._json({"rules": srv.faults.snapshot()})
             elif self.path == "/kvmap_len":
                 self._json({"len": store.kvmap_len() if store else 0})
             elif self.path == "/usage":
@@ -151,6 +170,25 @@ def _manage_handler(server_ref):
                 Logger.info("clear kvmap")
                 num = store.purge() if store else 0
                 self._json({"status": "ok", "num": num})
+            elif self.path == "/faults":
+                # arm/replace the fault-injection rule set (python
+                # backend; the C runtime has no injector).  Body: a JSON
+                # list of rules, or {"rules": [...]}; [] clears — and
+                # releases any stalled connections.
+                srv = server_ref()
+                if srv is None or not hasattr(srv, "faults"):
+                    self._json({"error": "fault injection requires the "
+                                         "python backend"}, 501)
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(n) or b"[]")
+                    rules = body.get("rules", []) if isinstance(body, dict) else body
+                    armed = srv.faults.arm(rules)
+                except (ValueError, TypeError) as e:
+                    self._json({"error": str(e)}, 400)
+                    return
+                self._json({"status": "ok", "armed": armed})
             else:
                 self._json({"error": "not found"}, 404)
 

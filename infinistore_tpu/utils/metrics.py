@@ -360,6 +360,44 @@ def default_registry() -> MetricsRegistry:
     return _DEFAULT
 
 
+def parse_prometheus_text(text: str) -> Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float]:
+    """Parse exposition text into ``{(name, sorted label items): value}``.
+
+    The read-side complement of ``to_prometheus_text``: resilience and
+    chaos tests assert on scraped state (circuit transitions, degraded-op
+    counters) the way an operator's alerting would — through the text
+    endpoint, not internal objects.  Handles the subset this package
+    emits (no escapes-in-labels round-trip beyond what ``_escape_label``
+    produces)."""
+    out: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            metric, value = line.rsplit(" ", 1)
+            labels: Tuple[Tuple[str, str], ...] = ()
+            if "{" in metric:
+                name, rest = metric.split("{", 1)
+                body = rest.rsplit("}", 1)[0]
+                items = []
+                for pair in body.split(","):
+                    if not pair:
+                        continue
+                    k, v = pair.split("=", 1)
+                    items.append((k, v.strip('"')
+                                  .replace('\\"', '"')
+                                  .replace("\\n", "\n")
+                                  .replace("\\\\", "\\")))
+                labels = tuple(sorted(items))
+            else:
+                name = metric
+            out[(name, labels)] = float(value)
+        except ValueError:
+            continue  # not a sample line
+    return out
+
+
 def stats_to_prometheus(stats: dict, prefix: str,
                         gauges: frozenset) -> List[str]:
     """Exposition lines for a flat numeric stats dict (the store's

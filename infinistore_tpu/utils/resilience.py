@@ -1,0 +1,257 @@
+"""Resilience primitives for the store data plane.
+
+The store tier exists to *accelerate* serving (prefix reuse, PD-disagg KV
+hand-off); it must never be able to take serving down with it.  Three
+primitives enforce that contract across the client -> transfer -> engine ->
+serve vertical:
+
+* ``Deadline`` — a monotonic time budget.  The client channel uses it to
+  bound every wire op (``ClientConfig.op_timeout_s``), turning a *hung*
+  server — which a socket error would never surface — into a reconnectable
+  transport failure.
+* ``RetryPolicy`` — exponential backoff with full jitter under a hard time
+  budget.  Shared by the ALLOC_PUT RETRY loop (contended-writer backoff)
+  and the strict-durability push retry.
+* ``CircuitBreaker`` — closed -> open after N *consecutive* transport
+  failures, half-open probe after a cooldown, closed again on probe
+  success.  While open, the serving stack skips store hops outright
+  (prefix lookups report miss, pushes are counted drops), so a dead or
+  wedged store costs recompute, not a per-request timeout tax.
+
+Metrics (process-default registry, the same place the client data-plane
+histograms live, so every serving ``/metrics`` exposition carries them):
+
+* ``istpu_store_circuit_state{name=}`` — 0 closed / 1 open / 2 half-open
+* ``istpu_store_circuit_transitions_total{name=,to=}`` — transition counts
+  (the chaos test reads open -> half-open -> closed off this family)
+* ``istpu_store_degraded_ops_total{op=}`` — store hops answered by the
+  degraded path (lookup/load miss-fallbacks, skipped hops, failed flushes)
+* ``istpu_store_push_dropped_total{reason=}`` — async KV pushes not
+  attempted or failed (parked error, open circuit, push error)
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Callable, Iterator, Optional
+
+from . import metrics as _metrics
+
+# errors that count as TRANSPORT failures for the breaker: the socket or
+# channel died (or timed out — InfiniStoreTimeoutError subclasses the
+# connection error in lib.py).  Server-ANSWERED statuses (KEY_NOT_FOUND,
+# OOM) are normal protocol outcomes and never trip the circuit.
+# OSError covers raw socket failures surfaced below the client exception
+# hierarchy (reset, refused, send timeout).
+def transport_errors() -> tuple:
+    from ..lib import InfiniStoreConnectionError
+
+    return (OSError, InfiniStoreConnectionError)
+
+
+class Deadline:
+    """A monotonic time budget.  ``timeout_s=None`` never expires."""
+
+    __slots__ = ("_at", "_time")
+
+    def __init__(self, timeout_s: Optional[float],
+                 time_fn: Callable[[], float] = time.monotonic):
+        self._time = time_fn
+        self._at = None if timeout_s is None else time_fn() + timeout_s
+
+    @property
+    def expired(self) -> bool:
+        return self._at is not None and self._time() >= self._at
+
+    def remaining(self, cap: Optional[float] = None) -> Optional[float]:
+        """Seconds left (clamped at 0), or ``cap``/None when unbounded."""
+        if self._at is None:
+            return cap
+        rem = max(0.0, self._at - self._time())
+        return rem if cap is None else min(rem, cap)
+
+
+class RetryPolicy:
+    """Exponential backoff with full jitter under a hard time budget.
+
+    ``max_attempts=0`` means unlimited attempts (the budget is the only
+    bound).  Delays double from ``base_delay_s`` up to ``max_delay_s``;
+    with ``jitter`` each sleep is uniform in (0, delay] (the AWS
+    full-jitter scheme — decorrelates retry storms from many clients
+    hammering one recovering server).
+    """
+
+    def __init__(self, max_attempts: int = 4, base_delay_s: float = 0.002,
+                 max_delay_s: float = 0.256, budget_s: Optional[float] = 10.0,
+                 jitter: bool = True,
+                 rng: Callable[[], float] = random.random,
+                 time_fn: Callable[[], float] = time.monotonic):
+        assert max_attempts >= 0 and base_delay_s > 0
+        self.max_attempts = max_attempts
+        self.base_delay_s = base_delay_s
+        self.max_delay_s = max_delay_s
+        self.budget_s = budget_s
+        self.jitter = jitter
+        self._rng = rng
+        self._time = time_fn
+
+    def backoff(self) -> Iterator[float]:
+        """Yield sleep durations until attempts or budget run out.  The
+        caller sleeps and retries after each yield; the generator ending
+        means the policy is exhausted and the last error should surface."""
+        deadline = Deadline(self.budget_s, self._time)
+        delay = self.base_delay_s
+        attempt = 0
+        while not deadline.expired:
+            attempt += 1
+            if self.max_attempts and attempt >= self.max_attempts:
+                return
+            d = delay * self._rng() if self.jitter else delay
+            rem = deadline.remaining()
+            if rem is not None:
+                d = min(d, rem)
+            yield max(d, 0.0)
+            delay = min(delay * 2, self.max_delay_s)
+
+    def run(self, fn, retry_on: tuple,
+            sleep: Callable[[float], None] = time.sleep):
+        """Call ``fn`` with retries on ``retry_on`` exceptions; the last
+        error propagates once the policy is exhausted."""
+        it = self.backoff()
+        while True:
+            try:
+                return fn()
+            except retry_on:
+                d = next(it, None)
+                if d is None:
+                    raise
+                sleep(d)
+
+
+_STATE_CODE = {"closed": 0, "open": 1, "half-open": 2}
+
+
+class CircuitBreaker:
+    """Closed -> open after N consecutive transport failures; half-open
+    probe after ``cooldown_s``; probe success closes, probe failure
+    reopens (fresh cooldown).
+
+    Thread-safe: the serving stack calls ``allow``/``record_*`` from the
+    engine thread, the streamer worker, and HTTP handler threads.  In
+    half-open exactly ONE caller gets the probe (``allow`` returns True
+    once until the probe resolves), so a recovering server is not
+    stampeded.
+    """
+
+    CLOSED = "closed"
+    OPEN = "open"
+    HALF_OPEN = "half-open"
+
+    def __init__(self, name: str = "store", failure_threshold: int = 3,
+                 cooldown_s: float = 5.0, registry=None,
+                 time_fn: Callable[[], float] = time.monotonic):
+        assert failure_threshold >= 1 and cooldown_s >= 0
+        self.name = name
+        self.failure_threshold = failure_threshold
+        self.cooldown_s = cooldown_s
+        self._time = time_fn
+        self._lock = threading.Lock()
+        self._state = self.CLOSED
+        self._consecutive_failures = 0
+        self._opened_at = 0.0
+        self._probe_inflight = False
+        reg = registry or _metrics.default_registry()
+        self._g_state = reg.gauge(
+            "istpu_store_circuit_state",
+            "Store circuit state: 0 closed / 1 open / 2 half-open",
+            labelnames=("name",),
+        ).labels(name)
+        self._g_state.set(0)
+        self._c_trans = reg.counter(
+            "istpu_store_circuit_transitions_total",
+            "Circuit state transitions, labeled by destination state",
+            labelnames=("name", "to"),
+        )
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            # an elapsed cooldown is observable before any allow() call:
+            # /healthz polls state without sending a probe
+            if (self._state == self.OPEN
+                    and self._time() - self._opened_at >= self.cooldown_s):
+                self._transition(self.HALF_OPEN)
+            return self._state
+
+    @property
+    def state_code(self) -> int:
+        return _STATE_CODE[self.state]
+
+    def allow(self) -> bool:
+        """May a store hop run right now?  Closed: yes.  Open: no, until
+        the cooldown elapses.  Half-open: yes for exactly one probe."""
+        with self._lock:
+            if self._state == self.CLOSED:
+                return True
+            if (self._state == self.OPEN
+                    and self._time() - self._opened_at >= self.cooldown_s):
+                self._transition(self.HALF_OPEN)
+            if self._state == self.HALF_OPEN and not self._probe_inflight:
+                self._probe_inflight = True
+                return True
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._consecutive_failures = 0
+            self._probe_inflight = False
+            if self._state != self.CLOSED:
+                self._transition(self.CLOSED)
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._consecutive_failures += 1
+            was_probe, self._probe_inflight = self._probe_inflight, False
+            if self._state == self.HALF_OPEN and was_probe:
+                # probe failed: reopen with a fresh cooldown
+                self._opened_at = self._time()
+                self._transition(self.OPEN)
+            elif (self._state == self.CLOSED
+                  and self._consecutive_failures >= self.failure_threshold):
+                self._opened_at = self._time()
+                self._transition(self.OPEN)
+            # failures while already OPEN (ops in flight when it tripped)
+            # do NOT push the cooldown out — recovery must stay reachable
+            # under sustained traffic
+
+    def _transition(self, to: str) -> None:
+        # caller holds the lock
+        self._state = to
+        self._g_state.set(_STATE_CODE[to])
+        self._c_trans.labels(self.name, to).inc()
+
+
+# -- shared degradation counters (process-default registry, so every
+#    serving /metrics exposition picks them up next to the client-op
+#    histograms) --
+
+_DEGRADED = _metrics.default_registry().counter(
+    "istpu_store_degraded_ops_total",
+    "Store hops answered by the degraded path instead of the store",
+    labelnames=("op",),
+)
+_DROPPED = _metrics.default_registry().counter(
+    "istpu_store_push_dropped_total",
+    "Async KV pushes dropped (not attempted, or failed and not retried)",
+    labelnames=("reason",),
+)
+
+
+def count_degraded(op: str) -> None:
+    _DEGRADED.labels(op).inc()
+
+
+def count_push_dropped(reason: str, n: int = 1) -> None:
+    _DROPPED.labels(reason).inc(n)
