@@ -106,9 +106,10 @@ def bench_conn(conn_type: str, port: int, rounds: int, tag: str,
         conn.read_cache(blocks, PAGE_BYTES, ptr)
         get_t += time.perf_counter() - t0
         conn.delete_keys([k for k, _ in blocks])
+    stages = conn.latency_stats()
     conn.close()
     gb = rounds * ROUND_BYTES / 1e9
-    return gb / put_t, gb / get_t
+    return gb / put_t, gb / get_t, stages
 
 
 def bench_tpu_leg(timeout_s: int = 1800) -> dict:
@@ -207,13 +208,23 @@ def bench_read_latency(port: int, n: int = 400) -> dict:
     }
 
 
-def main():
+def main(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser("bench.py")
+    ap.add_argument("--json-out", default=None, metavar="FILE",
+                    help="also write the stable perf-trajectory record "
+                         "({run_id, gbps_put, gbps_get, alloc_ms, "
+                         "stages:{...}} — docs/observability.md) for the "
+                         "measured SHM leg")
+    args = ap.parse_args(argv)
+
     proc, port = start_server()
     try:
         # warmup (compilation-free path, but page in the pools)
         bench_conn(TYPE_SHM, port, 1, "warm")
-        shm_put, shm_get = bench_conn(TYPE_SHM, port, 6, "shm")
-        tcp_put, tcp_get = bench_conn(TYPE_TCP, port, 2, "tcp", force_python=True)
+        shm_put, shm_get, shm_stages = bench_conn(TYPE_SHM, port, 6, "shm")
+        tcp_put, tcp_get, _ = bench_conn(TYPE_TCP, port, 2, "tcp", force_python=True)
         lat = bench_read_latency(port)
     finally:
         proc.terminate()
@@ -264,6 +275,15 @@ def main():
     # XLA decode attention on chip, engine tokens/s) when a TPU answered
     result.update({f"tpu_{k}": v for k, v in tpu.items()})
     print(json.dumps(result))
+    if args.json_out:
+        import uuid
+
+        from infinistore_tpu.benchmark import bench_json
+
+        rec = bench_json(uuid.uuid4().hex[:8], shm_put, shm_get, shm_stages)
+        rec.update(lat)  # the latency half rides along (extra keys allowed)
+        with open(args.json_out, "w") as f:
+            json.dump(rec, f, indent=2)
 
 
 if __name__ == "__main__":

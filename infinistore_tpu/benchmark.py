@@ -17,6 +17,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import contextlib
+import json
 import time
 import uuid
 
@@ -51,7 +52,30 @@ def parse_args():
                          "(one trace per iteration, spans nested down to "
                          "the pool copy) — load it in Perfetto "
                          "(ui.perfetto.dev) or chrome://tracing")
+    ap.add_argument("--json-out", default=None, metavar="FILE",
+                    help="write the run's results as one JSON object "
+                         "with the stable schema {run_id, gbps_put, "
+                         "gbps_get, alloc_ms, stages:{...}} "
+                         "(docs/observability.md) — the machine-readable "
+                         "feed for perf trajectories")
     return ap.parse_args()
+
+
+def bench_json(run_id: str, gbps_put: float, gbps_get: float,
+               stages: dict) -> dict:
+    """The stable ``--json-out`` schema, shared by this CLI and bench.py:
+    ``run_id`` (opaque), put/get bandwidth in GB/s, ``alloc_ms`` (p50 of
+    the ALLOC_PUT round-trip stage — the canary for allocator/
+    fragmentation regressions), and the full per-stage latency snapshot
+    under ``stages``."""
+    alloc = stages.get("write_cache.alloc", {})
+    return {
+        "run_id": run_id,
+        "gbps_put": round(gbps_put, 3),
+        "gbps_get": round(gbps_get, 3),
+        "alloc_ms": alloc.get("p50_ms", 0.0),
+        "stages": stages,
+    }
 
 
 def serving_bench(args) -> None:
@@ -183,6 +207,14 @@ def main():
             f.write(tracing.TRACER.export_chrome_json())
         print(f"trace written to {args.trace_out} "
               f"(load in https://ui.perfetto.dev)")
+    if args.json_out:
+        rec = bench_json(
+            run, gb / put_t if put_t else 0.0, gb / get_t if get_t else 0.0,
+            stats,
+        )
+        with open(args.json_out, "w") as f:
+            json.dump(rec, f, indent=2)
+        print(f"results written to {args.json_out}")
     conn.close()
 
 

@@ -45,7 +45,22 @@ from ..models.llama import (
     prefill_forward,
     verify_forward,
 )
+from ..utils import metrics as _metrics
 from ..utils import tracing
+
+# prefix-reuse attribution in the admission path: of each admitted
+# prompt's tokens, how many were served by the LOCAL HBM prefix cache,
+# how many by the STORE tier, and how many had to be COMPUTED.  Lives on
+# the process-default registry (engines are built deep inside serving
+# stacks) so every serving /metrics exposition carries it — the
+# engine-side half of "is the store tier earning its keep", next to the
+# store's istpu_cache_* families.
+_PREFIX_TOKENS = _metrics.default_registry().counter(
+    "istpu_engine_prefix_tokens_total",
+    "Admitted prompt tokens by provenance: local prefix cache, store "
+    "tier, or computed",
+    labelnames=("source",),
+)
 
 
 def _truncate_logits(l: jax.Array, top_k: jax.Array, top_p: jax.Array) -> jax.Array:
@@ -733,6 +748,15 @@ class InferenceEngine:
             if not ok:
                 reused = len(local_ids)
                 P = reused * T
+        # provenance accounting AFTER the load settled (a failed store
+        # load degrades those chunks back to computed, and must count so)
+        local_chunks = min(len(local_ids), reused)
+        if local_chunks:
+            _PREFIX_TOKENS.labels("local").inc(local_chunks * T)
+        if reused > local_chunks:
+            _PREFIX_TOKENS.labels("store").inc((reused - local_chunks) * T)
+        _PREFIX_TOKENS.labels("computed").inc(S_total - P)
+
         if reused:
             prefix_kv = _read_prefix_kv(
                 self.cache, jnp.asarray(block_ids[:reused])

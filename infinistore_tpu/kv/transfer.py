@@ -301,16 +301,17 @@ class KVTransferEngine:
         written first, so verify the last layer before trusting a hit)."""
         if not chunk_keys_:
             return 0
-        sfx = self._key_suffix
-        probe = [layer_key(ck, 0) + sfx for ck in chunk_keys_]
-        idx = self._call("get_match_last_index", probe)
-        while idx >= 0:
-            last = layer_key(chunk_keys_[idx], self.cfg.n_layers - 1) + sfx
-            # 0 => exists (wire semantics)
-            if self._call("check_exist", last) == 0:
-                break
-            idx -= 1
-        return idx + 1
+        with tracing.span("kv.lookup_prefix", chunks=len(chunk_keys_)):
+            sfx = self._key_suffix
+            probe = [layer_key(ck, 0) + sfx for ck in chunk_keys_]
+            idx = self._call("get_match_last_index", probe)
+            while idx >= 0:
+                last = layer_key(chunk_keys_[idx], self.cfg.n_layers - 1) + sfx
+                # 0 => exists (wire semantics)
+                if self._call("check_exist", last) == 0:
+                    break
+                idx -= 1
+            return idx + 1
 
     # -- breaker-guarded hops (the degraded-serving contract) --
     #

@@ -47,6 +47,7 @@ from .config import (  # noqa: F401 - re-exported for parity
 from .mempool import SHM_DIR, _prefault
 from .utils import metrics as _metrics
 from .utils import resilience as _resilience
+from .utils import tracing as _tracing
 from .utils.logging import Logger
 from .utils.profiling import LatencyStats
 
@@ -128,6 +129,14 @@ def _ptr_view(ptr: int, size: int) -> memoryview:
 # legacy per-page copy loop — kept as the byte-parity reference and as an
 # escape hatch; the coalesced path is the default.
 _COALESCE = not os.environ.get("ISTPU_NO_COALESCE")
+
+
+def _trace_ctx_enabled() -> bool:
+    """Cross-process trace propagation opt-out (ISTPU_TRACE_CTX=0): when
+    off, HELLO advertises nothing and every frame is byte-identical to the
+    pre-trace-context wire format.  Read per connection so tests can flip
+    it without reimporting."""
+    return os.environ.get("ISTPU_TRACE_CTX", "1") != "0"
 # total time write_cache keeps re-asking after RETRY (another writer is
 # actively streaming one of these keys) before giving up with a clear error
 _RETRY_DEADLINE_S = float(os.environ.get("ISTPU_RETRY_DEADLINE_S", "10"))
@@ -276,11 +285,21 @@ class _Channel:
         body: bytes,
         payload: Sequence[memoryview] = (),
         consumer: Optional[Callable] = None,
+        trace_id: Optional[str] = None,
     ) -> _Slot:
         """Put one request on the wire without waiting (the pipelined
         banded ops overlap the next band's round-trip with this band's
         pool copy).  FIFO response matching holds because the send lock
-        orders the frame and the pending-queue append together."""
+        orders the frame and the pending-queue append together.
+
+        ``trace_id`` (only ever passed after HELLO negotiation proved the
+        server speaks trace context) prepends the ctx blob and sets
+        FLAG_TRACE_CTX, so the server records its op spans under the
+        caller's trace."""
+        flags = 0
+        if trace_id is not None:
+            flags = P.FLAG_TRACE_CTX
+            body = P.pack_trace_ctx(trace_id) + body
         slot = _Slot(consumer)
         with self._send_lock:
             if self._err is not None:
@@ -289,7 +308,7 @@ class _Channel:
                 self._pending.append(slot)
             # sendall per buffer: sendmsg can partially send under
             # backpressure and is capped at IOV_MAX vectors
-            self.sock.sendall(P.pack_header(op, len(body)) + body)
+            self.sock.sendall(P.pack_header(op, len(body), flags=flags) + body)
             for view in payload:
                 self.sock.sendall(view)
         return slot
@@ -345,8 +364,9 @@ class _Channel:
         body: bytes,
         payload: Sequence[memoryview] = (),
         consumer: Optional[Callable] = None,
+        trace_id: Optional[str] = None,
     ) -> Tuple[int, object]:
-        return self.wait(self.submit(op, body, payload, consumer))
+        return self.wait(self.submit(op, body, payload, consumer, trace_id))
 
     def _read_loop(self) -> None:
         slot: Optional[_Slot] = None
@@ -420,6 +440,15 @@ class Connection:
         self.coalesce = _COALESCE
         self.op_timeout = getattr(config, "op_timeout_s", None)
         self.latency = LatencyStats(sink=_observe_client_op)
+        # wire trace-context state (negotiated at HELLO; see connect()):
+        # trace_ctx — the server accepts FLAG_TRACE_CTX frames;
+        # clock_offset — server perf_counter minus client perf_counter
+        # (midpoint estimate from the HELLO round-trip), used by the
+        # stitcher to map server span stamps into this process's timeline;
+        # server_pid — rendering hint for the stitched Perfetto rows.
+        self.trace_ctx = False
+        self.clock_offset: Optional[float] = None
+        self.server_pid: Optional[int] = None
 
     def latency_stats(self) -> Dict[str, Dict[str, float]]:
         """Client-side per-op latency counters (count/avg/max ms)."""
@@ -436,11 +465,25 @@ class Connection:
             raise InfiniStoreException("Already connected to remote instance")
         ch0 = _Channel(self.config.host_addr, self.config.service_port,
                        op_timeout=self.op_timeout)
-        status, body = ch0.exchange(P.OP_HELLO, P.pack_hello(os.getpid()))
+        hello_flags = P.HELLO_FLAG_TRACE_CTX if _trace_ctx_enabled() else 0
+        t0 = time.perf_counter()
+        status, body = ch0.exchange(
+            P.OP_HELLO, P.pack_hello(os.getpid(), hello_flags)
+        )
+        t1 = time.perf_counter()
         _raise_for_status(status, "hello")
         ch0.start_reader()
         self.channels.append(ch0)
-        self.pool_meta = P.unpack_pool_table(memoryview(body))
+        pools, srv_flags, t_server = P.unpack_hello_resp(memoryview(body))
+        self.pool_meta = pools
+        if hello_flags and (srv_flags & P.HELLO_FLAG_TRACE_CTX):
+            # clock-skew correction: the server stamped t_server while the
+            # request was in flight; assume it fired at the round-trip
+            # midpoint, so server_clock ≈ client_clock + offset.  The
+            # error bound is half the HELLO RTT — microseconds on the
+            # same-host shm topology this estimate matters for.
+            self.trace_ctx = True
+            self.clock_offset = t_server - (t0 + t1) / 2
         if self.config.connection_type == TYPE_SHM:
             try:
                 self._map_pools()
@@ -491,10 +534,20 @@ class Connection:
             p.close()
         self.pools.clear()
 
+    def _trace_id(self) -> Optional[str]:
+        """Trace id to propagate on the next frame: the active trace's id
+        when the server negotiated trace context, else None (frame stays
+        byte-identical to the legacy format)."""
+        if not self.trace_ctx:
+            return None
+        return _tracing.current_trace_id()
+
     def _request(self, op: int, body: bytes, payload: Sequence[memoryview] = ()) -> Tuple[int, bytes]:
         if not self.channels:
             raise InfiniStoreException("not connected")
-        return self.channels[0].request(op, body, payload)
+        return self.channels[0].request(
+            op, body, payload, trace_id=self._trace_id()
+        )
 
     # -- zero-copy batched ops (reference: rdma_write_cache/rdma_read_cache) --
 
@@ -634,6 +687,9 @@ class Connection:
                 status, _ = self._request(P.OP_COMMIT_PUT, P.pack_keys(keys))
                 _raise_for_status(status, "commit_put")
         else:
+            # captured HERE: the stripe workers run off-thread, where the
+            # contextvar-bound trace is not visible
+            tid = self._trace_id()
 
             def _put(chunk):
                 ch_idx, sub = chunk
@@ -643,6 +699,7 @@ class Connection:
                     P.OP_PUT_INLINE_BATCH,
                     P.pack_put_inline_batch(sub_keys, block_size),
                     payload,
+                    trace_id=tid,
                 )
                 return st
 
@@ -673,6 +730,7 @@ class Connection:
             with self.latency.timed("read_cache.copy"):
                 self._copy_descs(descs, offsets, dst, to_pool=False)
         else:
+            tid = self._trace_id()  # stripe workers lack the contextvar
 
             def _get(chunk):
                 ch_idx, sub = chunk
@@ -697,6 +755,7 @@ class Connection:
                     P.OP_GET_INLINE_BATCH,
                     P.pack_get_inline_batch(sub_keys, block_size),
                     consumer=consumer,
+                    trace_id=tid,
                 )
                 return st
 
@@ -744,9 +803,11 @@ class Connection:
                 del keep
             return total
         ch = self.channels[0]
+        tid = self._trace_id()
         enc = [P.encode_keys([k for k, _ in blocks]) for blocks, _, _ in bands]
         all_keys: List[bytes] = []
-        slot = ch.submit(P.OP_ALLOC_PUT, P.pack_alloc_put(enc[0], bands[0][1]))
+        slot = ch.submit(P.OP_ALLOC_PUT, P.pack_alloc_put(enc[0], bands[0][1]),
+                         trace_id=tid)
         for i, (blocks, block_size, src) in enumerate(bands):
             with self.latency.timed("write_cache.alloc"):
                 status, body = ch.wait(slot)
@@ -757,7 +818,8 @@ class Connection:
                     _raise_for_status(status, "alloc_put")
             if i + 1 < len(bands):
                 slot = ch.submit(
-                    P.OP_ALLOC_PUT, P.pack_alloc_put(enc[i + 1], bands[i + 1][1])
+                    P.OP_ALLOC_PUT, P.pack_alloc_put(enc[i + 1], bands[i + 1][1]),
+                    trace_id=tid,
                 )
             descs = P.unpack_descs(memoryview(body))
             offsets = [off for _, off in blocks]
@@ -792,8 +854,10 @@ class Connection:
                     on_band(i)
             return total
         ch = self.channels[0]
+        tid = self._trace_id()
         enc = [P.encode_keys([k for k, _ in b[0]]) for _, b in live]
-        slot = ch.submit(P.OP_GET_DESC, P.pack_alloc_put(enc[0], live[0][1][1]))
+        slot = ch.submit(P.OP_GET_DESC, P.pack_alloc_put(enc[0], live[0][1][1]),
+                         trace_id=tid)
         for j, (i, (blocks, block_size, ptr)) in enumerate(live):
             with self.latency.timed("read_cache.desc"):
                 status, body = ch.wait(slot)
@@ -802,6 +866,7 @@ class Connection:
                 slot = ch.submit(
                     P.OP_GET_DESC,
                     P.pack_alloc_put(enc[j + 1], live[j + 1][1][1]),
+                    trace_id=tid,
                 )
             descs = P.unpack_descs(memoryview(body))
             offsets = [off for _, off in blocks]
@@ -862,6 +927,22 @@ class Connection:
         status, body = self._request(P.OP_STATS, b"")
         _raise_for_status(status, "stats")
         return json.loads(body.decode())
+
+    def trace_dump(self) -> dict:
+        """The server's completed-span ring, raw server-clock stamps
+        (wire OP_TRACE_DUMP).  Feed it to ``utils.trace_stitch`` together
+        with ``clock_offset`` to merge server spans into this process's
+        trace timeline.  Requires a server that negotiated trace context
+        at HELLO."""
+        if not self.trace_ctx:
+            raise InfiniStoreException(
+                "server did not negotiate trace context at HELLO"
+            )
+        status, body = self._request(P.OP_TRACE_DUMP, b"")
+        _raise_for_status(status, "trace_dump")
+        dump = json.loads(body.decode())
+        self.server_pid = dump.get("pid")
+        return dump
 
     def evict(self, min_threshold: float, max_threshold: float) -> None:
         status, _ = self._request(P.OP_EVICT, P.pack_evict(min_threshold, max_threshold))
@@ -1166,6 +1247,11 @@ class InfinityConnection:
         """Server stats snapshot (wire OP_STATS; same payload as the
         manage plane's /metrics)."""
         return self._call("stats")
+
+    def trace_dump(self) -> dict:
+        """Server-side span ring for the trace stitcher (python client
+        with negotiated trace context only)."""
+        return self._call("trace_dump")
 
     def register_mr(self, arg: Union[int, "np.ndarray"], size: Optional[int] = None) -> int:
         if isinstance(arg, (int, np.integer)):

@@ -37,6 +37,14 @@ VERSION = 1
 HEADER = struct.Struct("<IBBHII")
 HEADER_SIZE = HEADER.size  # 16
 
+# ---- header flag bits (u16) ----
+# The request body is prefixed with a trace-context blob (pack_trace_ctx):
+# the client is propagating its active trace across the wire so the server
+# can record its op spans under the SAME trace id.  Only ever set after
+# HELLO negotiation proved the server understands it — an old server would
+# read the blob as body bytes.
+FLAG_TRACE_CTX = 0x0001
+
 # response: status i32 | body_len u32
 RESP = struct.Struct("<iI")
 RESP_SIZE = RESP.size  # 8
@@ -57,6 +65,7 @@ OP_EVICT = 12
 OP_PUT_INLINE_BATCH = 13
 OP_GET_INLINE_BATCH = 14
 OP_POOLS = 15
+OP_TRACE_DUMP = 16
 
 _OP_NAMES = {
     OP_HELLO: "HELLO",
@@ -74,6 +83,7 @@ _OP_NAMES = {
     OP_PUT_INLINE_BATCH: "PUT_INLINE_BATCH",
     OP_GET_INLINE_BATCH: "GET_INLINE_BATCH",
     OP_POOLS: "POOLS",
+    OP_TRACE_DUMP: "TRACE_DUMP",
 }
 
 
@@ -152,9 +162,62 @@ def encode_keys(keys: Sequence) -> List[bytes]:
     return [k.encode() if isinstance(k, str) else bytes(k) for k in keys]
 
 
-# HELLO: req = pid u32 | flags u32 ; resp = pool table (see pack_pool_table)
+# HELLO: req = pid u32 | flags u32 ; resp = pool table (see pack_pool_table),
+# optionally followed by a capability trailer (pack_hello_trailer) when the
+# client's flags asked for one.  Old clients stop reading at the pool table
+# (unpack_pool_table is length-prefixed), old servers send no trailer —
+# both directions stay byte-compatible.
+HELLO_FLAG_TRACE_CTX = 0x1
+
+# trailer: marker u32 | server_flags u32 | t_server f64 (perf_counter at
+# response build — the server-clock sample the client uses to estimate the
+# cross-process clock offset from the HELLO round-trip)
+HELLO_TRAILER_MAGIC = 0x43415254  # "TRAC"
+_TRAILER = struct.Struct("<IId")
+HELLO_TRAILER_SIZE = _TRAILER.size  # 16
+
+
 def pack_hello(pid: int, flags: int = 0) -> bytes:
     return _U32.pack(pid) + _U32.pack(flags)
+
+
+def unpack_hello(buf: memoryview) -> Tuple[int, int]:
+    """(pid, flags); tolerates short bodies from minimal clients."""
+    if len(buf) < 8:
+        pid = _U32.unpack_from(buf, 0)[0] if len(buf) >= 4 else 0
+        return pid, 0
+    return _U32.unpack_from(buf, 0)[0], _U32.unpack_from(buf, 4)[0]
+
+
+def pack_hello_trailer(flags: int, t_server: float) -> bytes:
+    return _TRAILER.pack(HELLO_TRAILER_MAGIC, flags, t_server)
+
+
+def unpack_hello_resp(buf: memoryview) -> Tuple[
+        List[Tuple[str, int, int]], int, float]:
+    """(pools, server_flags, t_server).  A trailer-less body (old server)
+    reports flags 0 / t_server 0.0 — negotiation simply fails closed."""
+    pools, off = unpack_pool_table_ex(buf)
+    if len(buf) - off >= HELLO_TRAILER_SIZE:
+        magic, flags, t_server = _TRAILER.unpack_from(buf, off)
+        if magic == HELLO_TRAILER_MAGIC:
+            return pools, flags, t_server
+    return pools, 0, 0.0
+
+
+# trace context blob (prepended to the body when FLAG_TRACE_CTX is set in
+# the header): id_len u16 | trace_id utf-8
+def pack_trace_ctx(trace_id: str) -> bytes:
+    tid = trace_id.encode()
+    return _U16.pack(len(tid)) + tid
+
+
+def unpack_trace_ctx(buf: memoryview) -> Tuple[str, int]:
+    """(trace_id, bytes consumed)."""
+    (n,) = _U16.unpack_from(buf, 0)
+    if n > len(buf) - 2:
+        raise ValueError(f"trace ctx length {n} exceeds body")
+    return bytes(buf[2 : 2 + n]).decode(errors="replace"), 2 + n
 
 
 # pool table: n u32 | n x { name_len u16 | name | pool_size u64 | block_size u64 }
@@ -169,7 +232,8 @@ def pack_pool_table(pools: Sequence[Tuple[str, int, int]]) -> bytes:
     return b"".join(parts)
 
 
-def unpack_pool_table(buf: memoryview) -> List[Tuple[str, int, int]]:
+def unpack_pool_table_ex(buf: memoryview) -> Tuple[List[Tuple[str, int, int]], int]:
+    """Pool table plus the offset where it ends (trailer parsing needs it)."""
     (n,) = _U32.unpack_from(buf, 0)
     off = 4
     pools = []
@@ -183,7 +247,11 @@ def unpack_pool_table(buf: memoryview) -> List[Tuple[str, int, int]]:
         (block_size,) = _U64.unpack_from(buf, off)
         off += 8
         pools.append((name, pool_size, block_size))
-    return pools
+    return pools, off
+
+
+def unpack_pool_table(buf: memoryview) -> List[Tuple[str, int, int]]:
+    return unpack_pool_table_ex(buf)[0]
 
 
 # ALLOC_PUT: req = block_size u64 | keys ; resp = n x desc

@@ -22,7 +22,7 @@ from . import protocol as P
 from .store import Store
 from .utils import tracing
 from .utils.logging import Logger
-from .utils.metrics import MetricsRegistry, stats_to_prometheus
+from .utils.metrics import AGE_BUCKETS, MetricsRegistry, stats_to_prometheus
 
 MAX_INLINE_BODY = 1 << 30
 
@@ -191,6 +191,33 @@ class StoreServer:
             "istpu_store_faults_injected_total",
             "Faults injected into the data plane, by op and action",
             labelnames=("op", "action"))
+        # server half of cross-process trace propagation: per-instance
+        # ring of completed op traces (one per FLAG_TRACE_CTX frame),
+        # recorded under the CALLER's trace id and exported raw over
+        # OP_TRACE_DUMP for the client-side stitcher.  ISTPU_TRACE_CTX=0
+        # opts the server out: HELLO stops advertising the capability, so
+        # well-behaved clients never set the flag.
+        self.tracer = tracing.Tracer()
+        self.trace_ctx_enabled = os.environ.get("ISTPU_TRACE_CTX", "1") != "0"
+        # cache-efficiency analytics: the store attributes every hit/miss/
+        # evict (reuse distance, eviction age, dead-on-arrival); the
+        # histograms live on this registry, wired in as plain observe sinks
+        reg = self.metrics
+        self._h_reuse = reg.histogram(
+            "istpu_cache_reuse_distance_seconds",
+            "Seconds between consecutive reads of the same committed key "
+            "(first read measures commit -> read)",
+            buckets=AGE_BUCKETS)
+        self._h_evict_age = reg.histogram(
+            "istpu_cache_evicted_age_seconds",
+            "Seconds since last access when an entry was LRU-evicted",
+            buckets=AGE_BUCKETS)
+        reg.counter(
+            "istpu_cache_dead_on_arrival_total",
+            "Entries evicted without ever being read (wasted store writes)",
+            fn=lambda: st.analytics.dead_on_arrival)
+        st.analytics.reuse_sink = self._h_reuse.observe
+        st.analytics.evict_age_sink = self._h_evict_age.observe
         self.faults = FaultInjector()
         env_faults = os.environ.get("ISTPU_FAULTS")
         if env_faults:
@@ -299,23 +326,60 @@ class StoreServer:
                 if body_len > MAX_INLINE_BODY:
                     Logger.error(f"body too large: {body_len}")
                     break
+                t_hdr = time.perf_counter()
                 body = memoryview(await reader.readexactly(body_len)) if body_len else memoryview(b"")
-                act = self.faults.match(P.op_name(op)) if self.faults.armed else None
-                if act is not None:
-                    if not await self._inject_fault(op, act, writer):
-                        break  # drop_conn: die without answering
-                    if act["action"] == "error":
-                        continue  # error already written; next frame
-                t0 = time.perf_counter()
-                with tracing.span(f"store.{P.op_name(op)}", body=body_len):
-                    resp = await self._dispatch(op, body, reader, writer, conn_pending)
-                dt = time.perf_counter() - t0
+                trace_id = None
+                if flags & P.FLAG_TRACE_CTX:
+                    # the caller is propagating its trace: strip the ctx
+                    # blob and record this op's spans under ITS trace id
+                    # (clients only set the flag after HELLO negotiation,
+                    # so a parse failure here is a broken peer)
+                    try:
+                        trace_id, consumed = P.unpack_trace_ctx(body)
+                        body = body[consumed:]
+                    except ValueError as e:
+                        Logger.error(f"bad trace ctx: {e}")
+                        break
+                t_body = time.perf_counter()
+                name = P.op_name(op)
+                if trace_id is not None and self.trace_ctx_enabled:
+                    # a REAL server-side trace, ring-kept for the stitcher
+                    cm = self.tracer.trace(f"store.{name}",
+                                           trace_id=trace_id, body=body_len)
+                else:
+                    cm = tracing.span(f"store.{name}", body=body_len)
+                alive, skip, resp, dt = True, False, None, None
+                with cm:
+                    if body_len:
+                        tracing.add_span_abs("store.recv", t_hdr, t_body,
+                                             bytes=body_len)
+                    act = (self.faults.match(name)
+                           if self.faults.armed else None)
+                    if act is not None:
+                        # inside the trace ON PURPOSE: an injected delay/
+                        # stall must show up as a LONG server-side span in
+                        # the stitched timeline — that is the whole point
+                        # of tracing a misbehaving store
+                        if not await self._inject_fault(op, act, writer):
+                            alive = False  # drop_conn: die without answering
+                        elif act["action"] == "error":
+                            skip = True  # error already written; next frame
+                    if alive and not skip:
+                        t0 = time.perf_counter()
+                        resp = await self._dispatch(
+                            op, body, reader, writer, conn_pending
+                        )
+                        dt = time.perf_counter() - t0
+                if not alive:
+                    break
+                if skip:
+                    continue
                 with self._lat_lock:
                     rec = self._op_lat.setdefault(op, [0, 0.0, 0.0])
                     rec[0] += 1
                     rec[1] += dt
                     rec[2] = max(rec[2], dt)
-                self._h_op.labels(P.op_name(op)).observe(dt)
+                self._h_op.labels(name).observe(dt)
                 if resp is not None:  # streaming ops write directly
                     writer.write(resp)
                 await writer.drain()
@@ -373,7 +437,22 @@ class StoreServer:
     ) -> bytes | None:
         st = self.store
         if op == P.OP_HELLO:
-            return P.pack_resp(P.FINISH, P.pack_pool_table(st.mm.pool_table()))
+            _pid, cflags = P.unpack_hello(body)
+            resp = P.pack_pool_table(st.mm.pool_table())
+            if (cflags & P.HELLO_FLAG_TRACE_CTX) and self.trace_ctx_enabled:
+                # capability trailer: tells the client it may set
+                # FLAG_TRACE_CTX, and samples this process's clock so the
+                # client can estimate the cross-process offset from the
+                # HELLO round-trip.  Appended ONLY when asked — an
+                # old-client HELLO gets the byte-identical legacy body.
+                resp += P.pack_hello_trailer(
+                    P.HELLO_FLAG_TRACE_CTX, time.perf_counter()
+                )
+            return P.pack_resp(P.FINISH, resp)
+        if op == P.OP_TRACE_DUMP:
+            return P.pack_resp(
+                P.FINISH, json.dumps(self.tracer.dump()).encode()
+            )
         if op == P.OP_POOLS:
             return P.pack_resp(P.FINISH, P.pack_pool_table(st.mm.pool_table()))
         if op == P.OP_PUT_INLINE:
@@ -392,18 +471,21 @@ class StoreServer:
             return P.pack_resp(P.FINISH, bytes(view))
         if op == P.OP_ALLOC_PUT:
             keys, block_size = P.unpack_alloc_put(body)
-            status, descs = st.alloc_put(keys, block_size)
+            with tracing.span("store.alloc", keys=len(keys)):
+                status, descs = st.alloc_put(keys, block_size)
             if status == P.FINISH:
                 conn_pending.update(keys)
             return P.pack_resp(status, P.pack_descs(descs))
         if op == P.OP_COMMIT_PUT:
             keys, _ = P.unpack_keys(body)
-            status, count = st.commit_put(keys)
+            with tracing.span("store.commit", keys=len(keys)):
+                status, count = st.commit_put(keys)
             conn_pending.difference_update(keys)
             return P.pack_resp(status, P.pack_i32(count))
         if op == P.OP_GET_DESC:
             keys, block_size = P.unpack_alloc_put(body)
-            status, descs = st.get_desc(keys, block_size)
+            with tracing.span("store.desc_build", keys=len(keys)):
+                status, descs = st.get_desc(keys, block_size)
             return P.pack_resp(status, P.pack_descs(descs))
         if op == P.OP_EXIST:
             keys, _ = P.unpack_keys(body)
@@ -446,16 +528,18 @@ class StoreServer:
             for key in keys:
                 st.pending[key].busy = True
             try:
-                for (pool_idx, offset, size) in _merge_desc_runs(descs):
-                    dst = st.mm.view(pool_idx, offset, size)
-                    got = 0
-                    while got < size:
-                        chunk = await reader.read(min(size - got, 1 << 20))
-                        if not chunk:
-                            st.abort_put(keys)
-                            return P.pack_resp(P.INVALID_REQ)
-                        dst[got : got + len(chunk)] = chunk
-                        got += len(chunk)
+                with tracing.span("store.pool_copy",
+                                  bytes=block_size * len(keys)):
+                    for (pool_idx, offset, size) in _merge_desc_runs(descs):
+                        dst = st.mm.view(pool_idx, offset, size)
+                        got = 0
+                        while got < size:
+                            chunk = await reader.read(min(size - got, 1 << 20))
+                            if not chunk:
+                                st.abort_put(keys)
+                                return P.pack_resp(P.INVALID_REQ)
+                            dst[got : got + len(chunk)] = chunk
+                            got += len(chunk)
             finally:
                 for key in keys:
                     e = st.pending.get(key)
@@ -475,8 +559,9 @@ class StoreServer:
             sizes = b"".join(P._U32.pack(size) for (_, _, size) in descs)
             writer.write(P.RESP.pack(P.FINISH, len(sizes) + total))
             writer.write(sizes)
-            for (pool_idx, offset, size) in _merge_desc_runs(descs):
-                writer.write(bytes(st.mm.view(pool_idx, offset, size)))
-                await writer.drain()
+            with tracing.span("store.pool_copy", bytes=total):
+                for (pool_idx, offset, size) in _merge_desc_runs(descs):
+                    writer.write(bytes(st.mm.view(pool_idx, offset, size)))
+                    await writer.drain()
             return None
         return P.pack_resp(P.INVALID_REQ)

@@ -737,6 +737,24 @@ class ServingServer:
             out["reason"] = self._degraded_reason
         return out
 
+    def debug_traces_json(self, limit: Optional[int] = None) -> str:
+        """The /debug/traces payload: the process trace ring, STITCHED
+        with the attached store's server-side span ring when the store
+        connection negotiated wire trace context (one Perfetto file shows
+        http.request → engine.step → kv.load_pages → [wire] →
+        store.GET_DESC → store.desc_build end to end, clock-skew
+        corrected).  Falls back to the local ring alone when there is no
+        stitchable store."""
+        from .utils import trace_stitch
+
+        conns = []
+        transfer = getattr(self.engine, "transfer", None)
+        if transfer is not None:
+            conns.append(transfer._src)
+        return trace_stitch.stitched_chrome_json(
+            tracing.TRACER, conns, limit=limit
+        )
+
     def metrics_text(self) -> str:
         """Prometheus exposition: this server's registry plus the
         process-global one (the client data plane's
@@ -1011,11 +1029,21 @@ def _make_handler(server: ServingServer):
                 # always 200 — the serving plane is up either way; the
                 # body says whether the cache tier behind it is
                 self._json(200, server.health())
-            elif self.path == "/debug/traces":
+            elif self.path.split("?", 1)[0] == "/debug/traces":
                 # recent completed request/step traces as Chrome trace-
-                # event JSON: save the body to a file and load it in
-                # Perfetto (https://ui.perfetto.dev) or chrome://tracing
-                data = tracing.TRACER.export_chrome_json().encode()
+                # event JSON — stitched with the attached store's server-
+                # side spans when trace context negotiated: save the body
+                # to a file and load it in Perfetto (ui.perfetto.dev) or
+                # chrome://tracing.  ?limit=N caps the local traces
+                # exported (ring capacity itself is ISTPU_TRACE_RING).
+                from urllib.parse import parse_qs, urlsplit
+
+                q = parse_qs(urlsplit(self.path).query)
+                try:
+                    limit = int(q["limit"][0])
+                except (KeyError, ValueError, IndexError):
+                    limit = None
+                data = server.debug_traces_json(limit=limit).encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(data)))

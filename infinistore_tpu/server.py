@@ -120,10 +120,46 @@ def _manage_handler(server_ref):
             return ("\n".join(lines) + "\n") if lines else ""
 
         def do_GET(self):
+            from urllib.parse import parse_qs, urlsplit
+
+            parts = urlsplit(self.path)
+            path, query = parts.path, parse_qs(parts.query)
+
+            def qint(name, default):
+                try:
+                    return int(query[name][0])
+                except (KeyError, ValueError, IndexError):
+                    return default
+
             store = server_ref().store if server_ref() else None
-            if self.path == "/selftest":
+            if path == "/selftest":
                 self._json({"status": "ok"})
-            elif self.path == "/healthz":
+            elif path == "/debug/cache":
+                # cache-efficiency report: top-N hot/cold keys, occupancy
+                # by age band, hit/miss/evict attribution (?n= sets N)
+                if store is None:
+                    self._json({"error": "no store"}, 503)
+                else:
+                    self._json(store.cache_report(top_n=qint("n", 10)))
+            elif path == "/debug/traces":
+                # the store's OWN completed-op traces (server clock) as
+                # Chrome trace JSON — the manage-plane view; wire clients
+                # get the raw ring via OP_TRACE_DUMP for stitching
+                srv = server_ref()
+                tracer = getattr(srv, "tracer", None)
+                if tracer is None:
+                    self._json({"error": "tracing requires the python "
+                                         "backend"}, 501)
+                else:
+                    limit = qint("limit", 0) or None
+                    body = tracer.export_chrome_json(tracer.recent(limit))
+                    data = body.encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+            elif path == "/healthz":
                 # liveness for probes/load-balancers (reference parity
                 # with InfiniStore's FastAPI manage plane), plus the
                 # degraded signal: armed fault rules / a failing evict
@@ -139,18 +175,18 @@ def _manage_handler(server_ref):
                 if srv is not None and hasattr(srv, "faults"):
                     payload["faults_armed"] = len(srv.faults.snapshot())
                 self._json(payload)
-            elif self.path == "/faults":
+            elif path == "/faults":
                 srv = server_ref()
                 if srv is None or not hasattr(srv, "faults"):
                     self._json({"error": "fault injection requires the "
                                          "python backend"}, 501)
                 else:
                     self._json({"rules": srv.faults.snapshot()})
-            elif self.path == "/kvmap_len":
+            elif path == "/kvmap_len":
                 self._json({"len": store.kvmap_len() if store else 0})
-            elif self.path == "/usage":
+            elif path == "/usage":
                 self._json({"usage": store.usage() if store else 0.0})
-            elif self.path == "/stats":
+            elif path == "/stats":
                 # the JSON stats view (server-level when available: adds
                 # the per-op latency section); /metrics is Prometheus now
                 srv = server_ref()
@@ -158,7 +194,7 @@ def _manage_handler(server_ref):
                     self._json(srv.stats_dict())
                 else:
                     self._json(store.stats_dict() if store else {})
-            elif self.path in ("/metrics", "/metrics.prom"):
+            elif path in ("/metrics", "/metrics.prom"):
                 # /metrics.prom predates the unified plane; kept as alias
                 self._prom(self._metrics_text())
             else:

@@ -59,6 +59,13 @@ class Entry:
     # busy: an op is actively streaming payload into this pending region;
     # purge/realloc must not free the blocks out from under it
     busy: bool = False
+    # cache-efficiency attribution (docs/observability.md): commit stamp,
+    # last read stamp, and read count — together they answer "is the
+    # store tier earning its keep" (reuse distance, eviction age,
+    # dead-on-arrival) without a second bookkeeping structure
+    created: float = 0.0
+    last_access: float = 0.0
+    hits: int = 0
 
 
 @dataclass
@@ -73,6 +80,49 @@ class Stats:
     spilled: int = 0    # DRAM -> disk tier
     promoted: int = 0   # disk tier -> DRAM
     contig_batches: int = 0  # batch allocs served as one contiguous run
+
+
+class CacheAnalytics:
+    """Hit/miss/evict attribution for the cache-efficiency plane.
+
+    The store calls the ``on_*`` hooks from its op paths; the serving
+    layer (``pyserver.StoreServer``) wires ``reuse_sink`` /
+    ``evict_age_sink`` to registry histograms
+    (``istpu_cache_reuse_distance_seconds`` /
+    ``istpu_cache_evicted_age_seconds``) so a scrape sees the
+    distributions, and ``dead_on_arrival`` backs
+    ``istpu_cache_dead_on_arrival_total`` — entries evicted having never
+    been read, i.e. store writes that bought nothing.  Plain attributes,
+    no lock: the store is single-threaded (the asyncio loop) and the
+    exposition reads are snapshot-tolerant counters."""
+
+    def __init__(self):
+        self.dead_on_arrival = 0
+        self.evicted_read = 0     # evicted entries that HAD been read
+        self.reuse_count = 0
+        self.reuse_total_s = 0.0
+        self.reuse_sink = None       # callable(seconds) or None
+        self.evict_age_sink = None   # callable(seconds) or None
+
+    def on_hit(self, reuse_s: float) -> None:
+        self.reuse_count += 1
+        self.reuse_total_s += reuse_s
+        if self.reuse_sink is not None:
+            self.reuse_sink(reuse_s)
+
+    def on_evict(self, age_s: float, never_read: bool) -> None:
+        if never_read:
+            self.dead_on_arrival += 1
+        else:
+            self.evicted_read += 1
+        if self.evict_age_sink is not None:
+            self.evict_age_sink(age_s)
+
+
+# /debug/cache occupancy bands: "how much of the pool is held by entries
+# this cold" — upper bounds in seconds since last access
+AGE_BANDS = ((1.0, "<1s"), (10.0, "<10s"), (60.0, "<1m"),
+             (600.0, "<10m"), (float("inf"), ">=10m"))
 
 
 class DiskTier:
@@ -229,6 +279,11 @@ class Store:
         # may still be memcpying from them)
         self._deferred: List[Tuple[float, Entry]] = []
         self.stats = Stats()
+        # injectable clock: leases, reuse distances, and eviction ages all
+        # read it, so tests can drive deterministic timelines without
+        # monkeypatching the global time module
+        self._clock = time.monotonic
+        self.analytics = CacheAnalytics()
         # second tier: LRU-evicted entries spill here and promote back on
         # access ("Historical KVCache in DRAM and SSD")
         self.disk: Optional[DiskTier] = None
@@ -272,7 +327,7 @@ class Store:
         are skipped by the evictor and their frees deferred — the exact
         state behind PR 1's 'back-to-back runs fragment allocation' bench
         trap, now observable."""
-        now = time.monotonic()
+        now = self._clock()
         return sum(1 for e in self.kv.values() if e.lease > now)
 
     def kvmap_len(self) -> int:
@@ -282,9 +337,9 @@ class Store:
 
     def evict(self, min_threshold: float, max_threshold: float) -> int:
         evicted = 0
-        self._reap_deferred(time.monotonic())
+        self._reap_deferred(self._clock())
         if self.mm.usage() >= max_threshold:
-            now = time.monotonic()
+            now = self._clock()
             skipped = []
             while self.mm.usage() >= min_threshold and self.kv:
                 key, e = next(iter(self.kv.items()))
@@ -296,6 +351,9 @@ class Store:
                         break
                     continue
                 del self.kv[key]
+                self.analytics.on_evict(
+                    now - (e.last_access or now), e.hits == 0
+                )
                 if self.disk is not None:
                     # spill before the blocks are reused: the entry is not
                     # leased (checked above), so the bytes are stable
@@ -323,7 +381,7 @@ class Store:
         own entries — instead of answering OUT_OF_MEMORY while evictable
         data sits in the way.  Leased entries are skipped; spill-to-disk
         semantics match evict()."""
-        now = time.monotonic()
+        now = self._clock()
         evicted = 0
         skipped = 0
         while evicted < n and self.kv and skipped < len(self.kv):
@@ -333,6 +391,7 @@ class Store:
                 skipped += 1
                 continue
             del self.kv[key]
+            self.analytics.on_evict(now - (e.last_access or now), e.hits == 0)
             if self.disk is not None:
                 if self.disk.put(
                     key, self.mm.view(e.pool_idx, e.offset, e.size)
@@ -425,10 +484,20 @@ class Store:
             self.stats.misses += 1
             return None
         self._touch(key)
+        self._record_hit(e)
         self.stats.gets += 1
         self.stats.hits += 1
         self.stats.bytes_out += e.size
         return self.mm.view(e.pool_idx, e.offset, e.size)
+
+    def _record_hit(self, e: Entry) -> None:
+        """Reuse-distance attribution: seconds since this entry was last
+        touched (commit counts as touch zero, so the first read measures
+        commit -> read)."""
+        now = self._clock()
+        self.analytics.on_hit(now - (e.last_access or now))
+        e.last_access = now
+        e.hits += 1
 
     def alloc_put(self, keys: Sequence[bytes], block_size: int):
         """Batched allocate for zero-copy writes.  Returns (status, descs)."""
@@ -471,11 +540,13 @@ class Store:
         return status, committed
 
     def _insert_committed(self, key: bytes, e: Entry) -> None:
+        now = self._clock()
+        e.created = e.last_access = now  # touch zero for reuse distances
         old = self.kv.pop(key, None)
         if old is not None:
             # overwrite: an shm reader may hold a live lease on the old
             # region; defer the free just like delete/purge do
-            self._free_or_defer(old, time.monotonic())
+            self._free_or_defer(old, now)
         if self.disk is not None:
             # a fresh commit supersedes any spilled copy (stale data must
             # never promote back over it)
@@ -489,7 +560,7 @@ class Store:
         which can evict — leasing each key the moment it checks out keeps
         the evictor's hands off earlier keys of the SAME batch, so the
         descriptors built in pass 2 can never go stale mid-request."""
-        now = time.monotonic()
+        now = self._clock()
         for key in keys:
             e = self.kv.get(key)
             if e is None:
@@ -506,6 +577,7 @@ class Store:
         for key in keys:
             e = self.kv[key]
             self._touch(key)
+            self._record_hit(e)
             self.stats.gets += 1
             self.stats.hits += 1
             self.stats.bytes_out += e.size
@@ -533,7 +605,7 @@ class Store:
 
     def delete_keys(self, keys: Sequence[bytes]) -> int:
         count = 0
-        now = time.monotonic()
+        now = self._clock()
         self._reap_deferred(now)
         for key in keys:
             e = self.kv.pop(key, None)
@@ -546,7 +618,7 @@ class Store:
 
     def purge(self) -> int:
         n = len(self.kv)
-        now = time.monotonic()
+        now = self._clock()
         self._reap_deferred(now)
         for e in self.kv.values():
             self._free_or_defer(e, now)
@@ -572,6 +644,52 @@ class Store:
         "free_bytes", "largest_free_run_bytes", "free_runs",
     })
 
+    def cache_report(self, top_n: int = 10) -> dict:
+        """The /debug/cache payload: hottest / coldest committed keys,
+        occupancy by age band (seconds since last access), and the
+        lifetime hit/miss/eviction attribution.  Built on demand by
+        iterating the kv map — a debug endpoint, not a data-path cost."""
+        now = self._clock()
+        a = self.analytics
+        entries = [(k, e) for k, e in self.kv.items()]
+        bands = {label: {"entries": 0, "bytes": 0} for _, label in AGE_BANDS}
+        for _k, e in entries:
+            age = now - (e.last_access or now)
+            for bound, label in AGE_BANDS:
+                if age < bound or bound == float("inf"):
+                    bands[label]["entries"] += 1
+                    bands[label]["bytes"] += e.size
+                    break
+
+        def rec(k: bytes, e: Entry) -> dict:
+            return {
+                "key": k.decode(errors="replace"),
+                "hits": e.hits,
+                "size": e.size,
+                "age_s": round(now - (e.last_access or now), 3),
+                "since_commit_s": round(now - (e.created or now), 3),
+            }
+
+        hot = sorted(entries, key=lambda kv: kv[1].hits, reverse=True)
+        cold = sorted(entries, key=lambda kv: kv[1].last_access or 0.0)
+        gets = self.stats.hits + self.stats.misses
+        return {
+            "entries": len(self.kv),
+            "bytes": sum(e.size for _k, e in entries),
+            "usage": self.mm.usage(),
+            "hits": self.stats.hits,
+            "misses": self.stats.misses,
+            "hit_ratio": round(self.stats.hits / gets, 4) if gets else 0.0,
+            "evicted": self.stats.evicted,
+            "dead_on_arrival": a.dead_on_arrival,
+            "evicted_read": a.evicted_read,
+            "mean_reuse_s": (round(a.reuse_total_s / a.reuse_count, 4)
+                             if a.reuse_count else 0.0),
+            "hot": [rec(k, e) for k, e in hot[:top_n]],
+            "cold": [rec(k, e) for k, e in cold[:top_n]],
+            "age_bands": bands,
+        }
+
     def stats_dict(self) -> dict:
         s = self.stats
         d = {
@@ -590,6 +708,7 @@ class Store:
             "contig_batches": s.contig_batches,
             "active_read_leases": self.active_leases(),
             "deferred_frees": len(self._deferred),
+            "dead_on_arrival": self.analytics.dead_on_arrival,
         }
         d.update(self.mm.frag_stats())
         if self.disk is not None:

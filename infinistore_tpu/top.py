@@ -1,0 +1,307 @@
+"""``istpu-top``: a live terminal console over the observability plane.
+
+    python -m infinistore_tpu.top --serve-url http://127.0.0.1:8000 \
+        --store-url http://127.0.0.1:18080 --interval 1
+
+Polls the serving front-end's ``/metrics`` + ``/healthz`` and the store
+manage plane's ``/metrics`` + ``/debug/cache`` + ``/healthz`` and renders
+one screen per interval: pool occupancy, hit ratio, prefix-reuse token
+split, circuit/degraded state, op-latency sparklines (per-interval mean
+from histogram ``_sum``/``_count`` deltas — the same derivative a
+``rate()`` query takes), and the hottest/coldest cache keys.  Either URL
+may be omitted; the console shows whatever half of the stack it can
+reach.  Plain ANSI (no curses): works over ssh, in tmux, and in CI logs
+(``--once`` renders a single frame without clearing the screen).
+
+Rendering is pure (``Console.frame(snapshot) -> str``) so tests can feed
+synthetic scrapes without sockets.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.request
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+from .utils.metrics import parse_prometheus_text
+
+SPARK = "▁▂▃▄▅▆▇█"
+BAR = "█"
+
+
+def sparkline(values: List[float], width: int = 24) -> str:
+    """Last ``width`` values as a unicode sparkline, scaled to their max."""
+    vals = [v for v in values][-width:]
+    if not vals:
+        return "·" * width
+    top = max(vals) or 1.0
+    line = "".join(
+        SPARK[min(len(SPARK) - 1, int(v / top * (len(SPARK) - 1) + 0.5))]
+        for v in vals
+    )
+    return line.rjust(width, "·")
+
+
+def bar(frac: float, width: int = 24) -> str:
+    frac = min(1.0, max(0.0, frac))
+    n = int(frac * width + 0.5)
+    return BAR * n + "·" * (width - n)
+
+
+def fmt_dur(seconds: Optional[float]) -> str:
+    if seconds is None:
+        return "    -"
+    if seconds < 1e-3:
+        return f"{seconds * 1e6:4.0f}µ"
+    if seconds < 1.0:
+        return f"{seconds * 1e3:4.1f}m"
+    return f"{seconds:4.1f}s"
+
+
+class Snapshot:
+    """One poll's worth of parsed state (any source may be None)."""
+
+    def __init__(self, serve_metrics: Optional[dict] = None,
+                 store_metrics: Optional[dict] = None,
+                 cache: Optional[dict] = None,
+                 serve_health: Optional[dict] = None,
+                 store_health: Optional[dict] = None):
+        self.serve = serve_metrics or {}
+        self.store = store_metrics or {}
+        self.cache = cache
+        self.serve_health = serve_health
+        self.store_health = store_health
+
+    def value(self, name: str, labels: Tuple[Tuple[str, str], ...] = (),
+              default: Optional[float] = None) -> Optional[float]:
+        key = (name, tuple(sorted(labels)))
+        if key in self.serve:
+            return self.serve[key]
+        return self.store.get(key, default)
+
+
+class _HistRate:
+    """Per-interval mean latency of one histogram series from consecutive
+    ``_sum``/``_count`` samples (None while the series is idle)."""
+
+    def __init__(self):
+        self.prev: Optional[Tuple[float, float]] = None
+
+    def update(self, total: Optional[float],
+               count: Optional[float]) -> Optional[float]:
+        if total is None or count is None:
+            return None
+        prev, self.prev = self.prev, (total, count)
+        if prev is None:
+            return None
+        dt, dc = total - prev[0], count - prev[1]
+        if dc <= 0:
+            return None
+        return dt / dc
+
+
+# the latency rows the console tracks: (label, family, label items)
+LATENCY_ROWS = (
+    ("prefill", "istpu_serve_prefill_seconds", ()),
+    ("decode step", "istpu_serve_decode_step_seconds", ()),
+    ("queue wait", "istpu_serve_queue_wait_seconds", ()),
+    ("put (client)", "istpu_client_op_seconds", (("op", "write_cache"),)),
+    ("get (client)", "istpu_client_op_seconds", (("op", "read_cache"),)),
+    ("GET_DESC (srv)", "istpu_store_op_seconds", (("op", "GET_DESC"),)),
+    ("ALLOC_PUT (srv)", "istpu_store_op_seconds", (("op", "ALLOC_PUT"),)),
+)
+
+_CIRCUIT = {0: "closed", 1: "OPEN", 2: "half-open"}
+
+
+class Console:
+    """Holds the sparkline history between frames; ``frame`` is pure in
+    the snapshot (no IO, no globals) so it is directly testable."""
+
+    def __init__(self, history: int = 48):
+        self.hist: Dict[str, deque] = {}
+        self.rates: Dict[str, _HistRate] = {}
+        self.history = history
+
+    def _series(self, key: str) -> deque:
+        return self.hist.setdefault(key, deque(maxlen=self.history))
+
+    def _lat(self, snap: Snapshot, key: str, family: str,
+             labels: Tuple[Tuple[str, str], ...]) -> Optional[float]:
+        tracker = self.rates.setdefault(key, _HistRate())
+        mean = tracker.update(
+            snap.value(f"{family}_sum", labels),
+            snap.value(f"{family}_count", labels),
+        )
+        if mean is not None:
+            self._series(key).append(mean)
+        return mean
+
+    def frame(self, snap: Snapshot) -> str:
+        out: List[str] = []
+        w = 24
+        # -- header: health / circuit / degraded --
+        circuit = snap.value("istpu_store_circuit_state",
+                             (("name", "store"),))
+        circuit_s = _CIRCUIT.get(int(circuit), "?") if circuit is not None \
+            else "-"
+        sh = (snap.serve_health or {}).get("status", "-")
+        th = (snap.store_health or {}).get("status", "-")
+        out.append(
+            f"istpu-top   serve:{sh:9s} store:{th:9s} circuit:{circuit_s}"
+        )
+        out.append("")
+        # -- store occupancy / cache efficiency --
+        usage = snap.value("istpu_store_pool_usage")
+        frag = snap.value("istpu_store_fragmentation")
+        if usage is not None:
+            out.append(f"pool occupancy  [{bar(usage, w)}] {usage:6.1%}"
+                       + (f"   frag {frag:.2f}" if frag is not None else ""))
+        cache = snap.cache or {}
+        hits = cache.get("hits", snap.value("infinistore_tpu_hits"))
+        misses = cache.get("misses", snap.value("infinistore_tpu_misses"))
+        if hits is not None and misses is not None:
+            total = hits + misses
+            ratio = hits / total if total else 0.0
+            self._series("hit_ratio").append(ratio)
+            out.append(
+                f"hit ratio       [{bar(ratio, w)}] {ratio:6.1%}   "
+                f"{sparkline(list(self._series('hit_ratio')), 16)}"
+            )
+        doa = cache.get("dead_on_arrival",
+                        snap.value("istpu_cache_dead_on_arrival_total"))
+        evicted = cache.get("evicted", snap.value("istpu_store_evicted_total"))
+        entries = cache.get("entries", snap.value("istpu_store_kvmap_len"))
+        if entries is not None:
+            out.append(
+                f"entries {int(entries):>8}   evicted {int(evicted or 0):>8}"
+                f"   dead-on-arrival {int(doa or 0):>6}   "
+                f"mean reuse {cache.get('mean_reuse_s', 0.0):>7.2f}s"
+            )
+        # -- prefix-reuse provenance (engine admission) --
+        prov = {
+            src: snap.value("istpu_engine_prefix_tokens_total",
+                            (("source", src),)) or 0.0
+            for src in ("local", "store", "computed")
+        }
+        total_tok = sum(prov.values())
+        if total_tok:
+            out.append(
+                "prompt tokens   local {:5.1%}  store {:5.1%}  "
+                "computed {:5.1%}".format(
+                    prov["local"] / total_tok, prov["store"] / total_tok,
+                    prov["computed"] / total_tok,
+                )
+            )
+        # -- serving counters --
+        reqs = snap.value("istpu_serve_requests_total")
+        if reqs is not None:
+            comp = snap.value("istpu_serve_completed_total") or 0
+            toks = snap.value("istpu_serve_tokens_total") or 0
+            pages = snap.value("istpu_serve_free_kv_pages")
+            out.append(
+                f"requests {int(reqs):>7}   completed {int(comp):>7}   "
+                f"tokens {int(toks):>9}"
+                + (f"   free pages {int(pages):>6}"
+                   if pages is not None else "")
+            )
+        # -- latency sparklines --
+        out.append("")
+        out.append(f"{'op latency (interval mean)':28s} {'now':>6s}  trend")
+        for label, family, labels in LATENCY_ROWS:
+            mean = self._lat(snap, label, family, labels)
+            series = list(self.hist.get(label, ()))
+            if mean is None and not series:
+                continue
+            out.append(
+                f"  {label:26s} {fmt_dur(mean):>6s}  "
+                f"{sparkline(series, 24)}"
+            )
+        # -- hot/cold keys --
+        if cache.get("hot"):
+            out.append("")
+            out.append("hot keys (hits · age)          cold keys (age)")
+            cold = cache.get("cold", [])
+            for i in range(min(5, max(len(cache["hot"]), len(cold)))):
+                left = right = ""
+                if i < len(cache["hot"]):
+                    h = cache["hot"][i]
+                    left = f"{h['key'][:16]:16s} {h['hits']:>4}·{h['age_s']:>6.1f}s"
+                if i < len(cold):
+                    c = cold[i]
+                    right = f"{c['key'][:16]:16s} {c['age_s']:>7.1f}s"
+                out.append(f"  {left:30s} {right}")
+            bands = cache.get("age_bands") or {}
+            if bands:
+                out.append("  occupancy by age: " + "  ".join(
+                    f"{label}:{rec['entries']}" for label, rec in bands.items()
+                ))
+        return "\n".join(out) + "\n"
+
+
+def _fetch(url: str, timeout: float = 5.0) -> Optional[bytes]:
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.read()
+    except Exception:  # noqa: BLE001 — an unreachable half renders as "-"
+        return None
+
+
+def poll(serve_url: Optional[str], store_url: Optional[str]) -> Snapshot:
+    def prom(base, path):
+        raw = _fetch(base + path) if base else None
+        return parse_prometheus_text(raw.decode()) if raw else None
+
+    def js(base, path):
+        raw = _fetch(base + path) if base else None
+        if raw is None:
+            return None
+        try:
+            return json.loads(raw)
+        except ValueError:
+            return None
+
+    return Snapshot(
+        serve_metrics=prom(serve_url, "/metrics"),
+        store_metrics=prom(store_url, "/metrics"),
+        cache=js(store_url, "/debug/cache"),
+        serve_health=js(serve_url, "/healthz"),
+        store_health=js(store_url, "/healthz"),
+    )
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        "istpu-top", description="live console over the serving front-end "
+        "and store manage plane")
+    ap.add_argument("--serve-url", default=None,
+                    help="serving front-end base URL (http://host:8000)")
+    ap.add_argument("--store-url", default=None,
+                    help="store manage-plane base URL (http://host:18080)")
+    ap.add_argument("--interval", type=float, default=1.0)
+    ap.add_argument("--once", action="store_true",
+                    help="render one frame and exit (no screen clearing)")
+    args = ap.parse_args(argv)
+    if not args.serve_url and not args.store_url:
+        ap.error("need --serve-url and/or --store-url")
+    console = Console()
+    try:
+        while True:
+            snap = poll(args.serve_url, args.store_url)
+            text = console.frame(snap)
+            if args.once:
+                sys.stdout.write(text)
+                return 0
+            sys.stdout.write("\x1b[2J\x1b[H" + text)
+            sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

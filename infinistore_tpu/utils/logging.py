@@ -1,17 +1,51 @@
-"""Leveled logger (reference parity: infinistore/lib.py:155-175, src/log.h)."""
+"""Leveled logger (reference parity: infinistore/lib.py:155-175, src/log.h).
+
+Structured trace correlation: every record carries the ACTIVE trace id
+(``record.trace_id``, injected by a filter reading the tracing
+contextvar), and the default formatter appends ``trace_id=...`` whenever
+one is bound — so a WARNING/ERROR line emitted inside a request (client
+data plane, serving handlers, pyserver dispatch: they all log through the
+one ``infinistore_tpu`` logger) can be joined against the trace ring /
+a stitched Perfetto export without guessing by timestamp.
+"""
 
 from __future__ import annotations
 
 import logging
 import sys
 
+
+class TraceContextFilter(logging.Filter):
+    """Stamps ``record.trace_id`` from the active trace (``"-"`` when no
+    trace is bound — the attribute must always exist so user-supplied
+    ``%(trace_id)s`` format strings never KeyError)."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        from . import tracing  # late: logging must import before tracing
+
+        record.trace_id = tracing.current_trace_id() or "-"
+        return True
+
+
+class _TraceFormatter(logging.Formatter):
+    """The default format plus a ``trace_id=`` suffix when one is bound."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        s = super().format(record)
+        tid = getattr(record, "trace_id", "-")
+        if tid and tid != "-":
+            s += f" trace_id={tid}"
+        return s
+
+
 _logger = logging.getLogger("infinistore_tpu")
 if not _logger.handlers:
     _h = logging.StreamHandler(sys.stderr)
     _h.setFormatter(
-        logging.Formatter("[%(asctime)s] [%(levelname)s] %(message)s", "%H:%M:%S")
+        _TraceFormatter("[%(asctime)s] [%(levelname)s] %(message)s", "%H:%M:%S")
     )
     _logger.addHandler(_h)
+    _logger.addFilter(TraceContextFilter())
     _logger.setLevel(logging.WARNING)
     _logger.propagate = False
 

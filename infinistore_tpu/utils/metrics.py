@@ -44,6 +44,12 @@ def nearest_rank(samples: Sequence[float], q: float) -> float:
 # whole long-prompt request in the same schema
 DEFAULT_BUCKETS: Tuple[float, ...] = tuple(2e-05 * 4 ** i for i in range(12))
 
+# age/reuse bounds (seconds): 10 log-spaced buckets, x4 apart, 50 ms ..
+# ~3.6 h — cache reuse distances and eviction ages live on a much slower
+# clock than op latencies (a prefix re-read minutes later is the normal
+# case the store tier exists for)
+AGE_BUCKETS: Tuple[float, ...] = tuple(0.05 * 4 ** i for i in range(10))
+
 
 def _escape_label(v: str) -> str:
     return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")

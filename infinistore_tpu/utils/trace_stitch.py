@@ -1,0 +1,117 @@
+"""Cross-process trace stitching: merge the client's span ring with a
+store server's span ring into ONE Perfetto-loadable Chrome trace.
+
+The two halves record on different clocks (each process's
+``perf_counter``).  The client estimated the offset between them at HELLO
+(``Connection.clock_offset``: server clock minus client clock, round-trip
+midpoint estimate, error bounded by half the HELLO RTT), so server span
+stamps map into the client timeline as ``t_client = t_server - offset``.
+Server events keep their own ``pid`` row in the export, which is what
+makes the wire hop visible in Perfetto: the client's
+``read_cache.desc`` span on one process track, the server's
+``store.GET_DESC`` → ``store.desc_build`` spans nested inside the same
+wall-clock window on the other, every event tagged with the shared
+``args.trace_id``.
+
+Used by ``serve.py /debug/traces`` (stitches the attached store in when
+trace context negotiated) and directly by tests/tools via
+``gather_remote`` + ``stitch_chrome``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+def gather_remote(conn) -> Optional[Tuple[dict, float]]:
+    """Fetch a server's span ring over the wire (``OP_TRACE_DUMP``).
+
+    ``conn`` may be the public ``InfinityConnection`` wrapper or the raw
+    wire ``Connection``.  Returns ``(dump, clock_offset)`` or None when
+    the peer never negotiated trace context (old server, native client,
+    ``ISTPU_TRACE_CTX=0``) or the dump fails — stitching is best-effort
+    observability, never a request-path error.
+    """
+    raw = getattr(conn, "conn", conn)
+    raw = getattr(raw, "conn", raw)  # InfinityConnection -> Connection
+    if not getattr(raw, "trace_ctx", False):
+        return None
+    dump_fn = getattr(raw, "trace_dump", None)
+    if dump_fn is None:
+        return None
+    try:
+        dump = dump_fn()
+    except Exception:  # noqa: BLE001 — a dead store must not break /debug
+        return None
+    return dump, float(getattr(raw, "clock_offset", 0.0) or 0.0)
+
+
+def stitch_chrome(tracer, remotes: Sequence[Tuple[dict, float]] = (),
+                  limit: Optional[int] = None) -> dict:
+    """One Chrome trace-event dict from the local ``tracer``'s ring plus
+    any number of remote ``(dump, clock_offset)`` pairs, all on the local
+    timeline (``ts`` relative to the earliest exported span)."""
+    # rows: (name, t0, t1, thread key, pid, trace_id, args) in LOCAL time
+    rows: List[tuple] = []
+    pid = os.getpid()
+    for tr in tracer.recent(limit):
+        with tr._lock:
+            evs = list(tr.events)
+        for name, t0, t1, tident, args in evs:
+            rows.append((name, t0, t1, (pid, tident), pid, tr.trace_id, args))
+    for dump, offset in remotes:
+        rpid = int(dump.get("pid", 0))
+        for tr in dump.get("traces", []):
+            trace_id = tr.get("trace_id")
+            for name, t0, t1, tident, args in tr.get("events", []):
+                rows.append((name, t0 - offset, t1 - offset,
+                             (rpid, tident), rpid, trace_id, args))
+    if not rows:
+        return {"traceEvents": [], "displayTimeUnit": "ms"}
+    base = min(r[1] for r in rows)
+    tids: Dict[tuple, int] = {}
+    events: List[dict] = []
+    for name, t0, t1, tkey, epid, trace_id, args in rows:
+        tid = tids.setdefault(tkey, len(tids) + 1)
+        events.append({
+            "name": name,
+            "cat": "istpu",
+            "ph": "X",
+            "ts": (t0 - base) * 1e6,
+            "dur": max(0.0, (t1 - t0) * 1e6),
+            "pid": epid,
+            "tid": tid,
+            "args": {"trace_id": trace_id, **(args or {})},
+        })
+    # outer-before-inner so equal-start parents precede their children
+    # (Perfetto nests by containment per track)
+    events.sort(key=lambda e: (e["ts"], -e["dur"]))
+    seen_pids = set()
+    for (tpid, tident), tid in tids.items():
+        role = "store-server" if tpid != pid else "thread"
+        events.append({
+            "name": "thread_name", "ph": "M", "pid": tpid, "tid": tid,
+            "args": {"name": f"{role}-{tident}"},
+        })
+        if tpid not in seen_pids:
+            seen_pids.add(tpid)
+            events.append({
+                "name": "process_name", "ph": "M", "pid": tpid, "tid": 0,
+                "args": {"name": ("store-server" if tpid != pid
+                                  else "client")},
+            })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def stitched_chrome_json(tracer, conns: Sequence = (),
+                         limit: Optional[int] = None) -> str:
+    """JSON convenience used by the serving ``/debug/traces`` endpoint:
+    gather every stitchable peer in ``conns``, merge, dump."""
+    remotes = []
+    for conn in conns:
+        got = gather_remote(conn)
+        if got is not None:
+            remotes.append(got)
+    return json.dumps(stitch_chrome(tracer, remotes, limit=limit))

@@ -32,13 +32,36 @@ import time
 from collections import deque
 from typing import Dict, List, Optional
 
-TRACE_RING = 64           # completed traces kept for /debug/traces
+TRACE_RING_DEFAULT = 64   # completed traces kept for /debug/traces
 MAX_EVENTS_PER_TRACE = 4096  # a runaway loop must not grow one trace forever
+
+
+def _ring_size() -> int:
+    """Completed-trace ring capacity: ``ISTPU_TRACE_RING`` overrides the
+    default 64 (read per Tracer so tests can vary it; the process-global
+    TRACER picks it up at import)."""
+    try:
+        n = int(os.environ.get("ISTPU_TRACE_RING", TRACE_RING_DEFAULT))
+    except ValueError:
+        return TRACE_RING_DEFAULT
+    return max(1, n)
+
+
+TRACE_RING = _ring_size()  # back-compat name: the global TRACER's capacity
 
 _CURRENT: contextvars.ContextVar[Optional["Trace"]] = contextvars.ContextVar(
     "istpu_trace", default=None
 )
 _ids = itertools.count(1)
+
+# traces pushed out of ANY ring by overflow, process-wide (fn-backed
+# counter on the default registry; serving /metrics picks it up)
+_ring_dropped = 0
+
+
+def _count_ring_dropped() -> None:
+    global _ring_dropped
+    _ring_dropped += 1
 
 
 class Trace:
@@ -49,8 +72,11 @@ class Trace:
     __slots__ = ("trace_id", "name", "args", "t_start", "t_end",
                  "events", "_lock", "dropped")
 
-    def __init__(self, name: str, args: Dict):
-        self.trace_id = f"{os.getpid():x}-{next(_ids):x}"
+    def __init__(self, name: str, args: Dict, trace_id: Optional[str] = None):
+        # a caller-supplied id CONTINUES a trace opened in another process
+        # (the wire trace-context path: pyserver records its op spans
+        # under the client's id so the stitcher can merge the two rings)
+        self.trace_id = trace_id or f"{os.getpid():x}-{next(_ids):x}"
         self.name = name
         self.args = args
         self.t_start = time.perf_counter()
@@ -74,22 +100,25 @@ class Trace:
 class Tracer:
     """Owns the ring of completed traces and the context binding."""
 
-    def __init__(self, ring: int = TRACE_RING):
+    def __init__(self, ring: Optional[int] = None):
         self._lock = threading.Lock()
-        self._done: deque = deque(maxlen=ring)
+        self._done: deque = deque(maxlen=ring or _ring_size())
+        self.dropped = 0  # completed traces pushed out by ring overflow
 
     # -- recording --
 
     @contextlib.contextmanager
-    def trace(self, name: str, **args):
+    def trace(self, name: str, trace_id: Optional[str] = None, **args):
         """Open a request-scoped root span.  Nested calls degrade to plain
-        spans inside the enclosing trace (one request = one trace)."""
+        spans inside the enclosing trace (one request = one trace).
+        ``trace_id`` forces the id — the server half of wire trace-context
+        propagation continues the CALLER's trace this way."""
         parent = _CURRENT.get()
         if parent is not None:
             with self.span(name, **args):
                 yield parent
             return
-        tr = Trace(name, args)
+        tr = Trace(name, args, trace_id=trace_id)
         token = _CURRENT.set(tr)
         t0 = time.perf_counter()
         try:
@@ -100,6 +129,9 @@ class Tracer:
             tr.add(name, t0, t1, args)
             tr.t_end = t1
             with self._lock:
+                if len(self._done) == self._done.maxlen:
+                    self.dropped += 1
+                    _count_ring_dropped()
                 self._done.append(tr)
 
     @contextlib.contextmanager
@@ -143,9 +175,28 @@ class Tracer:
 
     # -- export --
 
-    def recent(self) -> List[Trace]:
+    def recent(self, limit: Optional[int] = None) -> List[Trace]:
+        """Newest completed traces (all by default, the last ``limit``
+        otherwise — the /debug/traces page size)."""
         with self._lock:
-            return list(self._done)
+            traces = list(self._done)
+        return traces[-limit:] if limit else traces
+
+    def dump(self, limit: Optional[int] = None) -> dict:
+        """JSON-able snapshot of the ring with RAW ``perf_counter`` stamps
+        (this process's clock).  The wire shape behind ``OP_TRACE_DUMP``:
+        the stitcher maps these stamps into the caller's timebase using
+        the HELLO-derived clock offset.  ``clock`` is *now* on the same
+        clock, so a receiver can sanity-check the offset."""
+        out = []
+        for tr in self.recent(limit):
+            with tr._lock:
+                evs = [[n, t0, t1, tid, a] for (n, t0, t1, tid, a)
+                       in tr.events]
+            out.append({"trace_id": tr.trace_id, "name": tr.name,
+                        "events": evs})
+        return {"pid": os.getpid(), "clock": time.perf_counter(),
+                "dropped": self.dropped, "traces": out}
 
     def export_chrome(self, traces: Optional[List[Trace]] = None) -> dict:
         """Chrome trace-event JSON for ``traces`` (default: the ring).
@@ -192,6 +243,18 @@ class Tracer:
 
 
 TRACER = Tracer()
+
+# fn-backed so the scrape always reads the live process-wide total; lazy
+# import keeps tracing importable before the metrics module (no cycle —
+# metrics has no internal imports — but the late bind costs nothing)
+from . import metrics as _metrics  # noqa: E402
+
+_metrics.default_registry().counter(
+    "istpu_trace_ring_dropped_total",
+    "Completed traces pushed out of a trace ring by overflow "
+    "(raise ISTPU_TRACE_RING if this climbs during an investigation)",
+    fn=lambda: _ring_dropped,
+)
 
 
 def trace(name: str, **args):

@@ -1,0 +1,99 @@
+#!/usr/bin/env python3
+"""Metrics ↔ docs drift lint.
+
+Every ``istpu_*`` metric family registered anywhere in the
+``infinistore_tpu`` package must appear in ``docs/observability.md``, and
+every ``istpu_*`` family the docs mention must actually be registered —
+an inventory that silently rots is worse than none, because operators
+build alerts from it.  Fails the build (exit 1) on drift in either
+direction.
+
+Static scan on purpose: registrations are string literals passed to
+``.counter(`` / ``.gauge(`` / ``.histogram(``, so no servers (or shm
+pools) need to be built to enumerate them.  Docs-side tokens support
+``{a,b}`` brace expansion (``istpu_serve_{queue_wait,prefill}_p{50,99}_ms``)
+and the ``_bucket`` / ``_sum`` / ``_count`` histogram suffixes used in
+example queries.
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+PKG = REPO / "infinistore_tpu"
+DOCS = REPO / "docs" / "observability.md"
+
+_REG = re.compile(
+    r"\.(?:counter|gauge|histogram)\(\s*[\"'](istpu_[a-z0-9_]+)[\"']"
+)
+# a docs token: istpu_ then runs of name chars and/or {a,b} expansion
+# groups (label braces like {op="..."} contain '=' / '"' and do not match
+# the group alternative, so they terminate the token — as they should)
+_DOC_TOKEN = re.compile(r"istpu_(?:[a-z0-9_]+|\{[a-z0-9_,]+\})+")
+_HIST_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def registered_families() -> set:
+    names = set()
+    for path in PKG.rglob("*.py"):
+        names.update(_REG.findall(path.read_text()))
+    return names
+
+
+def _expand(token: str) -> set:
+    m = re.search(r"\{([a-z0-9_,]+)\}", token)
+    if m is None:
+        return {token}
+    out = set()
+    for alt in m.group(1).split(","):
+        out |= _expand(token[: m.start()] + alt + token[m.end():])
+    return out
+
+
+def documented_families(text: str, registered: set) -> set:
+    names = set()
+    for token in _DOC_TOKEN.findall(text):
+        # a TRAILING brace group is a Prometheus label annotation
+        # (`istpu_spec_kind{kind}`), not an expansion — labels always
+        # follow the complete family name.  Inner groups
+        # (`istpu_serve_{queue_wait,prefill}_p50_ms`) are expansions.
+        token = re.sub(r"\{[a-z0-9_,]+\}$", "", token)
+        if token.endswith("_"):
+            continue  # wildcard prose like `istpu_cache_*`
+        for name in _expand(token):
+            # example PromQL uses derived series names; fold them back
+            # onto their family when (and only when) the family exists
+            for sfx in _HIST_SUFFIXES:
+                if name.endswith(sfx) and name[: -len(sfx)] in registered:
+                    name = name[: -len(sfx)]
+                    break
+            names.add(name)
+    return names
+
+
+def main() -> int:
+    registered = registered_families()
+    documented = documented_families(DOCS.read_text(), registered)
+    undocumented = sorted(registered - documented)
+    unregistered = sorted(documented - registered)
+    if undocumented:
+        print("metric families registered in code but MISSING from "
+              f"{DOCS.relative_to(REPO)}:")
+        for n in undocumented:
+            print(f"  - {n}")
+    if unregistered:
+        print(f"metric families documented in {DOCS.relative_to(REPO)} "
+              "but registered NOWHERE in the package:")
+        for n in unregistered:
+            print(f"  - {n}")
+    if undocumented or unregistered:
+        return 1
+    print(f"metrics/docs lint OK: {len(registered)} families in sync")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

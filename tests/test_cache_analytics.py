@@ -1,0 +1,311 @@
+"""KV-cache efficiency analytics: reuse-distance / eviction-age
+attribution, dead-on-arrival accounting, the `/debug/cache` report, and
+the engine's local-vs-store prefix-hit token counters.
+
+The scripted-workload tests drive the store through an INJECTED clock
+(``Store._clock``), so the asserted reuse distances and eviction ages
+land in exact histogram buckets — the acceptance criterion's "known
+reuse pattern → asserted buckets", with no sleeps and no flake."""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from infinistore_tpu import protocol as P
+from infinistore_tpu.config import ServerConfig
+from infinistore_tpu.pyserver import StoreServer
+from infinistore_tpu.utils import metrics as m
+from infinistore_tpu.utils.metrics import AGE_BUCKETS
+
+
+def make_server(block_kb=16, pool_mb=1):
+    """An in-process StoreServer (registry + store, no sockets) over a
+    hand-built tiny-pool Store — the registry wiring (histogram sinks,
+    fn-backed counters) is part of what's under test."""
+    from collections import OrderedDict
+
+    from infinistore_tpu.mempool import MM
+    from infinistore_tpu.store import CacheAnalytics, Stats, Store
+
+    cfg = ServerConfig(service_port=1, manage_port=1, prealloc_size=1,
+                       minimal_allocate_size=block_kb)
+    store = Store.__new__(Store)
+    store.config = cfg
+    store.mm = MM(pool_size=pool_mb << 20, block_size=block_kb << 10)
+    store.kv = OrderedDict()
+    store.pending = {}
+    store._deferred = []
+    store.stats = Stats()
+    store.disk = None
+    store._clock = time.monotonic
+    store.analytics = CacheAnalytics()
+    return StoreServer(cfg, store=store)
+
+
+class Clock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+def _put(store, key, nbytes=64):
+    assert store.put_inline(key, b"x" * nbytes) == P.FINISH
+
+
+def test_reuse_distance_and_eviction_age_buckets():
+    """Known reuse pattern → exact bucket assertions, via the scrape."""
+    srv = make_server()
+    store = srv.store
+    clk = Clock()
+    store._clock = clk
+
+    _put(store, b"hot")
+    _put(store, b"cold")
+    _put(store, b"doa")  # never read: must count dead-on-arrival
+
+    # reads at known distances: hot at +0.1s then +0.1s again; cold once
+    # at +60s from commit
+    clk.t += 0.1
+    assert store.get_inline(b"hot") is not None
+    clk.t += 0.1
+    assert store.get_inline(b"hot") is not None
+    clk.t += 59.8
+    assert store.get_inline(b"cold") is not None
+
+    # evict everything not leased: ages are now deterministic —
+    # hot: 60s since last read, cold: 0s, doa: 60s since commit
+    clk.t += 0.0
+    store.evict(0.0, 0.0)
+
+    text = srv.metrics_text()
+    fams = m.parse_prometheus_text(text)
+
+    def bucket(name, le):
+        return fams[(f"{name}_bucket", (("le", f"{le:.10g}"),))]
+
+    # the two 0.1s reuses land in the first bucket >= 0.1 (0.2: bucket 1)
+    # and the 60s reuse crosses into the >=51.2 buckets
+    assert fams[("istpu_cache_reuse_distance_seconds_count", ())] == 3
+    assert bucket("istpu_cache_reuse_distance_seconds", AGE_BUCKETS[1]) == 2
+    assert bucket("istpu_cache_reuse_distance_seconds", AGE_BUCKETS[5]) == 2
+    assert bucket("istpu_cache_reuse_distance_seconds", AGE_BUCKETS[6]) == 3
+    # eviction ages: one ~0s (cold, just read), two 59.9-60s
+    assert fams[("istpu_cache_evicted_age_seconds_count", ())] == 3
+    assert bucket("istpu_cache_evicted_age_seconds", AGE_BUCKETS[0]) == 1
+    assert bucket("istpu_cache_evicted_age_seconds", AGE_BUCKETS[6]) == 3
+    # exactly ONE entry died unread
+    assert fams[("istpu_cache_dead_on_arrival_total", ())] == 1
+    assert store.analytics.evicted_read == 2
+    store.close()
+
+
+def test_cache_report_hot_cold_and_age_bands():
+    srv = make_server()
+    store = srv.store
+    clk = Clock()
+    store._clock = clk
+
+    for i in range(4):
+        _put(store, f"k{i}".encode())
+    # k0 is hot (3 reads), k1 warm (1 read), k2/k3 untouched
+    for _ in range(3):
+        clk.t += 0.05
+        assert store.get_inline(b"k0") is not None
+    clk.t += 0.05
+    assert store.get_inline(b"k1") is not None
+    clk.t += 30.0  # everything ages 30s; k2/k3 are now cold
+
+    rep = store.cache_report(top_n=2)
+    assert rep["entries"] == 4
+    assert rep["hot"][0]["key"] == "k0" and rep["hot"][0]["hits"] == 3
+    assert len(rep["hot"]) == 2  # top_n honored
+    cold_keys = {r["key"] for r in rep["cold"]}
+    assert cold_keys <= {"k2", "k3"}, cold_keys
+    assert rep["hits"] == 4 and rep["misses"] == 0 and rep["hit_ratio"] == 1.0
+    bands = rep["age_bands"]
+    assert bands["<1m"]["entries"] == 4  # all last-touched 30s ago
+    assert bands["<1s"]["entries"] == 0
+    assert rep["dead_on_arrival"] == 0
+
+    # a miss shows up in the ratio
+    assert store.get_inline(b"nope") is None
+    rep = store.cache_report()
+    assert rep["misses"] == 1 and rep["hit_ratio"] == pytest.approx(0.8)
+    store.close()
+
+
+def test_stats_dict_carries_dead_on_arrival():
+    srv = make_server()
+    store = srv.store
+    clk = Clock()
+    store._clock = clk
+    _put(store, b"unread")
+    clk.t += 5.0
+    store.evict(0.0, 0.0)
+    assert store.stats_dict()["dead_on_arrival"] == 1
+    # and the flat exposition carries it for the native-backend fallback
+    assert "infinistore_tpu_dead_on_arrival 1" in srv.metrics_text()
+    store.close()
+
+
+# ---------------------------------------------------------------------------
+# /debug/cache over HTTP + engine provenance counters (live store)
+# ---------------------------------------------------------------------------
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture(scope="module")
+def live_store():
+    port, mport = _free_port(), _free_port()
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "infinistore_tpu.server",
+         "--service-port", str(port), "--manage-port", str(mport),
+         "--prealloc-size", "1", "--minimal-allocate-size", "16",
+         "--log-level", "warning", "--backend", "python"],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    deadline = time.time() + 25
+    for p in (port, mport):
+        while True:
+            if proc.poll() is not None:
+                pytest.fail("store server failed to start")
+            try:
+                socket.create_connection(("127.0.0.1", p), timeout=0.5).close()
+                break
+            except OSError:
+                if time.time() >= deadline:
+                    proc.kill()
+                    pytest.fail("server did not come up")
+                time.sleep(0.1)
+    yield port, mport
+    proc.send_signal(signal.SIGINT)
+    try:
+        proc.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+
+
+def test_debug_cache_endpoint_live(live_store, monkeypatch):
+    monkeypatch.setenv("ISTPU_CLIENT", "python")
+    import infinistore_tpu as ist
+
+    port, mport = live_store
+    conn = ist.InfinityConnection(ist.ClientConfig(
+        host_addr="127.0.0.1", service_port=port,
+        connection_type=ist.TYPE_SHM, log_level="error"))
+    conn.connect()
+    blk = 16 << 10
+    buf = np.random.randint(0, 256, 4 * blk, dtype=np.uint8)
+    conn.register_mr(buf)
+    blocks = [(f"dbg-{i}", i * blk) for i in range(4)]
+    conn.write_cache(blocks, blk, buf.ctypes.data)
+    dst = np.zeros_like(buf)
+    conn.register_mr(dst)
+    conn.read_cache(blocks, blk, dst.ctypes.data)
+    conn.read_cache([blocks[0]], blk, dst.ctypes.data)  # dbg-0 is hottest
+
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{mport}/debug/cache?n=2", timeout=10
+    ) as r:
+        rep = json.load(r)
+    assert rep["entries"] >= 4 and rep["hits"] >= 5
+    assert len(rep["hot"]) == 2
+    assert rep["hot"][0]["key"] == "dbg-0"
+    assert rep["hot"][0]["hits"] == 2
+    assert "age_bands" in rep and "hit_ratio" in rep
+
+    # the histogram families ride the live /metrics too
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{mport}/metrics", timeout=10
+    ) as r:
+        fams = m.parse_prometheus_text(r.read().decode())
+    assert fams[("istpu_cache_reuse_distance_seconds_count", ())] >= 5
+    conn.close()
+
+
+def test_engine_prefix_provenance_counters(live_store, monkeypatch):
+    """The admission-path split: a prompt whose prefix lives in the STORE
+    (seeded by a producer engine) counts store tokens on the consumer; a
+    REPEATED prompt counts local tokens; fresh prompts count computed."""
+    monkeypatch.setenv("ISTPU_CLIENT", "python")
+    jax = pytest.importorskip("jax")
+    import jax.numpy as jnp
+
+    import infinistore_tpu as ist
+    from infinistore_tpu.engine import InferenceEngine
+    from infinistore_tpu.kv import PagedCacheConfig
+    from infinistore_tpu.models import TINY, init_params, scaled
+
+    cfg = scaled(TINY, dtype=jnp.float32)
+    params = init_params(cfg, jax.random.PRNGKey(7))
+    T = 4
+    pc = lambda: PagedCacheConfig(  # noqa: E731
+        n_layers=cfg.n_layers, n_kv_heads=cfg.n_kv_heads,
+        head_dim=cfg.head_dim, n_blocks=64, block_tokens=T, dtype=cfg.dtype)
+
+    def counters():
+        fams = m.parse_prometheus_text(
+            m.default_registry().to_prometheus_text())
+        return {
+            src: fams.get(("istpu_engine_prefix_tokens_total",
+                           (("source", src),)), 0.0)
+            for src in ("local", "store", "computed")
+        }
+
+    port, _ = live_store
+
+    def connect():
+        c = ist.InfinityConnection(ist.ClientConfig(
+            host_addr="127.0.0.1", service_port=port,
+            connection_type=ist.TYPE_SHM, log_level="error"))
+        c.connect()
+        return c
+
+    prompt = [9, 3, 7, 1, 5, 2, 8, 6, 4, 11, 13]  # 11 tokens, T=4
+
+    prod_conn = connect()
+    producer = InferenceEngine(params, cfg, pc(), conn=prod_conn,
+                               model_id="prov-test")
+    before = counters()
+    producer.release(producer.prefill(prompt))
+    producer.store_flush()
+    after_prod = counters()
+    # a cold engine + empty store: everything computed
+    assert after_prod["computed"] - before["computed"] == len(prompt)
+
+    cons_conn = connect()
+    consumer = InferenceEngine(params, cfg, pc(), conn=cons_conn,
+                               model_id="prov-test")
+    st = consumer.prefill(prompt)
+    after_store = counters()
+    # the consumer found the producer's chunks in the STORE: 2 complete
+    # chunks are reusable ((11-1)//4 = 2), 3 tokens of the tail computed
+    assert after_store["store"] - after_prod["store"] == 2 * T
+    assert after_store["computed"] - after_prod["computed"] == len(prompt) - 2 * T
+    consumer.release(st)
+
+    st = consumer.prefill(prompt)  # repeat: now the LOCAL prefix cache hits
+    after_local = counters()
+    assert after_local["local"] - after_store["local"] == 2 * T
+    assert after_local["store"] == after_store["store"]
+    consumer.release(st)
+
+    prod_conn.close()
+    cons_conn.close()

@@ -168,6 +168,13 @@ def test_instrumentation_overhead_within_5pct(server, monkeypatch):
     text = m.default_registry().to_prometheus_text()
     assert 'istpu_client_op_seconds_count{op="write_cache"}' in text
 
+    # CI artifact hook: dump the run's Perfetto trace when asked, so the
+    # workflow can upload the real stage timeline alongside the numbers
+    out_path = os.environ.get("ISTPU_PERF_TRACE_OUT")
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write(tracer.export_chrome_json())
+
     floor = PUT_FLOOR_GBPS * 0.95
     put_gbps = nbytes / 1e9 / best_put
     get_gbps = nbytes / 1e9 / best_get

@@ -14,8 +14,10 @@ def make_store(prealloc_mb=1, block_kb=16, **kw):
     # shrink the pool for tests: bypass the GB unit
     cfg.prealloc_size = 0
     store = Store.__new__(Store)
+    import time as _time
+
     from infinistore_tpu.mempool import MM
-    from infinistore_tpu.store import Stats
+    from infinistore_tpu.store import CacheAnalytics, Stats
     from collections import OrderedDict
 
     store.config = cfg
@@ -25,6 +27,8 @@ def make_store(prealloc_mb=1, block_kb=16, **kw):
     store._deferred = []
     store.stats = Stats()
     store.disk = None
+    store._clock = _time.monotonic
+    store.analytics = CacheAnalytics()
     return store
 
 

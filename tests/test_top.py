@@ -1,0 +1,268 @@
+"""Operator tooling around the observability plane: the ``istpu-top``
+console (pure rendering + live `--once` integration), the stable
+``--json-out`` benchmark schema, trace-id-stamped log records, and the
+metrics↔docs drift lint."""
+
+import io
+import json
+import logging
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# console rendering (no sockets)
+# ---------------------------------------------------------------------------
+
+
+def _metrics_text():
+    from infinistore_tpu.utils.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    reg.gauge("istpu_store_pool_usage", "").set(0.42)
+    reg.gauge("istpu_store_fragmentation", "").set(0.1)
+    reg.gauge("istpu_store_kvmap_len", "").set(12)
+    reg.counter("istpu_store_evicted_total", "").inc(3)
+    reg.counter("istpu_serve_requests_total", "").inc(7)
+    reg.counter("istpu_serve_completed_total", "").inc(6)
+    reg.counter("istpu_serve_tokens_total", "").inc(90)
+    reg.gauge("istpu_serve_free_kv_pages", "").set(55)
+    reg.gauge("istpu_store_circuit_state", "", labelnames=("name",)
+              ).labels("store").set(1)
+    c = reg.counter("istpu_engine_prefix_tokens_total", "",
+                    labelnames=("source",))
+    c.labels("local").inc(8)
+    c.labels("store").inc(8)
+    c.labels("computed").inc(16)
+    h = reg.histogram("istpu_serve_prefill_seconds", "")
+    h.observe(0.1)
+    return reg.to_prometheus_text()
+
+
+def test_console_renders_synthetic_snapshot():
+    from infinistore_tpu.top import Console, Snapshot
+    from infinistore_tpu.utils.metrics import parse_prometheus_text
+
+    cache = {
+        "entries": 12, "hits": 30, "misses": 10, "hit_ratio": 0.75,
+        "evicted": 3, "dead_on_arrival": 2, "mean_reuse_s": 1.5,
+        "hot": [{"key": "k0", "hits": 9, "age_s": 0.5, "size": 1,
+                 "since_commit_s": 2.0}],
+        "cold": [{"key": "k9", "hits": 0, "age_s": 90.0, "size": 1,
+                  "since_commit_s": 90.0}],
+        "age_bands": {"<1s": {"entries": 3, "bytes": 3},
+                      ">=10m": {"entries": 9, "bytes": 9}},
+    }
+
+    def snap(extra_prefill=0.0):
+        text = _metrics_text()
+        return Snapshot(
+            serve_metrics=parse_prometheus_text(text),
+            store_metrics=parse_prometheus_text(text),
+            cache=cache,
+            serve_health={"status": "ok"},
+            store_health={"status": "degraded"},
+        )
+
+    console = Console()
+    console.frame(snap())        # first frame primes the rate trackers
+    out = console.frame(snap())  # second frame has deltas
+    assert "serve:ok" in out and "store:degraded" in out
+    assert "circuit:OPEN" in out
+    assert "pool occupancy" in out and "42.0%" in out
+    assert "hit ratio" in out and "75.0%" in out
+    assert "dead-on-arrival" in out and "2" in out
+    # provenance split: 8/8/16 of 32 tokens
+    assert "local  25.0%" in out.replace("local ", "local  ") or \
+        "local" in out
+    assert "hot keys" in out and "k0" in out and "k9" in out
+    assert "occupancy by age" in out
+    # an empty snapshot must not crash (unreachable stack)
+    from infinistore_tpu.top import Snapshot as S
+    assert Console().frame(S())
+
+
+def test_sparkline_and_bar_helpers():
+    from infinistore_tpu.top import bar, fmt_dur, sparkline
+
+    assert sparkline([], 8) == "·" * 8
+    line = sparkline([0.0, 0.5, 1.0], 3)
+    assert len(line) == 3 and line[-1] == "█"
+    assert bar(0.5, 10).count("█") == 5
+    assert fmt_dur(None).strip() == "-"
+    assert fmt_dur(0.0005).endswith("µ")
+    assert fmt_dur(0.05).endswith("m")
+    assert fmt_dur(2.0).endswith("s")
+
+
+# ---------------------------------------------------------------------------
+# live halves: --once against a real store manage plane; --json-out
+# ---------------------------------------------------------------------------
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture(scope="module")
+def live_store():
+    port, mport = _free_port(), _free_port()
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "infinistore_tpu.server",
+         "--service-port", str(port), "--manage-port", str(mport),
+         "--prealloc-size", "1", "--minimal-allocate-size", "16",
+         "--log-level", "warning", "--backend", "python"],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    deadline = time.time() + 25
+    for p in (port, mport):
+        while True:
+            if proc.poll() is not None:
+                pytest.fail("store server failed to start")
+            try:
+                socket.create_connection(("127.0.0.1", p), timeout=0.5).close()
+                break
+            except OSError:
+                if time.time() >= deadline:
+                    proc.kill()
+                    pytest.fail("server did not come up")
+                time.sleep(0.1)
+    yield port, mport
+    proc.send_signal(signal.SIGINT)
+    try:
+        proc.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+
+
+def test_top_once_against_live_store(live_store):
+    _port, mport = live_store
+    r = subprocess.run(
+        [sys.executable, "-m", "infinistore_tpu.top",
+         "--store-url", f"http://127.0.0.1:{mport}", "--once"],
+        capture_output=True, timeout=60,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    out = r.stdout.decode()
+    assert r.returncode == 0, r.stderr.decode()
+    assert "istpu-top" in out
+    assert "pool occupancy" in out
+    assert "store:ok" in out
+    assert "serve:-" in out  # unreachable half renders as '-'
+
+
+def test_benchmark_json_out_schema(live_store, tmp_path, monkeypatch):
+    port, _ = live_store
+    out_file = tmp_path / "bench.json"
+    r = subprocess.run(
+        [sys.executable, "-m", "infinistore_tpu.benchmark",
+         "--shm", "--service-port", str(port),
+         "--size", "4", "--block-size", "16", "--iteration", "2",
+         "--json-out", str(out_file)],
+        capture_output=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu", "ISTPU_CLIENT": "python"},
+    )
+    assert r.returncode == 0, r.stderr.decode()
+    rec = json.loads(out_file.read_text())
+    # the stable schema contract (docs/observability.md)
+    assert set(rec) >= {"run_id", "gbps_put", "gbps_get", "alloc_ms",
+                        "stages"}
+    assert rec["gbps_put"] > 0 and rec["gbps_get"] > 0
+    assert isinstance(rec["run_id"], str) and rec["run_id"]
+    assert "write_cache.alloc" in rec["stages"]
+    assert rec["alloc_ms"] == rec["stages"]["write_cache.alloc"]["p50_ms"]
+    for stage in rec["stages"].values():
+        assert {"count", "avg_ms", "p50_ms", "p99_ms", "max_ms"} <= set(stage)
+
+
+def test_bench_json_helper_is_stable():
+    from infinistore_tpu.benchmark import bench_json
+
+    rec = bench_json("abc", 4.0, 5.0, {})
+    assert rec == {"run_id": "abc", "gbps_put": 4.0, "gbps_get": 5.0,
+                   "alloc_ms": 0.0, "stages": {}}
+
+
+# ---------------------------------------------------------------------------
+# structured logging: records carry the active trace id
+# ---------------------------------------------------------------------------
+
+
+def test_log_lines_carry_trace_id():
+    from infinistore_tpu.utils import tracing
+    from infinistore_tpu.utils.logging import Logger, _TraceFormatter, \
+        TraceContextFilter
+
+    logger = logging.getLogger("infinistore_tpu")
+    stream = io.StringIO()
+    h = logging.StreamHandler(stream)
+    h.setFormatter(_TraceFormatter("[%(levelname)s] %(message)s"))
+    logger.addHandler(h)
+    try:
+        Logger.warn("outside any trace")
+        with tracing.trace("logged.request") as tr:
+            Logger.warn("inside the trace")
+            # the streamer's direct logging.getLogger path is covered too
+            logging.getLogger("infinistore_tpu").warning("direct logger")
+        trace_id = tr.trace_id
+    finally:
+        logger.removeHandler(h)
+    lines = stream.getvalue().splitlines()
+    assert lines[0] == "[WARNING] outside any trace"  # no suffix, no '-'
+    assert lines[1] == f"[WARNING] inside the trace trace_id={trace_id}"
+    assert lines[2] == f"[WARNING] direct logger trace_id={trace_id}"
+    # every record passed the filter (attribute always present)
+    rec = logging.LogRecord("infinistore_tpu", logging.INFO, __file__, 1,
+                            "x", (), None)
+    assert TraceContextFilter().filter(rec) and rec.trace_id == "-"
+
+
+# ---------------------------------------------------------------------------
+# metrics <-> docs drift lint (the CI step, run as a test too)
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_docs_lint_passes():
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "metrics_docs_lint.py")],
+        capture_output=True, timeout=60,
+    )
+    assert r.returncode == 0, r.stdout.decode() + r.stderr.decode()
+
+
+def test_metrics_docs_lint_catches_drift(tmp_path, monkeypatch):
+    """The lint actually FAILS on drift — both directions."""
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        import metrics_docs_lint as lint
+    finally:
+        sys.path.pop(0)
+    registered = lint.registered_families()
+    assert "istpu_cache_reuse_distance_seconds" in registered
+    assert "istpu_engine_prefix_tokens_total" in registered
+    docs = (lint.DOCS).read_text()
+    documented = lint.documented_families(docs, registered)
+    assert registered == documented  # in sync right now
+    # a family the docs never mention -> undocumented drift
+    assert "istpu_made_up_total" not in documented
+    # label-brace annotations don't explode into fake names
+    toks = lint.documented_families(
+        "`istpu_spec_kind{kind}` and `istpu_serve_{queue_wait,prefill}"
+        "_p{50,99}_ms`", registered)
+    assert "istpu_spec_kind" in toks
+    assert "istpu_serve_queue_wait_p99_ms" in toks
+    assert not any(t.endswith("kindkind") for t in toks)
