@@ -26,6 +26,7 @@ from .lib import (
     InfinityConnection,
     InfiniStoreConnectionError,
     InfiniStoreException,
+    InfiniStoreIntegrityError,
     InfiniStoreKeyNotFound,
     InfiniStoreTimeoutError,
 )
@@ -59,5 +60,6 @@ __all__ = [
     "InfiniStoreKeyNotFound",
     "InfiniStoreConnectionError",
     "InfiniStoreTimeoutError",
+    "InfiniStoreIntegrityError",
     "evict_cache",
 ]

@@ -115,6 +115,17 @@ class ServerConfig:
         # "sizeclass" = pow2 size classes with lazily carved per-class
         # pools (the jemalloc-shaped option for mixed page sizes)
         self.allocator = kwargs.get("allocator", "bitmap")
+        # KV integrity plane (docs/robustness.md §5): "" defers to
+        # ISTPU_INTEGRITY (default "verify").  "off" = no checksums;
+        # "verify" = entries stamped after commit, clients verify reads;
+        # "scrub" = verify + the background scrubber re-checks committed,
+        # unleased entries at ~scrub_rate pages/s and quarantines
+        # mismatches.  integrity_alg: "" -> ISTPU_INTEGRITY_ALG ->
+        # "sum64" (vectorized; "crc32" = zlib, slower but standard).
+        self.integrity = kwargs.get("integrity", "")
+        self.integrity_alg = kwargs.get("integrity_alg", "")
+        # pages/second; 0 defers to ISTPU_SCRUB_RATE (default 256)
+        self.scrub_rate = kwargs.get("scrub_rate", 0)
 
     def __repr__(self):
         return (
@@ -145,3 +156,9 @@ class ServerConfig:
             raise Exception("backend should be auto, native or python")
         if getattr(self, "allocator", "bitmap") not in ("bitmap", "sizeclass"):
             raise Exception("allocator should be bitmap or sizeclass")
+        if getattr(self, "integrity", "") not in ("", "off", "verify", "scrub"):
+            raise Exception("integrity should be off, verify or scrub")
+        if getattr(self, "integrity_alg", "") not in ("", "sum64", "crc32"):
+            raise Exception("integrity_alg should be sum64 or crc32")
+        if float(getattr(self, "scrub_rate", 0)) < 0:
+            raise Exception("scrub_rate must be non-negative (0 = default)")

@@ -33,6 +33,13 @@ class StoreConnector:
     hops (miss on failure, hop skipped while the circuit is open);
     ``store_kv`` counts a failed push as a dropped hop and returns 0.
     ``breaker=`` shares one circuit across connectors on the same store.
+
+    The same contract covers BAD BYTES, not just dead stores: with the
+    integrity plane on (docs/robustness.md §5) every ``retrieve_kv`` is
+    checksum-verified after the copy and epoch-fenced against server
+    restarts; a verification failure surfaces here as ``(cache, 0)`` —
+    a miss — with the failed pages deleted from the store so later
+    lookups miss cleanly, and never as corrupt KV handed to the engine.
     """
 
     def __init__(

@@ -321,7 +321,10 @@ class KVTransferEngine:
     # failures (socket dead, channel torn down, op deadline fired) feed
     # the breaker; while it is open the hop is skipped outright — no
     # timeout tax per request.  KeyNotFound is a normal protocol answer
-    # (eviction race) and neither trips nor counts against the circuit.
+    # (eviction race) and neither trips nor counts against the circuit;
+    # the same goes for integrity failures (checksum/epoch fence) — the
+    # transport is healthy, the BYTES were bad, so the hop degrades to a
+    # miss without touching the circuit.
 
     def guarded_lookup_prefix(self, chunk_keys_: Sequence[str]) -> int:
         """``lookup_prefix`` degraded to 0 (miss) on store failure or an
@@ -352,7 +355,7 @@ class KVTransferEngine:
         if not self.breaker.allow():
             _resilience.count_degraded("load")
             return cache, False
-        from ..lib import InfiniStoreKeyNotFound
+        from ..lib import InfiniStoreIntegrityError, InfiniStoreKeyNotFound
 
         try:
             out = self.load_pages(cache, block_ids, chunk_keys_)
@@ -361,6 +364,22 @@ class KVTransferEngine:
             # server LRU evicts per PAGE key, so a chunk can lose a
             # middle layer while the probed layers survive) — a healthy
             # miss, not a store fault
+            _resilience.count_degraded("load")
+            return cache, False
+        except InfiniStoreIntegrityError as e:
+            # verification failure IS a cache miss (the detected form of
+            # the lease-expiry race / pool corruption / a restart's epoch
+            # fence) — already counted per cause in
+            # istpu_integrity_failures_total by the client.  The store is
+            # HEALTHY, so the circuit is untouched.  Client-assisted
+            # quarantine: ask the store to drop the pages that failed so
+            # later requests miss cleanly instead of re-paying a failed
+            # verification until the scrubber finds them.
+            if e.cause in ("checksum", "lease") and e.keys:
+                try:
+                    self._call("delete_keys", list(e.keys))
+                except Exception:  # noqa: BLE001 — best-effort hygiene
+                    pass
             _resilience.count_degraded("load")
             return cache, False
         except _resilience.transport_errors():

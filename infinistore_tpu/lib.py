@@ -45,6 +45,8 @@ from .config import (  # noqa: F401 - re-exported for parity
     LINK_IB,
 )
 from .mempool import SHM_DIR, _prefault
+from .store import READ_LEASE_S
+from .utils import checksum as _checksum
 from .utils import metrics as _metrics
 from .utils import resilience as _resilience
 from .utils import tracing as _tracing
@@ -66,6 +68,22 @@ _CLIENT_OPS = _metrics.default_registry().histogram(
 
 def _observe_client_op(name: str, seconds: float) -> None:
     _CLIENT_OPS.labels(name).observe(seconds)
+
+
+# end-to-end KV integrity failures detected CLIENT-side, by cause:
+# checksum — the bytes that landed do not match the entry's stamped
+# checksum (pool corruption, or a region recycled mid-copy);
+# lease — same mismatch, but the copy outlasted the server's read lease,
+# so the root cause is almost certainly the lease-expiry race;
+# epoch — descriptors or pool mappings predate a server restart (the
+# epoch fence fired).  Every cause is handled as a cache MISS by the
+# serving stack (guarded_load -> recompute), never a failed request.
+_INTEGRITY_FAILURES = _metrics.default_registry().counter(
+    "istpu_integrity_failures_total",
+    "Client-detected KV integrity failures, by cause "
+    "(checksum / lease / epoch); each one is served as a cache miss",
+    labelnames=("cause",),
+)
 
 
 def _timed_op(name: str):
@@ -104,6 +122,19 @@ class InfiniStoreTimeoutError(InfiniStoreConnectionError):
     channel is torn down and the op rides the reconnect machinery."""
 
 
+class InfiniStoreIntegrityError(InfiniStoreException):
+    """The bytes a read delivered failed end-to-end verification (or the
+    epoch fence fired).  NOT a connection error on purpose: the transport
+    is healthy and a reconnect-retry would re-read the same bad bytes —
+    the correct remedy is to treat the read as a cache MISS and recompute
+    (``kv.transfer.guarded_load`` does exactly that)."""
+
+    def __init__(self, msg: str, cause: str = "checksum", keys=()):
+        super().__init__(msg)
+        self.cause = cause
+        self.keys = list(keys)
+
+
 _STATUS_EXC = {
     P.KEY_NOT_FOUND: InfiniStoreKeyNotFound,
     # the server never answers SYSTEM_ERROR over the wire; this status
@@ -137,6 +168,13 @@ def _trace_ctx_enabled() -> bool:
     pre-trace-context wire format.  Read per connection so tests can flip
     it without reimporting."""
     return os.environ.get("ISTPU_TRACE_CTX", "1") != "0"
+
+
+def _integrity_enabled() -> bool:
+    """Client half of the integrity opt-out (ISTPU_INTEGRITY=off): when
+    off, HELLO never asks for the capability and every read stays on the
+    legacy wire format.  Read per connection, like the trace gate."""
+    return os.environ.get("ISTPU_INTEGRITY", "verify") != "off"
 # total time write_cache keeps re-asking after RETRY (another writer is
 # actively streaming one of these keys) before giving up with a clear error
 _RETRY_DEADLINE_S = float(os.environ.get("ISTPU_RETRY_DEADLINE_S", "10"))
@@ -449,6 +487,15 @@ class Connection:
         self.trace_ctx = False
         self.clock_offset: Optional[float] = None
         self.server_pid: Optional[int] = None
+        # integrity state (negotiated at HELLO): when the server answers
+        # the EPOC capability trailer, every GET_DESC / inline-get on
+        # this connection carries checksums + the server's boot epoch,
+        # reads verify AFTER the bulk copy completes, and read leases are
+        # released explicitly (OP_RELEASE_DESC) the moment a copy checks
+        # out
+        self.integrity = False
+        self.epoch: Optional[int] = None
+        self.checksum_alg = _checksum.ALG_SUM64
 
     def latency_stats(self) -> Dict[str, Dict[str, float]]:
         """Client-side per-op latency counters (count/avg/max ms)."""
@@ -466,6 +513,8 @@ class Connection:
         ch0 = _Channel(self.config.host_addr, self.config.service_port,
                        op_timeout=self.op_timeout)
         hello_flags = P.HELLO_FLAG_TRACE_CTX if _trace_ctx_enabled() else 0
+        if _integrity_enabled():
+            hello_flags |= P.HELLO_FLAG_INTEGRITY
         t0 = time.perf_counter()
         status, body = ch0.exchange(
             P.OP_HELLO, P.pack_hello(os.getpid(), hello_flags)
@@ -476,7 +525,18 @@ class Connection:
         self.channels.append(ch0)
         pools, srv_flags, t_server = P.unpack_hello_resp(memoryview(body))
         self.pool_meta = pools
-        if hello_flags and (srv_flags & P.HELLO_FLAG_TRACE_CTX):
+        if hello_flags & P.HELLO_FLAG_INTEGRITY:
+            # integrity capability answer: an EPOC trailer with the boot
+            # epoch (the fence every later response is checked against)
+            # and the server's checksum algorithm.  Absent (old server /
+            # native runtime / ISTPU_INTEGRITY=off server-side) ->
+            # negotiation fails closed, legacy wire format throughout.
+            got = P.unpack_hello_epoch(memoryview(body))
+            if got is not None:
+                self.checksum_alg, self.epoch = got
+                self.integrity = True
+        if (hello_flags & P.HELLO_FLAG_TRACE_CTX) and (
+                srv_flags & P.HELLO_FLAG_TRACE_CTX):
             # clock-skew correction: the server stamped t_server while the
             # request was in flight; assume it fired at the round-trip
             # midpoint, so server_clock ≈ client_clock + offset.  The
@@ -499,7 +559,13 @@ class Connection:
             for _ in range(int(self.config.num_streams) - 1):
                 ch = _Channel(self.config.host_addr, self.config.service_port,
                               op_timeout=self.op_timeout)
-                st, _b = ch.exchange(P.OP_HELLO, P.pack_hello(os.getpid()))
+                # the integrity capability is per-CONNECTION server-side:
+                # every striped data channel must negotiate it too, or the
+                # server would answer batched gets in the legacy layout
+                st, _b = ch.exchange(P.OP_HELLO, P.pack_hello(
+                    os.getpid(),
+                    P.HELLO_FLAG_INTEGRITY if self.integrity else 0,
+                ))
                 _raise_for_status(st, "hello")
                 ch.start_reader()
                 self.channels.append(ch)
@@ -628,6 +694,105 @@ class Connection:
             for run in runs:
                 copy_one(run)
 
+    # -- integrity plane: epoch fence, post-copy verification, release --
+
+    def _epoch_fence(self, server_epoch: int) -> None:
+        """Compare a response's epoch against the one captured at HELLO.
+        A mismatch means this connection's descriptors and shm mappings
+        predate a server restart: drop the stale attach, re-map the
+        CURRENT server's pools, and invalidate this read — copying from a
+        recycled pool is the one failure the lease machinery can never
+        see."""
+        if server_epoch == self.epoch:
+            return
+        old, self.epoch = self.epoch, server_epoch
+        _INTEGRITY_FAILURES.labels("epoch").inc()
+        Logger.warn(
+            f"store epoch changed ({old} -> {server_epoch}): dropping "
+            f"stale pool attach and invalidating the in-flight read"
+        )
+        if self.shm_mode:
+            with self._pool_lock:
+                stale, self.pools = self.pools, []
+                self.pool_meta = []
+                try:
+                    self._refresh_pools()
+                except Exception as e:  # noqa: BLE001 — fence still fires
+                    Logger.warn(f"pool remap after epoch change failed: {e!r}")
+                for p in stale:
+                    try:
+                        p.close()
+                    except Exception:  # noqa: BLE001 — a pinned view is fine
+                        pass
+        raise InfiniStoreIntegrityError(
+            f"store epoch changed ({old} -> {server_epoch}); descriptors "
+            f"predate a server restart", cause="epoch",
+        )
+
+    def _verify_descs(self, descs_ex, offsets, client_view, keys,
+                      t_desc: float) -> None:
+        """Verify delivered bytes against the entries' stamped checksums,
+        AFTER the bulk copy completed — this is what converts the
+        unfixable lease-expiry race (region recycled mid-copy) into a
+        detected, retryable miss.  Vectorized over coalesced runs of
+        equal-size descs (one numpy pass per run, not a per-page loop);
+        descs the server hasn't stamped yet (csum None) are skipped."""
+        arr = np.frombuffer(client_view, dtype=np.uint8)
+        bad: List[bytes] = []
+        n = len(descs_ex)
+        i = 0
+        while i < n:
+            csum = descs_ex[i][3]
+            if csum is None:
+                i += 1
+                continue
+            size = descs_ex[i][2]
+            j = i + 1
+            if self.checksum_alg == _checksum.ALG_SUM64 and size % 8 == 0:
+                # grow a client-contiguous, same-size, stamped run
+                while (j < n and descs_ex[j][3] is not None
+                       and descs_ex[j][2] == size
+                       and offsets[j] == offsets[i] + (j - i) * size):
+                    j += 1
+            if j - i > 1:
+                rows = arr[offsets[i]: offsets[i] + (j - i) * size]
+                got = _checksum.checksum_rows(
+                    rows.reshape(j - i, size), self.checksum_alg
+                )
+            else:
+                got = [_checksum.checksum(
+                    arr[offsets[i]: offsets[i] + size], self.checksum_alg
+                )]
+            for k in range(i, j):
+                if descs_ex[k][3] != got[k - i]:
+                    bad.append(keys[k])
+            i = j
+        if not bad:
+            return
+        # the copy outlasting the server's read lease makes the recycled-
+        # region race the overwhelmingly likely root cause
+        cause = ("lease" if time.monotonic() - t_desc > READ_LEASE_S
+                 else "checksum")
+        _INTEGRITY_FAILURES.labels(cause).inc()
+        shown = b", ".join(bad[:4]).decode(errors="replace")
+        raise InfiniStoreIntegrityError(
+            f"{len(bad)}/{n} pages failed checksum verification "
+            f"(cause={cause}): {shown}{'...' if len(bad) > 4 else ''}",
+            cause=cause,
+            keys=[k.decode(errors="replace") for k in bad],
+        )
+
+    def _release_descs(self, keys: Sequence[bytes]) -> None:
+        """Fire-and-forget OP_RELEASE_DESC: the copy verified, so the
+        read lease has nothing left to protect — releasing now (instead
+        of waiting out the 5 s lease) keeps back-to-back runs from
+        fragmenting allocation behind lingering leases.  Advisory: a lost
+        release just falls back to the timed lease."""
+        try:
+            self.channels[0].submit(P.OP_RELEASE_DESC, P.pack_keys(keys))
+        except Exception:  # noqa: BLE001 — lease expiry covers us
+            pass
+
     def _alloc_put_retrying(self, keys: Sequence[bytes], block_size: int) -> bytes:
         """ALLOC_PUT with exponential backoff on RETRY (another writer is
         actively streaming one of these keys) and a hard deadline that
@@ -726,9 +891,25 @@ class Connection:
                     P.OP_GET_DESC, P.pack_alloc_put(keys, block_size)
                 )
                 _raise_for_status(status, "get_desc")
-            descs = P.unpack_descs(memoryview(body))
+            t_desc = time.monotonic()
+            if self.integrity:
+                epoch, descs_ex = P.unpack_desc_resp_ex(memoryview(body))
+                self._epoch_fence(epoch)
+                descs = [(p, o, s) for p, o, s, _c in descs_ex]
+            else:
+                descs_ex = None
+                descs = P.unpack_descs(memoryview(body))
             with self.latency.timed("read_cache.copy"):
                 self._copy_descs(descs, offsets, dst, to_pool=False)
+            if self.integrity:
+                # verify AFTER the copy (the lease-expiry race detector),
+                # then hand the leases back immediately either way
+                try:
+                    with self.latency.timed("read_cache.verify"):
+                        self._verify_descs(descs_ex, offsets, dst, keys,
+                                           t_desc)
+                finally:
+                    self._release_descs(keys)
         else:
             tid = self._trace_id()  # stripe workers lack the contextvar
 
@@ -744,6 +925,21 @@ class Connection:
                         if body_len:
                             ch._recv_exact_into(memoryview(bytearray(body_len)))
                         return None
+                    if self.integrity:
+                        hdr = bytearray(8)
+                        ch._recv_exact_into(memoryview(hdr))
+                        (epoch,) = P._U64.unpack(bytes(hdr))
+                        items_buf = bytearray(
+                            P.BATCH_ITEM_EX_SIZE * len(sub_keys))
+                        ch._recv_exact_into(memoryview(items_buf))
+                        items = P.unpack_batch_items_ex(
+                            memoryview(items_buf), len(sub_keys))
+                        for (size, _c), dst_off in zip(items, sub_offs):
+                            ch._recv_exact_into(dst[dst_off:dst_off + size])
+                        # verification happens on the CALLING thread (an
+                        # exception here would be misclassified as a
+                        # transport failure by _Channel.wait)
+                        return epoch, items
                     sizes_buf = bytearray(4 * len(sub_keys))
                     ch._recv_exact_into(memoryview(sizes_buf))
                     sizes = np.frombuffer(sizes_buf, dtype="<u4")
@@ -751,21 +947,31 @@ class Connection:
                         ch._recv_exact_into(dst[dst_off : dst_off + int(size)])
                     return True
 
-                st, _ = self.channels[ch_idx].request(
+                st, res = self.channels[ch_idx].request(
                     P.OP_GET_INLINE_BATCH,
                     P.pack_get_inline_batch(sub_keys, block_size),
                     consumer=consumer,
                     trace_id=tid,
                 )
-                return st
+                return st, res, sub_keys, sub_offs
 
+            t_desc = time.monotonic()
             chunks = self._stripe(blocks)
             if len(chunks) == 1:
-                statuses = [_get(chunks[0])]
+                results = [_get(chunks[0])]
             else:
-                statuses = list(self._stripe_pool.map(_get, chunks))
-            for st in statuses:
+                results = list(self._stripe_pool.map(_get, chunks))
+            for st, _res, _k, _o in results:
                 _raise_for_status(st, "get_inline_batch")
+            if self.integrity:
+                for _st, res, sub_keys, sub_offs in results:
+                    if not res:
+                        continue
+                    epoch, items = res
+                    self._epoch_fence(epoch)
+                    descs_ex = [(0, 0, size, csum) for size, csum in items]
+                    self._verify_descs(descs_ex, sub_offs, dst, sub_keys,
+                                       t_desc)
         return P.FINISH
 
     # -- pipelined banded ops (the prefill-save / restore hot path) --
@@ -862,17 +1068,34 @@ class Connection:
             with self.latency.timed("read_cache.desc"):
                 status, body = ch.wait(slot)
                 _raise_for_status(status, "get_desc")
+            t_desc = time.monotonic()
             if j + 1 < len(live):
                 slot = ch.submit(
                     P.OP_GET_DESC,
                     P.pack_alloc_put(enc[j + 1], live[j + 1][1][1]),
                     trace_id=tid,
                 )
-            descs = P.unpack_descs(memoryview(body))
+            if self.integrity:
+                epoch, descs_ex = P.unpack_desc_resp_ex(memoryview(body))
+                self._epoch_fence(epoch)
+                descs = [(p, o, s) for p, o, s, _c in descs_ex]
+            else:
+                descs_ex = None
+                descs = P.unpack_descs(memoryview(body))
             offsets = [off for _, off in blocks]
             view = _ptr_view(ptr, max(offsets) + block_size)
             with self.latency.timed("read_cache.copy"):
                 self._copy_descs(descs, offsets, view, to_pool=False)
+            if self.integrity:
+                # verify BEFORE on_band fires: a band is only handed to
+                # the H2D upload once its bytes checked out — corrupt
+                # pages must never be admitted into the paged cache
+                try:
+                    with self.latency.timed("read_cache.verify"):
+                        self._verify_descs(descs_ex, offsets, view, enc[j],
+                                           t_desc)
+                finally:
+                    self._release_descs(enc[j])
             total += sum(s for _, _, s in descs)
             if on_band is not None:
                 on_band(i)
@@ -899,6 +1122,18 @@ class Connection:
     def r_tcp(self, key: str) -> np.ndarray:
         status, body = self._request(P.OP_GET_INLINE, P.pack_keys([key.encode()]))
         _raise_for_status(status, "tcp read")
+        if self.integrity:
+            epoch, csum, consumed = P.unpack_inline_resp_ex(memoryview(body))
+            self._epoch_fence(epoch)
+            payload = np.frombuffer(body, dtype=np.uint8)[consumed:]
+            if csum is not None and _checksum.checksum(
+                    payload, self.checksum_alg) != csum:
+                _INTEGRITY_FAILURES.labels("checksum").inc()
+                raise InfiniStoreIntegrityError(
+                    f"inline read of {key!r} failed checksum verification",
+                    cause="checksum", keys=[key],
+                )
+            return payload
         return np.frombuffer(body, dtype=np.uint8)
 
     # -- metadata ops --
@@ -1044,6 +1279,7 @@ class InfinityConnection:
         # recognizable transport whose ops keep raising connection errors, so
         # a later op can retry the reconnect once the server is back.
         self._needs_reconnect = True
+        old_epoch = getattr(self.conn, "epoch", None)
         try:
             self.conn.close()
         except Exception:
@@ -1053,6 +1289,21 @@ class InfinityConnection:
         # mid-session (e.g. under a scoped env pin)
         conn = type(self.conn)(self.config)
         conn.connect()
+        new_epoch = getattr(conn, "epoch", None)
+        if (old_epoch is not None and new_epoch is not None
+                and new_epoch != old_epoch):
+            # the server behind the address RESTARTED (not just a
+            # transient outage): any state derived from the old epoch —
+            # descriptors, pool mappings, cached existence answers — is
+            # void.  The fresh connection mapped the new pools already;
+            # count the fence so operators see restarts in the failure
+            # breakdown.
+            _INTEGRITY_FAILURES.labels("epoch").inc()
+            Logger.warn(
+                f"store epoch changed across reconnect "
+                f"({old_epoch} -> {new_epoch}): pre-restart descriptors "
+                f"and pool mappings invalidated"
+            )
         for ptr, size in self._mrs:
             conn.register_mr(ptr, size)
         self.conn = conn

@@ -28,7 +28,7 @@ mirroring the reference's OP_TCP_PUT/OP_TCP_GET (src/infinistore.cpp:236-297).
 from __future__ import annotations
 
 import struct
-from typing import List, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 MAGIC = 0x54504B56  # "VKPT"
 VERSION = 1
@@ -66,6 +66,12 @@ OP_PUT_INLINE_BATCH = 13
 OP_GET_INLINE_BATCH = 14
 OP_POOLS = 15
 OP_TRACE_DUMP = 16
+# integrity plane (negotiated via HELLO_FLAG_INTEGRITY; the native C++
+# runtime does not implement it — negotiation fails closed there, so
+# mixed-runtime pairs simply stay on the legacy wire format):
+# release read leases as soon as the client's copy verified, instead of
+# waiting out the timed lease (legacy clients keep the timed behavior)
+OP_RELEASE_DESC = 17
 
 _OP_NAMES = {
     OP_HELLO: "HELLO",
@@ -84,6 +90,7 @@ _OP_NAMES = {
     OP_GET_INLINE_BATCH: "GET_INLINE_BATCH",
     OP_POOLS: "POOLS",
     OP_TRACE_DUMP: "TRACE_DUMP",
+    OP_RELEASE_DESC: "RELEASE_DESC",
 }
 
 
@@ -163,11 +170,15 @@ def encode_keys(keys: Sequence) -> List[bytes]:
 
 
 # HELLO: req = pid u32 | flags u32 ; resp = pool table (see pack_pool_table),
-# optionally followed by a capability trailer (pack_hello_trailer) when the
-# client's flags asked for one.  Old clients stop reading at the pool table
-# (unpack_pool_table is length-prefixed), old servers send no trailer —
-# both directions stay byte-compatible.
+# optionally followed by capability trailers when the client's flags asked
+# for them: the "TRAC" block (pack_hello_trailer) answers
+# HELLO_FLAG_TRACE_CTX, the "EPOC" block (pack_epoch_trailer) answers
+# HELLO_FLAG_INTEGRITY with the server's boot epoch + checksum algorithm.
+# Old clients stop reading at the pool table (unpack_pool_table is
+# length-prefixed), old servers send no trailer — both directions stay
+# byte-compatible.
 HELLO_FLAG_TRACE_CTX = 0x1
+HELLO_FLAG_INTEGRITY = 0x2
 
 # trailer: marker u32 | server_flags u32 | t_server f64 (perf_counter at
 # response build — the server-clock sample the client uses to estimate the
@@ -203,6 +214,41 @@ def unpack_hello_resp(buf: memoryview) -> Tuple[
         if magic == HELLO_TRAILER_MAGIC:
             return pools, flags, t_server
     return pools, 0, 0.0
+
+
+# epoch trailer (the integrity capability answer): marker u32 | alg u32 |
+# epoch u64.  ``epoch`` is the serving store's boot epoch — a client that
+# sees a DIFFERENT epoch on a later response than the one it captured at
+# HELLO is talking through state that predates a server restart and must
+# fence (drop its shm attach, re-map pools, invalidate the read).
+# ``alg`` names the checksum algorithm every entry is stamped with
+# (utils/checksum.py), so client verification always matches the server.
+HELLO_EPOCH_MAGIC = 0x434F5045  # "EPOC"
+_EPOCH_TRAILER = struct.Struct("<IIQ")
+HELLO_EPOCH_SIZE = _EPOCH_TRAILER.size  # 16
+
+
+def pack_epoch_trailer(alg: int, epoch: int) -> bytes:
+    return _EPOCH_TRAILER.pack(HELLO_EPOCH_MAGIC, alg, epoch)
+
+
+def unpack_hello_epoch(buf: memoryview) -> Optional[Tuple[int, int]]:
+    """Scan a HELLO response for the EPOC trailer; returns (alg, epoch)
+    or None when the server did not answer the integrity capability
+    (old server, native runtime, or ISTPU_INTEGRITY=off)."""
+    _pools, off = unpack_pool_table_ex(buf)
+    while len(buf) - off >= 4:
+        (magic,) = _U32.unpack_from(buf, off)
+        if (magic == HELLO_TRAILER_MAGIC
+                and len(buf) - off >= HELLO_TRAILER_SIZE):
+            off += HELLO_TRAILER_SIZE  # skip the TRAC block
+            continue
+        if (magic == HELLO_EPOCH_MAGIC
+                and len(buf) - off >= HELLO_EPOCH_SIZE):
+            _m, alg, epoch = _EPOCH_TRAILER.unpack_from(buf, off)
+            return alg, epoch
+        break
+    return None
 
 
 # trace context blob (prepended to the body when FLAG_TRACE_CTX is set in
@@ -272,6 +318,88 @@ def pack_descs(descs: Sequence[Tuple[int, int, int]]) -> bytes:
 def unpack_descs(buf: memoryview) -> List[Tuple[int, int, int]]:
     n = len(buf) // DESC_SIZE
     return [_DESC.unpack_from(buf, i * DESC_SIZE) for i in range(n)]
+
+
+# extended descriptor (integrity-negotiated connections only — the server
+# switches GET_DESC responses to this layout per connection after the
+# HELLO handshake, so legacy peers keep the 20-byte descs):
+# pool_idx u32 | offset u64 | size u64 | csum u32 | flags u32
+_DESC_EX = struct.Struct("<IQQII")
+DESC_EX_SIZE = _DESC_EX.size  # 28
+DESC_FLAG_CSUM = 0x1  # csum field is valid (entry already stamped)
+
+
+def pack_desc_resp_ex(
+    epoch: int, descs: Sequence[Tuple[int, int, int, Optional[int]]]
+) -> bytes:
+    """Integrity GET_DESC response body: epoch u64 | n x desc_ex.  A desc
+    whose checksum is None (committed but not yet stamped) carries
+    flags 0 — the client copies without verifying it."""
+    parts = [_U64.pack(epoch)]
+    for p, o, s, c in descs:
+        parts.append(_DESC_EX.pack(
+            p, o, s, 0 if c is None else c,
+            0 if c is None else DESC_FLAG_CSUM,
+        ))
+    return b"".join(parts)
+
+
+def unpack_desc_resp_ex(
+    buf: memoryview,
+) -> Tuple[int, List[Tuple[int, int, int, Optional[int]]]]:
+    """(epoch, [(pool_idx, offset, size, csum-or-None)])."""
+    (epoch,) = _U64.unpack_from(buf, 0)
+    n = (len(buf) - 8) // DESC_EX_SIZE
+    descs = []
+    for i in range(n):
+        p, o, s, c, f = _DESC_EX.unpack_from(buf, 8 + i * DESC_EX_SIZE)
+        descs.append((p, o, s, c if f & DESC_FLAG_CSUM else None))
+    return epoch, descs
+
+
+# integrity GET_INLINE response prefix: epoch u64 | csum u32 | flags u32,
+# followed by the payload; GET_INLINE_BATCH uses epoch u64 then one
+# _BATCH_ITEM_EX (size u32 | csum u32 | flags u32) per key before the
+# concatenated payloads.
+_INLINE_EX = struct.Struct("<QII")
+INLINE_EX_SIZE = _INLINE_EX.size  # 16
+_BATCH_ITEM_EX = struct.Struct("<III")
+BATCH_ITEM_EX_SIZE = _BATCH_ITEM_EX.size  # 12
+
+
+def pack_inline_resp_ex(epoch: int, csum: Optional[int]) -> bytes:
+    return _INLINE_EX.pack(
+        epoch, 0 if csum is None else csum,
+        0 if csum is None else DESC_FLAG_CSUM,
+    )
+
+
+def unpack_inline_resp_ex(
+    buf: memoryview,
+) -> Tuple[int, Optional[int], int]:
+    """(epoch, csum-or-None, bytes consumed)."""
+    epoch, csum, flags = _INLINE_EX.unpack_from(buf, 0)
+    return epoch, (csum if flags & DESC_FLAG_CSUM else None), INLINE_EX_SIZE
+
+
+def pack_batch_item_ex(size: int, csum: Optional[int]) -> bytes:
+    return _BATCH_ITEM_EX.pack(
+        size, 0 if csum is None else csum,
+        0 if csum is None else DESC_FLAG_CSUM,
+    )
+
+
+def unpack_batch_items_ex(
+    buf: memoryview, n: int
+) -> List[Tuple[int, Optional[int]]]:
+    """n x (size, csum-or-None) from a batch-ex item table."""
+    out = []
+    for i in range(n):
+        size, csum, flags = _BATCH_ITEM_EX.unpack_from(
+            buf, i * BATCH_ITEM_EX_SIZE
+        )
+        out.append((size, csum if flags & DESC_FLAG_CSUM else None))
+    return out
 
 
 # PUT_INLINE: req = key_len u16 | key | value_len u64 | value

@@ -30,7 +30,28 @@ MAX_INLINE_BODY = 1 << 30
 # backstop so a forgotten rule can never wedge a CI run past its timeout
 _STALL_CAP_S = 120.0
 
-_FAULT_ACTIONS = ("drop_conn", "delay", "error", "stall")
+_FAULT_ACTIONS = ("drop_conn", "delay", "error", "stall", "corrupt")
+
+
+def _fault_keys(op: int, body: memoryview):
+    """Keys named by a request frame, for targeted fault actions
+    (``corrupt`` flips bytes in exactly the entries the caller is talking
+    about, which is what makes corruption chaos tests deterministic)."""
+    try:
+        if op in (P.OP_ALLOC_PUT, P.OP_GET_DESC, P.OP_PUT_INLINE_BATCH,
+                  P.OP_GET_INLINE_BATCH):
+            keys, _bs = P.unpack_alloc_put(body)
+            return keys
+        if op in (P.OP_EXIST, P.OP_MATCH_LAST_IDX, P.OP_DELETE_KEYS,
+                  P.OP_COMMIT_PUT, P.OP_GET_INLINE, P.OP_RELEASE_DESC):
+            keys, _ = P.unpack_keys(body)
+            return keys
+        if op == P.OP_PUT_INLINE:
+            key, _vlen, _n = P.unpack_put_inline_head(body)
+            return [key]
+    except (ValueError, IndexError):
+        pass
+    return []
 
 
 class FaultInjector:
@@ -44,9 +65,15 @@ class FaultInjector:
     per-op deadline exists for).  Armed via the manage plane's ``POST
     /faults`` or the ``ISTPU_FAULTS`` env (JSON list of rules).
 
+    ``corrupt`` is the integrity plane's fault: it XOR-flips one byte in
+    the COMMITTED pool region of every key the matched request names
+    (the entry's stamped checksum is untouched, so verification — client
+    read-side or the background scrubber — must catch it).
+
     A rule: ``{"op": "GET_DESC" | "*", "action": one of drop_conn/delay/
-    error/stall, "delay_s": float, "error_status": int, "times": int
-    (-1 = until cleared), "after": int (skip the first N matching ops)}``.
+    error/stall/corrupt, "delay_s": float, "error_status": int, "times":
+    int (-1 = until cleared), "after": int (skip the first N matching
+    ops)}``.
     Rules are evaluated first-match in arm order.  Thread-safe: the manage
     plane arms/clears from HTTP threads while the asyncio loop matches;
     stalled connections poll rule liveness, so ``clear()`` releases them.
@@ -218,6 +245,20 @@ class StoreServer:
             fn=lambda: st.analytics.dead_on_arrival)
         st.analytics.reuse_sink = self._h_reuse.observe
         st.analytics.evict_age_sink = self._h_evict_age.observe
+        # integrity plane: stamping backlog + scrubber counters, fed by
+        # the integrity worker task (start() launches it; level "off"
+        # skips it entirely)
+        reg.counter(
+            "istpu_store_scrub_pages_total",
+            "Committed entries re-verified (or first-stamped) by the "
+            "background scrubber",
+            fn=lambda: st.stats.scrub_pages)
+        reg.counter(
+            "istpu_store_scrub_corrupt_total",
+            "Corrupt entries found by checksum re-verification and "
+            "quarantined (key dropped, blocks deferred-freed)",
+            fn=lambda: st.stats.scrub_corrupt)
+        self._integrity_task = None
         self.faults = FaultInjector()
         env_faults = os.environ.get("ISTPU_FAULTS")
         if env_faults:
@@ -269,6 +310,7 @@ class StoreServer:
         self._server = await asyncio.start_server(
             self._handle_conn, host, self.config.service_port, reuse_address=True
         )
+        self.start_integrity_worker()
         Logger.info(f"pyserver listening on {host}:{self.config.service_port}")
 
     async def serve_forever(self) -> None:
@@ -298,9 +340,50 @@ class StoreServer:
 
         self._evict_task = asyncio.get_running_loop().create_task(_loop())
 
+    def start_integrity_worker(self) -> None:
+        """Launch the background integrity task: eagerly drains the
+        commit-time stamping backlog (small byte-bounded slices with a
+        yield between, so data-plane ops interleave), then — at level
+        ``scrub`` — walks committed, unleased entries at the configured
+        rate, re-verifying checksums and quarantining mismatches."""
+        if self.store.integrity == "off" or self._integrity_task is not None:
+            return
+
+        async def _loop():
+            st = self.store
+            # ~20 scrub ticks/s; rate is entries (pages) per second
+            scrub_batch = max(1, int(st.scrub_rate / 20))
+            while True:
+                try:
+                    if st.stamp_pending():
+                        await asyncio.sleep(0)  # yield, keep draining
+                        continue
+                    if st.integrity == "scrub":
+                        st.scrub_step(scrub_batch)
+                        await asyncio.sleep(0.05)
+                    else:
+                        await asyncio.sleep(0.02)
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:  # noqa: BLE001 — worker must survive
+                    Logger.error(f"integrity worker failed: {e!r}")
+                    await asyncio.sleep(0.5)
+
+        self._integrity_task = asyncio.get_running_loop().create_task(_loop())
+
+    def integrity_report(self) -> dict:
+        rep = self.store.integrity_report()
+        rep["worker_running"] = bool(
+            self._integrity_task is not None
+            and not self._integrity_task.done()
+        )
+        return rep
+
     async def close(self) -> None:
         if self._evict_task:
             self._evict_task.cancel()
+        if self._integrity_task:
+            self._integrity_task.cancel()
         if self._server:
             self._server.close()
             await self._server.wait_closed()
@@ -312,6 +395,11 @@ class StoreServer:
         # keys this connection has allocated but not yet committed; reclaimed
         # if the client disconnects mid-write
         conn_pending: set = set()
+        # per-connection negotiated capabilities: "integrity" flips at
+        # HELLO and switches GET_DESC/inline-get responses to the
+        # checksummed + epoch-fenced layouts; legacy peers (who never set
+        # HELLO_FLAG_INTEGRITY) keep byte-identical legacy frames
+        cs = {"integrity": False}
         try:
             while True:
                 try:
@@ -360,14 +448,14 @@ class StoreServer:
                         # stall must show up as a LONG server-side span in
                         # the stitched timeline — that is the whole point
                         # of tracing a misbehaving store
-                        if not await self._inject_fault(op, act, writer):
+                        if not await self._inject_fault(op, act, writer, body):
                             alive = False  # drop_conn: die without answering
                         elif act["action"] == "error":
                             skip = True  # error already written; next frame
                     if alive and not skip:
                         t0 = time.perf_counter()
                         resp = await self._dispatch(
-                            op, body, reader, writer, conn_pending
+                            op, body, reader, writer, conn_pending, cs
                         )
                         dt = time.perf_counter() - t0
                 if not alive:
@@ -396,14 +484,29 @@ class StoreServer:
             except Exception:
                 pass
 
-    async def _inject_fault(self, op: int, act: dict, writer) -> bool:
+    async def _inject_fault(self, op: int, act: dict, writer, body) -> bool:
         """Apply one matched fault rule.  Returns False when the
         connection must die (``drop_conn``); True continues — after a
-        ``delay``/``stall`` the op proceeds normally, after ``error`` the
-        caller skips dispatch (the error response is already written)."""
+        ``delay``/``stall``/``corrupt`` the op proceeds normally, after
+        ``error`` the caller skips dispatch (the error response is
+        already written)."""
         name = P.op_name(op)
         self._c_faults.labels(name, act["action"]).inc()
         Logger.warn(f"fault injected: {act['action']} on {name}")
+        if act["action"] == "corrupt":
+            # deterministic bit damage: XOR-flip the first byte of every
+            # named key's committed region, leaving the stamped checksum
+            # stale — the exact fault the verification plane exists for
+            flipped = 0
+            for key in _fault_keys(op, body):
+                e = self.store.kv.get(key)
+                if e is None or e.size == 0:
+                    continue
+                view = self.store.mm.view(e.pool_idx, e.offset, e.size)
+                view[0] ^= 0xFF
+                flipped += 1
+            Logger.warn(f"corrupt fault flipped {flipped} committed entries")
+            return True
         if act["action"] == "drop_conn":
             try:
                 writer.transport.abort()  # RST, mid-op — no goodbye
@@ -434,6 +537,7 @@ class StoreServer:
         reader: asyncio.StreamReader,
         writer: asyncio.StreamWriter,
         conn_pending: set,
+        cs: dict,
     ) -> bytes | None:
         st = self.store
         if op == P.OP_HELLO:
@@ -448,6 +552,14 @@ class StoreServer:
                 resp += P.pack_hello_trailer(
                     P.HELLO_FLAG_TRACE_CTX, time.perf_counter()
                 )
+            if (cflags & P.HELLO_FLAG_INTEGRITY) and st.integrity != "off":
+                # integrity capability answer: boot epoch + checksum alg.
+                # Appended only when asked, so legacy HELLOs stay
+                # byte-identical; from here on THIS connection's
+                # GET_DESC / inline-get responses use the checksummed,
+                # epoch-fenced layouts.
+                resp += P.pack_epoch_trailer(st.checksum_alg, st.epoch)
+                cs["integrity"] = True
             return P.pack_resp(P.FINISH, resp)
         if op == P.OP_TRACE_DUMP:
             return P.pack_resp(
@@ -468,6 +580,9 @@ class StoreServer:
             view = st.get_inline(keys[0])
             if view is None:
                 return P.pack_resp(P.KEY_NOT_FOUND)
+            if cs["integrity"]:
+                hdr = P.pack_inline_resp_ex(st.epoch, st.kv[keys[0]].crc)
+                return P.pack_resp(P.FINISH, hdr + bytes(view))
             return P.pack_resp(P.FINISH, bytes(view))
         if op == P.OP_ALLOC_PUT:
             keys, block_size = P.unpack_alloc_put(body)
@@ -486,7 +601,18 @@ class StoreServer:
             keys, block_size = P.unpack_alloc_put(body)
             with tracing.span("store.desc_build", keys=len(keys)):
                 status, descs = st.get_desc(keys, block_size)
+            if cs["integrity"]:
+                if status != P.FINISH:
+                    return P.pack_resp(status)
+                ex = [(p, o, s, st.kv[k].crc)
+                      for (p, o, s), k in zip(descs, keys)]
+                return P.pack_resp(
+                    status, P.pack_desc_resp_ex(st.epoch, ex)
+                )
             return P.pack_resp(status, P.pack_descs(descs))
+        if op == P.OP_RELEASE_DESC:
+            keys, _ = P.unpack_keys(body)
+            return P.pack_resp(P.FINISH, P.pack_i32(st.release_desc(keys)))
         if op == P.OP_EXIST:
             keys, _ = P.unpack_keys(body)
             if not keys:
@@ -553,10 +679,19 @@ class StoreServer:
             status, descs = st.get_desc(keys, block_size)
             if status != P.FINISH:
                 return P.pack_resp(status)
-            # resp body = n x size:u32 | payloads streamed straight from the
-            # shm pool (no batch-sized intermediate copies)
+            # resp body = n x size:u32 | payloads streamed straight from
+            # the shm pool (no batch-sized intermediate copies); on
+            # integrity-negotiated connections the size table becomes
+            # epoch u64 | n x {size, csum, flags} so the client can
+            # verify the received bytes end to end
             total = sum(size for (_, _, size) in descs)
-            sizes = b"".join(P._U32.pack(size) for (_, _, size) in descs)
+            if cs["integrity"]:
+                sizes = P.pack_u64(st.epoch) + b"".join(
+                    P.pack_batch_item_ex(size, st.kv[k].crc)
+                    for (_, _, size), k in zip(descs, keys)
+                )
+            else:
+                sizes = b"".join(P._U32.pack(size) for (_, _, size) in descs)
             writer.write(P.RESP.pack(P.FINISH, len(sizes) + total))
             writer.write(sizes)
             with tracing.span("store.pool_copy", bytes=total):

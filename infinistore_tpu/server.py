@@ -182,6 +182,15 @@ def _manage_handler(server_ref):
                                          "python backend"}, 501)
                 else:
                     self._json({"rules": srv.faults.snapshot()})
+            elif path == "/debug/integrity":
+                # the integrity plane's state: level/alg/epoch, stamping
+                # backlog, scrub + quarantine counters (python backend)
+                srv = server_ref()
+                if srv is None or not hasattr(srv, "integrity_report"):
+                    self._json({"error": "integrity requires the python "
+                                         "backend"}, 501)
+                else:
+                    self._json(srv.integrity_report())
             elif path == "/kvmap_len":
                 self._json({"len": store.kvmap_len() if store else 0})
             elif path == "/usage":
@@ -264,6 +273,20 @@ def parse_args():
                              "(both backends)")
     parser.add_argument("--disk-tier-size", required=False, default=64, type=int,
                         help="disk tier capacity in GB")
+    parser.add_argument("--integrity", required=False, default="",
+                        choices=["", "off", "verify", "scrub"],
+                        help="KV integrity level (default: ISTPU_INTEGRITY "
+                             "or 'verify'): checksummed entries + read "
+                             "verification; 'scrub' adds the background "
+                             "scrubber (docs/robustness.md)")
+    parser.add_argument("--integrity-alg", required=False, default="",
+                        choices=["", "sum64", "crc32"],
+                        help="entry checksum algorithm (default: "
+                             "ISTPU_INTEGRITY_ALG or 'sum64')")
+    parser.add_argument("--scrub-rate", required=False, default=0,
+                        type=float,
+                        help="scrubber re-verification rate, pages/second "
+                             "(0 = ISTPU_SCRUB_RATE or 256)")
     parser.add_argument("--allocator", required=False, default="bitmap",
                         choices=["bitmap", "sizeclass"],
                         help="pool allocator: 'bitmap' (uniform-block "

@@ -38,12 +38,13 @@ from __future__ import annotations
 
 import os
 import time
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from . import protocol as P
 from .mempool import MM
+from .utils import checksum as _checksum
 
 ON_DEMAND_MIN_THRESHOLD = 0.8  # reference: src/infinistore.cpp:52
 ON_DEMAND_MAX_THRESHOLD = 0.95  # reference: src/infinistore.cpp:53
@@ -66,6 +67,14 @@ class Entry:
     created: float = 0.0
     last_access: float = 0.0
     hits: int = 0
+    # integrity plane: content checksum stamped after commit (None while
+    # the stamping backlog hasn't reached this entry — readers skip
+    # verification for unstamped descs), and the live GET_DESC reader
+    # count behind the lease (OP_RELEASE_DESC decrements; the lease
+    # clears early when it reaches zero, while legacy clients that never
+    # release keep the timed behavior)
+    crc: Optional[int] = None
+    readers: int = 0
 
 
 @dataclass
@@ -80,6 +89,8 @@ class Stats:
     spilled: int = 0    # DRAM -> disk tier
     promoted: int = 0   # disk tier -> DRAM
     contig_batches: int = 0  # batch allocs served as one contiguous run
+    scrub_pages: int = 0    # entries re-verified by the background scrubber
+    scrub_corrupt: int = 0  # corrupt entries found and quarantined
 
 
 class CacheAnalytics:
@@ -284,6 +295,7 @@ class Store:
         # monkeypatching the global time module
         self._clock = time.monotonic
         self.analytics = CacheAnalytics()
+        self._init_integrity(config)
         # second tier: LRU-evicted entries spill here and promote back on
         # access ("Historical KVCache in DRAM and SSD")
         self.disk: Optional[DiskTier] = None
@@ -294,6 +306,33 @@ class Store:
                 int(getattr(config, "disk_tier_size", 64)) << 30,
                 self.mm.block_size,
             )
+
+    def _init_integrity(self, config) -> None:
+        """Integrity-plane state (also called by tests that hand-build
+        stores via ``Store.__new__``).  ``epoch`` is the boot epoch every
+        descriptor is fenced against: a client holding descs or pool
+        mappings from a different epoch is talking through a restart."""
+        level = (getattr(config, "integrity", "") or
+                 os.environ.get("ISTPU_INTEGRITY", "") or "verify")
+        if level not in ("off", "verify", "scrub"):
+            raise ValueError(
+                f"ISTPU_INTEGRITY must be off|verify|scrub, got {level!r}"
+            )
+        self.integrity = level
+        alg = (getattr(config, "integrity_alg", "") or
+               os.environ.get("ISTPU_INTEGRITY_ALG", "") or "sum64")
+        self.checksum_alg = _checksum.alg_id(alg)
+        self.epoch = time.time_ns() & ((1 << 63) - 1)
+        self.scrub_rate = float(
+            getattr(config, "scrub_rate", 0)
+            or os.environ.get("ISTPU_SCRUB_RATE", 0) or 256.0
+        )
+        # commit-time stamping backlog: (key, entry) pairs drained by
+        # stamp_pending.  Deferred on purpose — a synchronous checksum at
+        # COMMIT_PUT would serialize a full extra memory pass into the
+        # measured put path (the perf-smoke floor)
+        self._unstamped: deque = deque()
+        self._scrub_keys: List[bytes] = []  # current scrub pass snapshot
 
     # ---- helpers ----
 
@@ -552,6 +591,11 @@ class Store:
             # never promote back over it)
             self.disk.pop(key)
         self.kv[key] = e  # appended at MRU end
+        if self.integrity != "off":
+            # queue for checksum stamping; the integrity worker drains
+            # this eagerly (stamp_pending), so commit latency never pays
+            # the checksum pass
+            self._unstamped.append((key, e))
 
     def get_desc(self, keys: Sequence[bytes], block_size: int = 0):
         """Batched descriptors for zero-copy reads.  404 if any key missing.
@@ -572,6 +616,9 @@ class Store:
                 return P.KEY_NOT_FOUND, []
             if block_size and e.size > block_size:
                 return P.INVALID_REQ, []
+            if e.lease <= now:
+                e.readers = 0  # previous lease window fully over
+            e.readers += 1
             e.lease = now + READ_LEASE_S
         descs = []
         for key in keys:
@@ -583,6 +630,118 @@ class Store:
             self.stats.bytes_out += e.size
             descs.append((e.pool_idx, e.offset, e.size))
         return P.FINISH, descs
+
+    def release_desc(self, keys: Sequence[bytes]) -> int:
+        """Explicit read-lease release (wire OP_RELEASE_DESC): a client
+        whose copy verified has no further claim on the region.  Each
+        release pays back one GET_DESC's reader count; the lease clears
+        only at zero, so a LEGACY reader's concurrent timed lease is
+        never cut short by a new client's release."""
+        released = 0
+        now = self._clock()
+        for key in keys:
+            e = self.kv.get(key)
+            if e is None or e.lease <= now:
+                continue
+            if e.readers > 0:
+                e.readers -= 1
+            if e.readers == 0:
+                e.lease = 0.0
+                released += 1
+        return released
+
+    # ---- integrity: stamping, scrubbing, quarantine ----
+
+    def _checksum_entry(self, e: Entry) -> int:
+        return _checksum.checksum(
+            self.mm.view(e.pool_idx, e.offset, e.size), self.checksum_alg
+        )
+
+    def stamp_pending(self, max_bytes: int = 4 << 20) -> int:
+        """Drain (a bounded slice of) the commit-time stamping backlog.
+        Returns entries stamped; 0 means the backlog is empty.  Bound is
+        in BYTES so one call's pool pass stays small enough to interleave
+        with data-plane ops.  Entries that were deleted/overwritten since
+        commit are discarded by the identity re-check."""
+        done = 0
+        budget = max_bytes
+        while self._unstamped and budget > 0:
+            key, e = self._unstamped.popleft()
+            if self.kv.get(key) is not e or e.crc is not None:
+                continue
+            crc = self._checksum_entry(e)
+            if self.kv.get(key) is e:  # still bound after the pass
+                e.crc = crc
+                done += 1
+            budget -= e.size
+        return done
+
+    def verify_entry(self, key: bytes, e: Entry) -> Optional[bool]:
+        """Re-verify one committed entry.  None = unstamped (nothing to
+        compare yet)."""
+        if e.crc is None:
+            return None
+        return self._checksum_entry(e) == e.crc
+
+    def quarantine(self, key: bytes) -> bool:
+        """Corrupt entry containment: the key disappears immediately (a
+        read must MISS, never serve bad bytes) and the blocks go through
+        the existing deferred-release path in case an shm reader still
+        holds a lease on them."""
+        now = self._clock()
+        e = self.kv.pop(key, None)
+        if self.disk is not None:
+            self.disk.pop(key)
+        if e is None:
+            return False
+        self._free_or_defer(e, now)
+        self.stats.scrub_corrupt += 1
+        return True
+
+    def scrub_step(self, max_entries: int = 32) -> Tuple[int, int]:
+        """One bounded scrubber pass over committed, unleased entries:
+        re-verify stamped checksums, quarantine mismatches, and stamp any
+        entry the commit backlog missed (its first verification).  Walks
+        a snapshot of the key space so concurrent commits/evictions
+        between steps never skip or double-visit; returns
+        (entries scanned, corrupt found)."""
+        if not self._scrub_keys:
+            self._scrub_keys = list(self.kv.keys())
+        now = self._clock()
+        scanned = corrupt = 0
+        while self._scrub_keys and scanned < max_entries:
+            key = self._scrub_keys.pop()
+            e = self.kv.get(key)
+            if e is None or e.busy or e.lease > now:
+                continue  # gone, streaming, or under a live read lease
+            scanned += 1
+            if e.crc is None:
+                e.crc = self._checksum_entry(e)
+                continue
+            if self._checksum_entry(e) != e.crc:
+                self.quarantine(key)
+                corrupt += 1
+        self.stats.scrub_pages += scanned
+        return scanned, corrupt
+
+    def unverified_count(self) -> int:
+        """Committed entries not yet stamped (the /debug/integrity view;
+        O(n) — a debug read, not a data-path cost)."""
+        return sum(1 for e in self.kv.values() if e.crc is None)
+
+    def integrity_report(self) -> dict:
+        """The /debug/integrity payload."""
+        return {
+            "level": self.integrity,
+            "alg": _checksum.alg_name(self.checksum_alg),
+            "epoch": self.epoch,
+            "unverified": self.unverified_count(),
+            "stamp_backlog": len(self._unstamped),
+            "scrub_pages": self.stats.scrub_pages,
+            "scrub_corrupt": self.stats.scrub_corrupt,
+            "quarantined": self.stats.scrub_corrupt,
+            "scrub_rate": self.scrub_rate,
+        }
 
     def _present(self, key: bytes) -> bool:
         """Retrievable from EITHER tier — the presence notion exist and the
@@ -642,6 +801,7 @@ class Store:
         "disk_entries", "disk_bytes",
         "active_read_leases", "deferred_frees", "fragmentation",
         "free_bytes", "largest_free_run_bytes", "free_runs",
+        "epoch", "stamp_backlog",
     })
 
     def cache_report(self, top_n: int = 10) -> dict:
@@ -709,6 +869,10 @@ class Store:
             "active_read_leases": self.active_leases(),
             "deferred_frees": len(self._deferred),
             "dead_on_arrival": self.analytics.dead_on_arrival,
+            "epoch": self.epoch,
+            "stamp_backlog": len(self._unstamped),
+            "scrub_pages": s.scrub_pages,
+            "scrub_corrupt": s.scrub_corrupt,
         }
         d.update(self.mm.frag_stats())
         if self.disk is not None:

@@ -69,12 +69,14 @@ class Snapshot:
                  store_metrics: Optional[dict] = None,
                  cache: Optional[dict] = None,
                  serve_health: Optional[dict] = None,
-                 store_health: Optional[dict] = None):
+                 store_health: Optional[dict] = None,
+                 integrity: Optional[dict] = None):
         self.serve = serve_metrics or {}
         self.store = store_metrics or {}
         self.cache = cache
         self.serve_health = serve_health
         self.store_health = store_health
+        self.integrity = integrity
 
     def value(self, name: str, labels: Tuple[Tuple[str, str], ...] = (),
               default: Optional[float] = None) -> Optional[float]:
@@ -118,6 +120,20 @@ LATENCY_ROWS = (
 _CIRCUIT = {0: "closed", 1: "OPEN", 2: "half-open"}
 
 
+class _Delta:
+    """Per-frame increment of one counter series (None until two
+    samples)."""
+
+    def __init__(self):
+        self.prev: Optional[float] = None
+
+    def update(self, value: Optional[float]) -> Optional[float]:
+        if value is None:
+            return None
+        prev, self.prev = self.prev, value
+        return None if prev is None else max(0.0, value - prev)
+
+
 class Console:
     """Holds the sparkline history between frames; ``frame`` is pure in
     the snapshot (no IO, no globals) so it is directly testable."""
@@ -125,6 +141,7 @@ class Console:
     def __init__(self, history: int = 48):
         self.hist: Dict[str, deque] = {}
         self.rates: Dict[str, _HistRate] = {}
+        self.deltas: Dict[str, _Delta] = {}
         self.history = history
 
     def _series(self, key: str) -> deque:
@@ -171,6 +188,32 @@ class Console:
             out.append(
                 f"hit ratio       [{bar(ratio, w)}] {ratio:6.1%}   "
                 f"{sparkline(list(self._series('hit_ratio')), 16)}"
+            )
+        # -- integrity plane: scrub progress, corruption, epoch --
+        integ = snap.integrity or {}
+        scrub_pages = snap.value("istpu_store_scrub_pages_total",
+                                 default=integ.get("scrub_pages"))
+        corrupt = snap.value("istpu_store_scrub_corrupt_total",
+                             default=integ.get("scrub_corrupt"))
+        if integ.get("level") or scrub_pages is not None:
+            rate = self.deltas.setdefault("scrub", _Delta()).update(
+                scrub_pages
+            )
+            fails = sum(
+                v for (name, _labels), v in snap.serve.items()
+                if name == "istpu_integrity_failures_total"
+            ) or integ.get("client_failures", 0)
+            out.append(
+                "integrity {:6s}  epoch {:>12}  scrubbed {:>8} pg"
+                " ({}/s)  corrupt {:>4}  quarantined {:>4}".format(
+                    str(integ.get("level", "?")),
+                    str(integ.get("epoch", "-"))[-12:],
+                    int(scrub_pages or 0),
+                    "-" if rate is None else f"{rate:.0f}",
+                    int(corrupt or 0),
+                    int(integ.get("quarantined", corrupt or 0)),
+                )
+                + (f"   verify-fails {int(fails)}" if fails else "")
             )
         doa = cache.get("dead_on_arrival",
                         snap.value("istpu_cache_dead_on_arrival_total"))
@@ -265,12 +308,16 @@ def poll(serve_url: Optional[str], store_url: Optional[str]) -> Snapshot:
         except ValueError:
             return None
 
+    integ = js(store_url, "/debug/integrity")
+    if integ is not None and "level" not in integ:
+        integ = None  # native backend: endpoint answers an error payload
     return Snapshot(
         serve_metrics=prom(serve_url, "/metrics"),
         store_metrics=prom(store_url, "/metrics"),
         cache=js(store_url, "/debug/cache"),
         serve_health=js(serve_url, "/healthz"),
         store_health=js(store_url, "/healthz"),
+        integrity=integ,
     )
 
 

@@ -47,6 +47,7 @@ def make_server(block_kb=16, pool_mb=1):
     store.disk = None
     store._clock = time.monotonic
     store.analytics = CacheAnalytics()
+    store._init_integrity(cfg)  # integrity plane state (epoch, backlog)
     return StoreServer(cfg, store=store)
 
 

@@ -1,0 +1,644 @@
+"""End-to-end KV-cache integrity: checksummed pages, epoch-fenced
+descriptors, corruption injection and the background scrubber.
+
+The contract under test (docs/robustness.md §5): silent garbage —
+a region recycled behind an expired read lease, a torn write, a fault-
+flipped pool byte, a pool mapping that predates a server restart — is
+always DETECTED and served as a cache miss (recompute), never delivered
+into the paged cache or surfaced as a failed request.  Corruption is
+driven deterministically through the ``FaultInjector``'s ``corrupt``
+action, never by poking /dev/shm and hoping.
+"""
+
+import http.client
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import infinistore_tpu as ist
+from infinistore_tpu import protocol as P
+from infinistore_tpu.utils import checksum as C
+from infinistore_tpu.utils import metrics as m
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _boot(port, mport, extra_env=None, extra_args=()):
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "infinistore_tpu.server",
+         "--service-port", str(port), "--manage-port", str(mport),
+         "--prealloc-size", "1", "--minimal-allocate-size", "16",
+         "--log-level", "warning", "--backend", "python", *extra_args],
+        env={**os.environ, "JAX_PLATFORMS": "cpu", **(extra_env or {})},
+    )
+    deadline = time.time() + 25
+    for p in (port, mport):
+        while True:
+            if proc.poll() is not None:
+                pytest.fail("server process failed to start")
+            try:
+                socket.create_connection(("127.0.0.1", p), timeout=0.5).close()
+                break
+            except OSError:
+                if time.time() >= deadline:
+                    proc.kill()
+                    pytest.fail(f"server port {p} did not come up")
+                time.sleep(0.1)
+    return proc
+
+
+def _stop(proc):
+    proc.send_signal(signal.SIGINT)
+    try:
+        proc.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+
+
+def _arm(mport, rules):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{mport}/faults", method="POST",
+        data=json.dumps(rules).encode(),
+    )
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return json.load(r)
+
+
+def _integrity(mport):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{mport}/debug/integrity", timeout=10
+    ) as r:
+        return json.load(r)
+
+
+def _wait_stamped(mport, timeout=10.0):
+    """Block until the stamping backlog drained (every committed entry
+    carries a checksum) — corruption tests arm faults only after this,
+    so detection is deterministic, not racing the integrity worker."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        rep = _integrity(mport)
+        if rep["unverified"] == 0 and rep["stamp_backlog"] == 0:
+            return rep
+        time.sleep(0.05)
+    pytest.fail("stamping backlog did not drain")
+
+
+def _store_metrics(mport):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{mport}/metrics", timeout=10
+    ) as r:
+        return m.parse_prometheus_text(r.read().decode())
+
+
+def _conn(port, ctype=ist.TYPE_SHM, op_timeout_s=5.0, **kw):
+    # op_timeout_s pins the PYTHON client: the integrity plane lives in
+    # its channel layer (the native C client never negotiates it)
+    c = ist.InfinityConnection(ist.ClientConfig(
+        host_addr="127.0.0.1", service_port=port, connection_type=ctype,
+        log_level="error", op_timeout_s=op_timeout_s, **kw,
+    ))
+    c.connect()
+    return c
+
+
+def _failures(cause):
+    parsed = m.parse_prometheus_text(
+        m.default_registry().to_prometheus_text()
+    )
+    return parsed.get(
+        ("istpu_integrity_failures_total", (("cause", cause),)), 0.0
+    )
+
+
+@pytest.fixture(scope="module")
+def server():
+    port, mport = _free_port(), _free_port()
+    proc = _boot(port, mport)
+    yield port, mport
+    _stop(proc)
+
+
+@pytest.fixture(autouse=True)
+def _clear_faults(server):
+    yield
+    try:
+        _arm(server[1], [])
+    except OSError:
+        pass
+
+
+# ---- checksum + protocol units (no server) ----
+
+
+def test_checksum_algorithms_agree_and_detect_flips():
+    data = np.random.randint(0, 256, 64 << 10, dtype=np.uint8)
+    for alg in (C.ALG_SUM64, C.ALG_CRC32):
+        ref = C.checksum(data, alg)
+        assert ref == C.checksum(bytes(data), alg)  # buffer-kind agnostic
+        flipped = data.copy()
+        flipped[12345] ^= 0x01  # a single bit
+        assert C.checksum(flipped, alg) != ref
+        # the row-vectorized path must agree bit-for-bit with the scalar
+        rows = data.reshape(4, 16 << 10)
+        assert C.checksum_rows(rows, alg) == [
+            C.checksum(rows[i], alg) for i in range(4)
+        ]
+    # scalar sum64 handles non-8-aligned tails
+    odd = data[: (16 << 10) + 3]
+    assert C.checksum(odd) != C.checksum(odd[:-1])
+
+
+def test_protocol_epoch_trailer_and_desc_ex_roundtrip():
+    pools = [("istpu_pool_0", 1 << 20, 16 << 10)]
+    legacy = P.pack_pool_table(pools)
+    # legacy body: no epoch, and the 3-tuple hello parser is untouched
+    assert P.unpack_hello_epoch(memoryview(legacy)) is None
+    # EPOC alone, and EPOC behind a TRAC trailer, both resolve; the
+    # legacy pool-table parser ignores every trailer byte either way
+    for body in (
+        legacy + P.pack_epoch_trailer(C.ALG_SUM64, 777),
+        legacy + P.pack_hello_trailer(P.HELLO_FLAG_TRACE_CTX, 1.5)
+        + P.pack_epoch_trailer(C.ALG_CRC32, 888),
+    ):
+        assert P.unpack_pool_table(memoryview(body)) == pools
+        alg, epoch = P.unpack_hello_epoch(memoryview(body))
+        assert (alg, epoch) in ((C.ALG_SUM64, 777), (C.ALG_CRC32, 888))
+    got_pools, flags, t = P.unpack_hello_resp(
+        memoryview(legacy + P.pack_epoch_trailer(1, 9)))
+    assert got_pools == pools and flags == 0  # EPOC != TRAC for old logic
+
+    descs = [(0, 0, 4096, 123), (1, 1 << 33, 65536, None)]
+    buf = P.pack_desc_resp_ex(42, descs)
+    epoch, out = P.unpack_desc_resp_ex(memoryview(buf))
+    assert epoch == 42 and out == descs
+    # inline ex prefix + batch items
+    epoch, csum, consumed = P.unpack_inline_resp_ex(
+        memoryview(P.pack_inline_resp_ex(7, None) + b"xy"))
+    assert (epoch, csum, consumed) == (7, None, P.INLINE_EX_SIZE)
+    items = P.pack_batch_item_ex(10, 5) + P.pack_batch_item_ex(20, None)
+    assert P.unpack_batch_items_ex(memoryview(items), 2) == [
+        (10, 5), (20, None)]
+
+
+# ---- store units (hand-built store, injectable clock) ----
+
+
+def _unit_store():
+    from test_store_unit import make_store
+
+    return make_store()
+
+
+def test_store_stamps_verifies_and_quarantines():
+    s = _unit_store()
+    try:
+        s.put_inline(b"k", b"hello world" * 100)
+        e = s.kv[b"k"]
+        assert e.crc is None  # stamping is deferred off the commit path
+        assert s.stamp_pending() == 1
+        assert e.crc is not None and s.verify_entry(b"k", e) is True
+        # flip a committed byte: verify fails, scrub quarantines
+        s.mm.view(e.pool_idx, e.offset, e.size)[0] ^= 0xFF
+        assert s.verify_entry(b"k", e) is False
+        scanned, corrupt = s.scrub_step()
+        assert scanned == 1 and corrupt == 1
+        assert b"k" not in s.kv and s.stats.scrub_corrupt == 1
+        assert s.integrity_report()["quarantined"] == 1
+    finally:
+        s.close()
+
+
+def test_scrub_skips_leased_and_stamps_backlog():
+    s = _unit_store()
+    try:
+        now = [1000.0]
+        s._clock = lambda: now[0]
+        s.put_inline(b"a", b"x" * 4096)
+        s.put_inline(b"b", b"y" * 4096)
+        st, _ = s.get_desc([b"a"])  # leases 'a'
+        assert st == P.FINISH
+        scanned, corrupt = s.scrub_step()
+        # 'a' is under a live lease -> skipped; 'b' gets first-stamped
+        assert scanned == 1 and corrupt == 0
+        assert s.kv[b"b"].crc is not None and s.kv[b"a"].crc is None
+        now[0] += 10.0  # lease expires -> next pass reaches 'a'
+        s.scrub_step()
+        assert s.kv[b"a"].crc is not None
+    finally:
+        s.close()
+
+
+def test_quarantine_defers_free_under_live_lease():
+    s = _unit_store()
+    try:
+        now = [1000.0]
+        s._clock = lambda: now[0]
+        s.put_inline(b"k", b"z" * 4096)
+        s.get_desc([b"k"])  # an shm reader may be mid-copy
+        assert s.quarantine(b"k")
+        assert b"k" not in s.kv  # key gone immediately (reads must miss)
+        assert len(s._deferred) == 1  # blocks still pinned for the reader
+        now[0] += 10.0
+        s._reap_deferred(now[0])
+        assert not s._deferred
+    finally:
+        s.close()
+
+
+def test_release_desc_clears_lease_only_at_zero_readers():
+    s = _unit_store()
+    try:
+        now = [1000.0]
+        s._clock = lambda: now[0]
+        s.put_inline(b"k", b"q" * 4096)
+        s.get_desc([b"k"])
+        s.get_desc([b"k"])  # two concurrent readers
+        e = s.kv[b"k"]
+        assert e.readers == 2 and e.lease > now[0]
+        assert s.release_desc([b"k"]) == 0  # one reader still holds it
+        assert e.lease > now[0]
+        assert s.release_desc([b"k"]) == 1  # last reader out
+        assert e.lease == 0.0 and s.active_leases() == 0
+        # releasing an unleased / unknown key is a no-op
+        assert s.release_desc([b"k", b"nope"]) == 0
+        # a lease that expired naturally resets the reader count on the
+        # next grant (legacy clients never release)
+        s.get_desc([b"k"])
+        now[0] += 10.0
+        s.get_desc([b"k"])
+        assert s.kv[b"k"].readers == 1
+    finally:
+        s.close()
+
+
+# ---- wire: verification, release, corruption, epoch fencing ----
+
+
+def test_shm_read_verifies_and_releases_lease_early(server):
+    port, mport = server
+    conn = _conn(port)
+    assert conn.conn.integrity and conn.conn.epoch is not None
+    blk, n = 16 << 10, 8
+    src = np.random.randint(0, 256, n * blk, dtype=np.uint8)
+    dst = np.zeros_like(src)
+    conn.register_mr(src)
+    conn.register_mr(dst)
+    blocks = [(f"rel-{i}", i * blk) for i in range(n)]
+    conn.write_cache(blocks, blk, src.ctypes.data)
+    _wait_stamped(mport)
+    conn.read_cache(blocks, blk, dst.ctypes.data)
+    np.testing.assert_array_equal(src, dst)
+    # the satellite contract: verified copies hand their leases back NOW,
+    # not after the 5 s timed lease (which fragmented back-to-back bench
+    # runs); poll briefly — the release is a fire-and-forget frame
+    deadline = time.time() + 2.0
+    while time.time() < deadline:
+        if _store_metrics(mport).get(
+                ("istpu_store_active_read_leases", ()), 0) == 0:
+            break
+        time.sleep(0.05)
+    assert _store_metrics(mport)[
+        ("istpu_store_active_read_leases", ())] == 0
+    conn.close()
+
+
+def test_corrupt_fault_is_detected_and_counted(server):
+    port, mport = server
+    conn = _conn(port)
+    blk, n = 16 << 10, 4
+    src = np.random.randint(0, 256, n * blk, dtype=np.uint8)
+    dst = np.zeros_like(src)
+    conn.register_mr(src)
+    conn.register_mr(dst)
+    blocks = [(f"cor-{i}", i * blk) for i in range(n)]
+    conn.write_cache(blocks, blk, src.ctypes.data)
+    _wait_stamped(mport)
+    before = _failures("checksum")
+    _arm(mport, [{"op": "GET_DESC", "action": "corrupt", "times": 1}])
+    with pytest.raises(ist.InfiniStoreIntegrityError) as ei:
+        conn.read_cache(blocks, blk, dst.ctypes.data)
+    assert ei.value.cause == "checksum" and ei.value.keys
+    assert _failures("checksum") == before + 1
+    # the injected corruption is visible in the fault counter too
+    assert _store_metrics(mport)[
+        ("istpu_store_faults_injected_total",
+         (("action", "corrupt"), ("op", "GET_DESC")))] >= 1
+    conn.close()
+
+
+def test_corrupt_inline_get_detected_over_tcp(server):
+    port, mport = server
+    conn = _conn(port, ctype=ist.TYPE_TCP)
+    payload = np.random.randint(0, 256, 4096, dtype=np.uint8)
+    conn.register_mr(payload)
+    conn.tcp_write_cache("tcp-cor", payload.ctypes.data, payload.nbytes)
+    _wait_stamped(mport)
+    assert conn.tcp_read_cache("tcp-cor").tobytes() == payload.tobytes()
+    _arm(mport, [{"op": "GET_INLINE", "action": "corrupt", "times": 1}])
+    with pytest.raises(ist.InfiniStoreIntegrityError):
+        conn.tcp_read_cache("tcp-cor")
+    conn.close()
+
+
+def test_epoch_fence_invalidates_read_and_remaps(server):
+    """A client whose captured epoch no longer matches the server's must
+    fail the read closed (cause=epoch), drop its pool attach, remap, and
+    recover on the next op."""
+    port, mport = server
+    conn = _conn(port)
+    raw = conn.conn
+    blk = 16 << 10
+    src = np.random.randint(0, 256, blk, dtype=np.uint8)
+    dst = np.zeros_like(src)
+    conn.register_mr(src)
+    conn.register_mr(dst)
+    conn.write_cache([("ep-0", 0)], blk, src.ctypes.data)
+    before = _failures("epoch")
+    raw.epoch -= 1  # simulate state captured from a pre-restart server
+    with pytest.raises(ist.InfiniStoreIntegrityError) as ei:
+        conn.read_cache([("ep-0", 0)], blk, dst.ctypes.data)
+    assert ei.value.cause == "epoch"
+    assert _failures("epoch") == before + 1
+    assert raw.epoch is not None and raw.pools  # resynced + remapped
+    conn.read_cache([("ep-0", 0)], blk, dst.ctypes.data)  # recovered
+    np.testing.assert_array_equal(src, dst)
+    conn.close()
+
+
+def test_store_restart_fences_stale_clients_fail_closed():
+    """Kill → restart behind auto-reconnect: the reconnected client must
+    observe the NEW epoch (counted as an epoch fence), map the NEW pools,
+    and answer reads of pre-restart keys with a clean miss — never bytes
+    from a recycled pool."""
+    port, mport = _free_port(), _free_port()
+    proc = _boot(port, mport)
+    conn = _conn(port, op_timeout_s=2.0, auto_reconnect=True)
+    epoch0 = conn.conn.epoch
+    assert epoch0 is not None
+    blk = 16 << 10
+    src = np.random.randint(0, 256, blk, dtype=np.uint8)
+    dst = np.zeros_like(src)
+    conn.register_mr(src)
+    conn.register_mr(dst)
+    conn.write_cache([("fence-0", 0)], blk, src.ctypes.data)
+    conn.read_cache([("fence-0", 0)], blk, dst.ctypes.data)
+    np.testing.assert_array_equal(src, dst)
+
+    proc.kill()  # hard kill: no goodbye, shm unlinked by the sweeper
+    proc.wait(timeout=10)
+    proc = _boot(port, mport)
+    before = _failures("epoch")
+
+    # the first op fails over the dead socket, reconnects, and lands on
+    # the restarted (empty) store: fail-closed KeyNotFound, NEVER stale
+    # bytes out of a recycled pool
+    dst[:] = 0
+    with pytest.raises(ist.InfiniStoreKeyNotFound):
+        conn.read_cache([("fence-0", 0)], blk, dst.ctypes.data)
+    assert not dst.any(), "stale bytes delivered across a restart"
+    assert conn.conn.epoch != epoch0  # the new boot epoch was captured
+    assert _failures("epoch") >= before + 1  # the fence was counted
+    # and the fresh epoch serves normally
+    conn.write_cache([("fence-1", 0)], blk, src.ctypes.data)
+    conn.read_cache([("fence-1", 0)], blk, dst.ctypes.data)
+    np.testing.assert_array_equal(src, dst)
+    conn.close()
+    _stop(proc)
+
+
+# ---- the background scrubber (live, level=scrub) ----
+
+
+def test_scrubber_quarantines_corrupt_entries_live():
+    port, mport = _free_port(), _free_port()
+    proc = _boot(port, mport, extra_args=("--integrity", "scrub",
+                                          "--scrub-rate", "5000"))
+    conn = _conn(port)
+    blk, n = 16 << 10, 8
+    src = np.random.randint(0, 256, n * blk, dtype=np.uint8)
+    conn.register_mr(src)
+    blocks = [(f"scr-{i}", i * blk) for i in range(n)]
+    conn.write_cache(blocks, blk, src.ctypes.data)
+    _wait_stamped(mport)
+    # flip bytes in ONE entry via the corrupt fault (EXIST names the key
+    # without reading it, so nothing verifies client-side first)
+    _arm(mport, [{"op": "EXIST", "action": "corrupt", "times": 1}])
+    assert conn.check_exist("scr-3") is True
+    _arm(mport, [])
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        rep = _integrity(mport)
+        if rep["scrub_corrupt"] >= 1:
+            break
+        time.sleep(0.05)
+    assert rep["scrub_corrupt"] == 1 and rep["quarantined"] == 1, rep
+    assert rep["scrub_pages"] >= 1
+    # quarantined = the key disappeared; the other entries still serve
+    assert conn.check_exist("scr-3") is False
+    dst = np.zeros(blk, dtype=np.uint8)
+    conn.register_mr(dst)
+    with pytest.raises(ist.InfiniStoreKeyNotFound):
+        conn.read_cache([("scr-3", 0)], blk, dst.ctypes.data)
+    conn.read_cache([("scr-0", 0)], blk, dst.ctypes.data)
+    np.testing.assert_array_equal(src[:blk], dst)
+    # both scrub families are on /metrics for alerting
+    parsed = _store_metrics(mport)
+    assert parsed[("istpu_store_scrub_corrupt_total", ())] == 1
+    assert parsed[("istpu_store_scrub_pages_total", ())] >= 1
+    conn.close()
+    _stop(proc)
+
+
+# ---- corruption chaos under the serving stack ----
+
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from infinistore_tpu.engine import InferenceEngine  # noqa: E402
+from infinistore_tpu.kv import PagedCacheConfig  # noqa: E402
+from infinistore_tpu.models import TINY, init_params, scaled  # noqa: E402
+from infinistore_tpu.serve import ServingServer  # noqa: E402
+
+from conftest import make_dense_greedy  # noqa: E402
+
+CFG = scaled(TINY, dtype=jnp.float32)
+PARAMS = init_params(CFG, jax.random.PRNGKey(7))
+T = 4
+PROMPT = [11, 42, 7, 99, 5, 3, 17, 28, 64, 1, 2]
+
+dense_greedy = make_dense_greedy(PARAMS, CFG)
+
+
+def make_pc(n_blocks=128):
+    return PagedCacheConfig(
+        n_layers=CFG.n_layers, n_kv_heads=CFG.n_kv_heads,
+        head_dim=CFG.head_dim, n_blocks=n_blocks, block_tokens=T,
+        dtype=CFG.dtype,
+    )
+
+
+def _prompt(i):
+    """Distinct same-length prompts (first token varies): repeated
+    prompts would hit the engine's LOCAL prefix cache and never touch
+    the store (the PR-3 chaos-test trap)."""
+    assert i < 450, i
+    return [50 + i] + PROMPT[1:]
+
+
+def test_guarded_load_treats_verification_failure_as_miss(server):
+    """Engine level: a corrupt store prefix degrades to recompute with
+    byte-exact tokens; the failed pages are deleted (client-assisted
+    quarantine) so the NEXT request misses cleanly and repopulates."""
+    port, mport = server
+    prod = _conn(port, op_timeout_s=5.0)
+    a = InferenceEngine(PARAMS, CFG, make_pc(), conn=prod,
+                        model_id="integ-eng")
+    a.release(a.prefill(_prompt(0)))
+    a.store_flush()
+    _wait_stamped(mport)
+
+    cons = _conn(port, op_timeout_s=5.0)
+    b = InferenceEngine(PARAMS, CFG, make_pc(), conn=cons,
+                        model_id="integ-eng")
+    before = _failures("checksum") + _failures("lease")
+    _arm(mport, [{"op": "GET_DESC", "action": "corrupt", "times": 1}])
+    st = b.prefill(_prompt(0))  # store hit found, load fails verification
+    assert st.reused_chunks == 0  # withdrawn -> full recompute
+    assert b.decode(st, 8) == dense_greedy(_prompt(0), 8)
+    b.release(st)
+    assert _failures("checksum") + _failures("lease") >= before + 1
+    assert b.breaker.state == "closed"  # bad bytes never trip the circuit
+    _arm(mport, [])
+    # self-healing: the failed pages were deleted (client-assisted
+    # quarantine) and b's recompute re-pushed FRESH pages under the same
+    # content-addressed keys — a new consumer reuses them and still
+    # decodes byte-exact, proving the corruption never survived
+    _wait_stamped(mport)
+    c2 = _conn(port, op_timeout_s=5.0)
+    eng2 = InferenceEngine(PARAMS, CFG, make_pc(), conn=c2,
+                           model_id="integ-eng")
+    st2 = eng2.prefill(_prompt(0))
+    assert st2.reused_chunks == 2  # repopulated after the quarantine
+    assert eng2.decode(st2, 8) == dense_greedy(_prompt(0), 8)
+    eng2.release(st2)
+    prod.close()
+    cons.close()
+    c2.close()
+
+
+@pytest.fixture(scope="module")
+def chaos_stack():
+    port, mport = _free_port(), _free_port()
+    proc = _boot(port, mport)
+    conn = _conn(port, op_timeout_s=2.0)
+    eng = InferenceEngine(
+        PARAMS, CFG, make_pc(n_blocks=128), conn=conn,
+        model_id="integ-serve", store_durability="relaxed",
+    )
+    eng.decode_chunk = 4
+    srv = ServingServer(eng, port=0, max_batch=4, model_id="integ-serve")
+    srv.start()
+    yield srv, proc, port, mport
+    srv.close()
+    conn.close()
+    _stop(proc)
+
+
+def _post(port, body, timeout=180, path="/v1/completions"):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    conn.request("POST", path, json.dumps(body),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, json.loads(data)
+
+
+def _get(port, path):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, data
+
+
+def test_corruption_chaos_serving_stays_byte_exact(chaos_stack):
+    """THE acceptance chaos test: with bit-flip faults armed against the
+    live store, every request still answers 200 with byte-exact greedy
+    tokens (corrupt pages degrade to recompute and are NEVER admitted
+    into the paged cache), and the client-side failure counter walks."""
+    srv, proc, port, mport = chaos_stack
+    n = [300]
+
+    def ask(prompt=None):
+        p = prompt if prompt is not None else _prompt(n[0])
+        if prompt is None:
+            n[0] += 1
+        status, body = _post(srv.port, {
+            "prompt": p, "max_tokens": 6, "temperature": 0,
+        })
+        assert status == 200, body
+        # byte-exact greedy tokens == zero corrupt pages reached attention
+        assert body["choices"][0]["token_ids"] == dense_greedy(p, 6), body
+        return body
+
+    # phase 0: healthy; a producer seeds a store-resident prefix the
+    # serving engine has never seen locally
+    ask()
+    prod_conn = _conn(port, op_timeout_s=5.0)
+    prod = InferenceEngine(PARAMS, CFG, make_pc(), conn=prod_conn,
+                           model_id="integ-serve")
+    victims = [_prompt(400 + i) for i in range(3)]
+    for v in victims:
+        prod.release(prod.prefill(v))
+    prod.store_flush()
+    _wait_stamped(mport)
+
+    # phase 1: every GET_DESC frame corrupts the pages it asks for —
+    # each victim's store hit fails verification and recomputes
+    before = _failures("checksum") + _failures("lease")
+    _arm(mport, [{"op": "GET_DESC", "action": "corrupt"}])
+    for v in victims:
+        ask(v)          # store prefix found, corrupted, detected, recomputed
+    for _ in range(3):
+        ask()           # fresh prompts keep serving normally through it
+    assert _failures("checksum") + _failures("lease") > before
+    # the store counted the injected corruption deterministically
+    assert _store_metrics(mport).get(
+        ("istpu_store_faults_injected_total",
+         (("action", "corrupt"), ("op", "GET_DESC"))), 0) >= 1
+
+    # phase 2: faults cleared — victims now hit again; recompute pushed
+    # fresh (valid) pages under the same content-addressed keys, so
+    # serving returns to store-accelerated with byte parity intact
+    _arm(mport, [])
+    for v in victims:
+        ask(v)
+    st, data = _get(srv.port, "/healthz")
+    assert st == 200 and json.loads(data)["status"] == "ok"
+    # the failure breakdown is scrapeable from the serving /metrics
+    st, data = _get(srv.port, "/metrics")
+    parsed = m.parse_prometheus_text(data.decode())
+    total_fail = sum(
+        v for (name, _l), v in parsed.items()
+        if name == "istpu_integrity_failures_total"
+    )
+    assert total_fail >= 1
+    prod_conn.close()

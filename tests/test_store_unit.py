@@ -29,6 +29,7 @@ def make_store(prealloc_mb=1, block_kb=16, **kw):
     store.disk = None
     store._clock = _time.monotonic
     store.analytics = CacheAnalytics()
+    store._init_integrity(cfg)  # integrity plane state (epoch, backlog)
     return store
 
 

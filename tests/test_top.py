@@ -38,6 +38,10 @@ def _metrics_text():
     reg.gauge("istpu_serve_free_kv_pages", "").set(55)
     reg.gauge("istpu_store_circuit_state", "", labelnames=("name",)
               ).labels("store").set(1)
+    reg.counter("istpu_store_scrub_pages_total", "").inc(120)
+    reg.counter("istpu_store_scrub_corrupt_total", "").inc(2)
+    reg.counter("istpu_integrity_failures_total", "",
+                labelnames=("cause",)).labels("checksum").inc(3)
     c = reg.counter("istpu_engine_prefix_tokens_total", "",
                     labelnames=("source",))
     c.labels("local").inc(8)
@@ -63,6 +67,12 @@ def test_console_renders_synthetic_snapshot():
                       ">=10m": {"entries": 9, "bytes": 9}},
     }
 
+    integrity = {
+        "level": "scrub", "alg": "sum64", "epoch": 17858693167521,
+        "unverified": 0, "scrub_pages": 120, "scrub_corrupt": 2,
+        "quarantined": 2, "scrub_rate": 256.0,
+    }
+
     def snap(extra_prefill=0.0):
         text = _metrics_text()
         return Snapshot(
@@ -71,6 +81,7 @@ def test_console_renders_synthetic_snapshot():
             cache=cache,
             serve_health={"status": "ok"},
             store_health={"status": "degraded"},
+            integrity=integrity,
         )
 
     console = Console()
@@ -78,6 +89,13 @@ def test_console_renders_synthetic_snapshot():
     out = console.frame(snap())  # second frame has deltas
     assert "serve:ok" in out and "store:degraded" in out
     assert "circuit:OPEN" in out
+    # the integrity row: level, epoch tail, scrub/corrupt/quarantine
+    # counts fed from the new families, client verify failures
+    assert "integrity scrub" in out
+    assert "858693167521" in out           # epoch (last-12-digit tail)
+    assert "scrubbed      120 pg" in out
+    assert "corrupt    2" in out and "quarantined    2" in out
+    assert "verify-fails 3" in out
     assert "pool occupancy" in out and "42.0%" in out
     assert "hit ratio" in out and "75.0%" in out
     assert "dead-on-arrival" in out and "2" in out
