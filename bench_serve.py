@@ -1,0 +1,218 @@
+"""Serving SLO benchmark: open-loop goodput-vs-rate curve.
+
+Sweeps arrival rates against a live serving front-end (or a self-hosted
+tiny-model server with ``--self-serve``) through the open-loop harness
+(`infinistore_tpu/loadgen.py`): Poisson/deterministic arrivals,
+concurrent streaming sessions, a shared-prefix request population, and
+per-lane TTFT/TPOT percentiles.  The headline output is **goodput** —
+requests/s that complete AND meet the TTFT+TPOT SLOs — per offered
+rate, the curve ROADMAP item 4's admission/QoS work will be judged
+against.
+
+    # against a running server
+    python bench_serve.py --url http://127.0.0.1:8000 --rates 2,4,8 \
+        --n 64 --slo-ttft 2.0 --slo-tpot 0.25 --json-out serve_load.json
+
+    # zero-setup smoke (in-process tiny model; CI uses this)
+    JAX_PLATFORMS=cpu python bench_serve.py --self-serve --rates 8,16 --n 24
+
+``--json-out`` writes one JSON object joining the bench-schema family
+(``run_id`` + stable keys; docs/observability.md): ``{run_id, kind:
+"serve_load", slo: {...}, config: {...}, curve: [per-rate summaries]}``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import uuid
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def parse_rates(s: str):
+    rates = [float(x) for x in s.split(",") if x.strip()]
+    if not rates:
+        raise argparse.ArgumentTypeError("need at least one rate")
+    return rates
+
+
+def parse_mix(s: str):
+    """``weight:prompt:max_tokens`` triples, comma-separated — e.g.
+    ``3:24:8,1:96:32`` = 3/4 short chat turns, 1/4 long generations."""
+    mix = []
+    for part in s.split(","):
+        w, p, m = part.split(":")
+        mix.append((float(w), int(p), int(m)))
+    return mix
+
+
+def parse_lanes(s: str):
+    """``priority:weight`` pairs, comma-separated — e.g. ``10:1,0:4`` =
+    1 in 5 requests rides the high-priority lane."""
+    lanes = []
+    for part in s.split(","):
+        prio, w = part.split(":")
+        lanes.append((int(prio), float(w)))
+    return lanes
+
+
+def self_serve(args):
+    """An in-process tiny-model ServingServer on a free port: the
+    zero-setup target for smokes — real HTTP, real scheduler, no
+    checkpoint or separate process needed."""
+    import jax
+    import jax.numpy as jnp
+
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    from infinistore_tpu.engine import InferenceEngine
+    from infinistore_tpu.kv import PagedCacheConfig
+    from infinistore_tpu.models import TINY, init_params, scaled
+    from infinistore_tpu.serve import ServingServer
+
+    cfg = scaled(TINY, dtype=jnp.float32)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    pc = PagedCacheConfig(
+        n_layers=cfg.n_layers, n_kv_heads=cfg.n_kv_heads,
+        head_dim=cfg.head_dim, n_blocks=args.self_serve_blocks,
+        block_tokens=4, dtype=cfg.dtype,
+    )
+    eng = InferenceEngine(params, cfg, pc)
+    eng.decode_chunk = 4
+    srv = ServingServer(eng, port=0, max_batch=args.self_serve_batch,
+                        model_id="tiny-bench",
+                        slo_ttft_s=args.slo_ttft, slo_tpot_s=args.slo_tpot)
+    srv.start()
+    return srv, f"http://127.0.0.1:{srv.port}", cfg.vocab_size
+
+
+def main(argv=None) -> int:
+    from infinistore_tpu.loadgen import LoadConfig, sweep
+
+    ap = argparse.ArgumentParser("bench_serve.py")
+    ap.add_argument("--url", default=None,
+                    help="serving front-end base URL (http://host:8000)")
+    ap.add_argument("--self-serve", action="store_true",
+                    help="spin up an in-process tiny-model server to "
+                         "load instead of --url (CI smoke mode)")
+    ap.add_argument("--self-serve-blocks", type=int, default=512)
+    ap.add_argument("--self-serve-batch", type=int, default=8)
+    ap.add_argument("--rates", type=parse_rates, default=[2.0, 4.0, 8.0],
+                    help="comma-separated arrival rates (req/s) to sweep")
+    ap.add_argument("--n", type=int, default=32,
+                    help="requests per rate point")
+    ap.add_argument("--process", choices=["poisson", "deterministic"],
+                    default="poisson")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--mix", type=parse_mix, default=[(1.0, 24, 8)],
+                    help="weight:prompt_tokens:max_tokens triples, "
+                         "comma-separated (default 1:24:8)")
+    ap.add_argument("--lanes", type=parse_lanes, default=[(0, 1.0)],
+                    help="priority:weight pairs, comma-separated "
+                         "(default 0:1 — one lane)")
+    ap.add_argument("--prefixes", type=int, default=4,
+                    help="shared-prefix population size (0 disables)")
+    ap.add_argument("--prefix-len", type=int, default=16)
+    ap.add_argument("--prefix-frac", type=float, default=0.5,
+                    help="fraction of requests that prepend a shared "
+                         "prefix (tenant system-prompt traffic shape)")
+    ap.add_argument("--vocab", type=int, default=256,
+                    help="token ids drawn in [0, vocab) — keep within "
+                         "the served model's vocab")
+    ap.add_argument("--no-stream", action="store_true",
+                    help="non-streaming requests (TTFT == e2e)")
+    ap.add_argument("--timeout", type=float, default=120.0)
+    ap.add_argument("--slo-ttft", type=float,
+                    default=float(os.environ.get("ISTPU_SLO_TTFT_S", 2.0)),
+                    help="TTFT SLO in seconds (goodput threshold)")
+    ap.add_argument("--slo-tpot", type=float,
+                    default=float(os.environ.get("ISTPU_SLO_TPOT_S", 0.25)),
+                    help="TPOT SLO in seconds (goodput threshold)")
+    ap.add_argument("--cooldown", type=float, default=0.5,
+                    help="seconds between rate points (stragglers drain)")
+    ap.add_argument("--warmup", type=int, default=2,
+                    help="sequential requests before the sweep so jit "
+                         "compilation doesn't pollute the first rate "
+                         "point (0 disables)")
+    ap.add_argument("--json-out", default=None, metavar="FILE",
+                    help="write the run record (run_id + goodput curve; "
+                         "docs/observability.md schema)")
+    args = ap.parse_args(argv)
+
+    if bool(args.url) == bool(args.self_serve):
+        ap.error("pass exactly one of --url or --self-serve")
+    srv = None
+    url = args.url
+    vocab = args.vocab
+    if args.self_serve:
+        srv, url, model_vocab = self_serve(args)
+        vocab = min(vocab, model_vocab)
+    base = LoadConfig(
+        rate=args.rates[0], n_requests=args.n, process=args.process,
+        seed=args.seed, mix=args.mix, lanes=args.lanes,
+        n_prefixes=args.prefixes, prefix_len=args.prefix_len,
+        prefix_frac=args.prefix_frac, vocab=vocab,
+        stream=not args.no_stream, timeout_s=args.timeout,
+    )
+
+    def show(point):
+        lanes = "  ".join(
+            f"lane {k}: ttft p50/p99 "
+            f"{(v['ttft'] or {}).get('p50_ms', '-')}/"
+            f"{(v['ttft'] or {}).get('p99_ms', '-')} ms"
+            for k, v in point["lanes"].items()
+        )
+        print(
+            f"# rate {point['offered_rate_rps']:>6.2f} rps  "
+            f"completed {point['completed']}/{point['n']}  "
+            f"goodput {point['goodput_rps']:.2f} rps  "
+            f"attainment {point['slo_attainment']:.0%}  {lanes}",
+            file=sys.stderr,
+        )
+
+    t0 = time.time()
+    try:
+        if args.warmup:
+            from dataclasses import replace
+
+            from infinistore_tpu.loadgen import _http_post, make_requests
+
+            for body in make_requests(
+                replace(base, n_requests=args.warmup, seed=base.seed - 1)
+            ):
+                r = _http_post(url, body, args.timeout)
+                if not r["ok"]:
+                    print(f"# warmup request failed: {r['error']}",
+                          file=sys.stderr)
+        curve = sweep(url, base, args.rates, args.slo_ttft, args.slo_tpot,
+                      cooldown_s=args.cooldown, on_point=show)
+    finally:
+        if srv is not None:
+            srv.close()
+    record = {
+        "run_id": uuid.uuid4().hex[:8],
+        "kind": "serve_load",
+        "slo": {"ttft_s": args.slo_ttft, "tpot_s": args.slo_tpot},
+        "config": {
+            "n_per_rate": args.n, "process": args.process,
+            "mix": [list(m) for m in args.mix],
+            "lanes": [list(p) for p in args.lanes],
+            "prefixes": args.prefixes, "prefix_len": args.prefix_len,
+            "prefix_frac": args.prefix_frac, "stream": not args.no_stream,
+        },
+        "wall_s": round(time.time() - t0, 1),
+        "curve": curve,
+    }
+    print(json.dumps(record))
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump(record, f, indent=2)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -19,6 +19,7 @@ stays in Python.
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
@@ -370,6 +371,13 @@ class SequenceState:
     # leading pages already returned to the pool by SWA window reclamation
     # (ids stay in block_ids — masked off — so table math is unchanged)
     reclaimed_pages: int = 0
+    # prefix provenance for the request ledger: of ``reused_chunks``, how
+    # many came from the local HBM prefix cache vs the store tier, and
+    # the wall seconds the store hops (lookup + load) took — the
+    # "store-load" slice of the per-request latency waterfall
+    local_chunks: int = 0
+    store_chunks: int = 0
+    store_load_s: float = 0.0
 
 
 @dataclass
@@ -395,6 +403,10 @@ class PartialPrefill:
     off_last: int = 0
     logits: Optional[jax.Array] = None
     adapter_id: int = 0  # LoRA adapter slot (0 = base model)
+    # provenance carried onto the SequenceState (see its fields)
+    local_chunks: int = 0
+    store_chunks: int = 0
+    store_load_s: float = 0.0
 
 
 class InferenceEngine:
@@ -714,13 +726,16 @@ class InferenceEngine:
         max_reuse = (S_total - 1) // T
         local_ids = self.pages.match_prefix(keys[:max_reuse])  # pins hits
         reused = len(local_ids)
+        store_load_s = 0.0  # wall seconds spent on store hops (ledger)
         if self.transfer is not None and keys and reused < max_reuse:
             # breaker-guarded: a dead/hung store (or an open circuit)
             # reports 0 — a prefix-cache miss, never a failed request
+            t_store = time.perf_counter()
             reused = max(
                 reused,
                 min(self.transfer.guarded_lookup_prefix(keys), max_reuse),
             )
+            store_load_s += time.perf_counter() - t_store
         P = reused * T
 
         # pages for the rest of the sequence (incl. a partial tail page)
@@ -740,11 +755,13 @@ class InferenceEngine:
             # #4) and a transport failure mid-load leave the cache
             # untouched; fall back to the locally-resident prefix and
             # recompute the rest instead of failing the request
+            t_store = time.perf_counter()
             self.cache, ok = self.transfer.guarded_load(
                 self.cache,
                 block_ids[len(local_ids):reused],
                 keys[len(local_ids):reused],
             )
+            store_load_s += time.perf_counter() - t_store
             if not ok:
                 reused = len(local_ids)
                 P = reused * T
@@ -796,6 +813,8 @@ class InferenceEngine:
             tokens=tokens, keys=keys, block_ids=block_ids, reused=reused,
             done=reused, n_complete=S_total // T, padded=padded, C=C,
             single=single, buf=buf, plen=plen, S=S, adapter_id=adapter_id,
+            local_chunks=local_chunks, store_chunks=reused - local_chunks,
+            store_load_s=store_load_s,
         )
 
     def prefill_step(self, pp: "PartialPrefill") -> Optional[SequenceState]:
@@ -875,6 +894,8 @@ class InferenceEngine:
             reused_chunks=pp.reused,
             last_logits=_LAST_ROW(pp.logits, (pp.S - 1) - pp.off_last),
             adapter_id=pp.adapter_id,
+            local_chunks=pp.local_chunks, store_chunks=pp.store_chunks,
+            store_load_s=pp.store_load_s,
         )
         self._next_id += 1
         self.seqs[state.seq_id] = state

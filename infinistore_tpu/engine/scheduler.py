@@ -34,15 +34,24 @@ work, and returns the dropped requests for the serving layer to notify.
 
 from __future__ import annotations
 
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 
+from ..ledger import MAX_STAMPS
 from ..utils import tracing
 from ..utils.metrics import MetricsRegistry, default_registry, nearest_rank
 from .engine import _SPLIT2, InferenceEngine, PartialPrefill, SequenceState
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
 
 
 @dataclass
@@ -105,6 +114,16 @@ class Request:
     t_submit: float = 0.0
     t_admit: float = 0.0
     t_first: float = 0.0
+    # retirement stamp + the ledger's waterfall inputs: accumulated
+    # on_token delivery time (slow consumers show up as "stream", not
+    # "decode") and per-chunk token-delivery stamps (t_rel, cum_tokens)
+    t_done: float = 0.0
+    t_stream_s: float = 0.0
+    stamps: List[tuple] = field(default_factory=list)
+    # the trace id the submitting HTTP handler had bound (serve.py
+    # captures it on the handler thread) — joins this request's ledger
+    # record and log lines to its http.request trace
+    trace_id: Optional[str] = None
 
 
 class Scheduler:
@@ -119,8 +138,23 @@ class Scheduler:
                  spec_k: int = 4, prefill_concurrency: int = 4,
                  spec_batch: int = 1,
                  ngram_spec: bool = False, spec_g: int = 2,
-                 metrics: Optional[MetricsRegistry] = None):
+                 metrics: Optional[MetricsRegistry] = None,
+                 ledger=None,
+                 slo_ttft_s: Optional[float] = None,
+                 slo_tpot_s: Optional[float] = None):
         self.engine = engine
+        # per-request lifecycle ledger (infinistore_tpu.ledger): every
+        # request that leaves the scheduler — retired, cancelled, or
+        # dropped by fault_reset — is recorded exactly once
+        self.ledger = ledger
+        # SLO targets for the per-lane violation counters; None falls
+        # back to env (ISTPU_SLO_TTFT_S / ISTPU_SLO_TPOT_S), which
+        # itself defaults to 2 s TTFT / 250 ms TPOT — the bench-serve
+        # harness and serve.py flags override per deployment
+        self.slo_ttft_s = slo_ttft_s if slo_ttft_s is not None \
+            else _env_float("ISTPU_SLO_TTFT_S", 2.0)
+        self.slo_tpot_s = slo_tpot_s if slo_tpot_s is not None \
+            else _env_float("ISTPU_SLO_TPOT_S", 0.25)
         # latency histograms (log-spaced buckets -> rate()-able and
         # replica-aggregatable, unlike the rolling-window p50 gauges the
         # latency_metrics property still offers as a convenience view).
@@ -140,6 +174,38 @@ class Scheduler:
         self._h_decode_step = self.metrics.histogram(
             "istpu_serve_decode_step_seconds",
             "One decode dispatch: the whole batch advancing one chunk",
+        )
+        # per-lane SLO families: the admission-priority field doubles as
+        # the lane label (the multi-tenant QoS axis — ROADMAP item 4),
+        # so `histogram_quantile(0.99, rate(istpu_serve_ttft_seconds_
+        # bucket{lane="10"}[5m]))` is a per-lane SLO query out of the box
+        self._h_ttft = self.metrics.histogram(
+            "istpu_serve_ttft_seconds",
+            "Per-request time to first token (submit -> first visible "
+            "token), labeled by priority lane",
+            labelnames=("lane",),
+        )
+        self._h_tpot = self.metrics.histogram(
+            "istpu_serve_tpot_seconds",
+            "Per-request mean time per output token after the first, "
+            "labeled by priority lane",
+            labelnames=("lane",),
+        )
+        self._c_slo = self.metrics.counter(
+            "istpu_serve_slo_violations_total",
+            "Finished requests that missed the configured SLO target",
+            labelnames=("slo", "lane"),
+        )
+        self.metrics.gauge(
+            "istpu_serve_inflight",
+            "Requests holding engine resources (active batch + chunked "
+            "prefills)",
+            fn=lambda: len(self.active) + len(self._prefilling),
+        )
+        self.metrics.gauge(
+            "istpu_serve_queue_depth",
+            "Requests admitted to the scheduler but not yet prefilling",
+            fn=lambda: len(self.pending),
         )
         self.max_batch = max_batch
         self.pending: List[Request] = []
@@ -213,6 +279,7 @@ class Scheduler:
         adapter_id: int = 0,
         logprobs: int = 0,
         on_token: Optional[Callable[[List[int], bool], None]] = None,
+        trace_id: Optional[str] = None,
     ) -> int:
         # boundary validation: a bad request must be rejected HERE, not
         # explode inside a later engine step and fault out every in-flight
@@ -255,7 +322,7 @@ class Scheduler:
             logit_bias=dict(logit_bias) if logit_bias else None,
             priority=priority, adapter_id=adapter_id,
             logprobs=min(max(int(logprobs), 0), self.LOGPROBS_K),
-            on_token=on_token,
+            on_token=on_token, trace_id=trace_id,
         )
         self._next_id += 1
         req.t_submit = time.perf_counter()
@@ -284,6 +351,7 @@ class Scheduler:
                 req.cancelled = req.done = True
                 self.pending.pop(i)
                 self._stream(req, done=True)
+                self._finish(req, "cancelled")
                 return True
         for req, _pp in self._prefilling:
             if req.req_id == req_id and not req.cancelled:
@@ -313,10 +381,19 @@ class Scheduler:
         corrupt the scheduler (leak pages, leave a done request active), so
         it is disarmed after the first failure and the request continues as
         a non-streaming one."""
+        vis = self._visible_len(req)
+        if vis > req._sent and len(req.stamps) < MAX_STAMPS:
+            # chunk-boundary delivery stamp for the ledger (t relative
+            # to submit, cumulative visible tokens) — stamped whether or
+            # not a callback is attached, so /debug/requests shows the
+            # token cadence for batch-mode requests too
+            req.stamps.append(
+                (round(time.perf_counter() - req.t_submit, 6), vis)
+            )
         if req.on_token is None:
             return
+        t0 = time.perf_counter()
         try:
-            vis = self._visible_len(req)
             if vis > req._sent:
                 req.on_token(req.output[req._sent:vis], False)
                 req._sent = vis
@@ -330,6 +407,11 @@ class Scheduler:
                 "on_token callback for request %d raised %r; streaming "
                 "disabled for this request", req.req_id, e,
             )
+        finally:
+            # delivery time is the "stream" slice of the ledger's
+            # waterfall: a slow consumer must show up as stream, not
+            # inflate the decode share
+            req.t_stream_s += time.perf_counter() - t0
 
     @property
     def has_work(self) -> bool:
@@ -444,6 +526,7 @@ class Scheduler:
                 self._drop_draft(req)
                 self.engine.release(req.state)
                 self.record_latency(req)
+                self._finish(req, "cancelled" if req.cancelled else "done")
                 done_now.append(req)
             else:
                 self._stream(req, done=False)
@@ -661,6 +744,7 @@ class Scheduler:
                 self.engine.abandon_prefill(pp)
                 req.done = True
                 self._stream(req, done=True)
+                self._finish(req, "cancelled")
                 cancelled_prefill.append(req)
                 continue
             with tracing.span("sched.prefill_step", req=req.req_id):
@@ -799,9 +883,36 @@ class Scheduler:
                 req.state = None
             req.done = True
             req.on_token = None
+            self._finish(req, "error")
         self._admission_hold = False
         self._pen_cache.clear()
         return dropped
+
+    def _finish(self, req: Request, outcome: str) -> None:
+        """The ONE request exit point: stamp retirement, feed the
+        per-lane TTFT/TPOT histograms and SLO-violation counters, and
+        fold the request into the ledger.  Called exactly once per
+        request, from every path a request leaves the scheduler
+        (retirement, pending/prefill cancellation, fault_reset)."""
+        if not req.t_done:
+            req.t_done = time.perf_counter()
+        lane = str(req.priority)
+        n_out = len(req.output)
+        if req.t_first:
+            ttft = req.t_first - req.t_submit
+            self._h_ttft.labels(lane).observe(ttft)
+            if self.slo_ttft_s and ttft > self.slo_ttft_s:
+                self._c_slo.labels("ttft", lane).inc()
+            if n_out > 1 and req.t_done > req.t_first:
+                tpot = (req.t_done - req.t_first) / (n_out - 1)
+                self._h_tpot.labels(lane).observe(tpot)
+                if self.slo_tpot_s and tpot > self.slo_tpot_s:
+                    self._c_slo.labels("tpot", lane).inc()
+        if self.ledger is not None:
+            try:
+                self.ledger.record(req, outcome)
+            except Exception:  # noqa: BLE001 — observability must not
+                pass           # take the engine loop down
 
     def record_latency(self, req: Request) -> None:
         """Fold a finished request's stamps into the rolling latency

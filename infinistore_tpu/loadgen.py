@@ -1,0 +1,333 @@
+"""Open-loop load generation for the serving front-end.
+
+The serving stack had only ever been driven closed-loop (a handful of
+clients, each waiting for its response before sending the next) — a
+shape that can never overload anything and therefore can never find the
+knee of the latency curve.  This module is the open-loop harness the
+SLO work needs: requests fire on an **arrival process** (Poisson or
+deterministic) independent of completions, exactly the way tenant
+traffic arrives, so pushing the arrival rate past capacity produces the
+real failure shape (queue growth → TTFT blowup → SLO misses) instead of
+self-throttling.  DistServe/Mooncake-style serving work optimizes
+**goodput** — requests per second that complete AND meet their SLOs —
+and that is the headline number ``summarize`` computes.
+
+Pieces:
+
+* ``arrival_offsets`` — the arrival process as a pure function (seeded
+  RNG in, offsets out), so timing math is testable without a clock;
+* ``LoadConfig`` — arrival rate/process, prompt/output-length mix,
+  priority-lane weights, and a shared-prefix population (``n_prefixes``
+  prefixes of ``prefix_len`` tokens; each request prepends one with
+  probability ``prefix_frac`` — the system-prompt shape that makes the
+  store tier's prefix reuse matter under load);
+* ``run_load`` — fires one schedule against a live server: one thread
+  per in-flight request (hundreds of concurrent streaming sessions),
+  SSE-parsed TTFT/TPOT per request, injectable ``clock``/``sleep``/
+  ``post`` so tests drive the pacing loop deterministically;
+* ``summarize`` — per-lane TTFT/TPOT p50/p99 (nearest-rank, the repo's
+  one percentile definition), SLO attainment, and goodput;
+* ``sweep`` — the goodput-vs-rate curve: one ``run_load`` +
+  ``summarize`` per arrival rate.
+
+``bench_serve.py`` (repo root) is the CLI over this module; its
+``--json-out`` record joins the bench-schema family
+(docs/observability.md).
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+from urllib.parse import urlsplit
+
+from .utils.metrics import nearest_rank
+
+
+def arrival_offsets(rate: float, n: int, process: str = "poisson",
+                    rng: Optional[random.Random] = None) -> List[float]:
+    """Arrival times (seconds from t0) for ``n`` requests at ``rate``
+    req/s.  ``deterministic``: evenly spaced 1/rate apart.  ``poisson``:
+    exponential inter-arrivals (the memoryless process real independent
+    tenants produce — bursts included, which is the point).  Pure given
+    the RNG, so tests assert the math without any clock."""
+    if rate <= 0:
+        raise ValueError("rate must be > 0")
+    if n < 0:
+        raise ValueError("n must be >= 0")
+    if process == "deterministic":
+        return [i / rate for i in range(n)]
+    if process != "poisson":
+        raise ValueError(f"unknown arrival process {process!r}")
+    rng = rng or random.Random(0)
+    t, out = 0.0, []
+    for _ in range(n):
+        t += rng.expovariate(rate)
+        out.append(t)
+    return out
+
+
+@dataclass
+class LoadConfig:
+    """One load run's shape.  ``mix`` rows are ``(weight, prompt_tokens,
+    max_tokens)``; ``lanes`` rows are ``(priority, weight)`` — the
+    priority value becomes the server-side lane label."""
+
+    rate: float = 4.0
+    n_requests: int = 32
+    process: str = "poisson"
+    seed: int = 0
+    mix: Sequence[Tuple[float, int, int]] = ((1.0, 24, 8),)
+    lanes: Sequence[Tuple[int, float]] = ((0, 1.0),)
+    # shared-prefix population: tenant/system-prompt traffic shape
+    n_prefixes: int = 4
+    prefix_len: int = 16
+    prefix_frac: float = 0.5
+    vocab: int = 256          # token ids drawn in [0, vocab)
+    stream: bool = True       # SSE streaming (client-observed TTFT)
+    timeout_s: float = 120.0  # per-request HTTP timeout
+    extra_body: Dict[str, Any] = field(default_factory=dict)
+
+
+def _weighted_choice(rng: random.Random, rows, key=lambda r: r[-1]):
+    total = sum(key(r) for r in rows)
+    x = rng.random() * total
+    for r in rows:
+        x -= key(r)
+        if x <= 0:
+            return r
+    return rows[-1]
+
+
+def make_requests(cfg: LoadConfig) -> List[Dict[str, Any]]:
+    """The request population for one run: token-id prompts (no
+    tokenizer needed server-side), lane-tagged, with a shared-prefix
+    subset.  Deterministic in ``cfg.seed``."""
+    rng = random.Random(cfg.seed)
+    prefixes = [
+        [rng.randrange(cfg.vocab) for _ in range(cfg.prefix_len)]
+        for _ in range(max(0, cfg.n_prefixes))
+    ]
+    out = []
+    for _ in range(cfg.n_requests):
+        _w, plen, mtok = _weighted_choice(rng, list(cfg.mix),
+                                          key=lambda r: r[0])
+        lane, _w = _weighted_choice(rng, list(cfg.lanes))
+        prompt: List[int] = []
+        if prefixes and rng.random() < cfg.prefix_frac:
+            prompt += prefixes[rng.randrange(len(prefixes))]
+        need = max(1, plen - len(prompt))
+        prompt += [rng.randrange(cfg.vocab) for _ in range(need)]
+        body = {
+            "prompt": prompt, "max_tokens": int(mtok),
+            "temperature": 0, "priority": int(lane),
+            "stream": bool(cfg.stream),
+        }
+        body.update(cfg.extra_body)
+        out.append(body)
+    return out
+
+
+def _http_post(url: str, body: Dict[str, Any],
+               timeout_s: float) -> Dict[str, Any]:
+    """POST one completion request; parse the SSE stream for the
+    client-observed first-token and last-token stamps.  Returns the raw
+    per-request result dict (``ok``/``status``/``ttft_s``/``tpot_s``/
+    ``e2e_s``/``tokens``/``error``)."""
+    parts = urlsplit(url)
+    t0 = time.perf_counter()
+    first = last = None
+    tokens = 0
+    status = 0
+    err = None
+    try:
+        conn = http.client.HTTPConnection(
+            parts.hostname, parts.port, timeout=timeout_s
+        )
+        try:
+            conn.request(
+                "POST", "/v1/completions", json.dumps(body),
+                {"Content-Type": "application/json"},
+            )
+            resp = conn.getresponse()
+            status = resp.status
+            if status != 200:
+                err = resp.read().decode(errors="replace")[:200]
+            elif body.get("stream"):
+                for raw in resp:
+                    line = raw.strip()
+                    if not line.startswith(b"data: "):
+                        continue
+                    data = line[len(b"data: "):]
+                    if data == b"[DONE]":
+                        break
+                    ev = json.loads(data)
+                    ch = ev.get("choices", [{}])[0]
+                    n_new = len(ch.get("token_ids") or ())
+                    if "error" in ev:
+                        err = str(ev["error"])[:200]
+                        break
+                    if n_new:
+                        now = time.perf_counter()
+                        if first is None:
+                            first = now
+                        last = now
+                        tokens += n_new
+            else:
+                payload = json.loads(resp.read())
+                ch = payload.get("choices", [{}])[0]
+                tokens = len(ch.get("token_ids") or ())
+                first = last = time.perf_counter()
+        finally:
+            conn.close()
+    except Exception as e:  # noqa: BLE001 — a failed request is a data point
+        err = repr(e)[:200]
+    t1 = time.perf_counter()
+    ok = status == 200 and err is None and tokens > 0
+    return {
+        "ok": ok, "status": status, "error": err, "tokens": tokens,
+        "lane": body.get("priority", 0),
+        "ttft_s": (first - t0) if first is not None else None,
+        "tpot_s": ((last - first) / (tokens - 1)
+                   if ok and first is not None and last is not None
+                   and tokens > 1 else None),
+        "e2e_s": t1 - t0,
+    }
+
+
+def run_load(url: str, cfg: LoadConfig,
+             clock: Callable[[], float] = time.monotonic,
+             sleep: Callable[[float], None] = time.sleep,
+             post: Optional[Callable[[Dict[str, Any]], Dict[str, Any]]]
+             = None) -> Tuple[List[Dict[str, Any]], float]:
+    """Fire ``cfg``'s schedule open-loop against ``url``.  Returns
+    ``(results, makespan_s)`` — one result per request, arrival order.
+
+    Open-loop means the pacing loop NEVER waits for a completion: each
+    arrival spawns its own session thread at its scheduled offset (late
+    only if the previous sleep overran), so a saturated server sees the
+    queue it would see in production.  ``clock``/``sleep``/``post`` are
+    injectable: tests drive the pacer with a virtual clock and capture
+    fire times without sockets."""
+    offsets = arrival_offsets(cfg.rate, cfg.n_requests, cfg.process,
+                              random.Random(cfg.seed))
+    bodies = make_requests(cfg)
+    do_post = post or (lambda b: _http_post(url, b, cfg.timeout_s))
+    results: List[Optional[Dict[str, Any]]] = [None] * cfg.n_requests
+    threads: List[threading.Thread] = []
+    t0 = clock()
+
+    def fire(i: int, body: Dict[str, Any], late_s: float) -> None:
+        r = do_post(body)
+        r["sched_off_s"] = round(offsets[i], 6)
+        r["late_s"] = round(late_s, 6)
+        results[i] = r
+
+    for i, off in enumerate(offsets):
+        wait = off - (clock() - t0)
+        if wait > 0:
+            sleep(wait)
+        late = max(0.0, (clock() - t0) - off)
+        t = threading.Thread(target=fire, args=(i, bodies[i], late),
+                             daemon=True)
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join(timeout=cfg.timeout_s + 5)
+    makespan = clock() - t0
+    # a thread that never finished leaves a tombstone, not a None hole
+    for i, r in enumerate(results):
+        if r is None:
+            results[i] = {
+                "ok": False, "status": 0, "error": "timeout", "tokens": 0,
+                "lane": bodies[i].get("priority", 0), "ttft_s": None,
+                "tpot_s": None, "e2e_s": None,
+                "sched_off_s": round(offsets[i], 6), "late_s": 0.0,
+            }
+    return results, makespan  # type: ignore[return-value]
+
+
+def _pcts(vals: List[float]) -> Dict[str, float]:
+    vs = sorted(vals)
+    return {
+        "p50_ms": round(nearest_rank(vs, 0.50) * 1e3, 2),
+        "p99_ms": round(nearest_rank(vs, 0.99) * 1e3, 2),
+    }
+
+
+def meets_slo(r: Dict[str, Any], slo_ttft_s: float,
+              slo_tpot_s: float) -> bool:
+    """Did one completed request meet both SLOs?  A request too short to
+    have a TPOT (≤1 token) is judged on TTFT alone."""
+    if not r.get("ok") or r.get("ttft_s") is None:
+        return False
+    if r["ttft_s"] > slo_ttft_s:
+        return False
+    tpot = r.get("tpot_s")
+    return tpot is None or tpot <= slo_tpot_s
+
+
+def summarize(results: List[Dict[str, Any]], makespan_s: float,
+              slo_ttft_s: float, slo_tpot_s: float,
+              rate: Optional[float] = None) -> Dict[str, Any]:
+    """One run's summary: counts, achieved/goodput rates, SLO
+    attainment, and per-lane TTFT/TPOT percentiles."""
+    ok = [r for r in results if r.get("ok")]
+    met = [r for r in ok if meets_slo(r, slo_ttft_s, slo_tpot_s)]
+    lanes: Dict[str, Dict[str, Any]] = {}
+    for lane in sorted({r["lane"] for r in results}):
+        rs = [r for r in ok if r["lane"] == lane]
+        ttfts = [r["ttft_s"] for r in rs if r["ttft_s"] is not None]
+        tpots = [r["tpot_s"] for r in rs if r["tpot_s"] is not None]
+        lanes[str(lane)] = {
+            "n": len([r for r in results if r["lane"] == lane]),
+            "completed": len(rs),
+            "slo_met": len([r for r in rs
+                            if meets_slo(r, slo_ttft_s, slo_tpot_s)]),
+            "ttft": _pcts(ttfts) if ttfts else None,
+            "tpot": _pcts(tpots) if tpots else None,
+        }
+    makespan_s = max(makespan_s, 1e-9)
+    return {
+        "offered_rate_rps": rate,
+        "n": len(results),
+        "completed": len(ok),
+        "errors": len(results) - len(ok),
+        "makespan_s": round(makespan_s, 3),
+        "achieved_rps": round(len(ok) / makespan_s, 3),
+        "goodput_rps": round(len(met) / makespan_s, 3),
+        "slo_attainment": round(len(met) / len(results), 4) if results
+        else 0.0,
+        "tokens": sum(r.get("tokens") or 0 for r in results),
+        "lanes": lanes,
+    }
+
+
+def sweep(url: str, base: LoadConfig, rates: Sequence[float],
+          slo_ttft_s: float, slo_tpot_s: float,
+          cooldown_s: float = 0.5,
+          on_point: Optional[Callable[[Dict[str, Any]], None]] = None,
+          ) -> List[Dict[str, Any]]:
+    """The goodput-vs-rate curve: one open-loop run per arrival rate
+    (fresh seed-derived schedule each, same population shape).  The
+    short cooldown lets the previous point's stragglers drain so one
+    point's backlog doesn't pollute the next measurement."""
+    from dataclasses import replace
+
+    curve = []
+    for i, rate in enumerate(rates):
+        cfg = replace(base, rate=float(rate), seed=base.seed + i)
+        results, makespan = run_load(url, cfg)
+        point = summarize(results, makespan, slo_ttft_s, slo_tpot_s,
+                          rate=float(rate))
+        curve.append(point)
+        if on_point is not None:
+            on_point(point)
+        if cooldown_s and rate != rates[-1]:
+            time.sleep(cooldown_s)
+    return curve

@@ -50,6 +50,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional
 
 from .engine import Scheduler
+from .ledger import RequestLedger
 from .utils import metrics as _metrics
 from .utils import resilience as _resilience
 from .utils import tracing
@@ -65,7 +66,10 @@ class ServingServer:
                  tokenizer=None, draft_engine=None, spec_k: int = 4,
                  max_queue: Optional[int] = None, spec_batch: int = 1,
                  ngram_spec: bool = False, spec_g: int = 2,
-                 prefill_concurrency: int = 4):
+                 prefill_concurrency: int = 4,
+                 slo_ttft_s: Optional[float] = None,
+                 slo_tpot_s: Optional[float] = None,
+                 ledger_ring: Optional[int] = None):
         """``tokenizer``: any object with ``encode(str) -> [int]`` and
         ``decode([int]) -> str`` (an HF tokenizer qualifies) — enables
         string prompts, text responses, and string stop sequences.
@@ -85,12 +89,17 @@ class ServingServer:
         # the scheduler's queue-wait/prefill/decode histograms land here,
         # next to this server's own request counters
         self.metrics = MetricsRegistry()
+        # per-request lifecycle ledger, exported at /debug/requests and
+        # logged through the shared logger (trace_id-joinable) — the
+        # scheduler records into it at every request exit
+        self.ledger = RequestLedger(capacity=ledger_ring)
         self.sched = Scheduler(engine, max_batch=max_batch,
                                draft_engine=draft_engine, spec_k=spec_k,
                                spec_batch=spec_batch,
                                ngram_spec=ngram_spec, spec_g=spec_g,
                                prefill_concurrency=prefill_concurrency,
-                               metrics=self.metrics)
+                               metrics=self.metrics, ledger=self.ledger,
+                               slo_ttft_s=slo_ttft_s, slo_tpot_s=slo_tpot_s)
         self._register_metrics()
         self._cv = threading.Condition()
         self._staged: List[Dict[str, Any]] = []   # submissions from handlers
@@ -190,7 +199,12 @@ class ServingServer:
         # so increments behind it can never expose a torn scrape
         with self.metrics.lock:
             self.stats["requests"] += 1
-        item: Dict[str, Any] = {"body": body, "q": q}
+        # capture the HANDLER thread's trace id now: the scheduler submit
+        # happens later on the engine thread, where the ambient trace is
+        # an engine.step — the ledger must join to the request's own
+        # http.request trace
+        item: Dict[str, Any] = {"body": body, "q": q,
+                                "trace_id": tracing.current_trace_id()}
         if body.get("echo") and not body.get("_chat"):
             # scoring forwards are real TPU work: the admission limit must
             # bound them like anything else.  Check-and-reserve is ONE _cv
@@ -645,6 +659,7 @@ class ServingServer:
             # kwargs for the scoring forward); everything else validates
             # here on the engine thread
             kwargs = item.get("kwargs") or self._validate(body)
+            kwargs.setdefault("trace_id", item.get("trace_id"))
             tally["budget"] = kwargs["max_new_tokens"]
             tally["eos_set"] = frozenset(kwargs["eos_ids"] or ())
             req_id = self.sched.submit(on_token=on_token, **kwargs)
@@ -1029,6 +1044,19 @@ def _make_handler(server: ServingServer):
                 # always 200 — the serving plane is up either way; the
                 # body says whether the cache tier behind it is
                 self._json(200, server.health())
+            elif self.path.split("?", 1)[0] == "/debug/requests":
+                # the request ledger: recent per-request lifecycle
+                # records with waterfall attribution, joinable to
+                # /debug/traces by trace_id.  ?limit=N caps the tail
+                # (ring capacity itself is ISTPU_LEDGER_RING).
+                from urllib.parse import parse_qs, urlsplit
+
+                q = parse_qs(urlsplit(self.path).query)
+                try:
+                    limit = int(q["limit"][0])
+                except (KeyError, ValueError, IndexError):
+                    limit = None
+                self._json(200, server.ledger.snapshot(limit=limit))
             elif self.path.split("?", 1)[0] == "/debug/traces":
                 # recent completed request/step traces as Chrome trace-
                 # event JSON — stitched with the attached store's server-
@@ -1532,6 +1560,17 @@ def main(argv: Optional[List[str]] = None) -> None:
                          "queued, pushes drain behind decode — the TTFT-"
                          "friendly mode; strict: every page durable before "
                          "prefill returns (PD prefill-node contract)")
+    ap.add_argument("--slo-ttft", type=float, default=None,
+                    help="TTFT SLO target in seconds for the per-lane "
+                         "istpu_serve_slo_violations_total counters "
+                         "(default env ISTPU_SLO_TTFT_S, else 2.0)")
+    ap.add_argument("--slo-tpot", type=float, default=None,
+                    help="TPOT SLO target in seconds (default env "
+                         "ISTPU_SLO_TPOT_S, else 0.25)")
+    ap.add_argument("--ledger-ring", type=int, default=None,
+                    help="request-ledger ring capacity for "
+                         "/debug/requests (default env "
+                         "ISTPU_LEDGER_RING, else 256)")
     ap.add_argument("--log-level", default="info")
     args = ap.parse_args(argv)
     Logger.set_log_level(args.log_level)
@@ -1670,7 +1709,9 @@ def main(argv: Optional[List[str]] = None) -> None:
                         spec_k=args.spec_k, max_queue=args.max_queue,
                         spec_batch=args.spec_batch,
                         ngram_spec=args.ngram_spec, spec_g=args.spec_g,
-                        prefill_concurrency=args.prefill_concurrency)
+                        prefill_concurrency=args.prefill_concurrency,
+                        slo_ttft_s=args.slo_ttft, slo_tpot_s=args.slo_tpot,
+                        ledger_ring=args.ledger_ring)
     srv.start()
     try:
         while True:

@@ -3,12 +3,16 @@
     python -m infinistore_tpu.top --serve-url http://127.0.0.1:8000 \
         --store-url http://127.0.0.1:18080 --interval 1
 
-Polls the serving front-end's ``/metrics`` + ``/healthz`` and the store
-manage plane's ``/metrics`` + ``/debug/cache`` + ``/healthz`` and renders
-one screen per interval: pool occupancy, hit ratio, prefix-reuse token
-split, circuit/degraded state, op-latency sparklines (per-interval mean
-from histogram ``_sum``/``_count`` deltas — the same derivative a
-``rate()`` query takes), and the hottest/coldest cache keys.  Either URL
+Polls the serving front-end's ``/metrics`` + ``/healthz`` +
+``/debug/requests`` and the store manage plane's ``/metrics`` +
+``/debug/cache`` + ``/healthz`` and renders one screen per interval:
+pool occupancy, hit ratio, prefix-reuse token split, circuit/degraded
+state, the serving-SLO view (per-frame arrival/completion deltas,
+inflight and queue depth, a per-lane TTFT/TPOT table with sparklines and
+SLO-violation counts, and the newest request-ledger records with their
+latency waterfalls), op-latency sparklines (per-interval mean from
+histogram ``_sum``/``_count`` deltas — the same derivative a ``rate()``
+query takes), and the hottest/coldest cache keys.  Either URL
 may be omitted; the console shows whatever half of the stack it can
 reach.  Plain ANSI (no curses): works over ssh, in tmux, and in CI logs
 (``--once`` renders a single frame without clearing the screen).
@@ -70,13 +74,30 @@ class Snapshot:
                  cache: Optional[dict] = None,
                  serve_health: Optional[dict] = None,
                  store_health: Optional[dict] = None,
-                 integrity: Optional[dict] = None):
+                 integrity: Optional[dict] = None,
+                 requests: Optional[dict] = None):
         self.serve = serve_metrics or {}
         self.store = store_metrics or {}
         self.cache = cache
         self.serve_health = serve_health
         self.store_health = store_health
         self.integrity = integrity
+        # the serving /debug/requests payload (request ledger tail)
+        self.requests = requests
+
+    def lanes(self) -> List[str]:
+        """Priority lanes seen in the serving TTFT family, numeric
+        order — the rows of the per-lane SLO table."""
+        vals = {
+            dict(labels).get("lane")
+            for (name, labels) in self.serve
+            if name == "istpu_serve_ttft_seconds_count"
+        }
+        vals.discard(None)
+        return sorted(
+            vals,
+            key=lambda x: int(x) if x.lstrip("-").isdigit() else 0,
+        )
 
     def value(self, name: str, labels: Tuple[Tuple[str, str], ...] = (),
               default: Optional[float] = None) -> Optional[float]:
@@ -157,6 +178,83 @@ class Console:
         if mean is not None:
             self._series(key).append(mean)
         return mean
+
+    def _serving_slo(self, snap: Snapshot) -> List[str]:
+        """The serving-SLO section: per-frame arrival/completion deltas,
+        inflight/queue-depth, a per-lane TTFT/TPOT table with interval-
+        mean sparklines and SLO-violation counts, and the newest request-
+        ledger records with their waterfall shares."""
+        out: List[str] = []
+        inflight = snap.value("istpu_serve_inflight")
+        depth = snap.value("istpu_serve_queue_depth")
+        arr = self.deltas.setdefault("arrivals", _Delta()).update(
+            snap.value("istpu_serve_requests_total"))
+        comp = self.deltas.setdefault("completions", _Delta()).update(
+            snap.value("istpu_serve_completed_total"))
+        if inflight is not None or arr is not None:
+            viol = sum(
+                v for (name, _labels), v in snap.serve.items()
+                if name == "istpu_serve_slo_violations_total"
+            )
+            out.append("")
+            out.append(
+                "serving load    arrivals {:>5}/frame  completions "
+                "{:>5}/frame  inflight {:>4}  queued {:>4}  "
+                "slo-viol {:>5}".format(
+                    "-" if arr is None else int(arr),
+                    "-" if comp is None else int(comp),
+                    "-" if inflight is None else int(inflight),
+                    "-" if depth is None else int(depth),
+                    int(viol),
+                )
+            )
+        lanes = snap.lanes()
+        if lanes:
+            out.append(f"  {'lane':6s} {'ttft':>6s}  {'trend':16s} "
+                       f"{'tpot':>6s}  {'trend':16s} {'viol':>5s}")
+            for lane in lanes:
+                lab = (("lane", lane),)
+                ttft = self._lat(snap, f"ttft:{lane}",
+                                 "istpu_serve_ttft_seconds", lab)
+                tpot = self._lat(snap, f"tpot:{lane}",
+                                 "istpu_serve_tpot_seconds", lab)
+                viol = sum(
+                    v for (name, labels), v in snap.serve.items()
+                    if name == "istpu_serve_slo_violations_total"
+                    and dict(labels).get("lane") == lane
+                )
+                out.append(
+                    "  {:6s} {:>6s}  {:16s} {:>6s}  {:16s} {:>5d}".format(
+                        lane, fmt_dur(ttft),
+                        sparkline(list(self.hist.get(f"ttft:{lane}", ())),
+                                  16),
+                        fmt_dur(tpot),
+                        sparkline(list(self.hist.get(f"tpot:{lane}", ())),
+                                  16),
+                        int(viol),
+                    )
+                )
+        recs = (snap.requests or {}).get("records") or []
+        if recs:
+            out.append("  recent requests (newest first; "
+                       "q/s/p/d = queue/store/prefill/decode share)")
+            for rec in list(reversed(recs))[:5]:
+                sh = rec.get("shares") or {}
+                ttft = rec.get("ttft_s")
+                tpot = rec.get("tpot_s")
+                out.append(
+                    "  req {:>5} lane {:3s} {:9s} ttft {:>6s} tpot {:>6s}"
+                    "  q{:2.0%} s{:2.0%} p{:2.0%} d{:2.0%}  trace {}".format(
+                        rec.get("req_id", "?"),
+                        str(rec.get("lane", "?")),
+                        str(rec.get("outcome", "?")),
+                        fmt_dur(ttft), fmt_dur(tpot),
+                        sh.get("queue") or 0.0, sh.get("store") or 0.0,
+                        sh.get("prefill") or 0.0, sh.get("decode") or 0.0,
+                        rec.get("trace_id") or "-",
+                    )
+                )
+        return out
 
     def frame(self, snap: Snapshot) -> str:
         out: List[str] = []
@@ -252,6 +350,7 @@ class Console:
                 + (f"   free pages {int(pages):>6}"
                    if pages is not None else "")
             )
+        out.extend(self._serving_slo(snap))
         # -- latency sparklines --
         out.append("")
         out.append(f"{'op latency (interval mean)':28s} {'now':>6s}  trend")
@@ -318,6 +417,7 @@ def poll(serve_url: Optional[str], store_url: Optional[str]) -> Snapshot:
         serve_health=js(serve_url, "/healthz"),
         store_health=js(store_url, "/healthz"),
         integrity=integ,
+        requests=js(serve_url, "/debug/requests?limit=8"),
     )
 
 

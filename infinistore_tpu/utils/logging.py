@@ -18,12 +18,19 @@ import sys
 class TraceContextFilter(logging.Filter):
     """Stamps ``record.trace_id`` from the active trace (``"-"`` when no
     trace is bound — the attribute must always exist so user-supplied
-    ``%(trace_id)s`` format strings never KeyError)."""
+    ``%(trace_id)s`` format strings never KeyError).
+
+    A record that ARRIVES with a ``trace_id`` (``extra={"trace_id":
+    ...}``) keeps it: the request ledger logs a finished request from
+    the engine thread, where the ambient trace is the engine.step that
+    retired it — the line must carry the REQUEST's id, not the step's."""
 
     def filter(self, record: logging.LogRecord) -> bool:
         from . import tracing  # late: logging must import before tracing
 
-        record.trace_id = tracing.current_trace_id() or "-"
+        preset = getattr(record, "trace_id", None)
+        if not preset:
+            record.trace_id = tracing.current_trace_id() or "-"
         return True
 
 

@@ -606,7 +606,12 @@ def test_eos_and_sampling_params(server):
     })
     assert status == 200
     toks = body["choices"][0]["token_ids"]
-    assert toks == ref[:3] and toks[-1] == eos
+    # generation stops at the FIRST occurrence of the stop id (vLLM
+    # stop_token_ids semantics) — the greedy reference may emit the
+    # chosen token earlier than the index it was picked from (it does on
+    # this model/seed: ref[1] == ref[2]), so cut at ref.index, not at 2
+    cut = ref.index(eos)
+    assert toks == ref[:cut + 1] and toks[-1] == eos
     assert body["choices"][0]["finish_reason"] == "stop"
 
     # sampling path with nucleus: valid tokens, right count
