@@ -411,3 +411,7 @@ class NativeConnection:
     def register_mr(self, ptr: int, size: int) -> int:
         self._registered[ptr] = size
         return 0
+
+    def unregister_mr(self, ptr: int) -> int:
+        self._registered.pop(ptr, None)
+        return 0

@@ -41,6 +41,14 @@ def parse_args():
     ap.add_argument("--src-device", default="cpu", choices=["cpu", "tpu"])
     ap.add_argument("--simulate-layers", type=int, default=0,
                     help="issue one async write per layer (prefill pattern)")
+    ap.add_argument("--push-path", default="batched",
+                    choices=["batched", "into"],
+                    help="put API for the bandwidth loop: 'batched' = "
+                         "classic write_cache (copy from a client "
+                         "buffer), 'into' = alloc-first write_cache_into "
+                         "(descriptors learned first, payload filled "
+                         "straight into the mapped pool on shm) — "
+                         "compare the two to see the zero-copy win")
     ap.add_argument("--serving", action="store_true", default=False,
                     help="serving-loop benchmark instead of bandwidth: "
                          "prefill + decode tokens/s through the engine "
@@ -175,6 +183,16 @@ def main():
 
                 t0 = time.perf_counter()
                 asyncio.run(flood())
+                put_t += time.perf_counter() - t0
+            elif args.push_path == "into":
+                # alloc-first put: one band covering the whole batch, the
+                # fill lands the payload in the pool directly on shm
+                # (staged through scratch on TCP / legacy peers)
+                def fill(dst, _src=buf):
+                    np.copyto(dst, _src)
+
+                t0 = time.perf_counter()
+                conn.write_cache_into([(blocks, bs, fill)])
                 put_t += time.perf_counter() - t0
             else:
                 t0 = time.perf_counter()

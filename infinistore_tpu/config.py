@@ -126,6 +126,11 @@ class ServerConfig:
         self.integrity_alg = kwargs.get("integrity_alg", "")
         # pages/second; 0 defers to ISTPU_SCRUB_RATE (default 256)
         self.scrub_rate = kwargs.get("scrub_rate", 0)
+        # seconds an allocated-but-uncommitted reservation may live before
+        # the store reaps it (the alloc-first contract: clients that defer
+        # COMMIT_PUT rely on this to bound leaks from crashed peers).
+        # 0 defers to ISTPU_RESERVE_TTL_S (default 60)
+        self.reserve_ttl = kwargs.get("reserve_ttl", 0)
 
     def __repr__(self):
         return (

@@ -278,13 +278,20 @@ class _StoreStreamer:
                 target=self._run, name="istpu-kv-stream", daemon=True
             ).start()
             self._started = True
-        self._q.put((pages, chunk_keys_))
+        # the critical-path half runs HERE, on the submitting thread:
+        # push_begin only slices the gathered snapshot into bands and
+        # kicks their D2H DMAs (dispatch-only), so the prefill thread
+        # pays microseconds while the transfers overlap the next chunk's
+        # compute; everything that can block — materialize, pool copy,
+        # COMMIT_PUT — happens in push_commit on the worker
+        self._q.put((self._transfer.push_begin(pages, chunk_keys_),
+                     chunk_keys_))
 
     def _run(self) -> None:
         from ..utils import resilience as _res
 
         while True:
-            pages, keys = self._q.get()
+            token, keys = self._q.get()
             try:
                 if self._err is not None:
                     # parked error: skip queued items until the next
@@ -301,20 +308,23 @@ class _StoreStreamer:
                     self._dropped += 1
                     _res.count_push_dropped("circuit_open")
                 else:
-                    self._push_one(pages, keys, _res)
+                    self._push_one(token, keys, _res)
             finally:
                 self._q.task_done()
 
-    def _push_one(self, pages, keys, _res) -> None:
+    def _push_one(self, token, keys, _res) -> None:
         breaker = self._transfer.breaker
         attempts = 2 if self._durability == "strict" else 1
         for attempt in range(attempts):
             try:
                 # own trace: this thread has no request context, but
                 # async pushes should still show up in /debug/traces
-                # (kv.push_pages and the write_cache stages nest here)
+                # (kv.push_pages and the write_cache stages nest here).
+                # push_commit is the off-critical-path half: the token's
+                # D2H DMAs were kicked at submit time on the engine
+                # thread, so this worker mostly finds the bytes waiting.
                 with tracing.trace("store.push_async", chunks=len(keys)):
-                    self._transfer.push_pages(pages, keys)
+                    self._transfer.push_commit(token)
                 breaker.record_success()
                 return
             except BaseException as e:  # noqa: BLE001 — reported at flush()

@@ -16,6 +16,7 @@ layer-by-layer streaming (reference design.rst prefill flow) stays possible.
 
 from __future__ import annotations
 
+import time
 from typing import List, Optional, Sequence, Tuple
 
 import jax
@@ -45,6 +46,7 @@ class KVTransferEngine:
         pipeline_groups: int = 4,
         quant: Optional[str] = None,
         breaker: Optional[_resilience.CircuitBreaker] = None,
+        push_mode: str = "auto",
     ):
         # accept the public InfinityConnection or the raw wire Connection.
         # The SOURCE is kept (not unwrapped): the public wrapper owns the
@@ -82,6 +84,25 @@ class KVTransferEngine:
         # block_until_ready is optimistic (docs/tpu_perf_notes.md trap 1)
         self._staging: list = [None, None]
         self._staging_idx = 0
+        # push path selector: "auto" (default) = alloc-first zero-copy on
+        # negotiated shm connections, the pinned staging ring on TCP /
+        # native, legacy pipelined otherwise; "legacy" pins the pre-
+        # alloc-first path outright (the byte-parity reference, mirroring
+        # Connection.coalesce=False one layer down)
+        if push_mode not in ("auto", "legacy"):
+            raise ValueError(f"unsupported push_mode: {push_mode!r}")
+        self.push_mode = push_mode
+        # pinned, MR-registered staging ring for pushes on transports with
+        # no mappable pool (TCP / native): double-buffered per layer band,
+        # so band i's slot is never rewritten while its wire copy could
+        # still be in flight, and band i+1's D2H lands in the other slot
+        self._push_staging: list = [None, None]
+        self._push_idx = 0
+        # per-stage seconds of the LAST push_commit (d2h_s / pool_copy_s /
+        # alloc_s / commit_s, plus the zero-copy/staged band counters) —
+        # the bench legs read this to attribute regressions on the push
+        # path from bench output alone
+        self.last_push_stages: dict = {}
 
     @property
     def conn(self):
@@ -102,14 +123,44 @@ class KVTransferEngine:
             return call(name, *args)
         return getattr(self._src, name)(*args)
 
+    def _release_mr(self, buf: np.ndarray) -> None:
+        """Drop a replaced staging buffer's registration (connections
+        without the entry point — older wrappers — just leak one record,
+        the pre-fix behavior)."""
+        fn = getattr(self._src, "unregister_mr", None)
+        if fn is not None:
+            fn(buf.ctypes.data)
+
     def _ensure_staging(self, nbytes: int) -> np.ndarray:
         self._staging_idx ^= 1
         buf = self._staging[self._staging_idx]
         if buf is None or buf.nbytes < nbytes:
+            old = buf
             buf = np.empty(nbytes, dtype=np.uint8)
             # register on the SOURCE: the wrapper replays MRs on reconnect
             self._src.register_mr(buf.ctypes.data, buf.nbytes)
             self._staging[self._staging_idx] = buf
+            if old is not None:
+                # the grown-away buffer's registration must not linger in
+                # the MR table (one dead entry per growth, replayed on
+                # every reconnect, forever)
+                self._release_mr(old)
+        return buf
+
+    def _ensure_push_staging(self, nbytes: int) -> np.ndarray:
+        """Push-side twin of ``_ensure_staging``: the pinned ring slot
+        the next band materializes into on TCP/native transports.  Same
+        double-buffer alternation and the same unregister-on-growth
+        rule."""
+        self._push_idx ^= 1
+        buf = self._push_staging[self._push_idx]
+        if buf is None or buf.nbytes < nbytes:
+            old = buf
+            buf = np.empty(nbytes, dtype=np.uint8)
+            self._src.register_mr(buf.ctypes.data, buf.nbytes)
+            self._push_staging[self._push_idx] = buf
+            if old is not None:
+                self._release_mr(old)
         return buf
 
     def _page_blocks(
@@ -162,41 +213,128 @@ class KVTransferEngine:
 
         return mat
 
-    def push_pages(self, pages: jax.Array, chunk_keys_: Sequence[str]) -> int:
-        """Host-side half of a save: move gathered pages D2H and put them
-        into the store.  Split into layer bands, start every band's D2H up
-        front (copy_to_host_async), then hand the bands to the
-        connection's pipelined put: band i's pool copy overlaps band
-        i+1's D2H *and* its ALLOC_PUT round-trip, and one COMMIT_PUT
-        publishes the whole save.  Each band's host array pointer goes
-        straight to the put, so the only synchronous host copy is the
-        client->pool write (the RDMA-WRITE analog)."""
+    def _band_fill(self, p: jax.Array, stages: dict):
+        """``fill(dst)`` for one band of the alloc-first push: wait for
+        THIS band's D2H (``np.asarray`` — on same-host runtimes it
+        aliases the device buffer) and lay the bytes into ``dst`` with
+        one copy.  When ``dst`` is the mapped pool, that single copy is
+        the whole HBM→pool journey."""
+
+        def fill(dst: np.ndarray) -> None:
+            t0 = time.perf_counter()
+            host = np.asarray(p)
+            if not host.flags["C_CONTIGUOUS"]:
+                host = np.ascontiguousarray(host)
+            t1 = time.perf_counter()
+            np.copyto(dst, host.reshape(-1).view(np.uint8))
+            t2 = time.perf_counter()
+            stages["d2h_s"] += t1 - t0
+            stages["pool_copy_s"] += t2 - t1
+
+        return fill
+
+    def push_begin(self, pages: jax.Array, chunk_keys_: Sequence[str]):
+        """Critical-path half of a push: slice the gathered pages into
+        layer bands and KICK every band's device→host DMA
+        (``copy_to_host_async`` is dispatch-only) — the only store work
+        the prefill thread pays for.  Returns an opaque token for
+        ``push_commit``, the streamer-thread half."""
+        L = self.cfg.n_layers
+        G = max(1, min(self.pipeline_groups, L))
+        Lg = -(-L // G)
+        parts = [pages[l0 : l0 + Lg] for l0 in range(0, L, Lg)]
+        for p in parts:
+            p.copy_to_host_async()
+        return parts, list(chunk_keys_)
+
+    def push_commit(self, token) -> int:
+        """Off-critical-path half of a push: materialize each band —
+        straight into the shm pool on connections that negotiated
+        alloc-first descriptors, through the pinned staging ring on
+        TCP/native — and COMMIT_PUT.  Per-stage seconds land in
+        ``last_push_stages``.  Returns bytes written."""
+        parts, chunk_keys_ = token
         L = self.cfg.n_layers
         pb = self.wire_page_bytes
+        stages = {"d2h_s": 0.0, "pool_copy_s": 0.0, "wire_s": 0.0,
+                  "alloc_s": 0.0, "commit_s": 0.0,
+                  "zero_copy_bands": 0, "staged_bands": 0}
         with tracing.span("kv.push_pages", pages=len(chunk_keys_) * L,
                           bytes=len(chunk_keys_) * L * pb):
-            G = max(1, min(self.pipeline_groups, L))
-            Lg = -(-L // G)
-            parts = [pages[l0 : l0 + Lg] for l0 in range(0, L, Lg)]
-            for p in parts:
-                p.copy_to_host_async()
-            bands = []
-            for gi, p in enumerate(parts):
-                l0 = gi * Lg
-                blocks = self._page_blocks(chunk_keys_, l0, l0 + p.shape[0])
-                bands.append((blocks, pb, self._band_host(p)))
-            # the public wrapper always exposes the pipelined entry point
-            # (with its own per-band fallback); only a bare native client
-            # lacks it
-            writer = getattr(self._src, "write_cache_pipelined", None)
-            if writer is not None:
-                return writer(bands)
+            total = self._push_banded(parts, chunk_keys_, stages)
+        self.last_push_stages = stages
+        return total
+
+    def _push_banded(self, parts, chunk_keys_: Sequence[str],
+                     stages: dict) -> int:
+        pb = self.wire_page_bytes
+        raw = self.conn
+        l0s = []
+        l0 = 0
+        for p in parts:
+            l0s.append(l0)
+            l0 += p.shape[0]
+        if (self.push_mode != "legacy"
+                and getattr(raw, "shm_mode", False)
+                and getattr(raw, "alloc_first", False)):
+            # zero-copy path: descriptors learned up front, each band's
+            # fill targets the mapped pool itself (exactly one copy
+            # between the device buffer and the pool)
+            bands = [
+                (self._page_blocks(chunk_keys_, l0, l0 + p.shape[0]), pb,
+                 self._band_fill(p, stages))
+                for l0, p in zip(l0s, parts)
+            ]
+            info = self._src.write_cache_into(bands)
+            stages["alloc_s"] += info.get("alloc_s", 0.0)
+            stages["commit_s"] += info.get("commit_s", 0.0)
+            stages["zero_copy_bands"] += info.get("zero_copy_bands", 0)
+            stages["staged_bands"] += info.get("staged_bands", 0)
+            return info["bytes"]
+        if (self.push_mode != "legacy"
+                and not getattr(raw, "shm_mode", False)):
+            # no mappable pool (TCP / cross-host): materialize each band
+            # into the pinned staging ring, then the batched put — band
+            # i's socket write runs while band i+1's D2H (kicked at
+            # push_begin) is still in flight
             total = 0
-            for blocks, _pb, mat in bands:  # bare native client: per-band
-                host = mat()
-                self._call("write_cache", blocks, pb, host.ctypes.data)
-                total += host.nbytes
+            for l0, p in zip(l0s, parts):
+                blocks = self._page_blocks(chunk_keys_, l0, l0 + p.shape[0])
+                nbytes = pb * len(blocks)
+                slot = self._ensure_push_staging(nbytes)
+                self._band_fill(p, stages)(slot[:nbytes])
+                stages["staged_bands"] += 1
+                t0 = time.perf_counter()
+                self._call("write_cache", blocks, pb, slot.ctypes.data)
+                stages["wire_s"] += time.perf_counter() - t0
+                total += nbytes
             return total
+        # legacy path (push_mode="legacy", or an shm peer that did not
+        # negotiate alloc-first): the pre-alloc-first banded pipelined
+        # put, kept as the byte-parity reference and the old-server path
+        bands = [
+            (self._page_blocks(chunk_keys_, l0, l0 + p.shape[0]), pb,
+             self._band_host(p))
+            for l0, p in zip(l0s, parts)
+        ]
+        writer = getattr(self._src, "write_cache_pipelined", None)
+        if writer is not None:
+            return writer(bands)
+        total = 0
+        for blocks, _pb, mat in bands:  # bare native client: per-band
+            host = mat()
+            self._call("write_cache", blocks, pb, host.ctypes.data)
+            total += host.nbytes
+        return total
+
+    def push_pages(self, pages: jax.Array, chunk_keys_: Sequence[str]) -> int:
+        """Host-side half of a save: move gathered pages D2H and put
+        them into the store — ``push_begin`` (kick every band's D2H)
+        followed immediately by ``push_commit`` (materialize + commit).
+        Callers that can afford to defer the commit half off their
+        critical path (the engine's ``_StoreStreamer``) call the two
+        halves separately."""
+        return self.push_commit(self.push_begin(pages, chunk_keys_))
 
     def save_pages(
         self, cache: jax.Array, block_ids: Sequence[int], chunk_keys_: Sequence[str]

@@ -175,6 +175,14 @@ def _integrity_enabled() -> bool:
     off, HELLO never asks for the capability and every read stays on the
     legacy wire format.  Read per connection, like the trace gate."""
     return os.environ.get("ISTPU_INTEGRITY", "verify") != "off"
+
+
+def _alloc_first_enabled() -> bool:
+    """Alloc-first put opt-out (ISTPU_ALLOC_FIRST=0): when off, HELLO
+    never asks for the capability and ``write_cache_into`` stays on the
+    staged fallback — the byte-parity escape hatch for the zero-copy
+    push path, mirroring ISTPU_NO_COALESCE for the copy loop."""
+    return os.environ.get("ISTPU_ALLOC_FIRST", "1") != "0"
 # total time write_cache keeps re-asking after RETRY (another writer is
 # actively streaming one of these keys) before giving up with a clear error
 _RETRY_DEADLINE_S = float(os.environ.get("ISTPU_RETRY_DEADLINE_S", "10"))
@@ -496,6 +504,17 @@ class Connection:
         self.integrity = False
         self.epoch: Optional[int] = None
         self.checksum_alg = _checksum.ALG_SUM64
+        # alloc-first state (negotiated at HELLO): when the server answers
+        # the ALOC capability trailer, write_cache_into may learn pool
+        # descriptors BEFORE the payload exists and commit from another
+        # thread — the server's reservation TTL (reserve_ttl) bounds the
+        # leak if this process dies mid-push.  Fails closed: an old server
+        # or native runtime leaves alloc_first False and pushes staged.
+        self.alloc_first = False
+        self.reserve_ttl: Optional[float] = None
+        # grow-only scratch for write_cache_into's staged fallback (a
+        # fragmented allocation, a non-shm transport, or no negotiation)
+        self._scratch: Optional[np.ndarray] = None
 
     def latency_stats(self) -> Dict[str, Dict[str, float]]:
         """Client-side per-op latency counters (count/avg/max ms)."""
@@ -515,6 +534,8 @@ class Connection:
         hello_flags = P.HELLO_FLAG_TRACE_CTX if _trace_ctx_enabled() else 0
         if _integrity_enabled():
             hello_flags |= P.HELLO_FLAG_INTEGRITY
+        if _alloc_first_enabled():
+            hello_flags |= P.HELLO_FLAG_ALLOC_FIRST
         t0 = time.perf_counter()
         status, body = ch0.exchange(
             P.OP_HELLO, P.pack_hello(os.getpid(), hello_flags)
@@ -535,6 +556,14 @@ class Connection:
             if got is not None:
                 self.checksum_alg, self.epoch = got
                 self.integrity = True
+        if hello_flags & P.HELLO_FLAG_ALLOC_FIRST:
+            # alloc-first capability answer: the server's reservation TTL.
+            # Absent (old server / native runtime) -> negotiation fails
+            # closed and write_cache_into stages through scratch instead.
+            ttl = P.unpack_hello_alloc(memoryview(body))
+            if ttl is not None:
+                self.alloc_first = True
+                self.reserve_ttl = ttl
         if (hello_flags & P.HELLO_FLAG_TRACE_CTX) and (
                 srv_flags & P.HELLO_FLAG_TRACE_CTX):
             # clock-skew correction: the server stamped t_server while the
@@ -1041,6 +1070,105 @@ class Connection:
             _raise_for_status(status, "commit_put")
         return total
 
+    def _fill_scratch(self, nbytes: int) -> np.ndarray:
+        buf = self._scratch
+        if buf is None or buf.nbytes < nbytes:
+            buf = np.empty(nbytes, dtype=np.uint8)
+            self._scratch = buf
+        return buf
+
+    @_timed_op("write_cache_into")
+    def write_cache_into(self, bands) -> dict:
+        """Alloc-first, fill-in-place put — the zero-copy half of the
+        HBM→pool push path.
+
+        ``bands``: sequence of ``(blocks, block_size, fill)`` where
+        ``fill(dst)`` writes the band's ``len(blocks) * block_size``
+        payload bytes into ``dst`` (a writable uint8 ndarray).  On an shm
+        connection that negotiated the alloc-first capability, EVERY
+        band's ALLOC_PUT goes on the wire up front — before any payload
+        exists, so a device→host DMA can still be in flight — and each
+        band whose descriptors merge to one contiguous run hands ``fill``
+        a view of the MAPPED POOL itself: the payload's first landing in
+        host memory IS the store pool, no intermediate host array, no
+        second memcpy.  Fragmented allocations (and non-shm / legacy
+        peers) degrade to one staging copy through a reusable scratch
+        buffer.
+
+        Returns ``{"bytes", "zero_copy_bands", "staged_bands", "alloc_s",
+        "commit_s"}`` — the band counters the structural perf guard
+        asserts on, plus the phase seconds the bench breakdown reads."""
+        bands = [b for b in bands if b[0]]
+        info = {"bytes": 0, "zero_copy_bands": 0, "staged_bands": 0,
+                "alloc_s": 0.0, "commit_s": 0.0}
+        if not bands:
+            return info
+        if not (self.shm_mode and self.alloc_first):
+            # no negotiated zero-copy target: stage each band, then the
+            # ordinary batched put (works against any peer)
+            for blocks, block_size, fill in bands:
+                nbytes = block_size * len(blocks)
+                scratch = self._fill_scratch(nbytes)
+                fill(scratch[:nbytes])
+                self.write_cache(blocks, block_size, scratch.ctypes.data)
+                info["staged_bands"] += 1
+                info["bytes"] += nbytes
+            return info
+        ch = self.channels[0]
+        tid = self._trace_id()
+        enc = [P.encode_keys([k for k, _ in blocks])
+               for blocks, _, _ in bands]
+        t_alloc = time.perf_counter()
+        with self.latency.timed("write_cache.alloc"):
+            # all bands' ALLOC_PUTs pipelined on one channel: the
+            # descriptors come back while the payload is still being
+            # produced (this is what "alloc-first" buys)
+            slots = [
+                ch.submit(P.OP_ALLOC_PUT, P.pack_alloc_put(enc[i], b[1]),
+                          trace_id=tid)
+                for i, b in enumerate(bands)
+            ]
+            descs_per = []
+            for i, slot in enumerate(slots):
+                status, body = ch.wait(slot)
+                if status == P.RETRY:
+                    # rare contention path: synchronous backoff this band
+                    body = self._alloc_put_retrying(enc[i], bands[i][1])
+                else:
+                    _raise_for_status(status, "alloc_put")
+                descs_per.append(P.unpack_descs(memoryview(body)))
+        info["alloc_s"] = time.perf_counter() - t_alloc
+        all_keys: List[bytes] = []
+        for i, (blocks, block_size, fill) in enumerate(bands):
+            descs = descs_per[i]
+            offsets = [off for _, off in blocks]
+            nbytes = block_size * len(blocks)
+            runs = _merge_runs(descs, offsets)
+            with self.latency.timed("write_cache.fill"):
+                if (len(runs) == 1 and runs[0][2] == 0
+                        and runs[0][3] == nbytes):
+                    # one contiguous pool run covering the whole band:
+                    # fill writes the pool directly — zero staging copies
+                    pool_idx, pool_off, _cli, length = runs[0]
+                    fill(self._pool_arr(pool_idx)[
+                        pool_off : pool_off + length])
+                    info["zero_copy_bands"] += 1
+                else:
+                    scratch = self._fill_scratch(nbytes)
+                    fill(scratch[:nbytes])
+                    self._copy_descs(descs, offsets,
+                                     memoryview(scratch)[:nbytes],
+                                     to_pool=True)
+                    info["staged_bands"] += 1
+            all_keys.extend(enc[i])
+            info["bytes"] += nbytes
+        t_commit = time.perf_counter()
+        with self.latency.timed("write_cache.commit"):
+            status, _ = self._request(P.OP_COMMIT_PUT, P.pack_keys(all_keys))
+            _raise_for_status(status, "commit_put")
+        info["commit_s"] = time.perf_counter() - t_commit
+        return info
+
     @_timed_op("read_cache_pipelined")
     def read_cache_pipelined(self, bands, on_band: Optional[Callable] = None) -> int:
         """Mirror image of ``write_cache_pipelined``: band i+1's GET_DESC
@@ -1188,6 +1316,14 @@ class Connection:
         register with on a TPU-VM; kept for API parity and sanity checks
         (reference: lib.py:580-616)."""
         self._registered[ptr] = size
+        return 0
+
+    def unregister_mr(self, ptr: int) -> int:
+        """Release a registration made by ``register_mr`` — a staging
+        buffer that grew and was replaced must drop its old registration
+        or the MR table (and the wrapper's reconnect-replay list) leaks
+        one dead entry per growth."""
+        self._registered.pop(ptr, None)
         return 0
 
 
@@ -1391,6 +1527,24 @@ class InfinityConnection:
             total += block_size * len(blocks)
         return total
 
+    def write_cache_into(self, bands) -> dict:
+        """Alloc-first fill-in-place put (see ``Connection``): clients
+        without the entry point (native) stage each band through a
+        scratch buffer and ride the plain batched put."""
+        if hasattr(self.conn, "write_cache_into"):
+            return self._call("write_cache_into", bands)
+        info = {"bytes": 0, "zero_copy_bands": 0, "staged_bands": 0}
+        for blocks, block_size, fill in bands:
+            if not blocks:
+                continue
+            nbytes = block_size * len(blocks)
+            scratch = np.empty(nbytes, dtype=np.uint8)
+            fill(scratch)
+            self.write_cache(blocks, block_size, scratch.ctypes.data)
+            info["staged_bands"] += 1
+            info["bytes"] += nbytes
+        return info
+
     def read_cache_pipelined(self, bands, on_band=None) -> int:
         """Banded get with desc-prefetch overlap; ``on_band(i)`` fires as
         each band's bytes land (same fallback rule as the write side)."""
@@ -1526,3 +1680,12 @@ class InfinityConnection:
             if (ptr, size) not in self._mrs:
                 self._mrs.append((ptr, size))
             return ret
+
+    def unregister_mr(self, ptr: int) -> int:
+        """Release a registration: drops it from the live connection AND
+        from the reconnect-replay list, so a grown-and-replaced staging
+        buffer doesn't accumulate one dead MR per growth."""
+        with self._reconnect_lock:
+            self._mrs = [(p, s) for p, s in self._mrs if p != ptr]
+            fn = getattr(self.conn, "unregister_mr", None)
+            return fn(ptr) if fn is not None else 0

@@ -173,12 +173,19 @@ def encode_keys(keys: Sequence) -> List[bytes]:
 # optionally followed by capability trailers when the client's flags asked
 # for them: the "TRAC" block (pack_hello_trailer) answers
 # HELLO_FLAG_TRACE_CTX, the "EPOC" block (pack_epoch_trailer) answers
-# HELLO_FLAG_INTEGRITY with the server's boot epoch + checksum algorithm.
-# Old clients stop reading at the pool table (unpack_pool_table is
-# length-prefixed), old servers send no trailer — both directions stay
-# byte-compatible.
+# HELLO_FLAG_INTEGRITY with the server's boot epoch + checksum algorithm,
+# and the "ALOC" block (pack_alloc_trailer) answers HELLO_FLAG_ALLOC_FIRST
+# with the server's pending-reservation TTL.  Old clients stop reading at
+# the pool table (unpack_pool_table is length-prefixed), old servers send
+# no trailer — both directions stay byte-compatible.
 HELLO_FLAG_TRACE_CTX = 0x1
 HELLO_FLAG_INTEGRITY = 0x2
+# alloc-first puts: the client may run ALLOC_PUT before the payload exists
+# (device->host DMA still in flight) and COMMIT_PUT arbitrarily later from
+# a background thread.  The capability answer promises the server reaps
+# abandoned reservations after a TTL (a crashed client can't leak pool
+# blocks), which is what makes the deferred commit safe to rely on.
+HELLO_FLAG_ALLOC_FIRST = 0x4
 
 # trailer: marker u32 | server_flags u32 | t_server f64 (perf_counter at
 # response build — the server-clock sample the client uses to estimate the
@@ -232,23 +239,60 @@ def pack_epoch_trailer(alg: int, epoch: int) -> bytes:
     return _EPOCH_TRAILER.pack(HELLO_EPOCH_MAGIC, alg, epoch)
 
 
+# alloc-first capability trailer: marker u32 | flags u32 (reserved) |
+# reserve_ttl_s f64 — the server-side TTL after which an allocated-but-
+# uncommitted reservation is reaped.  Same 16-byte block shape as the
+# TRAC/EPOC trailers so one scanner walks all three in any order.
+HELLO_ALLOC_MAGIC = 0x434F4C41  # "ALOC"
+_ALLOC_TRAILER = struct.Struct("<IId")
+HELLO_ALLOC_SIZE = _ALLOC_TRAILER.size  # 16
+
+# every capability trailer is a 16-byte {magic u32 | ...} block; unknown
+# magics end the scan (a legacy body, or bytes that aren't a trailer)
+_TRAILER_MAGICS = (HELLO_TRAILER_MAGIC, HELLO_EPOCH_MAGIC, HELLO_ALLOC_MAGIC)
+
+
+def pack_alloc_trailer(reserve_ttl_s: float) -> bytes:
+    return _ALLOC_TRAILER.pack(HELLO_ALLOC_MAGIC, 0, reserve_ttl_s)
+
+
+def _find_hello_trailer(buf: memoryview, want_magic: int) -> Optional[int]:
+    """Offset of the 16-byte capability trailer with ``want_magic`` in a
+    HELLO response body, or None.  Skips other known trailers (the server
+    appends them in ask order, which differs per client)."""
+    _pools, off = unpack_pool_table_ex(buf)
+    while len(buf) - off >= HELLO_TRAILER_SIZE:
+        (magic,) = _U32.unpack_from(buf, off)
+        if magic == want_magic:
+            return off
+        if magic not in _TRAILER_MAGICS:
+            break
+        off += HELLO_TRAILER_SIZE
+    return None
+
+
 def unpack_hello_epoch(buf: memoryview) -> Optional[Tuple[int, int]]:
     """Scan a HELLO response for the EPOC trailer; returns (alg, epoch)
     or None when the server did not answer the integrity capability
     (old server, native runtime, or ISTPU_INTEGRITY=off)."""
-    _pools, off = unpack_pool_table_ex(buf)
-    while len(buf) - off >= 4:
-        (magic,) = _U32.unpack_from(buf, off)
-        if (magic == HELLO_TRAILER_MAGIC
-                and len(buf) - off >= HELLO_TRAILER_SIZE):
-            off += HELLO_TRAILER_SIZE  # skip the TRAC block
-            continue
-        if (magic == HELLO_EPOCH_MAGIC
-                and len(buf) - off >= HELLO_EPOCH_SIZE):
-            _m, alg, epoch = _EPOCH_TRAILER.unpack_from(buf, off)
-            return alg, epoch
-        break
-    return None
+    off = _find_hello_trailer(buf, HELLO_EPOCH_MAGIC)
+    if off is None:
+        return None
+    _m, alg, epoch = _EPOCH_TRAILER.unpack_from(buf, off)
+    return alg, epoch
+
+
+def unpack_hello_alloc(buf: memoryview) -> Optional[float]:
+    """Scan a HELLO response for the ALOC trailer; returns the server's
+    pending-reservation TTL in seconds, or None when the server did not
+    answer the alloc-first capability (old server / native runtime) —
+    negotiation fails closed and the client keeps the legacy staged
+    push."""
+    off = _find_hello_trailer(buf, HELLO_ALLOC_MAGIC)
+    if off is None:
+        return None
+    _m, _flags, ttl = _ALLOC_TRAILER.unpack_from(buf, off)
+    return ttl
 
 
 # trace context blob (prepended to the body when FLAG_TRACE_CTX is set in

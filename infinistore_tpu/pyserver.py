@@ -208,6 +208,10 @@ class StoreServer:
         reg.counter("istpu_store_contig_batches_total",
                     "Batch allocs served as one contiguous run",
                     fn=lambda: st.stats.contig_batches)
+        reg.counter("istpu_store_reservations_reaped_total",
+                    "Allocated-but-uncommitted reservations freed past the "
+                    "TTL (an alloc-first writer died without disconnecting)",
+                    fn=lambda: st.stats.reservations_reaped)
         # resilience plane: the periodic-evict loop counts its failures
         # here instead of dying silently, and the fault injector counts
         # every injected fault so chaos tests can assert determinism
@@ -560,6 +564,13 @@ class StoreServer:
                 # epoch-fenced layouts.
                 resp += P.pack_epoch_trailer(st.checksum_alg, st.epoch)
                 cs["integrity"] = True
+            if cflags & P.HELLO_FLAG_ALLOC_FIRST:
+                # alloc-first capability answer: promise the reservation
+                # TTL, so the client may defer COMMIT_PUT to a background
+                # thread knowing a crash can't leak its pool blocks.  No
+                # per-connection state: ALLOC_PUT/COMMIT_PUT semantics
+                # are unchanged, the trailer only advertises the reaper.
+                resp += P.pack_alloc_trailer(st.pending_ttl_s)
             return P.pack_resp(P.FINISH, resp)
         if op == P.OP_TRACE_DUMP:
             return P.pack_resp(

@@ -287,6 +287,12 @@ def parse_args():
                         type=float,
                         help="scrubber re-verification rate, pages/second "
                              "(0 = ISTPU_SCRUB_RATE or 256)")
+    parser.add_argument("--reserve-ttl", required=False, default=0,
+                        type=float,
+                        help="seconds before an allocated-but-uncommitted "
+                             "reservation is reaped (alloc-first clients "
+                             "defer COMMIT_PUT; this bounds leaks from "
+                             "crashed peers; 0 = ISTPU_RESERVE_TTL_S or 60)")
     parser.add_argument("--allocator", required=False, default="bitmap",
                         choices=["bitmap", "sizeclass"],
                         help="pool allocator: 'bitmap' (uniform-block "

@@ -49,6 +49,15 @@ from .utils import checksum as _checksum
 ON_DEMAND_MIN_THRESHOLD = 0.8  # reference: src/infinistore.cpp:52
 ON_DEMAND_MAX_THRESHOLD = 0.95  # reference: src/infinistore.cpp:53
 READ_LEASE_S = 5.0
+# how long an allocated-but-uncommitted reservation may sit before the
+# store reaps it.  Alloc-first clients (HELLO_FLAG_ALLOC_FIRST) learn
+# descriptors before the payload exists and commit from a background
+# thread, so a reservation legitimately outlives its ALLOC_PUT by a full
+# push; the TTL only has to catch clients that died without disconnecting
+# (disconnect already aborts via conn_pending).  Must comfortably exceed
+# the slowest conceivable push — a reaped reservation makes the late
+# COMMIT_PUT answer INVALID_REQ, a loud failure, never silent corruption.
+RESERVE_TTL_S = float(os.environ.get("ISTPU_RESERVE_TTL_S", "60"))
 
 
 @dataclass
@@ -91,6 +100,10 @@ class Stats:
     contig_batches: int = 0  # batch allocs served as one contiguous run
     scrub_pages: int = 0    # entries re-verified by the background scrubber
     scrub_corrupt: int = 0  # corrupt entries found and quarantined
+    # uncommitted reservations reaped past the TTL (a client that crashed
+    # mid-push without disconnecting; >0 in steady state means leaked
+    # alloc-first writers)
+    reservations_reaped: int = 0
 
 
 class CacheAnalytics:
@@ -327,6 +340,12 @@ class Store:
             getattr(config, "scrub_rate", 0)
             or os.environ.get("ISTPU_SCRUB_RATE", 0) or 256.0
         )
+        # reservation TTL for allocated-but-uncommitted regions (the
+        # alloc-first contract advertised in the HELLO ALOC trailer);
+        # initialized here so hand-built test stores get it too
+        self.pending_ttl_s = float(
+            getattr(config, "reserve_ttl", 0) or RESERVE_TTL_S
+        )
         # commit-time stamping backlog: (key, entry) pairs drained by
         # stamp_pending.  Deferred on purpose — a synchronous checksum at
         # COMMIT_PUT would serialize a full extra memory pass into the
@@ -354,6 +373,22 @@ class Store:
                 keep.append((expiry, e))
         self._deferred = keep
 
+    def reap_pending(self, now: Optional[float] = None) -> int:
+        """Free uncommitted reservations whose TTL lapsed (the writer
+        crashed without disconnecting — disconnect aborts them already).
+        ``busy`` regions are skipped: an op is actively streaming into
+        them and will commit or abort on its own.  Returns reservations
+        reaped.  A late COMMIT_PUT of a reaped key answers INVALID_REQ,
+        so an impossibly slow writer fails loudly, never silently."""
+        if now is None:
+            now = self._clock()
+        expired = [k for k, e in self.pending.items()
+                   if not e.busy and e.lease <= now]
+        for key in expired:
+            self._free(self.pending.pop(key))
+        self.stats.reservations_reaped += len(expired)
+        return len(expired)
+
     def _touch(self, key: bytes) -> None:
         self.kv.move_to_end(key)
 
@@ -376,7 +411,11 @@ class Store:
 
     def evict(self, min_threshold: float, max_threshold: float) -> int:
         evicted = 0
+        # both reapers ride every evict pass (periodic loop + the
+        # on-demand pass _allocate runs): lapsed read leases free their
+        # deferred blocks, lapsed reservations free leaked pending ones
         self._reap_deferred(self._clock())
+        self.reap_pending()
         if self.mm.usage() >= max_threshold:
             now = self._clock()
             skipped = []
@@ -490,7 +529,11 @@ class Store:
         if regions is None:
             return None
         pool_idx, offset = regions[0]
-        e = Entry(pool_idx, offset, size)
+        # lease doubles as the reservation expiry while the entry is
+        # pending (no read can lease an uncommitted key, so the field is
+        # otherwise idle until commit resets it)
+        e = Entry(pool_idx, offset, size,
+                  lease=self._clock() + self.pending_ttl_s)
         self.pending[key] = e
         return e
 
@@ -550,11 +593,14 @@ class Store:
         if regions is None:
             return P.OUT_OF_MEMORY, []
         descs = []
+        expiry = self._clock() + self.pending_ttl_s
         for key, (pool_idx, offset) in zip(keys, regions):
             old = self.pending.pop(key, None)
             if old is not None:
                 self._free(old)
-            self.pending[key] = Entry(pool_idx, offset, block_size)
+            # lease = reservation expiry while pending (see reap_pending)
+            self.pending[key] = Entry(pool_idx, offset, block_size,
+                                      lease=expiry)
             descs.append((pool_idx, offset, block_size))
         return P.FINISH, descs
 
@@ -581,6 +627,10 @@ class Store:
     def _insert_committed(self, key: bytes, e: Entry) -> None:
         now = self._clock()
         e.created = e.last_access = now  # touch zero for reuse distances
+        # while pending, lease held the reservation expiry; from commit on
+        # it is a READ lease and must start clear (a stale reservation
+        # stamp would make the evictor skip this entry for the whole TTL)
+        e.lease = 0.0
         old = self.kv.pop(key, None)
         if old is not None:
             # overwrite: an shm reader may hold a live lease on the old
@@ -868,6 +918,7 @@ class Store:
             "contig_batches": s.contig_batches,
             "active_read_leases": self.active_leases(),
             "deferred_frees": len(self._deferred),
+            "reservations_reaped": s.reservations_reaped,
             "dead_on_arrival": self.analytics.dead_on_arrival,
             "epoch": self.epoch,
             "stamp_backlog": len(self._unstamped),

@@ -42,6 +42,12 @@ METRICS = {
     "p50_read_latency_us": ("down", "p50 64KiB read us"),
     "p99_read_latency_us": ("down", "p99 64KiB read us"),
     "alloc_ms": ("down", "alloc p50 ms"),
+    # the HBM->pool push path (the alloc-first zero-copy tentpole): live
+    # captures emit these unprefixed; stale-snapshot copies ride the
+    # tpu_-prefixed rows below with the usual staleness annotation
+    "hbm_put_gbps": ("up", "HBM->store GB/s (live)"),
+    "hbm_get_gbps": ("up", "store->HBM GB/s (live)"),
+    "prefill_store_overhead": ("down", "store prefill x (live)"),
     "tpu_hbm_put_gbps": ("up", "HBM->store GB/s"),
     "tpu_hbm_get_gbps": ("up", "store->HBM GB/s"),
     "tpu_prefill_store_overhead": ("down", "store-attached prefill x"),

@@ -358,13 +358,15 @@ def test_prefill_streams_kv_per_chunk(server):
         prefill_chunk=T,
     )
     pushes = []
-    orig = eng.transfer.push_pages
+    orig = eng.transfer.push_commit
 
-    def spy(pages, keys):
-        pushes.append(list(keys))
-        return orig(pages, keys)
+    def spy(token):
+        # the streamer hands the worker half a (bands, keys) token;
+        # spying here observes exactly the per-chunk push cadence
+        pushes.append(list(token[1]))
+        return orig(token)
 
-    eng.transfer.push_pages = spy
+    eng.transfer.push_commit = spy
     eng.prefill(PROMPT)  # len 11, T=4 -> 2 complete chunks + tail
     assert len(pushes) == len(PROMPT) // T  # one push per complete chunk
     assert all(len(p) == 1 for p in pushes)  # each carries ONE chunk's keys
@@ -400,15 +402,15 @@ def test_relaxed_durability_prefill_returns_before_flush(server):
     eng.store_flush()
 
     DELAY = 0.5
-    orig = eng.transfer.push_pages
+    orig = eng.transfer.push_commit
     done = []
 
-    def slow(pages, keys):
+    def slow(token):
         _time.sleep(DELAY)
-        done.append(list(keys))
-        return orig(pages, keys)
+        done.append(list(token[1]))
+        return orig(token)
 
-    eng.transfer.push_pages = slow
+    eng.transfer.push_commit = slow
     t0 = _time.perf_counter()
     st = eng.prefill([t + 1 for t in PROMPT])  # distinct prefix
     dt = _time.perf_counter() - t0
@@ -440,10 +442,10 @@ def test_relaxed_durability_push_error_surfaces_at_flush(server):
         prefill_chunk=T, store_durability="relaxed",
     )
 
-    def boom(pages, keys):
+    def boom(token):
         raise RuntimeError("push failed")
 
-    eng.transfer.push_pages = boom
+    eng.transfer.push_commit = boom
     st = eng.prefill(PROMPT)  # must not raise here
     with pytest.raises(RuntimeError, match="push failed"):
         eng.store_flush()

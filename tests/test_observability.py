@@ -416,7 +416,9 @@ def test_trace_nests_request_through_transfer_to_pool_copy(
     events = [e for e in out["traceEvents"] if e.get("ph") == "X"]
     assert all(e["args"]["trace_id"] == trace_id for e in events)
     by = {e["name"]: e for e in events}
-    assert {"request", "kv.push_pages", "write_cache.copy"} <= set(by), (
+    # the alloc-first push records its fused D2H+pool stage as
+    # write_cache.fill (pre-alloc-first clients recorded write_cache.copy)
+    assert {"request", "kv.push_pages", "write_cache.fill"} <= set(by), (
         sorted(by)
     )
 
@@ -426,7 +428,7 @@ def test_trace_nests_request_through_transfer_to_pool_copy(
                 and c["ts"] + c["dur"] <= p["ts"] + p["dur"] + 1e-6)
 
     assert contained("kv.push_pages", "request")
-    assert contained("write_cache.copy", "kv.push_pages")
+    assert contained("write_cache.fill", "kv.push_pages")
 
 
 # ---------------------------------------------------------------------------

@@ -34,6 +34,13 @@ from infinistore_tpu.lib import _merge_runs
 pytestmark = pytest.mark.perf
 
 PUT_FLOOR_GBPS = 2.4
+# store-attached prefill budget (relaxed durability, the shipping
+# default): the critical-path half of a push is alloc-free and
+# copy-free — kick the async D2H, enqueue — so an attached prefill may
+# cost at most 20% over detached (the repo-level form of the reference's
+# <=1% overhead claim; the on-chip prefill_store_overhead <= 1.2 target
+# is asserted at the next live bench_tpu capture)
+ATTACHED_PREFILL_BUDGET = 1.2
 
 
 def _free_port():
@@ -182,4 +189,105 @@ def test_instrumentation_overhead_within_5pct(server, monkeypatch):
         f"instrumented shm put {put_gbps:.2f} GB/s fell below 95% of the "
         f"{PUT_FLOOR_GBPS} GB/s floor — observability overhead regression "
         f"(get measured {get_gbps:.2f})"
+    )
+
+
+def test_shm_push_performs_zero_intermediate_host_copies(server,
+                                                         monkeypatch):
+    """STRUCTURAL: the alloc-first shm push must hand its fill the
+    MAPPED POOL itself — ``zero_copy_bands`` counts every band that did,
+    ``staged_bands`` every band that went through a scratch copy.  A
+    regression that silently reintroduces client-side staging (losing
+    the tentpole's one-copy property) flips these counters long before
+    it shows up as bandwidth."""
+    monkeypatch.setenv("ISTPU_CLIENT", "python")
+    blk = 64 << 10
+    n = 64
+    payload = np.random.randint(0, 256, n * blk, dtype=np.uint8)
+    conn = ist.InfinityConnection(ist.ClientConfig(
+        host_addr="127.0.0.1", service_port=server,
+        connection_type=ist.TYPE_SHM, log_level="warning"))
+    conn.connect()
+    assert conn.conn.alloc_first, "alloc-first did not negotiate"
+    # four bands, like a real banded push
+    per = n // 4
+    bands = []
+    for b in range(4):
+        blocks = [(f"zcg-{b}-{i}", i * blk) for i in range(per)]
+        view = payload[b * per * blk : (b + 1) * per * blk]
+        bands.append((blocks, blk,
+                      lambda dst, _v=view: np.copyto(dst, _v)))
+    info = conn.write_cache_into(bands)
+    assert info["zero_copy_bands"] == 4 and info["staged_bands"] == 0, info
+    # and the bytes are byte-identical on the way back
+    dst = np.zeros(per * blk, dtype=np.uint8)
+    for b in range(4):
+        blocks = [(f"zcg-{b}-{i}", i * blk) for i in range(per)]
+        conn.read_cache(blocks, blk, dst.ctypes.data)
+        assert np.array_equal(dst,
+                              payload[b * per * blk : (b + 1) * per * blk])
+    conn.close()
+
+
+def test_store_attached_prefill_within_budget(server, monkeypatch):
+    """The commit-after-respond contract, measured: with relaxed
+    durability the prefill critical path carries only the cheap half of
+    each push (gather dispatch + async D2H kick + queue put), so a
+    store-ATTACHED prefill must stay within ``ATTACHED_PREFILL_BUDGET``
+    of detached.  This is the CPU-host form of the acceptance target;
+    the on-chip ratio is asserted from the next live bench capture."""
+    import jax
+
+    from infinistore_tpu.engine.engine import InferenceEngine
+    from infinistore_tpu.kv.cache import PagedCacheConfig
+    from infinistore_tpu.models import TINY, init_params
+
+    monkeypatch.setenv("ISTPU_CLIENT", "python")
+    cfg = TINY
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    pc = PagedCacheConfig(
+        n_layers=cfg.n_layers, n_kv_heads=cfg.n_kv_heads,
+        head_dim=cfg.head_dim, block_tokens=16, n_blocks=128,
+    )
+    S, C = 256, 64  # 4 chunks: 3 stream while later chunks compute
+    rng = np.random.RandomState(3)
+
+    def med3(conn, tag):
+        eng = InferenceEngine(
+            params, cfg, pc, conn=conn, model_id=f"psmoke-{tag}",
+            prefill_chunk=C, store_durability="relaxed",
+        )
+        prompt = [int(x) for x in rng.randint(1, cfg.vocab_size, size=S)]
+        st = eng.prefill(prompt)  # compile warmup
+        np.asarray(st.last_logits)
+        eng.store_flush()
+        eng.release(st)
+        times = []
+        for _ in range(3):
+            p = [int(x) for x in rng.randint(1, cfg.vocab_size, size=S)]
+            t0 = time.perf_counter()
+            st = eng.prefill(p)
+            np.asarray(st.last_logits)  # ground-truth completion
+            times.append(time.perf_counter() - t0)
+            eng.store_flush()
+            eng.release(st)
+        times.sort()
+        return times[1]
+
+    t_detached = med3(None, "detached")
+    conn = ist.InfinityConnection(ist.ClientConfig(
+        host_addr="127.0.0.1", service_port=server,
+        connection_type=ist.TYPE_SHM, log_level="warning"))
+    conn.connect()
+    try:
+        t_attached = med3(conn, "attached")
+    finally:
+        conn.close()
+    # +10 ms absolute slack: TINY prefills are tens of ms on this host,
+    # and scheduler jitter on a 1-vCPU runner must not flake the ratio
+    budget = t_detached * ATTACHED_PREFILL_BUDGET + 0.010
+    assert t_attached <= budget, (
+        f"store-attached prefill {t_attached * 1e3:.1f} ms exceeded "
+        f"{ATTACHED_PREFILL_BUDGET}x the detached {t_detached * 1e3:.1f} ms "
+        f"(+10 ms slack) — the push critical path grew"
     )

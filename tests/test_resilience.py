@@ -448,10 +448,12 @@ def test_streamer_counts_drops_and_reports_them_at_flush(server):
         prefill_chunk=T, store_durability="relaxed",
     )
 
-    def boom(pages, keys):
+    def boom(token):
         raise RuntimeError("push failed hard")
 
-    eng.transfer.push_pages = boom
+    # the streamer's worker half is push_commit (push_begin runs on the
+    # submitting thread and must stay cheap/unfailing)
+    eng.transfer.push_commit = boom
     before = m.parse_prometheus_text(
         m.default_registry().to_prometheus_text()
     ).get(("istpu_store_push_dropped_total", (("reason", "push_error"),)), 0.0)
