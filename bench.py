@@ -182,6 +182,88 @@ def bench_tpu_leg(timeout_s: int = 1800) -> dict:
         return {}
 
 
+def bench_cluster(n_nodes: int, rounds: int = 4) -> dict:
+    """Cluster leg: N local store instances driven through the
+    consistent-hash router (``infinistore_tpu.cluster``), one writer
+    thread per node per round — the aggregate number says what the
+    fleet sustains when one host's NIC/DRAM stops being the cap, and
+    the per-node split shows ring balance."""
+    import concurrent.futures as cf
+
+    from infinistore_tpu.cluster import RoutedStorePool
+
+    procs = []
+    try:
+        for _ in range(n_nodes):
+            procs.append(start_server())
+        pool = RoutedStorePool(
+            [f"127.0.0.1:{port}" for _, port in procs],
+            connection_type=TYPE_SHM,
+        )
+        bufs = {}
+        for node in pool.nodes():
+            buf = np.random.randint(0, 256, size=ROUND_BYTES, dtype=np.uint8)
+            node.conn.register_mr(buf)
+            bufs[node.endpoint] = buf
+        per_node = {ep: {"put_s": 0.0, "get_s": 0.0, "bytes": 0}
+                    for ep in pool.endpoints}
+        put_t = get_t = 0.0
+        with cf.ThreadPoolExecutor(max_workers=n_nodes) as pool_exec:
+            for r in range(rounds):
+                keys = [f"cl-r{r}-L{layer}-c{c}"
+                        for layer in range(N_LAYERS) for c in range(CHUNKS)]
+                groups = pool.partition(keys)
+
+                def one(ep_idxs, op):
+                    ep, idxs = ep_idxs
+                    blocks = [(keys[i], j * PAGE_BYTES)
+                              for j, i in enumerate(idxs)]
+                    conn = pool.node(ep).conn
+                    t0 = time.perf_counter()
+                    getattr(conn, op)(blocks, PAGE_BYTES,
+                                      bufs[ep].ctypes.data)
+                    dt = time.perf_counter() - t0
+                    per_node[ep]["put_s" if op == "write_cache"
+                                 else "get_s"] += dt
+                    if op == "write_cache":
+                        per_node[ep]["bytes"] += PAGE_BYTES * len(blocks)
+                    return dt
+
+                t0 = time.perf_counter()
+                list(pool_exec.map(lambda g: one(g, "write_cache"),
+                                   groups.items()))
+                put_t += time.perf_counter() - t0
+                t0 = time.perf_counter()
+                list(pool_exec.map(lambda g: one(g, "read_cache"),
+                                   groups.items()))
+                get_t += time.perf_counter() - t0
+                for ep, idxs in groups.items():
+                    pool.node(ep).conn.delete_keys(
+                        [keys[i] for i in idxs])
+        pool.close()
+    finally:
+        for proc, _ in procs:
+            proc.terminate()
+        for proc, _ in procs:
+            proc.wait(timeout=10)
+    gb = rounds * ROUND_BYTES / 1e9
+    return {
+        "cluster_nodes": n_nodes,
+        "cluster_put_gbps": round(gb / put_t, 3),
+        "cluster_get_gbps": round(gb / get_t, 3),
+        "cluster_per_node": {
+            ep: {
+                "put_gbps": round(s["bytes"] / 1e9 / s["put_s"], 3)
+                if s["put_s"] else 0.0,
+                "get_gbps": round(s["bytes"] / 1e9 / s["get_s"], 3)
+                if s["get_s"] else 0.0,
+                "bytes": s["bytes"],
+            }
+            for ep, s in per_node.items()
+        },
+    }
+
+
 def bench_read_latency(port: int, n: int = 400) -> dict:
     """Single-page (64 KiB) read latency percentiles on the zero-copy path —
     the latency half of the driver metric (BASELINE.json: "p50 read
@@ -217,6 +299,11 @@ def main(argv=None):
                          "({run_id, gbps_put, gbps_get, alloc_ms, "
                          "stages:{...}} — docs/observability.md) for the "
                          "measured SHM leg")
+    ap.add_argument("--endpoints", type=int, default=0, metavar="N",
+                    help="also run the CLUSTER leg: N local store "
+                         "instances driven through the consistent-hash "
+                         "router, reporting aggregate and per-node GB/s "
+                         "(cluster_put_gbps / cluster_get_gbps)")
     args = ap.parse_args(argv)
 
     proc, port = start_server()
@@ -229,6 +316,19 @@ def main(argv=None):
     finally:
         proc.terminate()
         proc.wait(timeout=10)
+
+    cluster = {}
+    if args.endpoints:
+        cluster = bench_cluster(args.endpoints)
+        print(
+            "# cluster x{}: put {} get {} GB/s | per-node {}".format(
+                cluster["cluster_nodes"], cluster["cluster_put_gbps"],
+                cluster["cluster_get_gbps"],
+                {ep: f"{s['put_gbps']}/{s['get_gbps']}"
+                 for ep, s in cluster["cluster_per_node"].items()},
+            ),
+            file=sys.stderr,
+        )
 
     tpu = bench_tpu_leg()
     if not tpu or "unavailable" in tpu or "timed_out" in tpu:
@@ -270,6 +370,7 @@ def main(argv=None):
         "shm_put_gbps": round(shm_put, 2),
         "shm_get_gbps": round(shm_get, 2),
         **lat,
+        **cluster,
     }
     # extra keys: the TPU-in-the-loop numbers (HBM<->store hop, Pallas vs
     # XLA decode attention on chip, engine tokens/s) when a TPU answered
@@ -282,6 +383,7 @@ def main(argv=None):
 
         rec = bench_json(uuid.uuid4().hex[:8], shm_put, shm_get, shm_stages)
         rec.update(lat)  # the latency half rides along (extra keys allowed)
+        rec.update(cluster)  # cluster aggregate + per-node, when run
         with open(args.json_out, "w") as f:
             json.dump(rec, f, indent=2)
 

@@ -49,6 +49,13 @@ def parse_args():
                          "(descriptors learned first, payload filled "
                          "straight into the mapped pool on shm) — "
                          "compare the two to see the zero-copy win")
+    ap.add_argument("--endpoints", default=None, metavar="HOST:PORT,...",
+                    help="drive a store CLUSTER instead of one server: "
+                         "blocks route per key over the consistent-hash "
+                         "ring (infinistore_tpu.cluster), one writer per "
+                         "node concurrently; prints aggregate and "
+                         "per-node GB/s.  Overrides --server/"
+                         "--service-port")
     ap.add_argument("--serving", action="store_true", default=False,
                     help="serving-loop benchmark instead of bandwidth: "
                          "prefill + decode tokens/s through the engine "
@@ -137,10 +144,77 @@ def _source_buffer(nbytes: int, device: str) -> np.ndarray:
     return np.random.randint(0, 256, size=nbytes, dtype=np.uint8)
 
 
+def cluster_bench(args) -> None:
+    """Cluster bandwidth loop: the batch partitions per ring owner and
+    each node's sub-batch is written/read by its own worker thread —
+    the fleet-level counterpart of the single-server loop below."""
+    import concurrent.futures as cf
+
+    from .cluster import RoutedStorePool
+
+    conn_type = TYPE_SHM if (args.shm or args.rdma) else TYPE_TCP
+    pool = RoutedStorePool(args.endpoints, connection_type=conn_type)
+    bs = args.block_size << 10
+    n_blocks = max(1, (args.size << 20) // bs)
+    buf = _source_buffer(n_blocks * bs, args.src_device)
+    dst = np.zeros_like(buf)
+    for node in pool.nodes():
+        node.conn.register_mr(buf)
+        node.conn.register_mr(dst)
+    run = uuid.uuid4().hex[:8]
+    per_node = {ep: 0 for ep in pool.endpoints}
+    put_t = get_t = 0.0
+    with cf.ThreadPoolExecutor(max_workers=len(pool.endpoints)) as ex:
+        for it in range(args.iteration):
+            blocks = [(f"bench-{run}-{it}-{i}", i * bs)
+                      for i in range(n_blocks)]
+            groups = pool.partition([k for k, _ in blocks])
+
+            def shard(ep_idxs, op, target):
+                ep, idxs = ep_idxs
+                sub = [blocks[i] for i in idxs]
+                getattr(pool.node(ep).conn, op)(sub, bs, target)
+                return ep, len(idxs)
+
+            t0 = time.perf_counter()
+            for ep, cnt in ex.map(
+                    lambda g: shard(g, "write_cache", buf.ctypes.data),
+                    groups.items()):
+                per_node[ep] += cnt * bs
+            put_t += time.perf_counter() - t0
+            t0 = time.perf_counter()
+            list(ex.map(lambda g: shard(g, "read_cache", dst.ctypes.data),
+                        groups.items()))
+            get_t += time.perf_counter() - t0
+            for ep, idxs in groups.items():
+                pool.node(ep).conn.delete_keys([blocks[i][0] for i in idxs])
+    assert np.array_equal(buf, dst), "data mismatch"
+    gb = args.iteration * n_blocks * bs / 1e9
+    print(f"transport={conn_type} cluster x{len(pool.endpoints)} "
+          f"blocks={n_blocks}x{args.block_size}KB x{args.iteration}")
+    print(f"put: {gb / put_t:.2f} GB/s   get: {gb / get_t:.2f} GB/s")
+    for ep, nbytes in per_node.items():
+        share = nbytes / (gb * 1e9) if gb else 0.0
+        print(f"  {ep:24s} {share:6.1%} of bytes")
+    if args.json_out:
+        rec = bench_json(run, gb / put_t if put_t else 0.0,
+                         gb / get_t if get_t else 0.0, {})
+        rec["cluster_nodes"] = len(pool.endpoints)
+        rec["cluster_put_gbps"] = rec["gbps_put"]
+        rec["cluster_get_gbps"] = rec["gbps_get"]
+        with open(args.json_out, "w") as f:
+            json.dump(rec, f, indent=2)
+        print(f"results written to {args.json_out}")
+    pool.close()
+
+
 def main():
     args = parse_args()
     if args.serving:
         serving_bench(args)
+        return
+    if args.endpoints:
+        cluster_bench(args)
         return
     conn_type = TYPE_SHM if (args.shm or args.rdma) else TYPE_TCP
     conn = InfinityConnection(ClientConfig(

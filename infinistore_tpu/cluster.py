@@ -1,0 +1,1008 @@
+"""Client-side multi-node store cluster: consistent-hash sharding, a
+routed per-endpoint connection pool, and hot-prefix replication.
+
+The single-store stack caps capacity at one host's DRAM and bandwidth at
+one host's NIC; PAPER.md §1(c) (cross-host prefix-cache reuse) needs a
+fleet.  This module composes pieces the repo already has into that
+cluster layer:
+
+* **Sharding** — ``HashRing``: stable virtual-node consistent hashing
+  over N store endpoints.  Content-addressed chunk keys
+  (``kv/hashing.py``) make routing trivial: the *chunk stem* (the key
+  before its ``#L{layer}`` suffix) is the routing unit, so every layer
+  of a chunk co-locates on one node and ``get_match_last_index`` still
+  answers per node.  The ring is deterministic across processes
+  (blake2b, never ``hash()``) and pure — unit-testable with no sockets.
+* **Routing** — ``RoutedStorePool``: one reconnect-aware
+  ``InfinityConnection`` per endpoint, each with its *own*
+  ``CircuitBreaker`` (``utils/resilience.py``) and its own epoch fence
+  (``lib.py``), so a dead or restarted node degrades to recompute for
+  only its key range — never the fleet — and a restart's stale bytes
+  fail closed per node.
+* **Replication** — writes for chunk stems flagged *hot* (client-side
+  reuse counting in ``HotKeyTracker``, the routed twin of the PR-4
+  server-side hot-key analytics, plus an explicit ``pin`` API for
+  system prompts) fan out to R ring-successor nodes; reads fail over
+  owner → replica → replica before declaring a miss.
+* **Lazy rebalance** — membership change moves no bytes.  A key whose
+  owner changed is simply a cache miss that re-pushes under the same
+  content-addressed name; the old copy ages out of the old owner's LRU.
+
+``ClusterTransferEngine`` presents the same surface as
+``kv.transfer.KVTransferEngine`` (push/load/lookup + the breaker-guarded
+degraded hops), so the engine, scheduler, and connector are agnostic:
+hand them a ``RoutedStorePool`` instead of a connection and every
+per-chunk hop routes by key hash, with multi-endpoint batches split and
+issued concurrently.  Single-endpoint configs never construct any of
+this — they keep the classic one-connection path byte-identically.
+
+Metrics (process-default registry, rides every serving ``/metrics``):
+
+* ``istpu_cluster_node_state{endpoint}`` — 0 closed / 1 open / 2 half-open
+* ``istpu_cluster_requests_total{endpoint,outcome}`` — per-node hops by
+  outcome (ok / error / skipped / miss)
+* ``istpu_cluster_replica_reads_total{result}`` — replica failovers that
+  hit vs. exhausted as a miss
+* ``istpu_cluster_ring_ownership{endpoint}`` — fraction of the hash
+  space each endpoint owns
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import os
+import threading
+from collections import OrderedDict
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .config import ClientConfig, TYPE_SHM
+from .utils import metrics as _metrics
+from .utils import resilience as _resilience
+from .utils.logging import Logger
+
+# virtual nodes per endpoint: enough that ownership spread over a few
+# physical nodes stays within ~2x of even (tested), cheap to rebuild
+DEFAULT_VNODES = int(os.environ.get("ISTPU_CLUSTER_VNODES", "64"))
+# total copies of a HOT chunk (owner + R-1 ring successors); 1 = no
+# replication.  Reads always probe up to this many candidates before a
+# miss, so it also bounds the failover walk.
+DEFAULT_REPLICAS = int(os.environ.get("ISTPU_CLUSTER_REPLICAS", "2"))
+# a chunk stem becomes hot after this many lookups touch it (system
+# prompts are read-heavy: their stems recur across requests, cold
+# one-off prompts never do)
+DEFAULT_HOT_AFTER = int(os.environ.get("ISTPU_HOT_AFTER", "3"))
+
+_RING_SPACE = float(1 << 64)
+
+
+def ring_hash(s) -> int:
+    """Stable 64-bit ring position.  blake2b, never ``hash()``: routing
+    must agree across processes and runs (PYTHONHASHSEED randomizes
+    ``hash``), or two clients would shard one fleet two ways."""
+    if isinstance(s, str):
+        s = s.encode()
+    return int.from_bytes(hashlib.blake2b(s, digest_size=8).digest(), "big")
+
+
+def route_stem(key: str) -> str:
+    """The routing unit of a page key: its chunk stem — everything
+    before the ``#L{layer}`` suffix (and therefore before the ``:q8``
+    quant marker that follows it), so every layer of a chunk lands on
+    one node and a node-local ``get_match_last_index`` stays sound."""
+    return key.rsplit("#L", 1)[0]
+
+
+def parse_endpoints(spec) -> List[str]:
+    """``host:port,host:port`` (or an iterable of them) → normalized,
+    order-preserving, deduplicated endpoint list."""
+    if isinstance(spec, str):
+        parts = [p.strip() for p in spec.split(",")]
+    else:
+        parts = [str(p).strip() for p in spec]
+    out: List[str] = []
+    for p in parts:
+        if not p:
+            continue
+        host, sep, port = p.rpartition(":")
+        if not sep or not host or not port.isdigit():
+            raise ValueError(f"bad store endpoint {p!r} (want host:port)")
+        ep = f"{host}:{int(port)}"
+        if ep not in out:
+            out.append(ep)
+    if not out:
+        raise ValueError("no store endpoints given")
+    return out
+
+
+class HashRing:
+    """Consistent-hash ring with virtual nodes.
+
+    Pure and deterministic: ownership depends only on the endpoint set
+    and ``vnodes`` — not insertion order, process, or run.  Adding or
+    removing one endpoint moves ~1/N of the key space (the consistent-
+    hashing contract the unit tests pin)."""
+
+    def __init__(self, endpoints: Sequence[str] = (), vnodes: int = DEFAULT_VNODES):
+        assert vnodes >= 1
+        self.vnodes = vnodes
+        self._endpoints: List[str] = []
+        self._points: List[Tuple[int, str]] = []  # sorted (hash, endpoint)
+        self._hashes: List[int] = []
+        for ep in endpoints:
+            self.add(ep)
+
+    @property
+    def endpoints(self) -> List[str]:
+        return list(self._endpoints)
+
+    def __len__(self) -> int:
+        return len(self._endpoints)
+
+    def _rebuild(self) -> None:
+        pts = [
+            (ring_hash(f"{ep}#vn{i}"), ep)
+            for ep in self._endpoints
+            for i in range(self.vnodes)
+        ]
+        pts.sort()
+        self._points = pts
+        self._hashes = [h for h, _ in pts]
+
+    def add(self, endpoint: str) -> None:
+        if endpoint in self._endpoints:
+            return
+        self._endpoints.append(endpoint)
+        self._rebuild()
+
+    def remove(self, endpoint: str) -> None:
+        if endpoint not in self._endpoints:
+            return
+        self._endpoints.remove(endpoint)
+        self._rebuild()
+
+    def owner(self, key: str) -> str:
+        """The endpoint owning ``key``'s routing stem: the first virtual
+        node at or clockwise of the key's ring position."""
+        if not self._points:
+            raise ValueError("empty ring")
+        h = ring_hash(route_stem(key))
+        i = bisect.bisect_left(self._hashes, h) % len(self._points)
+        return self._points[i][1]
+
+    def successors(self, key: str, n: int) -> List[str]:
+        """Up to ``n`` DISTINCT endpoints walking clockwise from the
+        key's position — element 0 is the owner, the rest are the
+        replica candidates (and the read-failover order)."""
+        if not self._points:
+            raise ValueError("empty ring")
+        n = min(n, len(self._endpoints))
+        h = ring_hash(route_stem(key))
+        i = bisect.bisect_left(self._hashes, h)
+        out: List[str] = []
+        for k in range(len(self._points)):
+            ep = self._points[(i + k) % len(self._points)][1]
+            if ep not in out:
+                out.append(ep)
+                if len(out) == n:
+                    break
+        return out
+
+    def ownership(self) -> Dict[str, float]:
+        """Fraction of the hash space each endpoint owns (arc lengths of
+        its virtual nodes) — the ring-balance gauge."""
+        if not self._points:
+            return {}
+        out = {ep: 0.0 for ep in self._endpoints}
+        prev = self._points[-1][0] - (1 << 64)  # wraparound arc
+        for h, ep in self._points:
+            out[ep] += (h - prev) / _RING_SPACE
+            prev = h
+        return out
+
+
+class HotKeyTracker:
+    """Client-side hot-prefix detection: bounded reuse counting over
+    chunk stems.  A stem probed by ``hot_after`` distinct lookups is
+    hot (system prompts recur across requests; cold prompts are seen
+    once); ``pin`` marks stems hot unconditionally and exempts them
+    from capacity eviction — the operator API for known system
+    prompts."""
+
+    def __init__(self, hot_after: Optional[int] = None, capacity: int = 4096):
+        self.hot_after = DEFAULT_HOT_AFTER if hot_after is None else int(hot_after)
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._counts: "OrderedDict[str, int]" = OrderedDict()
+        self._pinned: set = set()
+
+    def record(self, key: str) -> int:
+        stem = route_stem(key)
+        with self._lock:
+            c = self._counts.pop(stem, 0) + 1
+            self._counts[stem] = c  # re-append: LRU order
+            while len(self._counts) > self.capacity:
+                self._counts.popitem(last=False)
+            return c
+
+    def record_many(self, keys: Sequence[str]) -> None:
+        for k in keys:
+            self.record(k)
+
+    def is_hot(self, key: str) -> bool:
+        stem = route_stem(key)
+        with self._lock:
+            if stem in self._pinned:
+                return True
+            return self._counts.get(stem, 0) >= self.hot_after
+
+    def pin(self, keys: Sequence[str]) -> int:
+        with self._lock:
+            before = len(self._pinned)
+            self._pinned.update(route_stem(k) for k in keys)
+            return len(self._pinned) - before
+
+    def unpin(self, keys: Sequence[str]) -> None:
+        with self._lock:
+            self._pinned.difference_update(route_stem(k) for k in keys)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            hot = sum(1 for c in self._counts.values() if c >= self.hot_after)
+            return {
+                "hot_after": self.hot_after,
+                "tracked": len(self._counts),
+                "hot": hot + len(self._pinned - set(self._counts)),
+                "pinned": len(self._pinned),
+            }
+
+
+class _Node:
+    """One endpoint's client-side state: the reconnect-aware public
+    connection, its own circuit breaker (named by endpoint so the
+    per-node walk shows up in ``istpu_store_circuit_state``), and a
+    lock serializing staging-buffer ops (failover can route two
+    groups' fetches at one node concurrently)."""
+
+    def __init__(self, endpoint: str, make_conn, breaker=None):
+        self.endpoint = endpoint
+        self._make_conn = make_conn
+        self.conn = make_conn(endpoint)
+        self.breaker = breaker or _resilience.CircuitBreaker(
+            name=f"store@{endpoint}"
+        )
+        # reentrant: ensure_connected() runs both standalone (lookup
+        # probes) and under a caller-held staging lock (fetch/commit)
+        self.lock = threading.RLock()
+        self.connected = False
+        self.engine = None  # per-node KVTransferEngine, built lazily
+
+    def ensure_connected(self) -> None:
+        """Connect if never (successfully) connected; raises the
+        transport error on failure.  A half-connected wrapper is
+        replaced wholesale — ``InfinityConnection.connect`` is not
+        re-entrant after a partial bootstrap."""
+        if self.connected:
+            return
+        with self.lock:
+            if self.connected:
+                return
+            try:
+                self.conn.connect()
+            except Exception:
+                # fresh wrapper next attempt (a partial connect leaves
+                # channels the wrapper refuses to rebuild over)
+                self.conn = self._make_conn(self.endpoint)
+                self.engine = None
+                raise
+            self.connected = True
+
+
+class RoutedStorePool:
+    """The routed multi-endpoint pool: ring + per-node connections +
+    hot tracker + cluster metrics.  Pure bookkeeping — the transfer
+    logic lives in ``ClusterTransferEngine``; benches and tests drive
+    the pool directly."""
+
+    def __init__(
+        self,
+        endpoints,
+        connection_type: str = TYPE_SHM,
+        op_timeout_s: Optional[float] = None,
+        replicas: int = DEFAULT_REPLICAS,
+        vnodes: int = DEFAULT_VNODES,
+        hot_after: Optional[int] = None,
+        num_streams: int = 4,
+        conn_factory=None,
+        connect: bool = True,
+        registry=None,
+    ):
+        eps = parse_endpoints(endpoints)
+        assert replicas >= 1
+        self.replicas = min(replicas, len(eps))
+        self.ring = HashRing(eps, vnodes=vnodes)
+        self.tracker = HotKeyTracker(hot_after=hot_after)
+        self.connection_type = connection_type
+        self.op_timeout_s = op_timeout_s
+        self._num_streams = num_streams
+        self._make_conn = conn_factory or self._default_conn
+        self._nodes: Dict[str, _Node] = {
+            ep: _Node(ep, self._make_conn) for ep in eps
+        }
+        self._exec = ThreadPoolExecutor(
+            max_workers=min(8, max(2, len(eps))),
+            thread_name_prefix="istpu-cluster",
+        )
+        reg = registry or _metrics.default_registry()
+        self._g_state = reg.gauge(
+            "istpu_cluster_node_state",
+            "Per-endpoint store circuit: 0 closed / 1 open / 2 half-open",
+            labelnames=("endpoint",),
+        )
+        self._c_requests = reg.counter(
+            "istpu_cluster_requests_total",
+            "Cluster store hops per endpoint by outcome "
+            "(ok / error / skipped / miss)",
+            labelnames=("endpoint", "outcome"),
+        )
+        self._c_replica = reg.counter(
+            "istpu_cluster_replica_reads_total",
+            "Reads answered by a replica after owner failover (hit) or "
+            "exhausted across all replicas (miss)",
+            labelnames=("result",),
+        )
+        self._g_own = reg.gauge(
+            "istpu_cluster_ring_ownership",
+            "Fraction of the consistent-hash space each endpoint owns",
+            labelnames=("endpoint",),
+        )
+        # python-side mirrors of the counters, for /debug/cluster
+        self._req_counts: Dict[Tuple[str, str], int] = {}
+        self._replica_counts = {"hit": 0, "miss": 0}
+        self._counts_lock = threading.Lock()
+        self._refresh_ring_gauges()
+        if connect:
+            for node in self._nodes.values():
+                try:
+                    node.ensure_connected()
+                except Exception as e:  # noqa: BLE001 — a node down at
+                    # boot is a degraded start, not a failed one: its
+                    # breaker counts the failure and later hops retry
+                    node.breaker.record_failure()
+                    self.record_outcome(node.endpoint, "error")
+                    Logger.warn(
+                        f"store endpoint {node.endpoint} unreachable at "
+                        f"pool construction: {e!r} (its key range serves "
+                        f"degraded until it comes back)"
+                    )
+
+    def _default_conn(self, endpoint: str):
+        from .lib import InfinityConnection
+
+        host, _, port = endpoint.rpartition(":")
+        return InfinityConnection(ClientConfig(
+            host_addr=host,
+            service_port=int(port),
+            connection_type=self.connection_type,
+            op_timeout_s=self.op_timeout_s,
+            num_streams=self._num_streams,
+            log_level="warning",
+        ))
+
+    @classmethod
+    def from_config(cls, config: ClientConfig, **kw):
+        """Build a pool from a ``ClientConfig`` whose ``endpoints``
+        field names the fleet (the template's connection_type /
+        op_timeout_s / num_streams apply to every node)."""
+        assert config.endpoints, "ClientConfig.endpoints is empty"
+        return cls(
+            config.endpoints,
+            connection_type=config.connection_type or TYPE_SHM,
+            op_timeout_s=config.op_timeout_s,
+            num_streams=config.num_streams,
+            **kw,
+        )
+
+    # -- membership / topology --
+
+    @property
+    def endpoints(self) -> List[str]:
+        return self.ring.endpoints
+
+    def node(self, endpoint: str) -> _Node:
+        return self._nodes[endpoint]
+
+    def nodes(self) -> List[_Node]:
+        return [self._nodes[ep] for ep in self.ring.endpoints]
+
+    def add_endpoint(self, endpoint: str) -> None:
+        """Join a node.  Rebalance is LAZY on purpose: no bytes move —
+        a key whose owner changed is a cache miss that re-pushes under
+        its content-addressed name, and the old copy LRU-ages out."""
+        ep = parse_endpoints([endpoint])[0]
+        if ep in self._nodes:
+            return
+        self._nodes[ep] = _Node(ep, self._make_conn)
+        self.ring.add(ep)
+        self.replicas = min(max(self.replicas, 1), len(self._nodes))
+        self._refresh_ring_gauges()
+
+    def remove_endpoint(self, endpoint: str) -> None:
+        node = self._nodes.pop(endpoint, None)
+        self.ring.remove(endpoint)
+        self._refresh_ring_gauges()
+        if node is not None:
+            try:
+                node.conn.close()
+            except Exception:  # noqa: BLE001 — already dead is fine
+                pass
+
+    def _refresh_ring_gauges(self) -> None:
+        for ep, frac in self.ring.ownership().items():
+            self._g_own.labels(ep).set(frac)
+
+    # -- routing --
+
+    def owner(self, key: str) -> str:
+        return self.ring.owner(key)
+
+    def candidates(self, key: str) -> List[str]:
+        """Read-failover / replica order for a key: owner first, then
+        ring successors, ``replicas`` long."""
+        return self.ring.successors(key, self.replicas)
+
+    def write_targets(self, key: str) -> List[str]:
+        """Where a chunk's pages go: the owner — plus the replica
+        successors when the stem is hot or pinned (R-way fan-out)."""
+        if self.replicas > 1 and self.tracker.is_hot(key):
+            return self.candidates(key)
+        return [self.ring.owner(key)]
+
+    def partition(self, keys: Sequence[str]) -> "OrderedDict[str, List[int]]":
+        """Group key indices by owning endpoint, order-preserving."""
+        groups: "OrderedDict[str, List[int]]" = OrderedDict()
+        for i, k in enumerate(keys):
+            groups.setdefault(self.ring.owner(k), []).append(i)
+        return groups
+
+    def write_partition(self, keys: Sequence[str]) -> "OrderedDict[str, List[int]]":
+        """Like ``partition`` but fanned out: a hot key's index appears
+        in every replica target's group."""
+        groups: "OrderedDict[str, List[int]]" = OrderedDict()
+        for i, k in enumerate(keys):
+            for ep in self.write_targets(k):
+                groups.setdefault(ep, []).append(i)
+        return groups
+
+    # -- pin API (system prompts) --
+
+    def pin(self, keys: Sequence[str]) -> int:
+        """Mark chunk stems permanently hot: their writes fan out to
+        every replica target from now on.  Returns newly pinned count."""
+        return self.tracker.pin(keys)
+
+    def unpin(self, keys: Sequence[str]) -> None:
+        self.tracker.unpin(keys)
+
+    # -- accounting --
+
+    def record_outcome(self, endpoint: str, outcome: str) -> None:
+        self._c_requests.labels(endpoint, outcome).inc()
+        with self._counts_lock:
+            k = (endpoint, outcome)
+            self._req_counts[k] = self._req_counts.get(k, 0) + 1
+        node = self._nodes.get(endpoint)
+        if node is not None:
+            self._g_state.labels(endpoint).set(node.breaker.state_code)
+
+    def record_replica_read(self, result: str) -> None:
+        self._c_replica.labels(result).inc()
+        with self._counts_lock:
+            self._replica_counts[result] = (
+                self._replica_counts.get(result, 0) + 1
+            )
+
+    def report(self) -> dict:
+        """The ``/debug/cluster`` payload: ring, per-node state, and
+        the request/replica counters."""
+        own = self.ring.ownership()
+        with self._counts_lock:
+            req = dict(self._req_counts)
+            replica = dict(self._replica_counts)
+        nodes = []
+        for ep in self.ring.endpoints:
+            node = self._nodes[ep]
+            state = node.breaker.state
+            self._g_state.labels(ep).set(node.breaker.state_code)
+            nodes.append({
+                "endpoint": ep,
+                "state": state,
+                "connected": node.connected,
+                "epoch": getattr(getattr(node.conn, "conn", None),
+                                 "epoch", None),
+                "ownership": round(own.get(ep, 0.0), 4),
+                "requests": {
+                    oc: req.get((ep, oc), 0)
+                    for oc in ("ok", "error", "skipped", "miss")
+                },
+            })
+        return {
+            "enabled": True,
+            "replicas": self.replicas,
+            "vnodes": self.ring.vnodes,
+            "nodes": nodes,
+            "replica_reads": replica,
+            "hot": self.tracker.snapshot(),
+        }
+
+    def close(self) -> None:
+        self._exec.shutdown(wait=False)
+        for node in self._nodes.values():
+            try:
+                node.conn.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+
+class FleetBreaker:
+    """Aggregate, read-only view over the pool's per-node breakers for
+    callers that expect ONE circuit (serve /healthz, the streamer's
+    skip check).  ``state``: closed when every node is closed, open
+    when EVERY node is open (full-fleet outage), else ``partial`` —
+    /healthz reports degraded for anything non-closed, which is true:
+    some key ranges are recomputing.
+
+    Deliberately never consumes half-open probe slots (``allow`` reads
+    state only) and never records: per-node attribution happens at the
+    per-node hop, where the failure actually occurred."""
+
+    def __init__(self, pool: RoutedStorePool):
+        self._pool = pool
+
+    def _states(self) -> List[str]:
+        return [n.breaker.state for n in self._pool.nodes()]
+
+    @property
+    def state(self) -> str:
+        states = self._states()
+        if all(s == "closed" for s in states):
+            return "closed"
+        if states and all(s == "open" for s in states):
+            return "open"
+        return "partial"
+
+    @property
+    def state_code(self) -> int:
+        return {"closed": 0, "open": 1, "partial": 2}[self.state]
+
+    def allow(self) -> bool:
+        """May a cluster hop run?  Yes while ANY node might answer.
+        Per-node gating (and probe consumption) happens per hop."""
+        return any(s != "open" for s in self._states())
+
+    def record_success(self) -> None:  # per-node breakers record instead
+        pass
+
+    def record_failure(self) -> None:
+        pass
+
+
+class ClusterTransferEngine:
+    """``KVTransferEngine``'s surface over a ``RoutedStorePool``: every
+    chunk routes to its ring owner, multi-endpoint batches split and
+    issue concurrently, hot chunks replicate on push and fail over on
+    read.  The engine, streamer, connector, and serve layer use it
+    interchangeably with the single-node transfer."""
+
+    def __init__(
+        self,
+        pool: RoutedStorePool,
+        cfg,
+        pipeline_groups: int = 4,
+        quant: Optional[str] = None,
+        push_mode: str = "auto",
+    ):
+        from .kv.transfer import KVTransferEngine  # late: jax import
+
+        self._KVTransferEngine = KVTransferEngine
+        self.pool = pool
+        self.cfg = cfg
+        self.pipeline_groups = pipeline_groups
+        self.quant = quant
+        self.push_mode = push_mode
+        self.breaker = FleetBreaker(pool)
+        # template engine for endpoint-independent halves (device-side
+        # gather, key layout, scatter): same cfg/quant as every node
+        self._tpl = self._engine(pool.endpoints[0])
+        self.wire_page_bytes = self._tpl.wire_page_bytes
+        self._key_suffix = self._tpl._key_suffix
+        self.last_push_stages: dict = {}
+
+    # -- per-node plumbing --
+
+    def _engine(self, endpoint: str):
+        node = self.pool.node(endpoint)
+        eng = node.engine
+        if eng is None or eng._src is not node.conn:
+            # (re)bind: a node whose wrapper was replaced after a failed
+            # bootstrap needs a fresh transfer engine over the new conn
+            eng = self._KVTransferEngine(
+                node.conn, self.cfg, pipeline_groups=self.pipeline_groups,
+                quant=self.quant, breaker=node.breaker,
+                push_mode=self.push_mode,
+            )
+            node.engine = eng
+        return eng
+
+    def _map_nodes(self, items, fn):
+        """Run ``fn(item)`` for every item — concurrently when there is
+        more than one (the split-batch issue path)."""
+        items = list(items)
+        if len(items) <= 1:
+            return [fn(it) for it in items]
+        return list(self.pool._exec.map(fn, items))
+
+    def trace_srcs(self) -> list:
+        """Every connected node's public connection — serve's
+        /debug/traces stitches all of their server-side span rings."""
+        return [n.conn for n in self.pool.nodes() if n.connected]
+
+    @property
+    def _src(self):
+        """Single-conn compatibility probe (trace stitching falls back
+        here): the first connected node."""
+        srcs = self.trace_srcs()
+        return srcs[0] if srcs else self.pool.nodes()[0].conn
+
+    def cluster_report(self) -> dict:
+        return self.pool.report()
+
+    def pin_prefix(self, chunk_keys_: Sequence[str]) -> int:
+        """Pin chunk stems hot (the system-prompt API): their pages
+        replicate to every ring successor on the next push."""
+        return self.pool.pin(chunk_keys_)
+
+    def _call(self, name: str, *args):
+        """Metadata fan-out for connector parity.  Only ``delete_keys``
+        is meaningful cluster-wide (content-addressed keys may live on
+        any node — owner, replica, or a pre-rebalance owner); routed
+        ops go through push/load/lookup."""
+        if name != "delete_keys":
+            raise NotImplementedError(
+                f"cluster transfer routes {name!r} per-chunk; only "
+                f"delete_keys fans out"
+            )
+        (keys,) = args
+        total = 0
+        for node in self.pool.nodes():
+            if not node.connected or not node.breaker.allow():
+                continue
+            try:
+                total += self._engine(node.endpoint)._call("delete_keys", keys)
+                node.breaker.record_success()
+            except _resilience.transport_errors():
+                node.breaker.record_failure()
+                self.pool.record_outcome(node.endpoint, "error")
+        return total
+
+    def _page_keys(self, chunk_keys_: Sequence[str]) -> List[str]:
+        return self._tpl._page_keys(chunk_keys_)
+
+    # -- device-side halves (endpoint-independent) --
+
+    def gather_pages(self, cache, block_ids):
+        return self._tpl.gather_pages(cache, block_ids)
+
+    # -- push: route per chunk, fan out hot stems, commit concurrently --
+
+    def push_begin(self, pages, chunk_keys_: Sequence[str]):
+        """Critical-path half: group chunks by write target (owner +
+        replicas for hot stems), slice the gathered pages per target
+        (device-side, dispatch-only) and kick every group's D2H.
+        Returns the token ``push_commit`` consumes off-thread."""
+        import jax.numpy as jnp
+
+        chunk_keys_ = list(chunk_keys_)
+        groups = self.pool.write_partition(chunk_keys_)
+        token = []
+        for ep, idxs in groups.items():
+            sub_keys = [chunk_keys_[i] for i in idxs]
+            if len(idxs) == len(chunk_keys_):
+                sub_pages = pages
+            else:
+                sub_pages = jnp.take(
+                    pages, jnp.asarray(idxs, dtype=jnp.int32), axis=1
+                )
+            token.append(
+                (ep, self._engine(ep).push_begin(sub_pages, sub_keys),
+                 len(idxs))
+            )
+        return token
+
+    def push_commit(self, token) -> int:
+        """Off-critical-path half: commit every group on its node,
+        concurrently.  A failing node costs ONLY its own chunks
+        (counted drops, its breaker fed); the push raises only when
+        every attempted node failed — the full-fleet outage the
+        streamer's parked-error path exists for."""
+        stages = {"d2h_s": 0.0, "pool_copy_s": 0.0, "wire_s": 0.0,
+                  "alloc_s": 0.0, "commit_s": 0.0,
+                  "zero_copy_bands": 0, "staged_bands": 0}
+        results = self._map_nodes(token, self._commit_one)
+        total = 0
+        attempted = 0
+        errors = []
+        for written, err, node_stages in results:
+            total += written
+            if err is not None:
+                errors.append(err)
+            if err is not None or written:
+                attempted += 1
+            for k, v in (node_stages or {}).items():
+                if k in stages:
+                    stages[k] += v
+        stages["nodes"] = len(token)
+        stages["failed_nodes"] = len(errors)
+        self.last_push_stages = stages
+        if errors and attempted and total == 0:
+            raise errors[0]
+        return total
+
+    def _commit_one(self, entry):
+        ep, node_token, n_chunks = entry
+        node = self.pool.node(ep)
+        if not node.breaker.allow():
+            self.pool.record_outcome(ep, "skipped")
+            _resilience.count_push_dropped("circuit_open", n_chunks)
+            return 0, None, None
+        try:
+            with node.lock:
+                node.ensure_connected()
+                eng = self._engine(ep)
+                written = eng.push_commit(node_token)
+                node_stages = dict(eng.last_push_stages)
+        except _resilience.transport_errors() as e:
+            node.breaker.record_failure()
+            self.pool.record_outcome(ep, "error")
+            _resilience.count_push_dropped("push_error", n_chunks)
+            return 0, e, None
+        except Exception as e:  # noqa: BLE001 — a node-local fault
+            self.pool.record_outcome(ep, "error")
+            _resilience.count_push_dropped("push_error", n_chunks)
+            return 0, e, None
+        node.breaker.record_success()
+        self.pool.record_outcome(ep, "ok")
+        return written, None, node_stages
+
+    def push_pages(self, pages, chunk_keys_: Sequence[str]) -> int:
+        return self.push_commit(self.push_begin(pages, chunk_keys_))
+
+    def save_pages(self, cache, block_ids, chunk_keys_) -> int:
+        assert len(block_ids) == len(chunk_keys_)
+        if len(block_ids) == 0:
+            return 0
+        return self.push_pages(
+            self.gather_pages(cache, block_ids), chunk_keys_
+        )
+
+    # -- load: route per chunk, fail over replica -> replica --
+
+    def load_pages(self, cache, block_ids, chunk_keys_):
+        """Sharded load: each chunk fetched from its owner (all
+        endpoint groups concurrently), failing over along the ring
+        successors before a miss; the scatter into HBM happens after
+        every group's bytes verified.  All-or-nothing like the
+        single-node path: any unservable chunk raises KeyNotFound and
+        the cache is returned untouched by the guarded wrapper."""
+        import jax
+
+        from .lib import InfiniStoreKeyNotFound
+
+        assert len(block_ids) == len(chunk_keys_)
+        n = len(block_ids)
+        if n == 0:
+            return cache
+        chunk_keys_ = list(chunk_keys_)
+        candidates = [self.pool.candidates(k) for k in chunk_keys_]
+        fetched: List[Tuple[List[int], object]] = []
+        pending = list(range(n))
+        last_exc: Optional[Exception] = None
+        for depth in range(self.pool.replicas):
+            if not pending:
+                break
+            groups: "OrderedDict[str, List[int]]" = OrderedDict()
+            exhausted: List[int] = []
+            for i in pending:
+                if depth < len(candidates[i]):
+                    groups.setdefault(candidates[i][depth], []).append(i)
+                else:
+                    exhausted.append(i)
+            results = self._map_nodes(
+                groups.items(),
+                lambda kv: self._fetch_group(kv[0], kv[1], chunk_keys_,
+                                             depth),
+            )
+            pending = list(exhausted)
+            for (ep, idxs), (stacked, err) in zip(groups.items(), results):
+                if stacked is not None:
+                    fetched.append((idxs, stacked))
+                else:
+                    last_exc = err or last_exc
+                    pending.extend(idxs)
+        if pending:
+            if self.pool.replicas > 1:
+                self.pool.record_replica_read("miss")
+            raise (last_exc if isinstance(last_exc, InfiniStoreKeyNotFound)
+                   else InfiniStoreKeyNotFound(
+                       f"cluster: {len(pending)}/{n} chunks unservable "
+                       f"across {self.pool.replicas} candidates "
+                       f"({last_exc!r})"))
+        for idxs, stacked in fetched:
+            cache = self._tpl.scatter_pages(
+                cache, [block_ids[i] for i in idxs], stacked
+            )
+        jax.block_until_ready(cache)
+        return cache
+
+    def _fetch_group(self, ep: str, idxs: List[int],
+                     chunk_keys_: Sequence[str], depth: int):
+        """One node's fetch attempt for one group.  Returns ``(stacked,
+        None)`` on success, ``(None, err)`` to send the group to the
+        next ring successor."""
+        from .lib import (
+            InfiniStoreIntegrityError,
+            InfiniStoreKeyNotFound,
+        )
+
+        sub = [chunk_keys_[i] for i in idxs]
+        node = self.pool.node(ep)
+        if not node.breaker.allow():
+            self.pool.record_outcome(ep, "skipped")
+            return None, None
+        try:
+            with node.lock:
+                node.ensure_connected()
+                stacked = self._engine(ep).fetch_pages(sub)
+        except InfiniStoreKeyNotFound as e:
+            # healthy protocol miss: the transport answered
+            node.breaker.record_success()
+            self.pool.record_outcome(ep, "miss")
+            return None, e
+        except InfiniStoreIntegrityError as e:
+            # bad bytes on THIS node (checksum / epoch fence): hand the
+            # failed pages back for quarantine and try a replica — the
+            # transport is healthy, the circuit is untouched
+            if e.keys:
+                try:
+                    self._engine(ep)._call("delete_keys", list(e.keys))
+                except Exception:  # noqa: BLE001 — best-effort hygiene
+                    pass
+            self.pool.record_outcome(ep, "error")
+            return None, e
+        except _resilience.transport_errors() as e:
+            node.breaker.record_failure()
+            self.pool.record_outcome(ep, "error")
+            return None, e
+        node.breaker.record_success()
+        self.pool.record_outcome(ep, "ok")
+        if depth > 0:
+            self.pool.record_replica_read("hit")
+        return stacked, None
+
+    # -- lookup: per-node longest-match, merged --
+
+    def lookup_prefix(self, chunk_keys_: Sequence[str]) -> int:
+        """Longest store-resident prefix across the fleet: each node
+        answers ``get_match_last_index`` over ITS owned subsequence
+        (order within a node preserves the global order, so its answer
+        is a prefix property there too), merged into the longest global
+        prefix where every chunk's owner — or, when the owner is dead,
+        a ring successor — has the chunk.  An authoritative miss does
+        NOT fail over (a missing chunk re-pushes on recompute; lazy
+        rebalance makes that the heal path); node FAILURE does."""
+        if not chunk_keys_:
+            return 0
+        from .kv.hashing import layer_key
+
+        chunk_keys_ = list(chunk_keys_)
+        self.pool.tracker.record_many(chunk_keys_)
+        n = len(chunk_keys_)
+        sfx = self._key_suffix
+        avail = [False] * n
+        served: List[Optional[str]] = [None] * n
+        candidates = [self.pool.candidates(k) for k in chunk_keys_]
+        pending = list(range(n))
+        for depth in range(self.pool.replicas):
+            if not pending:
+                break
+            groups: "OrderedDict[str, List[int]]" = OrderedDict()
+            exhausted: List[int] = []
+            for i in pending:
+                if depth < len(candidates[i]):
+                    groups.setdefault(candidates[i][depth], []).append(i)
+                else:
+                    exhausted.append(i)
+            results = self._map_nodes(
+                groups.items(),
+                lambda kv: self._probe_group(kv[0], kv[1], chunk_keys_, sfx),
+            )
+            pending = list(exhausted)
+            for (ep, idxs), matched in zip(groups.items(), results):
+                if matched is None:  # node failure: next successor
+                    pending.extend(idxs)
+                    continue
+                for j in range(matched):
+                    avail[idxs[j]] = True
+                    served[idxs[j]] = ep
+        del served  # per-node probes verified their own tails
+        p = 0
+        while p < n and avail[p]:
+            p += 1
+        return p
+
+    def _probe_group(self, ep: str, idxs: List[int],
+                     chunk_keys_: Sequence[str], sfx: str):
+        """One node's longest-match probe over its owned subsequence.
+        Returns the matched chunk count, or None on node failure (the
+        caller walks the group to the next ring successor)."""
+        from .kv.hashing import layer_key
+
+        node = self.pool.node(ep)
+        if not node.breaker.allow():
+            self.pool.record_outcome(ep, "skipped")
+            return None
+        probe = [layer_key(chunk_keys_[i], 0) + sfx for i in idxs]
+        try:
+            node.ensure_connected()
+            eng = self._engine(ep)
+            idx = eng._call("get_match_last_index", probe)
+            # trust-but-verify like the single-node path: a chunk is
+            # only readable if its LAST layer committed (layer 0 lands
+            # first, so the match's tail must hold the whole chunk)
+            while idx >= 0:
+                last = layer_key(
+                    chunk_keys_[idxs[idx]], self.cfg.n_layers - 1) + sfx
+                if eng._call("check_exist", last) == 0:
+                    break
+                idx -= 1
+        except _resilience.transport_errors():
+            node.breaker.record_failure()
+            self.pool.record_outcome(ep, "error")
+            return None
+        except Exception:  # noqa: BLE001 — a lookup is an optimization
+            self.pool.record_outcome(ep, "error")
+            return None
+        node.breaker.record_success()
+        self.pool.record_outcome(ep, "ok")
+        return idx + 1
+
+    # -- breaker-guarded hops (the degraded-serving contract, fleet
+    #    edition: per-node breakers fed at the hop, aggregate gate
+    #    here) --
+
+    def guarded_lookup_prefix(self, chunk_keys_: Sequence[str]) -> int:
+        if not self.breaker.allow():
+            _resilience.count_degraded("lookup")
+            return 0
+        try:
+            return self.lookup_prefix(chunk_keys_)
+        except Exception:  # noqa: BLE001 — a lookup is an optimization
+            _resilience.count_degraded("lookup")
+            return 0
+
+    def guarded_load(self, cache, block_ids, chunk_keys_):
+        if not self.breaker.allow():
+            _resilience.count_degraded("load")
+            return cache, False
+        from .lib import InfiniStoreIntegrityError, InfiniStoreKeyNotFound
+
+        try:
+            out = self.load_pages(cache, block_ids, chunk_keys_)
+        except (InfiniStoreKeyNotFound, InfiniStoreIntegrityError):
+            _resilience.count_degraded("load")
+            return cache, False
+        except _resilience.transport_errors():
+            _resilience.count_degraded("load")
+            return cache, False
+        return out, True

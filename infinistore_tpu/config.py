@@ -57,6 +57,21 @@ class ClientConfig:
             "op_timeout_s", float(env_to) if env_to else None
         )
         self.op_timeout_s = float(raw_to) if raw_to else None
+        # ours: store CLUSTER membership — a "host:port,host:port" string
+        # (or list) naming N store endpoints.  When set, this config is
+        # the per-node template for cluster.RoutedStorePool (its
+        # connection_type / op_timeout_s / num_streams apply to every
+        # node) and host_addr/service_port may be omitted.  A single
+        # endpoint is NOT a cluster: callers collapse it to the classic
+        # host_addr/service_port one-connection path.
+        eps = kwargs.get("endpoints", None)
+        if isinstance(eps, str):
+            eps = [p.strip() for p in eps.split(",") if p.strip()]
+        self.endpoints = list(eps) if eps else None
+        if self.endpoints and not self.host_addr:
+            host, _, port = self.endpoints[0].rpartition(":")
+            self.host_addr = host
+            self.service_port = int(port) if port.isdigit() else None
 
     def __repr__(self):
         return (
@@ -68,6 +83,16 @@ class ClientConfig:
     def verify(self):
         if self.connection_type not in [TYPE_SHM, TYPE_TCP]:
             raise Exception("Invalid connection type")
+        if self.endpoints:
+            # checked before the host requirement: a malformed entry
+            # leaves host_addr underived, and "Host address is empty"
+            # would mask the actual mistake
+            for ep in self.endpoints:
+                host, sep, port = str(ep).rpartition(":")
+                if not sep or not host or not port.isdigit():
+                    raise Exception(
+                        f"endpoints entries must be host:port, got {ep!r}"
+                    )
         if not self.host_addr:
             raise Exception("Host address is empty")
         if not self.service_port:

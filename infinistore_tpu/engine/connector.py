@@ -46,7 +46,17 @@ class StoreConnector:
         self, conn, pc: PagedCacheConfig, model_id: str,
         quant: Optional[str] = None, breaker=None,
     ):
-        self.transfer = KVTransferEngine(conn, pc, quant=quant, breaker=breaker)
+        # ``conn`` may be a cluster.RoutedStorePool: the connector then
+        # routes per-chunk over the hash ring like the serving engine
+        # (same degraded contract, per-node breakers)
+        from ..cluster import ClusterTransferEngine, RoutedStorePool
+
+        if isinstance(conn, RoutedStorePool):
+            self.transfer = ClusterTransferEngine(conn, pc, quant=quant)
+        else:
+            self.transfer = KVTransferEngine(
+                conn, pc, quant=quant, breaker=breaker
+            )
         self.breaker = self.transfer.breaker
         self.pc = pc
         self.model_id = model_id

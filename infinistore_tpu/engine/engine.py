@@ -564,9 +564,21 @@ class InferenceEngine:
         # addressed by their prefix-commitment key and shared across
         # sequences (kv/cache.py PrefixPageCache)
         self.pages = PrefixPageCache(self.alloc)
-        self.transfer = (
-            KVTransferEngine(conn, pc, quant=kv_quant) if conn is not None else None
-        )
+        # ``conn`` may be a single store connection (the classic
+        # one-node path, byte-identical to every prior release) OR a
+        # cluster.RoutedStorePool — then every store hop routes
+        # per-chunk over the consistent-hash ring with per-node
+        # breakers and hot-prefix replication.  Late import: the
+        # cluster layer is only paid for when a fleet is configured.
+        if conn is None:
+            self.transfer = None
+        else:
+            from ..cluster import ClusterTransferEngine, RoutedStorePool
+
+            if isinstance(conn, RoutedStorePool):
+                self.transfer = ClusterTransferEngine(conn, pc, quant=kv_quant)
+            else:
+                self.transfer = KVTransferEngine(conn, pc, quant=kv_quant)
         if store_durability not in ("strict", "relaxed"):
             # a real error, not an assert: under python -O a typo would
             # otherwise silently behave as relaxed and drop the strict
@@ -951,6 +963,21 @@ class InferenceEngine:
         self._next_id += 1
         self.seqs[state.seq_id] = state
         return state
+
+    def pin_prefix(self, tokens: Sequence[int], adapter_id: int = 0) -> int:
+        """Pin a prompt's chunk stems hot in the store cluster (the
+        system-prompt API): every complete chunk of ``tokens``
+        replicates to its ring successors on the next push and reads
+        fail over replica→replica.  No-op (returns 0) without a
+        clustered store — a single node has nowhere to replicate."""
+        pin = getattr(self.transfer, "pin_prefix", None)
+        if pin is None:
+            return 0
+        keys = chunk_keys(
+            tokens, self._adapter_model_id(adapter_id),
+            chunk_tokens=self.pc.block_tokens,
+        )
+        return pin(keys)
 
     def store_flush(self) -> None:
         """Durability barrier: wait until every queued store push has

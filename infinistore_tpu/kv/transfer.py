@@ -376,10 +376,17 @@ class KVTransferEngine:
         with tracing.span("kv.load_pages", pages=L * n, bytes=nbytes):
             return self._load_pages_banded(cache, block_ids, chunk_keys_, n)
 
-    def _load_pages_banded(
-        self, cache: jax.Array, block_ids: Sequence[int],
-        chunk_keys_: Sequence[str], n: int
-    ) -> jax.Array:
+    def fetch_pages(self, chunk_keys_: Sequence[str]) -> jax.Array:
+        """Wire half of a load: read every (layer, chunk) page of
+        ``chunk_keys_`` into this engine's staging ring and hand each
+        band to an async H2D upload.  Returns the stacked device array
+        in store layout (``[L, n, wire_page_bytes]`` quantized, ``[L,
+        n] + page_shape`` otherwise) WITHOUT touching any cache — the
+        caller scatters via ``scatter_pages``.  Split out so the
+        cluster layer can fetch different chunks from different nodes
+        concurrently (each node engine owns its own staging) and
+        scatter once all bytes verified."""
+        n = len(chunk_keys_)
         pb = self.wire_page_bytes
         L = self.cfg.n_layers
         nbytes = L * n * pb
@@ -418,14 +425,29 @@ class KVTransferEngine:
                 self._call("read_cache", blocks, pb, ptr)
                 upload(i)
         # single band: already [L, n, ...] — don't pay a concat copy
-        stacked = devs[0] if len(devs) == 1 else jnp.concatenate(devs, axis=0)
+        return devs[0] if len(devs) == 1 else jnp.concatenate(devs, axis=0)
+
+    def scatter_pages(
+        self, cache: jax.Array, block_ids: Sequence[int], stacked: jax.Array
+    ) -> jax.Array:
+        """Device half of a load: dequantize/transpose the stacked
+        pages ``fetch_pages`` returned and scatter them into
+        ``block_ids``'s slots.  Returns the updated cache (NOT yet
+        materialized — callers block once after the last scatter)."""
         if self.quant:
             unpacked = dequantize_pages_jit(stacked, self.cfg)  # [L, n, 2, H, T, D]
             pages = jnp.transpose(unpacked, (0, 2, 3, 1, 4, 5))
         else:
             pages = jnp.transpose(stacked, (0, 2, 3, 1, 4, 5))  # [L,2,H,n,T,D]
         ids = jnp.asarray(np.asarray(block_ids, dtype=np.int32))
-        out = write_pages(cache, ids, pages)
+        return write_pages(cache, ids, pages)
+
+    def _load_pages_banded(
+        self, cache: jax.Array, block_ids: Sequence[int],
+        chunk_keys_: Sequence[str], n: int
+    ) -> jax.Array:
+        stacked = self.fetch_pages(chunk_keys_)
+        out = self.scatter_pages(cache, block_ids, stacked)
         # materialize before returning: every read of this call's staging
         # buffer must complete before a LATER call can rewrite it (with
         # the double buffer above, a stale optimistic sync would need two

@@ -765,10 +765,23 @@ class ServingServer:
         conns = []
         transfer = getattr(self.engine, "transfer", None)
         if transfer is not None:
-            conns.append(transfer._src)
+            srcs = getattr(transfer, "trace_srcs", None)
+            if srcs is not None:  # clustered: every node's span ring
+                conns.extend(srcs())
+            else:
+                conns.append(transfer._src)
         return trace_stitch.stitched_chrome_json(
             tracing.TRACER, conns, limit=limit
         )
+
+    def cluster_report(self) -> Dict[str, Any]:
+        """The /debug/cluster payload: ring + per-node state when the
+        engine's store is a RoutedStorePool, else a disabled marker."""
+        transfer = getattr(self.engine, "transfer", None)
+        rep = getattr(transfer, "cluster_report", None)
+        if rep is None:
+            return {"enabled": False}
+        return rep()
 
     def metrics_text(self) -> str:
         """Prometheus exposition: this server's registry plus the
@@ -1057,6 +1070,12 @@ def _make_handler(server: ServingServer):
                 except (KeyError, ValueError, IndexError):
                     limit = None
                 self._json(200, server.ledger.snapshot(limit=limit))
+            elif self.path.split("?", 1)[0] == "/debug/cluster":
+                # the store-cluster view: ring ownership, per-node
+                # circuit state, request/replica-read counters, and the
+                # hot/pinned prefix tracker ({"enabled": false} when the
+                # store is a single node or absent)
+                self._json(200, server.cluster_report())
             elif self.path.split("?", 1)[0] == "/debug/traces":
                 # recent completed request/step traces as Chrome trace-
                 # event JSON — stitched with the attached store's server-
@@ -1543,6 +1562,20 @@ def main(argv: Optional[List[str]] = None) -> None:
                          "store-resident prefixes across engine restarts "
                          "and hosts (requires --store-service-port)")
     ap.add_argument("--store-service-port", type=int, default=None)
+    ap.add_argument("--store-endpoints", default=None,
+                    help="store CLUSTER membership: comma-separated "
+                         "host:port list (or env ISTPU_STORE_ENDPOINTS). "
+                         "Two or more endpoints shard the KV store over a "
+                         "consistent-hash ring with per-node circuit "
+                         "breakers and hot-prefix replication "
+                         "(/debug/cluster shows the ring); exactly one "
+                         "endpoint takes the classic single-connection "
+                         "path.  Mutually exclusive with --store-host")
+    ap.add_argument("--store-replicas", type=int, default=None,
+                    help="total copies of a HOT chunk across the ring "
+                         "(owner + successors; default env "
+                         "ISTPU_CLUSTER_REPLICAS, else 2).  1 disables "
+                         "replication")
     ap.add_argument("--store-op-timeout", type=float, default=30.0,
                     help="per-op deadline (s) on the store connection: a "
                          "HUNG store op fails (and reconnects) within "
@@ -1661,7 +1694,34 @@ def main(argv: Optional[List[str]] = None) -> None:
         # explicitly (NamedSharding embeds the mesh), and set_mesh is
         # thread-local anyway — the engine thread would never see it
     conn = None
-    if args.store_host is not None:
+    endpoints_spec = args.store_endpoints or os.environ.get(
+        "ISTPU_STORE_ENDPOINTS"
+    )
+    if endpoints_spec and args.store_host is not None:
+        raise SystemExit("--store-endpoints and --store-host are mutually "
+                         "exclusive")
+    if endpoints_spec:
+        from .cluster import parse_endpoints
+
+        endpoints = parse_endpoints(endpoints_spec)
+        if len(endpoints) == 1:
+            # exactly one endpoint is NOT a cluster: collapse to the
+            # classic single-connection path (no ring, no routing
+            # overhead — byte-identical to --store-host)
+            host, _, port = endpoints[0].rpartition(":")
+            args.store_host, args.store_service_port = host, int(port)
+        else:
+            from .cluster import RoutedStorePool
+
+            conn = RoutedStorePool(
+                endpoints,
+                connection_type=("SHM" if args.store_connection == "shm"
+                                 else "TCP"),
+                op_timeout_s=args.store_op_timeout or None,
+                **({"replicas": args.store_replicas}
+                   if args.store_replicas else {}),
+            )
+    if conn is None and args.store_host is not None:
         if args.store_service_port is None:
             raise SystemExit("--store-host requires --store-service-port")
         from . import lib as ist

@@ -75,7 +75,8 @@ class Snapshot:
                  serve_health: Optional[dict] = None,
                  store_health: Optional[dict] = None,
                  integrity: Optional[dict] = None,
-                 requests: Optional[dict] = None):
+                 requests: Optional[dict] = None,
+                 cluster: Optional[dict] = None):
         self.serve = serve_metrics or {}
         self.store = store_metrics or {}
         self.cache = cache
@@ -84,6 +85,8 @@ class Snapshot:
         self.integrity = integrity
         # the serving /debug/requests payload (request ledger tail)
         self.requests = requests
+        # the serving /debug/cluster payload (multi-node store ring)
+        self.cluster = cluster
 
     def lanes(self) -> List[str]:
         """Priority lanes seen in the serving TTFT family, numeric
@@ -256,6 +259,44 @@ class Console:
                 )
         return out
 
+    def _cluster(self, snap: Snapshot) -> List[str]:
+        """The store-cluster section (serving /debug/cluster): one row
+        per endpoint — circuit state, ring-ownership share, ok/error
+        per-frame deltas, replica-read hits — plus the hot/pinned
+        prefix counts driving replication."""
+        cl = snap.cluster or {}
+        if not cl.get("enabled") or not cl.get("nodes"):
+            return []
+        out: List[str] = [""]
+        hot = cl.get("hot", {})
+        rr = cl.get("replica_reads", {})
+        out.append(
+            "cluster  nodes {}  replicas {}  hot {}  pinned {}  "
+            "repl-reads hit {} / miss {}".format(
+                len(cl["nodes"]), cl.get("replicas", 1),
+                hot.get("hot", 0), hot.get("pinned", 0),
+                rr.get("hit", 0), rr.get("miss", 0),
+            )
+        )
+        out.append(f"  {'endpoint':22s} {'state':10s} {'own%':>6s} "
+                   f"{'ok':>8s} {'err':>6s} {'skip':>6s}  Δok/frame")
+        for node in cl["nodes"]:
+            ep = node["endpoint"]
+            req = node.get("requests", {})
+            d_ok = self.deltas.setdefault(
+                f"cl_ok:{ep}", _Delta()).update(req.get("ok"))
+            state = node.get("state", "?")
+            out.append(
+                "  {:22s} {:10s} {:>5.1f}% {:>8d} {:>6d} {:>6d}  {}".format(
+                    ep[:22], "OPEN" if state == "open" else state,
+                    100.0 * node.get("ownership", 0.0),
+                    int(req.get("ok", 0)), int(req.get("error", 0)),
+                    int(req.get("skipped", 0)),
+                    "-" if d_ok is None else f"+{d_ok:.0f}",
+                )
+            )
+        return out
+
     def frame(self, snap: Snapshot) -> str:
         out: List[str] = []
         w = 24
@@ -351,6 +392,7 @@ class Console:
                    if pages is not None else "")
             )
         out.extend(self._serving_slo(snap))
+        out.extend(self._cluster(snap))
         # -- latency sparklines --
         out.append("")
         out.append(f"{'op latency (interval mean)':28s} {'now':>6s}  trend")
@@ -410,6 +452,9 @@ def poll(serve_url: Optional[str], store_url: Optional[str]) -> Snapshot:
     integ = js(store_url, "/debug/integrity")
     if integ is not None and "level" not in integ:
         integ = None  # native backend: endpoint answers an error payload
+    cluster = js(serve_url, "/debug/cluster")
+    if cluster is not None and not cluster.get("enabled"):
+        cluster = None  # single-node store: no ring to render
     return Snapshot(
         serve_metrics=prom(serve_url, "/metrics"),
         store_metrics=prom(store_url, "/metrics"),
@@ -418,6 +463,7 @@ def poll(serve_url: Optional[str], store_url: Optional[str]) -> Snapshot:
         store_health=js(store_url, "/healthz"),
         integrity=integ,
         requests=js(serve_url, "/debug/requests?limit=8"),
+        cluster=cluster,
     )
 
 

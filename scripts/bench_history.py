@@ -57,6 +57,10 @@ METRICS = {
     "tpu_pallas_speedup_vs_xla": ("up", "pallas vs XLA"),
     "goodput_rps": ("up", "serve goodput req/s"),
     "slo_attainment": ("up", "serve SLO attainment"),
+    # the multi-node cluster leg (bench.py --endpoints N): aggregate
+    # fleet bandwidth through the consistent-hash router
+    "cluster_put_gbps": ("up", "cluster put GB/s (aggregate)"),
+    "cluster_get_gbps": ("up", "cluster get GB/s (aggregate)"),
 }
 
 

@@ -229,6 +229,15 @@ def test_log_lines_carry_trace_id():
     h = logging.StreamHandler(stream)
     h.setFormatter(_TraceFormatter("[%(levelname)s] %(message)s"))
     logger.addHandler(h)
+    # the package logger's LEVEL is shared process state BY DESIGN
+    # (Logger.set_log_level; every InfinityConnection(..., log_level=)
+    # calls it) — an earlier test file that built connections with
+    # log_level="error" (test_trace_wire does) leaves the logger above
+    # WARNING and this test's records would be dropped before the
+    # handler.  Pin the level for the assertion and restore it after
+    # (docs/robustness.md triage note).
+    prev_level = logger.level
+    logger.setLevel(logging.WARNING)
     try:
         Logger.warn("outside any trace")
         with tracing.trace("logged.request") as tr:
@@ -238,6 +247,7 @@ def test_log_lines_carry_trace_id():
         trace_id = tr.trace_id
     finally:
         logger.removeHandler(h)
+        logger.setLevel(prev_level)
     lines = stream.getvalue().splitlines()
     assert lines[0] == "[WARNING] outside any trace"  # no suffix, no '-'
     assert lines[1] == f"[WARNING] inside the trace trace_id={trace_id}"
