@@ -18,7 +18,11 @@ against.
 
 ``--json-out`` writes one JSON object joining the bench-schema family
 (``run_id`` + stable keys; docs/observability.md): ``{run_id, kind:
-"serve_load", slo: {...}, config: {...}, curve: [per-rate summaries]}``.
+"serve_load", slo: {...}, config: {...}, curve: [per-rate summaries],
+stepprof: {...}}`` — ``stepprof`` is the server's step-profiler summary
+(``GET /debug/engine``): host-stall share, retrace pressure, dispatch
+counts for the whole sweep (absent against servers without the
+endpoint).
 """
 
 from __future__ import annotations
@@ -190,6 +194,20 @@ def main(argv=None) -> int:
                           file=sys.stderr)
         curve = sweep(url, base, args.rates, args.slo_ttft, args.slo_tpot,
                       cooldown_s=args.cooldown, on_point=show)
+        # the step profiler's summary for the whole sweep (best-effort:
+        # older servers have no /debug/engine) — host-stall share,
+        # retrace pressure, dispatch counts next to the goodput curve
+        stepprof = None
+        try:
+            import urllib.request
+
+            with urllib.request.urlopen(url + "/debug/engine?limit=0",
+                                        timeout=5) as r:
+                payload = json.loads(r.read())
+            if payload.get("enabled"):
+                stepprof = payload.get("summary")
+        except Exception:  # noqa: BLE001 — observability, not the bench
+            pass
     finally:
         if srv is not None:
             srv.close()
@@ -207,6 +225,11 @@ def main(argv=None) -> int:
         "wall_s": round(time.time() - t0, 1),
         "curve": curve,
     }
+    if stepprof is not None:
+        # profiler summary block (engine/stepprof.py): joins the schema
+        # the same way `slo`/`config` do — stable keys, documented in
+        # docs/observability.md §engine-attribution
+        record["stepprof"] = stepprof
     print(json.dumps(record))
     if args.json_out:
         with open(args.json_out, "w") as f:

@@ -3,6 +3,7 @@ from .connector import StoreConnector
 from .engine import InferenceEngine, SequenceState
 from .scheduler import Request, Scheduler
 from .speculative import SpeculativeDecoder
+from .stepprof import StepProfiler
 
 __all__ = [
     "InferenceEngine",
@@ -10,5 +11,6 @@ __all__ = [
     "Scheduler",
     "SequenceState",
     "SpeculativeDecoder",
+    "StepProfiler",
     "StoreConnector",
 ]

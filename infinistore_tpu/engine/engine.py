@@ -48,6 +48,7 @@ from ..models.llama import (
 )
 from ..utils import metrics as _metrics
 from ..utils import tracing
+from . import stepprof as _stepprof
 
 # prefix-reuse attribution in the admission path: of each admitted
 # prompt's tokens, how many were served by the LOCAL HBM prefix cache,
@@ -119,18 +120,23 @@ _JIT_CACHE: Dict[Any, Any] = {}
 
 
 def _shared_jit(fn, bound: Dict[str, Any], donate: tuple = ()):
+    # every shared-jit function is wrapped with the step profiler's
+    # per-fn trace counter (the python body only runs at trace time, so
+    # the count is exactly the trace-cache misses — the wrap-jit half of
+    # istpu_engine_retraces_total{fn}); functools.wraps keeps the
+    # signature inspectable for donate_argnames
     try:
         key = (fn, tuple(sorted(bound.items())), donate)
         hash(key)
     except TypeError:  # unhashable binding (exotic custom fn/mesh): private jit
         return jax.jit(
-            partial(fn, **bound),
+            partial(_stepprof.traced(fn), **bound),
             **({"donate_argnames": donate} if donate else {}),
         )
     got = _JIT_CACHE.get(key)
     if got is None:
         got = jax.jit(
-            partial(fn, **bound),
+            partial(_stepprof.traced(fn), **bound),
             **({"donate_argnames": donate} if donate else {}),
         )
         _JIT_CACHE[key] = got
@@ -858,6 +864,9 @@ class InferenceEngine:
                 self.params, tokens=arr, prefix_kv=pp.buf,
                 prefix_len=jnp.asarray(pp.plen, dtype=jnp.int32), **lkw
             )
+        # the chunk forward + its cache landing = one prefill dispatch
+        # unit for the step profiler's attribution
+        _stepprof.note_dispatch("prefill")
         n_pg = len(chunk) // T
         self.cache = _write_prefill_pages(
             self.cache,
@@ -1107,6 +1116,7 @@ class InferenceEngine:
         for b, p in enumerate(group):
             tokens[b, : len(p)] = p
         lkw = self._lora_args(aids + [0] * (Bp - B)) if self.lora else {}
+        _stepprof.note_dispatch("prefill")  # one padded group forward
         logits, kv = self._prefill_jit(
             self.params, tokens=jnp.asarray(tokens), **lkw
         )
@@ -1315,7 +1325,8 @@ class InferenceEngine:
             tail = (carry[2],) if penalized else ()  # final gen counts
             return (*parts, logits, cache, *tail)
 
-        fn = jax.jit(many, donate_argnums=(3,))
+        fn = jax.jit(_stepprof.traced(many, "decode_many"),
+                     donate_argnums=(3,))
         self._decode_many_cache[cache_key] = fn
         _JIT_CACHE[global_key] = fn
         return fn
@@ -1564,6 +1575,9 @@ class InferenceEngine:
                 aid_d,
                 pen,
             )
+            # one compiled scan dispatch advanced the whole batch a chunk
+            _stepprof.note_dispatch("decode")
+            _stepprof.note_tokens(chunk * B)
             if penalized:
                 # thread the device-side counts into the next chunk
                 *res, counts_d = res
@@ -1626,6 +1640,7 @@ class InferenceEngine:
         if rng is None:
             self._rng, rng = _SPLIT2(self._rng)
         variant = "filter" if (top_k > 0 or top_p < 1.0) else "plain"
+        _stepprof.note_dispatch("draft")  # the k-token proposal scan
         toks, probs, logits, self.cache = self._decode_many(
             k, variant, collect=True
         )(
@@ -1711,6 +1726,7 @@ class InferenceEngine:
         slot_blocks = np.asarray(
             [state.block_ids[p // T] for p in poss], dtype=np.int32
         )
+        _stepprof.note_dispatch("verify")
         logits, self.cache = self._verify_jit(
             self.params,
             tokens=jnp.asarray([list(run_tokens)], dtype=jnp.int32),

@@ -38,6 +38,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import stepprof as _stepprof
 from .engine import (
     _JIT_CACHE,
     _UNSTACK_ROWS,
@@ -269,6 +270,7 @@ class NgramSpeculator:
             # hist scatter would be DROPPED silently under jit
             assert max(len(st.tokens) for st in sts) + R * (k + 1) <= L
             fn = _build_ngram_rounds(eng, k, g, L, R)
+            _stepprof.note_dispatch("spec_round")  # R fused rounds, 1 sync
             outs, cnts, nF, lgT, eng.cache, hist = fn(
                 eng.params, eng.cache, eng._block_table(sts),
                 jnp.asarray([len(st.tokens) for st in sts], jnp.int32),

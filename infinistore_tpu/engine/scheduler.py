@@ -44,6 +44,7 @@ import jax
 from ..ledger import MAX_STAMPS
 from ..utils import tracing
 from ..utils.metrics import MetricsRegistry, default_registry, nearest_rank
+from . import stepprof as _stepprof
 from .engine import _SPLIT2, InferenceEngine, PartialPrefill, SequenceState
 
 
@@ -124,6 +125,10 @@ class Request:
     # captures it on the handler thread) — joins this request's ledger
     # record and log lines to its http.request trace
     trace_id: Optional[str] = None
+    # engine steps this request participated in (newest MAX_STEP_IDS
+    # kept) — the ledger's join key against the step profiler's
+    # /debug/engine records
+    step_ids: List[int] = field(default_factory=list)
 
 
 class Scheduler:
@@ -141,8 +146,16 @@ class Scheduler:
                  metrics: Optional[MetricsRegistry] = None,
                  ledger=None,
                  slo_ttft_s: Optional[float] = None,
-                 slo_tpot_s: Optional[float] = None):
+                 slo_tpot_s: Optional[float] = None,
+                 stepprof=None):
         self.engine = engine
+        # per-step engine/device attribution (engine/stepprof.py): when a
+        # StepProfiler is attached, every step() emits one structured
+        # record, participating requests collect the step ids for the
+        # ledger join, and each request's own trace gains engine.step /
+        # device-drain spans.  None = zero overhead (library default;
+        # ServingServer always attaches one).
+        self.stepprof = stepprof
         # per-request lifecycle ledger (infinistore_tpu.ledger): every
         # request that leaves the scheduler — retired, cancelled, or
         # dropped by fault_reset — is recorded exactly once
@@ -450,9 +463,14 @@ class Scheduler:
                 if first_admission:
                     req.t_admit = time.perf_counter()
                 try:
-                    pp = self.engine.prefill_start(
-                        req.tokens + req.output, adapter_id=req.adapter_id
-                    )
+                    # bound to the REQUEST's own trace: the admission
+                    # store hops (kv.lookup_prefix, kv.load_pages) are
+                    # this request's cost, not the ambient engine.step's
+                    with tracing.bind(req.trace_id):
+                        pp = self.engine.prefill_start(
+                            req.tokens + req.output,
+                            adapter_id=req.adapter_id,
+                        )
                 except MemoryError:
                     if first_admission:
                         req.t_admit = 0.0  # nothing ran; still queued
@@ -484,11 +502,18 @@ class Scheduler:
             t_wave = time.perf_counter()  # queue-wait ends as the wave runs
             try:
                 # prompt + output-so-far: a request shed mid-decode resumes
-                # where it left off (its generated tokens re-prefill)
-                states = self.engine.prefill_batch(
-                    [r.tokens + r.output for r in admit],
-                    adapter_ids=[r.adapter_id for r in admit],
-                )
+                # where it left off (its generated tokens re-prefill).  A
+                # single-request wave binds that request's trace so its
+                # store-hop spans attribute to it; a multi-request wave
+                # stays in the ambient engine.step trace (the work is
+                # genuinely shared).
+                with tracing.bind(
+                    admit[0].trace_id if len(admit) == 1 else None
+                ):
+                    states = self.engine.prefill_batch(
+                        [r.tokens + r.output for r in admit],
+                        adapter_ids=[r.adapter_id for r in admit],
+                    )
             except MemoryError:
                 if len(admit) > 1:
                     self._enqueue(admit.pop(), front=True)
@@ -608,6 +633,7 @@ class Scheduler:
             req._spec_off = True
             return False
         req.output.extend(toks)
+        _stepprof.note_tokens(len(toks))
         return True
 
     def _ngram_step_batch(self, reqs: List[Request], chunk: int) -> bool:
@@ -633,6 +659,7 @@ class Scheduler:
             return False
         for r, toks in zip(reqs, outs):
             r.output.extend(toks)
+            _stepprof.note_tokens(len(toks))
         return True
 
     def _spec_dispatch(self, reqs: List[Request], chunk: int) -> bool:
@@ -729,12 +756,56 @@ class Scheduler:
             return False
         for r, toks in zip(reqs, outs):
             r.output.extend(toks)
+            _stepprof.note_tokens(len(toks))
         return True
 
     def step(self) -> List[Request]:
         """Admit, advance each in-flight chunked prefill by one chunk,
         decode one chunk for the whole batch, retire.  Returns the requests
-        that finished this step."""
+        that finished this step.
+
+        With a ``stepprof`` attached the whole step runs under one
+        profiler record; afterwards every participating request collects
+        the step id (ledger join key) and — when it carries a trace id —
+        an ``engine.step`` span plus, on sampled steps, the device-drain
+        span on the synthetic device track, folded into ITS OWN
+        ``http.request`` trace."""
+        prof = self.stepprof
+        if prof is None or not prof.enabled:
+            return self._step_inner()
+        with prof.step(self) as rec:
+            retired = self._step_inner()
+        self._attribute_step(rec, retired)
+        return retired
+
+    def _attribute_step(self, rec: Optional[dict],
+                        retired: List[Request]) -> None:
+        if rec is None:
+            return
+        sid = rec["step"]
+        t0, t1 = rec.get("t0"), rec.get("t1")
+        participants = (
+            list(self.active)
+            + [r for r, _pp in self._prefilling]
+            + retired
+        )
+        for req in participants:
+            ids = req.step_ids
+            if (not ids or ids[-1] != sid) and len(ids) < _stepprof.MAX_STEP_IDS:
+                ids.append(sid)
+            if req.trace_id and t0 and t1:
+                tracing.add_span_abs_to(
+                    req.trace_id, "engine.step", t0, t1,
+                    step=sid, kind=rec["kind"],
+                )
+                stall = rec.get("host_stall_s")
+                if stall:
+                    tracing.add_span_abs_to(
+                        req.trace_id, "device.drain", t1, t1 + stall,
+                        tid="device", step=sid,
+                    )
+
+    def _step_inner(self) -> List[Request]:
         if not (self._admission_hold and self.active):
             self._admit()
         cancelled_prefill: List[Request] = []
@@ -747,7 +818,8 @@ class Scheduler:
                 self._finish(req, "cancelled")
                 cancelled_prefill.append(req)
                 continue
-            with tracing.span("sched.prefill_step", req=req.req_id):
+            with tracing.bind(req.trace_id), \
+                    tracing.span("sched.prefill_step", req=req.req_id):
                 st = self.engine.prefill_step(pp)  # ONE chunk per step each
             if st is not None:
                 req.state = st
@@ -896,6 +968,13 @@ class Scheduler:
         (retirement, pending/prefill cancellation, fault_reset)."""
         if not req.t_done:
             req.t_done = time.perf_counter()
+        # the step that retired this request must make the LEDGER record:
+        # the end-of-step attribution pass runs after ledger.record below
+        sid = _stepprof.current_step()
+        if (sid is not None
+                and (not req.step_ids or req.step_ids[-1] != sid)
+                and len(req.step_ids) < _stepprof.MAX_STEP_IDS):
+            req.step_ids.append(sid)
         lane = str(req.priority)
         n_out = len(req.output)
         if req.t_first:

@@ -51,6 +51,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import stepprof as _stepprof
 from .engine import (
     _ARGMAX_I32,
     _JIT_CACHE,
@@ -584,6 +585,10 @@ class SpeculativeDecoder:
                 self._acquire_for(self.draft, st, grow,
                                   base_len=len(st_t.tokens))
             fn = _build_fused_rounds(self.target, self.draft, k, R, variant)
+            # one compiled dispatch = R complete propose/verify/resync
+            # rounds for every row — the unit the step profiler's
+            # accepted-per-dispatch attribution divides by
+            _stepprof.note_dispatch("spec_round")
             outs, cnts, nF, t_lg, d_lg, t_cache, d_cache = fn(
                 self.target.params, self.draft.params,
                 self.target.cache, self.draft.cache,

@@ -1,0 +1,616 @@
+"""Per-step engine/device attribution: the ``StepProfiler``.
+
+The serving metrics say how the fleet is doing and the request ledger
+says where one request's latency went — but the ENGINE STEP LOOP and the
+device under it were a black box: no dispatch counts, no compile/retrace
+visibility, no host-blocked vs device-busy split, no HBM watermarks.
+The two losing on-chip stories (`prefill_store_overhead: 12.97x`,
+`spec_speedup: 0.53` at 0.938 acceptance — BENCH_TPU_SNAPSHOT.json)
+are unexplainable without exactly that attribution.  This module makes
+the step loop emit ONE structured record per scheduler step:
+
+* **step kind and batch composition** — prefill chunks advanced, decode
+  sequences, speculative rounds, pending depth;
+* **dispatch counts** — compiled STEP programs launched (decode scan
+  chunks, prefill chunk forwards, verify/draft forwards, fused
+  speculation rounds).  Counted at the granularity whose per-dispatch
+  overhead dominates on this platform (docs/tpu_perf_notes.md), not raw
+  XLA executable launches;
+* **host-stall vs device time** — on SAMPLED steps (1 in
+  ``ISTPU_STEPPROF_SAMPLE``, default 16) the profiler times a
+  ``block_until_ready`` on the engine's cache after the step body:
+  the measured wait is device work the host did NOT overlap.  High
+  stall share ⇒ device-bound; ~0 stall with long steps ⇒ the host loop
+  (dispatch overhead, Python) is the bottleneck — read this before
+  blaming a kernel (docs/tpu_perf_notes.md).  Sampling keeps the ≤5%
+  instrumentation-overhead guard passing: a per-step block would
+  serialize the async dispatch pipeline the engine exists to keep full;
+* **compile/retrace events** — a ``jax.monitoring`` duration listener
+  counts backend compiles process-wide, and the engine's shared-jit
+  wrapper (``count_trace``) attributes trace-cache misses PER FUNCTION
+  (the python body of a jitted function only runs at trace time, so
+  counting body executions counts traces exactly — first compile
+  included);
+* **device memory watermarks** — ``device.memory_stats()`` where the
+  backend provides it (TPU/GPU), falling back to summing
+  ``jax.live_arrays()`` on CPU; sampled with the stall probe;
+* **speculation attribution** — per-step deltas of the speculator's
+  rounds/proposed/accepted counters next to the dispatch counts, so
+  "0.53x despite 0.938 acceptance" reads as tokens-per-dispatch, not a
+  mystery;
+* **store-hop stages** — when a step moved pages, the transfer's
+  ``last_push_stages`` / ``last_load_stages`` breakdown rides along
+  (best-effort: pushes commit on the streamer thread, so a stage dict
+  may land one step late).
+
+Records live in a bounded ring (``ISTPU_STEPPROF_RING``, default 256),
+exported at the serving front-end's ``GET /debug/engine`` (``?limit=``),
+and feed the ``istpu_engine_*`` metric families on the owning server's
+registry.  Sampled steps also add a ``device.drain`` span on a synthetic
+**device track** to the engine-step trace AND to every participating
+request's own ``http.request`` trace, so one stitched Perfetto file runs
+HTTP handler → scheduler → engine.step → kv store hop → device dispatch
+under one trace id.
+
+Hooks (``note_dispatch`` / ``note_tokens`` / ``count_trace``) follow the
+tracing module's contract: with no active step record they cost one
+contextvar read and nothing else.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+from ..utils import metrics as _metrics
+from ..utils import tracing
+
+# -- knobs ------------------------------------------------------------------
+
+STEPPROF_SAMPLE_DEFAULT = 16   # 1-in-N steps pay the block+mem probe
+STEPPROF_RING_DEFAULT = 256    # records kept for /debug/engine
+
+# step ids a single request accumulates for the ledger join (the newest
+# window is what an investigation needs; a 100k-token request must not
+# grow its ledger record without bound)
+MAX_STEP_IDS = 64
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+# -- process-wide trace/compile accounting ----------------------------------
+
+_ACTIVE: contextvars.ContextVar[Optional[dict]] = contextvars.ContextVar(
+    "istpu_stepprof", default=None
+)
+
+_TRACE_LOCK = threading.Lock()
+_TRACES: Dict[str, int] = {}     # fn name -> traces (first compile included)
+_TRACES_TOTAL = 0
+_COMPILES = 0                     # backend compiles (jax.monitoring)
+_COMPILE_S = 0.0
+_MONITOR_INSTALLED = False
+
+
+def count_trace(name: str) -> None:
+    """Count one trace-cache miss of ``name`` (called from inside the
+    traced python body — engine._shared_jit wraps its functions with
+    this).  Also lands on the active step record, so a mid-serving
+    retrace shows up on the step that paid for it."""
+    global _TRACES_TOTAL
+    with _TRACE_LOCK:
+        _TRACES[name] = _TRACES.get(name, 0) + 1
+        _TRACES_TOTAL += 1
+    rec = _ACTIVE.get()
+    if rec is not None:
+        r = rec["retraces"]
+        r[name] = r.get(name, 0) + 1
+
+
+def traced(fn, name: Optional[str] = None):
+    """Wrap ``fn`` so every trace of the (later-jitted) function counts —
+    the wrap-``jit`` fallback of the retrace tracker.  ``functools.wraps``
+    keeps the signature inspectable, so ``donate_argnames`` on the
+    enclosing ``jax.jit`` still resolves."""
+    import functools
+
+    label = name or getattr(fn, "__name__", repr(fn))
+
+    @functools.wraps(fn)
+    def counted(*args, **kwargs):
+        count_trace(label)
+        return fn(*args, **kwargs)
+
+    return counted
+
+
+def _install_monitoring() -> None:
+    """Register the process-wide ``jax.monitoring`` compile listener
+    (idempotent).  Gives the global backend-compile count/seconds even
+    for programs the per-function wrapper never saw."""
+    global _MONITOR_INSTALLED
+    with _TRACE_LOCK:
+        if _MONITOR_INSTALLED:
+            return
+        _MONITOR_INSTALLED = True
+    try:
+        import jax.monitoring as mon
+
+        def _on_duration(event: str, duration: float, **kw) -> None:
+            global _COMPILES, _COMPILE_S
+            if event == "/jax/core/compile/backend_compile_duration":
+                with _TRACE_LOCK:
+                    _COMPILES += 1
+                    _COMPILE_S += float(duration)
+
+        mon.register_event_duration_secs_listener(_on_duration)
+    except Exception:  # noqa: BLE001 — monitoring is optional attribution
+        pass
+
+
+def trace_counts() -> Dict[str, int]:
+    with _TRACE_LOCK:
+        return dict(_TRACES)
+
+
+# -- step-local hooks (no-ops without an active record) ---------------------
+
+def note_dispatch(kind: str, n: int = 1) -> None:
+    """Count ``n`` compiled dispatches of ``kind`` against the active
+    step record (decode scan chunk, prefill chunk forward, verify,
+    draft, fused spec round...).  One contextvar read when inactive."""
+    rec = _ACTIVE.get()
+    if rec is not None:
+        d = rec["dispatches"]
+        d[kind] = d.get(kind, 0) + n
+
+
+def note_tokens(n: int) -> None:
+    """Count ``n`` tokens emitted by the active step's dispatches."""
+    rec = _ACTIVE.get()
+    if rec is not None:
+        rec["tokens"] += n
+
+
+def current_step() -> Optional[int]:
+    """The active step record's id (None outside a profiled step) — the
+    scheduler stamps it onto a request at RETIREMENT, before the ledger
+    record snapshots ``step_ids`` (the end-of-step attribution pass runs
+    too late for a request that exits mid-step)."""
+    rec = _ACTIVE.get()
+    return rec["step"] if rec is not None else None
+
+
+# -- device memory ----------------------------------------------------------
+
+def default_mem_reader() -> Optional[Dict[str, int]]:
+    """Device memory watermarks: ``memory_stats()`` where the backend
+    provides it (TPU/GPU PJRT devices), else the CPU fallback — the sum
+    of live jax array bytes (``live``) with ``peak`` tracked by the
+    caller.  Returns None when nothing is measurable."""
+    try:
+        import jax
+
+        dev = jax.devices()[0]
+        stats = getattr(dev, "memory_stats", lambda: None)()
+        if stats:
+            live = int(stats.get("bytes_in_use", 0))
+            peak = int(stats.get("peak_bytes_in_use", live))
+            limit = int(stats.get("bytes_limit", 0))
+            out = {"live_bytes": live, "peak_bytes": peak}
+            if limit:
+                out["limit_bytes"] = limit
+            return out
+        live = sum(int(x.nbytes) for x in jax.live_arrays())
+        return {"live_bytes": live, "peak_bytes": live, "cpu_fallback": 1}
+    except Exception:  # noqa: BLE001 — watermarks are best-effort
+        return None
+
+
+def default_block(x: Any) -> None:
+    import jax
+
+    jax.block_until_ready(x)
+
+
+# -- the profiler -----------------------------------------------------------
+
+class StepProfiler:
+    """One structured record per engine step; see the module docstring.
+
+    ``metrics``: the owning server's registry (defaults to the process
+    registry for library/bench use).  ``sentinel``: a no-arg callable
+    returning the device value the sampled stall probe blocks on
+    (typically ``lambda: engine.cache``).  ``clock`` / ``block`` /
+    ``mem_reader`` / ``sample`` are injectable so the record shape and
+    sampling math are unit-testable without a device or a wall clock.
+    """
+
+    def __init__(self, metrics: Optional[_metrics.MetricsRegistry] = None,
+                 sentinel: Optional[Callable[[], Any]] = None,
+                 sample: Optional[int] = None,
+                 ring: Optional[int] = None,
+                 clock: Callable[[], float] = time.perf_counter,
+                 block: Optional[Callable[[Any], None]] = None,
+                 mem_reader: Optional[Callable[[], Optional[dict]]] = None):
+        self.enabled = os.environ.get("ISTPU_STEPPROF", "1") != "0"
+        self.sample = max(1, sample if sample is not None else _env_int(
+            "ISTPU_STEPPROF_SAMPLE", STEPPROF_SAMPLE_DEFAULT))
+        cap = max(1, ring if ring is not None else _env_int(
+            "ISTPU_STEPPROF_RING", STEPPROF_RING_DEFAULT))
+        self._ring: "deque" = deque(maxlen=cap)
+        self._lock = threading.Lock()
+        # id of the step currently executing (None between steps): a
+        # ledger row written MID-step (requests retire inside the step)
+        # may name this id before the full record ring-appends at step
+        # end — /debug/engine exports it as an in_progress stub so the
+        # /debug/requests join can never dangle
+        self._current_step: Optional[int] = None
+        self._clock = clock
+        self._block = block if block is not None else default_block
+        self._mem = mem_reader if mem_reader is not None else \
+            default_mem_reader
+        self._sentinel = sentinel
+        self.steps = 0
+        # lifetime aggregates behind summary()/the metric callbacks
+        self._by_kind: Dict[str, int] = {}
+        self._dispatch_totals: Dict[str, int] = {}
+        self.tokens = 0
+        self._wall_s = 0.0
+        self._sampled_wall_s = 0.0
+        self._stall_s = 0.0
+        self._sampled = 0
+        self._mem_last: Optional[dict] = None
+        self._peak_live = 0  # running peak for the CPU fallback
+        # trace/compile baselines: the summary reports deltas since THIS
+        # profiler was built, not process-lifetime noise from warmup
+        self._traces0 = dict(_TRACES)
+        self._compiles0, self._compile_s0 = _COMPILES, _COMPILE_S
+        self.metrics = metrics if metrics is not None else \
+            _metrics.default_registry()
+        self._register_metrics()
+        _install_monitoring()
+
+    # -- metrics --
+
+    def _register_metrics(self) -> None:
+        reg = self.metrics
+        self._h_step = reg.histogram(
+            "istpu_engine_step_seconds",
+            "One scheduler step, by step kind; phase=wall is the step's "
+            "wall time (every step), phase=stall the sampled end-of-step "
+            "device drain (see istpu_engine_host_stall_seconds)",
+            labelnames=("kind", "phase"),
+        )
+        self._c_dispatch = reg.counter(
+            "istpu_engine_dispatches_total",
+            "Compiled step programs launched, by kind (decode scan "
+            "chunk, prefill chunk forward, verify/draft forward, fused "
+            "speculation round)",
+            labelnames=("kind",),
+        )
+        self._c_retrace = reg.counter(
+            "istpu_engine_retraces_total",
+            "jit trace-cache misses per engine function (first compile "
+            "included) — a climbing series during steady serving means "
+            "shape-polymorphic churn is eating steps",
+            labelnames=("fn",),
+        )
+        self._h_stall = reg.histogram(
+            "istpu_engine_host_stall_seconds",
+            "Sampled end-of-step block_until_ready wait: device work "
+            "the host loop did not overlap (high = device-bound, ~0 = "
+            "host/dispatch-bound)",
+        )
+        self._g_mem = reg.gauge(
+            "istpu_engine_device_mem_bytes",
+            "Device memory watermarks from device.memory_stats() "
+            "(live-array-sum fallback on CPU), sampled with the stall "
+            "probe",
+            labelnames=("kind",),
+        )
+        self._c_compiles = reg.counter(
+            "istpu_engine_compiles_total",
+            "Backend compiles observed process-wide via jax.monitoring "
+            "(includes programs the per-fn retrace wrapper never saw)",
+            fn=lambda: _COMPILES,
+        )
+
+    # -- recording --
+
+    @staticmethod
+    def _spec_counts(scheduler) -> Optional[tuple]:
+        spec = getattr(scheduler, "spec", None) if scheduler else None
+        if spec is None:
+            return None
+        return (int(spec.rounds), int(spec.proposed), int(spec.accepted))
+
+    @staticmethod
+    def _stage_ids(scheduler) -> tuple:
+        transfer = getattr(getattr(scheduler, "engine", None), "transfer",
+                           None) if scheduler else None
+        if transfer is None:
+            return None, None, None
+        return (transfer,
+                id(getattr(transfer, "last_push_stages", None)),
+                id(getattr(transfer, "last_load_stages", None)))
+
+    @contextlib.contextmanager
+    def step(self, scheduler=None, kind_hint: Optional[str] = None):
+        """Profile one engine step.  Yields the (mutable) record dict;
+        the finished record is ring-appended and metric-fed on exit.
+        Usable without a scheduler (``kind_hint`` labels the step) —
+        the bench legs and perf smoke wrap raw engine calls this way."""
+        if not self.enabled:
+            yield None
+            return
+        with self._lock:
+            self.steps += 1
+            step_id = self.steps
+            self._current_step = step_id
+        sampled = step_id % self.sample == 0
+        rec: Dict[str, Any] = {
+            "step": step_id,
+            "t_wall": round(time.time(), 3),
+            "trace_id": tracing.current_trace_id(),
+            "dispatches": {},
+            "tokens": 0,
+            "retraces": {},
+            "sampled": sampled,
+        }
+        if scheduler is not None:
+            rec["batch"] = {
+                "active": len(getattr(scheduler, "active", ())),
+                "prefilling": len(getattr(scheduler, "_prefilling", ())),
+                "pending": len(getattr(scheduler, "pending", ())),
+            }
+        spec0 = self._spec_counts(scheduler)
+        transfer, push0, load0 = self._stage_ids(scheduler)
+        compiles0, compile_s0 = _COMPILES, _COMPILE_S
+        token = _ACTIVE.set(rec)
+        t0 = self._clock()
+        try:
+            yield rec
+        finally:
+            t1 = self._clock()
+            _ACTIVE.reset(token)
+            self._finish(rec, scheduler, kind_hint, t0, t1, sampled,
+                         spec0, transfer, push0, load0,
+                         compiles0, compile_s0)
+
+    def _finish(self, rec, scheduler, kind_hint, t0, t1, sampled,
+                spec0, transfer, push0, load0,
+                compiles0, compile_s0) -> None:
+        dur = max(0.0, t1 - t0)
+        rec["dur_s"] = round(dur, 6)
+        rec["kind"] = kind_hint or self._classify(rec["dispatches"])
+        # sampled probe: time the device drain, then read the watermarks
+        # (reading them BEFORE the block would race in-flight dispatches)
+        if sampled:
+            stall = 0.0
+            sentinel = self._sentinel
+            target = None
+            if sentinel is not None:
+                target = sentinel()
+            elif scheduler is not None:
+                target = getattr(getattr(scheduler, "engine", None),
+                                 "cache", None)
+            if target is not None:
+                tb = self._clock()
+                try:
+                    self._block(target)
+                except Exception:  # noqa: BLE001 — probe must not fault steps
+                    pass
+                stall = max(0.0, self._clock() - tb)
+            rec["host_stall_s"] = round(stall, 6)
+            mem = self._mem()
+            if mem is not None:
+                if mem.get("cpu_fallback"):
+                    self._peak_live = max(self._peak_live,
+                                          mem["live_bytes"])
+                    mem["peak_bytes"] = self._peak_live
+                rec["mem"] = mem
+        # speculation attribution: per-step deltas of the speculator's
+        # counters next to the dispatch counts — accepted tokens PER
+        # DISPATCH is the number that explains a sub-1x speedup at high
+        # acceptance
+        spec1 = self._spec_counts(scheduler)
+        if spec0 is not None and spec1 is not None and spec1 != spec0:
+            rec["spec"] = {
+                "rounds": spec1[0] - spec0[0],
+                "proposed": spec1[1] - spec0[1],
+                "accepted": spec1[2] - spec0[2],
+            }
+        # store-hop stages: attach the transfer's per-stage breakdown
+        # when it changed under this step (push commits land on the
+        # streamer thread, so attribution is best-effort by design)
+        if transfer is not None:
+            store: Dict[str, Any] = {}
+            push = getattr(transfer, "last_push_stages", None)
+            if push and id(push) != push0:
+                store["push"] = dict(push)
+            load = getattr(transfer, "last_load_stages", None)
+            if load and id(load) != load0:
+                store["load"] = dict(load)
+            if store:
+                rec["store"] = store
+        if _COMPILES != compiles0:
+            rec["compiles"] = _COMPILES - compiles0
+            rec["compile_s"] = round(_COMPILE_S - compile_s0, 6)
+        # lifetime aggregates + metric families
+        kind = rec["kind"]
+        with self._lock:
+            self._by_kind[kind] = self._by_kind.get(kind, 0) + 1
+            for k, n in rec["dispatches"].items():
+                self._dispatch_totals[k] = \
+                    self._dispatch_totals.get(k, 0) + n
+            self.tokens += rec["tokens"]
+            self._wall_s += dur
+            if sampled:
+                self._sampled += 1
+                self._sampled_wall_s += dur
+                self._stall_s += rec.get("host_stall_s", 0.0)
+                if rec.get("mem"):
+                    self._mem_last = rec["mem"]
+            self._ring.append(rec)
+            if self._current_step == rec["step"]:
+                self._current_step = None
+        self._h_step.labels(kind, "wall").observe(dur)
+        for k, n in rec["dispatches"].items():
+            self._c_dispatch.labels(k).inc(n)
+        for fname, n in rec["retraces"].items():
+            self._c_retrace.labels(fname).inc(n)
+        if sampled:
+            stall = rec.get("host_stall_s", 0.0)
+            self._h_step.labels(kind, "stall").observe(stall)
+            self._h_stall.observe(stall)
+            mem = rec.get("mem")
+            if mem:
+                self._g_mem.labels("live").set(mem["live_bytes"])
+                self._g_mem.labels("peak").set(mem["peak_bytes"])
+        # the device sub-track: the sampled drain as a span on a
+        # synthetic "device" thread of the ACTIVE trace (the engine.step
+        # trace in serving; a bench.* trace in the legs) — the scheduler
+        # mirrors it into each participating request's own trace
+        if sampled and rec.get("host_stall_s"):
+            tracing.add_span_abs(
+                "device.drain", t1, t1 + rec["host_stall_s"],
+                tid="device", step=rec["step"],
+            )
+        rec["t0"], rec["t1"] = t0, t1  # for the scheduler's span mirror
+
+    @staticmethod
+    def _classify(dispatches: Dict[str, int]) -> str:
+        spec = any(k.startswith(("spec", "draft", "verify"))
+                   for k in dispatches)
+        prefill = "prefill" in dispatches
+        decode = "decode" in dispatches
+        if spec:
+            return "spec" if not (prefill or decode) else "mixed"
+        if prefill and decode:
+            return "mixed"
+        if prefill:
+            return "prefill"
+        if decode:
+            return "decode"
+        return "idle"
+
+    # -- export --
+
+    def summary(self) -> Dict[str, Any]:
+        """Lifetime aggregates: the ``/debug/engine`` header and the
+        bench-JSON profiler block.  ``host_stall_frac`` is the sampled
+        device-drain share of sampled step wall time — the one number
+        that says device-bound vs host-bound; ``retraces_per_100_steps``
+        the steady-state retrace pressure (both trend in
+        scripts/bench_history.py)."""
+        with self._lock:
+            steps = self.steps
+            by_kind = dict(self._by_kind)
+            dispatches = dict(self._dispatch_totals)
+            tokens = self.tokens
+            wall = self._wall_s
+            s_wall, stall, sampled = (self._sampled_wall_s, self._stall_s,
+                                      self._sampled)
+            mem = dict(self._mem_last) if self._mem_last else None
+        with _TRACE_LOCK:
+            retraces = {
+                k: v - self._traces0.get(k, 0) for k, v in _TRACES.items()
+                if v - self._traces0.get(k, 0) > 0
+            }
+            compiles = _COMPILES - self._compiles0
+            compile_s = _COMPILE_S - self._compile_s0
+        n_retr = sum(retraces.values())
+        return {
+            "steps": steps,
+            "by_kind": by_kind,
+            "dispatches": dispatches,
+            "dispatch_total": sum(dispatches.values()),
+            "tokens": tokens,
+            "wall_s": round(wall, 4),
+            "sampled_steps": sampled,
+            "host_stall_s": round(stall, 4),
+            "host_stall_frac": round(stall / s_wall, 4) if s_wall else 0.0,
+            "retraces": retraces,
+            "retraces_total": n_retr,
+            "retraces_per_100_steps": round(100.0 * n_retr / steps, 3)
+            if steps else 0.0,
+            "compiles": compiles,
+            "compile_s": round(compile_s, 4),
+            "mem": mem,
+        }
+
+    def tail(self, limit: Optional[int] = None) -> List[dict]:
+        with self._lock:
+            recs = [
+                {k: v for k, v in r.items() if k not in ("t0", "t1")}
+                for r in self._ring
+            ]
+        if limit is not None and limit >= 0:
+            recs = recs[len(recs) - min(limit, len(recs)):]
+        return recs
+
+    def snapshot(self, limit: Optional[int] = None) -> Dict[str, Any]:
+        """The ``GET /debug/engine`` payload."""
+        if not self.enabled:
+            return {"enabled": False}
+        # current BEFORE tail: a step completing in between then shows in
+        # the ring snapshot, so a step id a reader learned earlier (from
+        # /debug/requests) always resolves one way or the other
+        with self._lock:
+            current = self._current_step
+        recs = self.tail(limit)
+        if current is not None and not any(
+            r["step"] == current for r in recs
+        ):
+            # the step EXECUTING right now: a ledger row may already name
+            # it (requests retire mid-step), so the join must resolve —
+            # the full record replaces this stub when the step ends
+            recs.append({"step": current, "in_progress": True})
+        return {
+            "enabled": True,
+            "sample": self.sample,
+            "ring": self._ring.maxlen,
+            "summary": self.summary(),
+            "returned": len(recs),
+            "records": recs,
+        }
+
+
+# -- legacy jax.profiler capture, folded into the plane ---------------------
+
+@contextlib.contextmanager
+def device_trace(log_dir: Optional[str] = None):
+    """Capture device activity for the enclosed block.
+
+    The legacy helper (``utils.profiling.device_trace``, kept as a thin
+    alias) wrapped ``jax.profiler`` alone; folded into this plane it
+    ALSO records a ``device_trace`` span in the active istpu trace, so a
+    capture shows up in the same Perfetto export as the step records.
+    ``log_dir=None`` skips the (heavyweight) ``jax.profiler`` capture
+    and keeps just the span — the mode ``bench_tpu.py --trace-out``
+    uses."""
+    started = False
+    if log_dir:
+        import jax
+
+        jax.profiler.start_trace(log_dir)
+        started = True
+    try:
+        with tracing.span("device_trace", log_dir=log_dir or ""):
+            yield
+    finally:
+        if started:
+            import jax
+
+            jax.profiler.stop_trace()

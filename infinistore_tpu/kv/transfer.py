@@ -103,6 +103,11 @@ class KVTransferEngine:
         # the bench legs read this to attribute regressions on the push
         # path from bench output alone
         self.last_push_stages: dict = {}
+        # load-side twin: wire/pool half (fetch_s) vs device half
+        # (scatter_s, including the end-of-load block) of the LAST
+        # load_pages — the engine step records attach both dicts when a
+        # step moved pages (engine/stepprof.py)
+        self.last_load_stages: dict = {}
 
     @property
     def conn(self):
@@ -446,13 +451,21 @@ class KVTransferEngine:
         self, cache: jax.Array, block_ids: Sequence[int],
         chunk_keys_: Sequence[str], n: int
     ) -> jax.Array:
+        t0 = time.perf_counter()
         stacked = self.fetch_pages(chunk_keys_)
+        t1 = time.perf_counter()
         out = self.scatter_pages(cache, block_ids, stacked)
         # materialize before returning: every read of this call's staging
         # buffer must complete before a LATER call can rewrite it (with
         # the double buffer above, a stale optimistic sync would need two
         # further loads to become dangerous)
         jax.block_until_ready(out)
+        t2 = time.perf_counter()
+        self.last_load_stages = {
+            "fetch_s": round(t1 - t0, 6), "scatter_s": round(t2 - t1, 6),
+            "pages": self.cfg.n_layers * n,
+            "bytes": self.cfg.n_layers * n * self.wire_page_bytes,
+        }
         return out
 
     def lookup_prefix(self, chunk_keys_: Sequence[str]) -> int:

@@ -25,7 +25,10 @@ waterfall, not a soup of overlapping timers.
 
 Records live in a bounded ring (``ISTPU_LEDGER_RING``, default 256) and
 are exported at the serving front-end's ``GET /debug/requests``
-(``?limit=N`` caps the tail returned).  Each record is also emitted as
+(``?limit=N`` caps the tail returned).  Each record also carries
+``step_ids`` — the engine steps that served the request (stamped by the
+scheduler when a ``StepProfiler`` is attached) — so ledger rows join
+the per-step attribution records at ``GET /debug/engine``.  Each record is also emitted as
 one line through the shared ``infinistore_tpu`` logger at INFO with the
 request's OWN trace id stamped (``trace_id=``), so grepping the server
 log for a trace id from a Perfetto export finds the matching ledger
@@ -122,6 +125,12 @@ def build_record(req, outcome: str,
         "shares": shares,
         "events": events,
         "token_stamps": list(getattr(req, "stamps", ())),
+        # engine steps this request rode (newest window, capped by the
+        # scheduler) — join key against the step profiler's
+        # /debug/engine records: a slow request's waterfall points at
+        # the exact steps (and their dispatch/stall/retrace records)
+        # that served it
+        "step_ids": list(getattr(req, "step_ids", ())),
     }
 
 

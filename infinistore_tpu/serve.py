@@ -93,13 +93,23 @@ class ServingServer:
         # logged through the shared logger (trace_id-joinable) — the
         # scheduler records into it at every request exit
         self.ledger = RequestLedger(capacity=ledger_ring)
+        # per-step engine/device attribution (engine/stepprof.py),
+        # exported at /debug/engine: one record per scheduler step —
+        # dispatch counts, sampled host-stall/device-drain, retraces,
+        # device memory watermarks, speculation deltas.  ISTPU_STEPPROF=0
+        # disables; ISTPU_STEPPROF_SAMPLE/_RING tune it.
+        from .engine.stepprof import StepProfiler
+
+        self.stepprof = StepProfiler(metrics=self.metrics,
+                                     sentinel=lambda: self.engine.cache)
         self.sched = Scheduler(engine, max_batch=max_batch,
                                draft_engine=draft_engine, spec_k=spec_k,
                                spec_batch=spec_batch,
                                ngram_spec=ngram_spec, spec_g=spec_g,
                                prefill_concurrency=prefill_concurrency,
                                metrics=self.metrics, ledger=self.ledger,
-                               slo_ttft_s=slo_ttft_s, slo_tpot_s=slo_tpot_s)
+                               slo_ttft_s=slo_ttft_s, slo_tpot_s=slo_tpot_s,
+                               stepprof=self.stepprof)
         self._register_metrics()
         self._cv = threading.Condition()
         self._staged: List[Dict[str, Any]] = []   # submissions from handlers
@@ -1070,6 +1080,20 @@ def _make_handler(server: ServingServer):
                 except (KeyError, ValueError, IndexError):
                     limit = None
                 self._json(200, server.ledger.snapshot(limit=limit))
+            elif self.path.split("?", 1)[0] == "/debug/engine":
+                # the step profiler's ring: one record per engine step
+                # (kind, batch, dispatch counts, sampled host-stall and
+                # device-mem watermarks, retraces, speculation deltas)
+                # plus the lifetime summary.  ?limit=N caps the records
+                # returned; /debug/requests rows join here by step_ids.
+                from urllib.parse import parse_qs, urlsplit
+
+                q = parse_qs(urlsplit(self.path).query)
+                try:
+                    limit = int(q["limit"][0])
+                except (KeyError, ValueError, IndexError):
+                    limit = None
+                self._json(200, server.stepprof.snapshot(limit=limit))
             elif self.path.split("?", 1)[0] == "/debug/cluster":
                 # the store-cluster view: ring ownership, per-node
                 # circuit state, request/replica-read counters, and the

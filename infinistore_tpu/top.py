@@ -4,8 +4,9 @@
         --store-url http://127.0.0.1:18080 --interval 1
 
 Polls the serving front-end's ``/metrics`` + ``/healthz`` +
-``/debug/requests`` and the store manage plane's ``/metrics`` +
-``/debug/cache`` + ``/healthz`` and renders one screen per interval:
+``/debug/requests`` + ``/debug/engine`` and the store manage plane's
+``/metrics`` + ``/debug/cache`` + ``/healthz`` and renders one screen
+per interval:
 pool occupancy, hit ratio, prefix-reuse token split, circuit/degraded
 state, the serving-SLO view (per-frame arrival/completion deltas,
 inflight and queue depth, a per-lane TTFT/TPOT table with sparklines and
@@ -76,7 +77,8 @@ class Snapshot:
                  store_health: Optional[dict] = None,
                  integrity: Optional[dict] = None,
                  requests: Optional[dict] = None,
-                 cluster: Optional[dict] = None):
+                 cluster: Optional[dict] = None,
+                 engine: Optional[dict] = None):
         self.serve = serve_metrics or {}
         self.store = store_metrics or {}
         self.cache = cache
@@ -87,6 +89,8 @@ class Snapshot:
         self.requests = requests
         # the serving /debug/cluster payload (multi-node store ring)
         self.cluster = cluster
+        # the serving /debug/engine payload (step-profiler summary)
+        self.engine = engine
 
     def lanes(self) -> List[str]:
         """Priority lanes seen in the serving TTFT family, numeric
@@ -259,6 +263,61 @@ class Console:
                 )
         return out
 
+    def _engine(self, snap: Snapshot) -> List[str]:
+        """The engine-attribution view (serving /debug/engine): per-frame
+        token and step deltas with a step sparkline by kind, dispatch
+        counts, retrace pressure, the sampled host-stall share, and the
+        device-memory watermark bar."""
+        eng = snap.engine or {}
+        summ = eng.get("summary")
+        if not eng.get("enabled") or not summ:
+            return []
+        out: List[str] = [""]
+        d_tok = self.deltas.setdefault("eng_tokens", _Delta()).update(
+            summ.get("tokens"))
+        d_steps = self.deltas.setdefault("eng_steps", _Delta()).update(
+            summ.get("steps"))
+        if d_tok is not None:
+            self._series("eng_tok").append(d_tok)
+        by_kind = summ.get("by_kind") or {}
+        kinds = "  ".join(
+            f"{k}:{by_kind[k]}" for k in
+            ("prefill", "decode", "spec", "mixed", "idle") if k in by_kind
+        )
+        out.append(
+            "engine   tok/frame {:>6}  {}  steps/frame {:>4}  "
+            "dispatches {:>7}  ({})".format(
+                "-" if d_tok is None else int(d_tok),
+                sparkline(list(self._series("eng_tok")), 16),
+                "-" if d_steps is None else int(d_steps),
+                int(summ.get("dispatch_total", 0)),
+                kinds or "no steps yet",
+            )
+        )
+        d_retr = self.deltas.setdefault("eng_retr", _Delta()).update(
+            summ.get("retraces_total"))
+        line = (
+            "  retraces {:>5} (+{}/frame, {:.1f}/100 steps)   "
+            "host-stall {:>6}   compiles {:>4}".format(
+                int(summ.get("retraces_total", 0)),
+                "-" if d_retr is None else int(d_retr),
+                summ.get("retraces_per_100_steps", 0.0),
+                "{:.1%}".format(summ.get("host_stall_frac", 0.0)),
+                int(summ.get("compiles", 0)),
+            )
+        )
+        mem = summ.get("mem") or {}
+        if mem.get("peak_bytes"):
+            denom = mem.get("limit_bytes") or mem["peak_bytes"]
+            frac = mem.get("live_bytes", 0) / denom if denom else 0.0
+            line += "   mem [{}] {:.0f}/{:.0f} MB{}".format(
+                bar(frac, 12),
+                mem.get("live_bytes", 0) / 1e6, denom / 1e6,
+                " (peak)" if not mem.get("limit_bytes") else "",
+            )
+        out.append(line)
+        return out
+
     def _cluster(self, snap: Snapshot) -> List[str]:
         """The store-cluster section (serving /debug/cluster): one row
         per endpoint — circuit state, ring-ownership share, ok/error
@@ -392,6 +451,7 @@ class Console:
                    if pages is not None else "")
             )
         out.extend(self._serving_slo(snap))
+        out.extend(self._engine(snap))
         out.extend(self._cluster(snap))
         # -- latency sparklines --
         out.append("")
@@ -455,6 +515,9 @@ def poll(serve_url: Optional[str], store_url: Optional[str]) -> Snapshot:
     cluster = js(serve_url, "/debug/cluster")
     if cluster is not None and not cluster.get("enabled"):
         cluster = None  # single-node store: no ring to render
+    engine = js(serve_url, "/debug/engine?limit=0")  # summary only
+    if engine is not None and not engine.get("enabled"):
+        engine = None  # profiler off (ISTPU_STEPPROF=0): no view
     return Snapshot(
         serve_metrics=prom(serve_url, "/metrics"),
         store_metrics=prom(store_url, "/metrics"),
@@ -464,6 +527,7 @@ def poll(serve_url: Optional[str], store_url: Optional[str]) -> Snapshot:
         integrity=integ,
         requests=js(serve_url, "/debug/requests?limit=8"),
         cluster=cluster,
+        engine=engine,
     )
 
 

@@ -91,9 +91,12 @@ def stitch_chrome(tracer, remotes: Sequence[Tuple[dict, float]] = (),
     seen_pids = set()
     for (tpid, tident), tid in tids.items():
         role = "store-server" if tpid != pid else "thread"
+        # string idents are synthetic tracks named verbatim — the step
+        # profiler's "device" sub-track keeps its name across stitching
+        name = tident if isinstance(tident, str) else f"{role}-{tident}"
         events.append({
             "name": "thread_name", "ph": "M", "pid": tpid, "tid": tid,
-            "args": {"name": f"{role}-{tident}"},
+            "args": {"name": name},
         })
         if tpid not in seen_pids:
             seen_pids.add(tpid)

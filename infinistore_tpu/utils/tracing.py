@@ -70,7 +70,7 @@ class Trace:
     request thread."""
 
     __slots__ = ("trace_id", "name", "args", "t_start", "t_end",
-                 "events", "_lock", "dropped")
+                 "events", "_lock", "dropped", "__weakref__")
 
     def __init__(self, name: str, args: Dict, trace_id: Optional[str] = None):
         # a caller-supplied id CONTINUES a trace opened in another process
@@ -86,14 +86,19 @@ class Trace:
         self._lock = threading.Lock()
         self.dropped = 0
 
-    def add(self, name: str, t0: float, t1: float, args: Optional[Dict] = None
-            ) -> None:
+    def add(self, name: str, t0: float, t1: float,
+            args: Optional[Dict] = None, tid=None) -> None:
+        """``tid`` overrides the recording thread's ident with a
+        synthetic track key — a STRING names the track verbatim in the
+        Perfetto export (the step profiler's ``"device"`` sub-track
+        rides this; real idents keep rendering as ``thread-<n>``)."""
         with self._lock:
             if len(self.events) >= MAX_EVENTS_PER_TRACE:
                 self.dropped += 1
                 return
             self.events.append(
-                (name, t0, t1, threading.get_ident(), args or {})
+                (name, t0, t1,
+                 threading.get_ident() if tid is None else tid, args or {})
             )
 
 
@@ -104,6 +109,14 @@ class Tracer:
         self._lock = threading.Lock()
         self._done: deque = deque(maxlen=ring or _ring_size())
         self.dropped = 0  # completed traces pushed out by ring overflow
+        # OPEN traces by id (weak: a trace abandoned without completing
+        # must not leak here) — lets another thread append spans into a
+        # request's live trace by id (``bind`` / ``add_span_abs_to``,
+        # the engine-thread half of one-trace-per-request attribution)
+        import weakref
+
+        self._live: "weakref.WeakValueDictionary" = \
+            weakref.WeakValueDictionary()
 
     # -- recording --
 
@@ -119,6 +132,8 @@ class Tracer:
                 yield parent
             return
         tr = Trace(name, args, trace_id=trace_id)
+        with self._lock:
+            self._live[tr.trace_id] = tr
         token = _CURRENT.set(tr)
         t0 = time.perf_counter()
         try:
@@ -128,6 +143,11 @@ class Tracer:
             _CURRENT.reset(token)
             tr.add(name, t0, t1, args)
             tr.t_end = t1
+            # NOT removed from _live here: the ring still holds the
+            # trace, and a scheduler step that RETIRED the request
+            # appends its engine.step span just after the handler
+            # completes the trace — the weak dict forgets the id only
+            # when the trace falls off the ring
             with self._lock:
                 if len(self._done) == self._done.maxlen:
                     self.dropped += 1
@@ -157,14 +177,54 @@ class Tracer:
         t1 = time.perf_counter()
         tr.add(name, t1 - seconds, t1, args)
 
-    def add_span_abs(self, name: str, t0: float, t1: float, **args) -> None:
+    def add_span_abs(self, name: str, t0: float, t1: float, tid=None,
+                     **args) -> None:
         """Record a span from absolute ``perf_counter`` stamps taken on ANY
         thread (the scheduler's queue-wait/prefill stamps are folded into
-        the request's trace this way when the request completes)."""
+        the request's trace this way when the request completes).
+        ``tid``: synthetic track override (see ``Trace.add``)."""
         tr = _CURRENT.get()
         if tr is None or not (t0 and t1) or t1 < t0:
             return
-        tr.add(name, t0, t1, args)
+        tr.add(name, t0, t1, args, tid=tid)
+
+    def live(self, trace_id: Optional[str]) -> Optional[Trace]:
+        """A trace still addressable by id: OPEN, or completed but still
+        in the ring (weak registry; None once it scrolls away)."""
+        if not trace_id:
+            return None
+        with self._lock:
+            return self._live.get(trace_id)
+
+    def add_span_abs_to(self, trace_id: Optional[str], name: str,
+                        t0: float, t1: float, tid=None, **args) -> None:
+        """``add_span_abs`` into a SPECIFIC trace by id, from any thread
+        — how the engine thread folds per-step spans (engine.step, the
+        device drain sub-track) into each participating request's own
+        ``http.request`` trace.  Silently a no-op for unknown ids:
+        attribution is best-effort observability, never a step error."""
+        tr = self.live(trace_id)
+        if tr is None or not (t0 and t1) or t1 < t0:
+            return
+        tr.add(name, t0, t1, args, tid=tid)
+
+    @contextlib.contextmanager
+    def bind(self, trace_id: Optional[str]):
+        """Temporarily make the trace named by ``trace_id`` current on
+        THIS thread (no-op when the id is unknown or None): spans opened
+        inside land in that trace.  The scheduler binds a request's
+        ``http.request`` trace around its prefill work, so the store-hop
+        spans (kv.lookup_prefix, kv.load_pages) attribute to the REQUEST
+        that paid for them instead of the ambient engine.step trace."""
+        tr = self.live(trace_id)
+        if tr is None:
+            yield None
+            return
+        token = _CURRENT.set(tr)
+        try:
+            yield tr
+        finally:
+            _CURRENT.reset(token)
 
     def current(self) -> Optional[Trace]:
         return _CURRENT.get()
@@ -232,9 +292,12 @@ class Tracer:
         # outer-before-inner so equal-start parents precede their children
         events.sort(key=lambda e: (e["ts"], -e["dur"]))
         for tident, tid in tids.items():
+            # string idents are synthetic tracks named verbatim (the
+            # step profiler's "device" sub-track); ints are real threads
+            name = tident if isinstance(tident, str) else f"thread-{tident}"
             events.append({
                 "name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
-                "args": {"name": f"thread-{tident}"},
+                "args": {"name": name},
             })
         return {"traceEvents": events, "displayTimeUnit": "ms"}
 
@@ -269,8 +332,17 @@ def add_stage(name: str, seconds: float, **args) -> None:
     TRACER.add_stage(name, seconds, **args)
 
 
-def add_span_abs(name: str, t0: float, t1: float, **args) -> None:
-    TRACER.add_span_abs(name, t0, t1, **args)
+def add_span_abs(name: str, t0: float, t1: float, tid=None, **args) -> None:
+    TRACER.add_span_abs(name, t0, t1, tid=tid, **args)
+
+
+def add_span_abs_to(trace_id: Optional[str], name: str, t0: float,
+                    t1: float, tid=None, **args) -> None:
+    TRACER.add_span_abs_to(trace_id, name, t0, t1, tid=tid, **args)
+
+
+def bind(trace_id: Optional[str]):
+    return TRACER.bind(trace_id)
 
 
 def current_trace_id() -> Optional[str]:

@@ -57,6 +57,12 @@ METRICS = {
     "tpu_pallas_speedup_vs_xla": ("up", "pallas vs XLA"),
     "goodput_rps": ("up", "serve goodput req/s"),
     "slo_attainment": ("up", "serve SLO attainment"),
+    # the step profiler's serving-leg attribution (engine/stepprof.py):
+    # device-drain share of step wall time and retrace pressure — a
+    # round that turns the step loop host-bound or shape-polymorphic
+    # is flagged here, not argued about
+    "host_stall_frac": ("down", "serving host-stall frac"),
+    "retraces_per_100_steps": ("down", "retraces / 100 steps"),
     # the multi-node cluster leg (bench.py --endpoints N): aggregate
     # fleet bandwidth through the consistent-hash router
     "cluster_put_gbps": ("up", "cluster put GB/s (aggregate)"),

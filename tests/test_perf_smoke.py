@@ -140,6 +140,8 @@ def test_instrumentation_overhead_within_5pct(server, monkeypatch):
     from infinistore_tpu.utils import metrics as m
     from infinistore_tpu.utils import tracing
 
+    from infinistore_tpu.engine.stepprof import StepProfiler
+
     monkeypatch.setenv("ISTPU_CLIENT", "python")
     blk = 64 << 10
     nbytes = 128 << 20
@@ -153,19 +155,25 @@ def test_instrumentation_overhead_within_5pct(server, monkeypatch):
     conn.register_mr(dst)
     n = nbytes // blk
     tracer = tracing.TRACER
+    # the step profiler rides INSIDE the measured window at its default
+    # sampling — the ≤5% guard now covers the whole attribution plane
+    # (tracing + metrics + per-step profiling), not just tracing
+    prof = StepProfiler()
     best_put = best_get = float("inf")
     for it in range(4):
         blocks = [(f"ovh-{it}-{i}", i * blk) for i in range(n)]
         with tracer.trace("perf.request", iteration=it):
-            t0 = time.perf_counter()
-            conn.write_cache(blocks, blk, buf.ctypes.data)
-            best_put = min(best_put, time.perf_counter() - t0)
-            t0 = time.perf_counter()
-            conn.read_cache(blocks, blk, dst.ctypes.data)
-            best_get = min(best_get, time.perf_counter() - t0)
+            with prof.step(kind_hint="perf"):
+                t0 = time.perf_counter()
+                conn.write_cache(blocks, blk, buf.ctypes.data)
+                best_put = min(best_put, time.perf_counter() - t0)
+                t0 = time.perf_counter()
+                conn.read_cache(blocks, blk, dst.ctypes.data)
+                best_get = min(best_get, time.perf_counter() - t0)
         conn.delete_keys([k for k, _ in blocks])
     conn.close()
     assert np.array_equal(buf, dst)
+    assert prof.summary()["steps"] == 4
 
     # instrumentation proof: the trace recorded the op and stage spans...
     last = tracer.recent()[-1]
@@ -175,12 +183,19 @@ def test_instrumentation_overhead_within_5pct(server, monkeypatch):
     text = m.default_registry().to_prometheus_text()
     assert 'istpu_client_op_seconds_count{op="write_cache"}' in text
 
-    # CI artifact hook: dump the run's Perfetto trace when asked, so the
-    # workflow can upload the real stage timeline alongside the numbers
+    # CI artifact hooks: dump the run's Perfetto trace and the step
+    # profiler's JSON summary when asked, so the workflow uploads the
+    # real stage timeline AND the attribution block next to the numbers
     out_path = os.environ.get("ISTPU_PERF_TRACE_OUT")
     if out_path:
         with open(out_path, "w") as f:
             f.write(tracer.export_chrome_json())
+    prof_path = os.environ.get("ISTPU_PERF_STEPPROF_OUT")
+    if prof_path:
+        import json
+
+        with open(prof_path, "w") as f:
+            json.dump(prof.summary(), f, indent=2)
 
     floor = PUT_FLOOR_GBPS * 0.95
     put_gbps = nbytes / 1e9 / best_put
@@ -239,10 +254,16 @@ def test_store_attached_prefill_within_budget(server, monkeypatch):
     import jax
 
     from infinistore_tpu.engine.engine import InferenceEngine
+    from infinistore_tpu.engine.stepprof import StepProfiler
     from infinistore_tpu.kv.cache import PagedCacheConfig
     from infinistore_tpu.models import TINY, init_params
 
     monkeypatch.setenv("ISTPU_CLIENT", "python")
+    # profiler ON at DEFAULT sampling for both sides of the ratio: the
+    # attached/detached budget is measured with the engine-path hooks
+    # (prefill dispatch notes, sampled stall probe) live — the
+    # acceptance criterion's "with the StepProfiler ON" form
+    prof = StepProfiler()
     cfg = TINY
     params = init_params(cfg, jax.random.PRNGKey(0))
     pc = PagedCacheConfig(
@@ -266,8 +287,9 @@ def test_store_attached_prefill_within_budget(server, monkeypatch):
         for _ in range(3):
             p = [int(x) for x in rng.randint(1, cfg.vocab_size, size=S)]
             t0 = time.perf_counter()
-            st = eng.prefill(p)
-            np.asarray(st.last_logits)  # ground-truth completion
+            with prof.step(kind_hint=None):
+                st = eng.prefill(p)
+                np.asarray(st.last_logits)  # ground-truth completion
             times.append(time.perf_counter() - t0)
             eng.store_flush()
             eng.release(st)

@@ -109,6 +109,40 @@ def test_console_renders_synthetic_snapshot():
     assert Console().frame(S())
 
 
+def test_console_renders_engine_view():
+    """The engine-attribution section (serving /debug/engine): tokens
+    and steps per frame, retraces, host-stall share, mem watermark bar."""
+    from infinistore_tpu.top import Console, Snapshot
+
+    def engine(tokens, steps, retr):
+        return {
+            "enabled": True, "sample": 16, "ring": 256,
+            "summary": {
+                "steps": steps, "tokens": tokens,
+                "by_kind": {"prefill": 2, "decode": steps - 2},
+                "dispatches": {"decode": steps}, "dispatch_total": steps,
+                "host_stall_frac": 0.42, "retraces_total": retr,
+                "retraces_per_100_steps": 2.5, "compiles": 7,
+                "sampled_steps": 2, "host_stall_s": 0.5, "wall_s": 1.2,
+                "mem": {"live_bytes": 50_000_000,
+                        "peak_bytes": 100_000_000},
+            },
+            "returned": 0, "records": [],
+        }
+
+    console = Console()
+    console.frame(Snapshot(engine=engine(100, 10, 4)))
+    out = console.frame(Snapshot(engine=engine(180, 14, 5)))
+    assert "engine" in out
+    assert "tok/frame     80" in out       # per-frame delta
+    assert "steps/frame    4" in out
+    assert "retraces     5" in out and "+1/frame" in out
+    assert "host-stall  42.0%" in out
+    assert "mem [" in out and "50/100 MB (peak)" in out
+    # profiler off (or old server): section absent, frame still renders
+    assert "engine " not in Console().frame(Snapshot())
+
+
 def test_sparkline_and_bar_helpers():
     from infinistore_tpu.top import bar, fmt_dur, sparkline
 
