@@ -19,10 +19,13 @@ against.
 ``--json-out`` writes one JSON object joining the bench-schema family
 (``run_id`` + stable keys; docs/observability.md): ``{run_id, kind:
 "serve_load", slo: {...}, config: {...}, curve: [per-rate summaries],
-stepprof: {...}}`` — ``stepprof`` is the server's step-profiler summary
-(``GET /debug/engine``): host-stall share, retrace pressure, dispatch
-counts for the whole sweep (absent against servers without the
-endpoint).
+stepprof: {...}, health: {...}}`` — ``stepprof`` is the server's
+step-profiler summary (``GET /debug/engine``): host-stall share,
+retrace pressure, dispatch counts for the whole sweep; ``health`` is
+the health plane's verdict (``GET /debug/health``): alert firing
+transitions and the peak burn rate observed, with ``alerts_fired``
+mirrored top-level for the trend table (both absent against servers
+without the endpoints).
 """
 
 from __future__ import annotations
@@ -208,6 +211,36 @@ def main(argv=None) -> int:
                 stepprof = payload.get("summary")
         except Exception:  # noqa: BLE001 — observability, not the bench
             pass
+        # the health plane's verdict on the run (best-effort, same
+        # contract): alert firing transitions observed during the sweep
+        # and the peak burn rate the watchdogs saw — a load point that
+        # pages is a different result than one that merely misses SLO
+        health = None
+        try:
+            import urllib.request
+
+            with urllib.request.urlopen(url + "/debug/health",
+                                        timeout=5) as r:
+                payload = json.loads(r.read())
+            if payload.get("enabled"):
+                alerts = payload.get("alerts") or {}
+                burn_peaks = [
+                    a.get("peak") or 0.0 for name, a in alerts.items()
+                    if name.endswith("_burn")
+                ]
+                health = {
+                    "alerts_fired": payload.get("alerts_fired", 0),
+                    "firing": payload.get("firing", []),
+                    "burn_rate_peak": round(max(burn_peaks, default=0.0),
+                                            3),
+                    "alerts": {
+                        name: {"fired": a.get("fired", 0),
+                               "peak": a.get("peak")}
+                        for name, a in alerts.items() if a.get("fired")
+                    },
+                }
+        except Exception:  # noqa: BLE001 — observability, not the bench
+            pass
     finally:
         if srv is not None:
             srv.close()
@@ -230,6 +263,14 @@ def main(argv=None) -> int:
         # the same way `slo`/`config` do — stable keys, documented in
         # docs/observability.md §engine-attribution
         record["stepprof"] = stepprof
+    if health is not None:
+        # health-plane block (infinistore_tpu/health.py): alert
+        # transitions + burn-rate peak during the run.  alerts_fired is
+        # ALSO mirrored top-level so scripts/bench_history.py trends it
+        # (direction: down) without digging into nested blocks
+        record["health"] = health
+        record["alerts_fired"] = health["alerts_fired"]
+        record["burn_rate_peak"] = health["burn_rate_peak"]
     print(json.dumps(record))
     if args.json_out:
         with open(args.json_out, "w") as f:

@@ -1,0 +1,374 @@
+"""``istpu-doctor``: one-command incident bundles.
+
+    istpu-doctor --serve-url http://127.0.0.1:8000 \
+        --store-url http://127.0.0.1:18080 --out incident.tar.gz
+
+At 3am the operator does not want to hand-assemble six ``/debug/*``
+endpoints before the rings scroll; the doctor captures everything a
+post-mortem needs from a live serve (plus its attached store or
+cluster) into ONE tarball:
+
+* from the serving front-end: ``/metrics``, ``/healthz``,
+  ``/debug/requests`` (the ledger), ``/debug/engine`` (step profiler),
+  ``/debug/traces`` (stitched Perfetto), ``/debug/cluster``,
+  ``/debug/health`` (alerts + flight-recorder series);
+* from every reachable store manage plane (``--store-url`` repeated /
+  comma-separated, PLUS any node named by the serve's
+  ``/debug/health`` cluster rollup — so a clustered deployment is
+  discovered, not typed): ``/metrics``, ``/healthz``, ``/stats``,
+  ``/debug/cache``, ``/debug/integrity``, ``/debug/health``,
+  ``/debug/traces``.
+
+Every endpoint degrades gracefully: an unreachable node contributes a
+manifest entry with its error, never a failed bundle.  The bundle holds
+a ``manifest.json`` (what was fetched, from where, ok/error, byte
+counts) and a human ``SUMMARY.md``: active alerts across the fleet, the
+slowest requests joined to their ``step_ids`` and trace ids (ledger ↔
+``/debug/engine`` ↔ stitched trace — the PR-9 join, pre-walked), and
+the top retracing functions.  ``summarize_capture`` is pure in the
+fetched dicts, so the report is testable without sockets.
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import json
+import sys
+import tarfile
+import time
+import urllib.request
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+# (name, path, filename) per plane.  Trace exports can be large; the
+# ledger/engine rings are bounded anyway.
+SERVE_ENDPOINTS: Tuple[Tuple[str, str, str], ...] = (
+    ("metrics", "/metrics", "metrics.prom"),
+    ("healthz", "/healthz", "healthz.json"),
+    ("requests", "/debug/requests", "debug_requests.json"),
+    ("engine", "/debug/engine", "debug_engine.json"),
+    ("traces", "/debug/traces", "debug_traces.json"),
+    ("cluster", "/debug/cluster", "debug_cluster.json"),
+    ("health", "/debug/health", "debug_health.json"),
+)
+STORE_ENDPOINTS: Tuple[Tuple[str, str, str], ...] = (
+    ("metrics", "/metrics", "metrics.prom"),
+    ("healthz", "/healthz", "healthz.json"),
+    ("stats", "/stats", "stats.json"),
+    ("cache", "/debug/cache", "debug_cache.json"),
+    ("integrity", "/debug/integrity", "debug_integrity.json"),
+    ("health", "/debug/health", "debug_health.json"),
+    ("traces", "/debug/traces", "debug_traces.json"),
+)
+
+
+def _fetch(url: str, timeout: float) -> Tuple[Optional[bytes], Optional[str]]:
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.read(), None
+    except Exception as e:  # noqa: BLE001 — per-endpoint degradation
+        return None, repr(e)
+
+
+def _norm(url: str) -> str:
+    return url if url.startswith("http") else f"http://{url}"
+
+
+def capture_plane(base_url: str, endpoints, timeout: float) -> Dict[str, Any]:
+    """Fetch one plane's endpoint set.  Each entry:
+    ``{path, file, ok, error, bytes, data}`` (data = raw bytes)."""
+    base = _norm(base_url).rstrip("/")
+    out: Dict[str, Any] = {"url": base}
+    for name, path, fname in endpoints:
+        data, err = _fetch(base + path, timeout)
+        out[name] = {
+            "path": path, "file": fname, "ok": err is None,
+            "error": err, "bytes": len(data) if data else 0,
+            "data": data,
+        }
+    return out
+
+
+def _json_of(plane: Dict[str, Any], name: str) -> Optional[Any]:
+    ent = plane.get(name)
+    if not ent or not ent.get("ok") or not ent.get("data"):
+        return None
+    try:
+        return json.loads(ent["data"])
+    except ValueError:
+        return None
+
+
+def discover_store_urls(serve_plane: Dict[str, Any]) -> List[str]:
+    """Store manage endpoints named by the serve's /debug/health
+    cluster rollup — a clustered deployment is discovered from the one
+    URL the operator has."""
+    health = _json_of(serve_plane, "health")
+    if not health:
+        return []
+    nodes = (health.get("cluster") or {}).get("nodes") or []
+    return [n["endpoint"] for n in nodes if n.get("endpoint")]
+
+
+def capture(serve_url: Optional[str], store_urls: Sequence[str],
+            timeout: float = 5.0) -> Dict[str, Any]:
+    """The whole fleet capture: serve plane + every named/discovered
+    store manage plane, deduplicated."""
+    cap: Dict[str, Any] = {"fetched_at": time.time(), "stores": []}
+    if serve_url:
+        cap["serve"] = capture_plane(serve_url, SERVE_ENDPOINTS, timeout)
+        discovered = discover_store_urls(cap["serve"])
+    else:
+        cap["serve"] = None
+        discovered = []
+    seen = set()
+    for url in list(store_urls) + discovered:
+        key = _norm(url).rstrip("/")
+        if key in seen:
+            continue
+        seen.add(key)
+        cap["stores"].append(capture_plane(url, STORE_ENDPOINTS, timeout))
+    return cap
+
+
+# -- the human report -------------------------------------------------------
+
+
+def _fmt_s(v: Optional[float]) -> str:
+    return "-" if v is None else f"{v:.3f}s"
+
+
+def _alert_lines(health: Optional[dict], who: str) -> List[str]:
+    if not health or not health.get("enabled"):
+        return [f"- {who}: health plane unavailable"]
+    firing = health.get("firing") or []
+    alerts = health.get("alerts") or {}
+    if not firing:
+        fired = health.get("alerts_fired", 0)
+        return [f"- {who}: no alerts firing "
+                f"({fired} firing transition(s) lifetime)"]
+    out = []
+    for rule in firing:
+        a = alerts.get(rule, {})
+        out.append(
+            f"- {who}: **{rule}** [{a.get('severity', '?')}] — "
+            f"{a.get('reason') or 'firing'}"
+        )
+    return out
+
+
+def summarize_capture(cap: Dict[str, Any], top_n: int = 5) -> str:
+    """SUMMARY.md: active alerts, slowest requests joined to their step
+    records and trace ids, top retracers, per-node store state.  Pure in
+    the capture dict (tests feed synthetic captures)."""
+    lines: List[str] = ["# istpu-doctor incident bundle", ""]
+    lines.append(f"Captured {time.strftime('%Y-%m-%d %H:%M:%S %Z', time.localtime(cap.get('fetched_at', 0)))}")
+    serve = cap.get("serve")
+    if serve:
+        lines.append(f"Serve: {serve['url']}")
+    for i, store in enumerate(cap.get("stores", [])):
+        lines.append(f"Store[{i}]: {store['url']}")
+    lines.append("")
+
+    # -- health / alerts across the fleet --
+    lines.append("## Active alerts")
+    if serve:
+        hz = _json_of(serve, "healthz") or {}
+        lines.append(f"- serve `/healthz`: **{hz.get('status', 'unreachable')}**"
+                     + (f" (store_circuit={hz['store_circuit']})"
+                        if "store_circuit" in hz else ""))
+        lines.extend(_alert_lines(_json_of(serve, "health"), "serve"))
+    for i, store in enumerate(cap.get("stores", [])):
+        hz = _json_of(store, "healthz") or {}
+        lines.append(f"- store[{i}] `/healthz`: "
+                     f"**{hz.get('status', 'unreachable')}**")
+        lines.extend(_alert_lines(_json_of(store, "health"), f"store[{i}]"))
+    lines.append("")
+
+    # -- slowest requests, joined to their steps and traces --
+    if serve:
+        reqs = (_json_of(serve, "requests") or {}).get("records") or []
+        engine = _json_of(serve, "engine") or {}
+        steps = {r.get("step"): r for r in engine.get("records", [])
+                 if isinstance(r, dict)}
+        slow = sorted(
+            (r for r in reqs if r.get("e2e_s") is not None),
+            key=lambda r: r["e2e_s"], reverse=True,
+        )[:top_n]
+        lines.append("## Slowest requests (ledger ↔ /debug/engine ↔ trace)")
+        if not slow:
+            lines.append("- no finished requests in the ledger ring")
+        for r in slow:
+            sh = r.get("shares") or {}
+            step_ids = r.get("step_ids") or []
+            lines.append(
+                f"- req {r.get('req_id')} lane {r.get('lane')} "
+                f"[{r.get('outcome')}] e2e {_fmt_s(r.get('e2e_s'))} "
+                f"ttft {_fmt_s(r.get('ttft_s'))} "
+                f"(queue {sh.get('queue', 0):.0%} / store "
+                f"{sh.get('store', 0):.0%} / prefill "
+                f"{sh.get('prefill', 0):.0%} / decode "
+                f"{sh.get('decode', 0):.0%}) "
+                f"trace_id {r.get('trace_id') or '-'} "
+                f"step_ids {','.join(str(s) for s in step_ids) or '-'}"
+            )
+            for sid in step_ids[-3:]:  # the newest steps it rode
+                rec = steps.get(sid)
+                if rec is None:
+                    continue
+                if rec.get("in_progress"):
+                    lines.append(f"  - step {sid}: in progress at capture")
+                    continue
+                lines.append(
+                    f"  - step {sid}: kind={rec.get('kind')} "
+                    f"dur {_fmt_s(rec.get('dur_s'))} "
+                    f"dispatches {rec.get('dispatches')} "
+                    f"tokens {rec.get('tokens')}"
+                    + (f" host_stall {_fmt_s(rec['host_stall_s'])}"
+                       if rec.get("host_stall_s") is not None else "")
+                )
+        lines.append("")
+
+        # -- retrace pressure --
+        summ = engine.get("summary") or {}
+        retr = summ.get("retraces") or {}
+        lines.append("## Top retracing functions")
+        if not retr:
+            lines.append("- no retraces recorded")
+        for fn, n in sorted(retr.items(), key=lambda kv: -kv[1])[:top_n]:
+            lines.append(f"- {fn}: {n}")
+        if summ:
+            lines.append(
+                f"- steps {summ.get('steps')}  "
+                f"host_stall_frac {summ.get('host_stall_frac')}  "
+                f"retraces/100 steps {summ.get('retraces_per_100_steps')}"
+            )
+        lines.append("")
+
+    # -- per-store state --
+    if cap.get("stores"):
+        lines.append("## Store nodes")
+        for i, store in enumerate(cap["stores"]):
+            integ = _json_of(store, "integrity") or {}
+            cache = _json_of(store, "cache") or {}
+            reach = any(store[n]["ok"] for n, _p, _f in STORE_ENDPOINTS)
+            if not reach:
+                lines.append(f"- store[{i}] {store['url']}: UNREACHABLE")
+                continue
+            lines.append(
+                f"- store[{i}] {store['url']}: entries "
+                f"{cache.get('entries', '-')}  hit_ratio "
+                f"{cache.get('hit_ratio', '-')}  integrity "
+                f"{integ.get('level', '-')}"
+                + (f"  quarantined {integ.get('quarantined')}"
+                   if integ.get("quarantined") else "")
+            )
+        lines.append("")
+    return "\n".join(lines) + "\n"
+
+
+# -- bundle writing ---------------------------------------------------------
+
+
+def _add_bytes(tar: tarfile.TarFile, name: str, data: bytes) -> None:
+    info = tarfile.TarInfo(name)
+    info.size = len(data)
+    info.mtime = int(time.time())
+    tar.addfile(info, io.BytesIO(data))
+
+
+def write_bundle(cap: Dict[str, Any], out_path: str) -> Dict[str, Any]:
+    """Write the tarball; returns the manifest (also stored inside)."""
+    manifest: Dict[str, Any] = {
+        "fetched_at": cap.get("fetched_at"),
+        "serve": None, "stores": [], "files": [],
+    }
+
+    def plane_entries(plane: Dict[str, Any], prefix: str,
+                      endpoints) -> List[dict]:
+        ents = []
+        for name, _path, _f in endpoints:
+            e = plane[name]
+            ents.append({
+                "endpoint": e["path"], "file": f"{prefix}/{e['file']}",
+                "ok": e["ok"], "error": e["error"], "bytes": e["bytes"],
+            })
+        return ents
+
+    with tarfile.open(out_path, "w:gz") as tar:
+        serve = cap.get("serve")
+        if serve:
+            manifest["serve"] = {"url": serve["url"],
+                                 "endpoints": plane_entries(
+                                     serve, "serve", SERVE_ENDPOINTS)}
+            for name, _p, _f in SERVE_ENDPOINTS:
+                e = serve[name]
+                if e["data"]:
+                    path = f"serve/{e['file']}"
+                    _add_bytes(tar, path, e["data"])
+                    manifest["files"].append(path)
+        for i, store in enumerate(cap.get("stores", [])):
+            prefix = f"store-{i}"
+            manifest["stores"].append({
+                "url": store["url"],
+                "endpoints": plane_entries(store, prefix,
+                                           STORE_ENDPOINTS),
+            })
+            for name, _p, _f in STORE_ENDPOINTS:
+                e = store[name]
+                if e["data"]:
+                    path = f"{prefix}/{e['file']}"
+                    _add_bytes(tar, path, e["data"])
+                    manifest["files"].append(path)
+        summary = summarize_capture(cap)
+        _add_bytes(tar, "SUMMARY.md", summary.encode())
+        _add_bytes(tar, "manifest.json",
+                   json.dumps(manifest, indent=2).encode())
+    return manifest
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        "istpu-doctor",
+        description="capture a one-command incident bundle from a live "
+                    "serve (+attached store/cluster): every /metrics and "
+                    "/debug endpoint, a manifest, and a human SUMMARY.md",
+    )
+    ap.add_argument("--serve-url", default=None,
+                    help="serving front-end base URL (http://host:8000)")
+    ap.add_argument("--store-url", action="append", default=[],
+                    dest="store_urls", metavar="URL",
+                    help="store MANAGE-plane base URL (http://host:18080); "
+                         "repeatable, comma lists accepted.  Cluster "
+                         "nodes named by the serve's /debug/health "
+                         "rollup are discovered automatically")
+    ap.add_argument("--out", default=None,
+                    help="bundle path (default istpu-doctor-<ts>.tar.gz)")
+    ap.add_argument("--timeout", type=float, default=5.0,
+                    help="per-endpoint fetch timeout (s)")
+    args = ap.parse_args(argv)
+    store_urls = [u for part in args.store_urls
+                  for u in part.split(",") if u.strip()]
+    if not args.serve_url and not store_urls:
+        ap.error("need --serve-url and/or --store-url")
+    out = args.out or time.strftime("istpu-doctor-%Y%m%d-%H%M%S.tar.gz")
+    cap = capture(args.serve_url, store_urls, timeout=args.timeout)
+    reached = 0
+    if cap.get("serve"):
+        reached += sum(1 for n, _p, _f in SERVE_ENDPOINTS
+                       if cap["serve"][n]["ok"])
+    for store in cap.get("stores", []):
+        reached += sum(1 for n, _p, _f in STORE_ENDPOINTS
+                       if store[n]["ok"])
+    manifest = write_bundle(cap, out)
+    n_files = len(manifest["files"])
+    print(f"wrote {out}: {n_files} captures "
+          f"({reached} endpoint fetches ok)", file=sys.stderr)
+    if reached == 0:
+        print("nothing was reachable — check the URLs", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -163,6 +163,15 @@ def trace_counts() -> Dict[str, int]:
         return dict(_TRACES)
 
 
+def total_traces() -> int:
+    """Process-lifetime trace-cache misses across every counted
+    function — the cheap monotone series the health plane's
+    retrace-regression watchdog samples (deltas over its windows, so
+    the process-lifetime baseline cancels out)."""
+    with _TRACE_LOCK:
+        return _TRACES_TOTAL
+
+
 # -- step-local hooks (no-ops without an active record) ---------------------
 
 def note_dispatch(kind: str, n: int = 1) -> None:
@@ -504,6 +513,23 @@ class StepProfiler:
         if decode:
             return "decode"
         return "idle"
+
+    # -- cheap probe reads (the health sampler polls these every tick;
+    # summary() builds dicts and merges global trace state, too much for
+    # a 1 Hz background thread that only needs three numbers) --
+
+    def stall_totals(self) -> tuple:
+        """``(host_stall_s, sampled_wall_s)`` lifetime totals — windowed
+        deltas of the pair give the health plane an INSTANTANEOUS
+        host-stall fraction (``summary()['host_stall_frac']`` is the
+        lifetime aggregate, too damped to watchdog a trend)."""
+        with self._lock:
+            return self._stall_s, self._sampled_wall_s
+
+    def mem_last(self) -> Optional[Dict[str, int]]:
+        """The most recent sampled device-memory watermark dict."""
+        with self._lock:
+            return dict(self._mem_last) if self._mem_last else None
 
     # -- export --
 

@@ -1,0 +1,944 @@
+"""Fleet health plane: flight recorder, watchdogs, and `/debug/health`.
+
+The repo emits rich *instantaneous* signals — metric families, stitched
+traces, the request ledger, the step profiler, per-node cluster state —
+but nothing watches them **over time**: when a node wedges or TTFT burns
+through its SLO budget at 3am, ``/metrics`` shows only the current
+counter values and the operator hand-assembles six ``/debug/*``
+endpoints before history scrolls out of the rings.  This module is the
+missing layer, three parts:
+
+* **Flight recorder** (``TimeSeriesRing``): a fixed-memory, multi-tier
+  time-series ring — every sample lands in the raw tier (one point per
+  sampler tick, default 1 s) and is simultaneously rolled up into
+  10-step and 60-step aggregate tiers (min/max/last/sum/count per
+  bucket), so ~10 minutes of 1 s detail and hours of coarse history fit
+  in a few hundred tuples per series.  The clock is injectable and every
+  windowed read (``delta``/``mean``/``slope``/``changes``) falls back
+  from raw to the coarser tiers, so the math is unit-testable with no
+  sleeps and no live server.
+* **Watchdogs** (``WatchdogRule`` + the factories below): declarative
+  rules evaluated over the ring after every sample tick, with
+  firing/cleared transitions, hysteresis (``clear_for_s``), and the
+  ``istpu_health_alert_active{rule}`` / ``istpu_health_alerts_total
+  {rule,severity}`` families.  The flagship rule is the SRE-style
+  **multi-window SLO burn rate**: fire only when BOTH a fast window
+  (``ISTPU_BURN_FAST_S``, default 60 s — quick detection, quick
+  clearing) and a slow window (``ISTPU_BURN_SLOW_S``, default 600 s —
+  a momentary blip diluted over the slow window does not page) burn the
+  error budget faster than the threshold.
+* **Sampler** (``HealthSampler``): a background thread that runs the
+  registered probes once per ``ISTPU_HEALTH_STEP_S`` (default 1 s),
+  feeds the recorder, evaluates the rules, and serves the
+  ``GET /debug/health`` payload (alerts + ``?series=&limit=`` timeline
+  tail).  ``ISTPU_HEALTH=0`` is the kill switch.  Probes are plain
+  callables returning a number (or a dict of numbers); a raising probe
+  is counted and skipped — health watching must never take a serving
+  plane down.
+
+Severity semantics: a firing ``page``-severity alert flips the owning
+plane's ``/healthz`` to ``degraded`` (operators page on that); ``warn``
+rules surface in ``/debug/health`` and istpu-top without touching
+``/healthz``.  ``docs/runbook.md`` maps every rule below to the first
+``/debug/*`` endpoint to read when it fires.
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import math
+import os
+import threading
+import time
+import urllib.request
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from .utils import metrics as _metrics
+
+# -- knobs ------------------------------------------------------------------
+
+HEALTH_STEP_S_DEFAULT = 1.0
+BURN_FAST_S_DEFAULT = 60.0
+BURN_SLOW_S_DEFAULT = 600.0
+
+# tier shape: every sample lands raw; rollup tiers aggregate 10 and 60
+# consecutive base steps per bucket.  Caps bound memory per series:
+# 240 raw + 120 + 240 rollup points ≈ minutes of 1 s detail, hours of
+# 1 min history — fixed, regardless of uptime.
+TIER_ROLLUPS: Tuple[int, ...] = (10, 60)
+TIER_CAPS: Tuple[int, ...] = (240, 120, 240)
+
+SLO_BUDGET_FRAC = 0.1   # error budget: 10% of finishing requests may
+# miss their SLO before burn rate reads 1.0 (the SRE convention)
+BURN_THRESHOLD = 2.0    # both windows must burn ≥ 2x the budget rate
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def burn_windows() -> Tuple[float, float]:
+    """The (fast, slow) burn-rate windows in seconds, env-tunable."""
+    return (_env_float("ISTPU_BURN_FAST_S", BURN_FAST_S_DEFAULT),
+            _env_float("ISTPU_BURN_SLOW_S", BURN_SLOW_S_DEFAULT))
+
+
+# -- the flight recorder ----------------------------------------------------
+
+
+class _Tier:
+    """One rollup tier: closed buckets in a bounded deque plus the open
+    bucket still accumulating.  A bucket is
+    ``[t0, vmin, vmax, vlast, vsum, n]``."""
+
+    __slots__ = ("step", "dq", "open")
+
+    def __init__(self, step: float, cap: int):
+        self.step = step
+        self.dq: "deque" = deque(maxlen=cap)
+        self.open: Optional[list] = None
+
+    def observe(self, t: float, v: float) -> None:
+        t0 = math.floor(t / self.step) * self.step
+        if self.open is not None and self.open[0] != t0:
+            self.dq.append(tuple(self.open))
+            self.open = None
+        if self.open is None:
+            self.open = [t0, v, v, v, v, 1]
+        else:
+            o = self.open
+            o[1] = min(o[1], v)
+            o[2] = max(o[2], v)
+            o[3] = v
+            o[4] += v
+            o[5] += 1
+
+    def points(self) -> List[tuple]:
+        out = list(self.dq)
+        if self.open is not None:
+            out.append(tuple(self.open))
+        return out
+
+
+class _Series:
+    __slots__ = ("raw", "tiers", "first")
+
+    def __init__(self, step_s: float, rollups: Sequence[int],
+                 caps: Sequence[int]):
+        self.raw: "deque" = deque(maxlen=caps[0])
+        self.tiers = [
+            _Tier(step_s * mult, cap)
+            for mult, cap in zip(rollups, caps[1:])
+        ]
+        # the very first observation (t, v): value_at() for any time
+        # BEFORE it answers this value exactly — the correct pre-history
+        # stand-in for the monotone counters deltas are taken over
+        self.first: Optional[Tuple[float, float]] = None
+
+
+class TimeSeriesRing:
+    """The flight recorder: named series, raw tier + downsampled rollup
+    tiers, windowed reads that degrade from fine to coarse history.
+
+    Thread-safe (one lock); the clock is injectable and ``observe`` takes
+    an explicit ``t`` so tests drive deterministic timelines."""
+
+    def __init__(self, step_s: float = HEALTH_STEP_S_DEFAULT,
+                 rollups: Sequence[int] = TIER_ROLLUPS,
+                 caps: Sequence[int] = TIER_CAPS,
+                 clock: Callable[[], float] = time.time):
+        assert len(caps) == len(rollups) + 1
+        self.step_s = step_s
+        self._rollups = tuple(rollups)
+        self._caps = tuple(caps)
+        self._clock = clock
+        self._series: Dict[str, _Series] = {}
+        self._lock = threading.Lock()
+
+    def observe(self, name: str, value: float,
+                t: Optional[float] = None) -> None:
+        t = self._clock() if t is None else t
+        v = float(value)
+        with self._lock:
+            s = self._series.get(name)
+            if s is None:
+                s = self._series[name] = _Series(
+                    self.step_s, self._rollups, self._caps
+                )
+            if s.first is None:
+                s.first = (t, v)
+            s.raw.append((t, v))
+            for tier in s.tiers:
+                tier.observe(t, v)
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._series)
+
+    def latest(self, name: str) -> Optional[Tuple[float, float]]:
+        with self._lock:
+            s = self._series.get(name)
+            if s is None:
+                return None
+            if s.raw:
+                return s.raw[-1]
+            for tier in s.tiers:
+                pts = tier.points()
+                if pts:
+                    p = pts[-1]
+                    return (p[0], p[3])
+            return None
+
+    def _points(self, name: str, since: float) -> List[tuple]:
+        """Merged ``(t, vmin, vmax, vlast, vsum, n)`` points covering
+        ``[since, now]``, finest available data first: raw where it
+        reaches, then progressively coarser rollup buckets for the part
+        of the window raw has already forgotten."""
+        s = self._series.get(name)
+        if s is None:
+            return []
+        raw = [(t, v, v, v, v, 1) for t, v in s.raw if t >= since]
+        earliest = s.raw[0][0] if s.raw else float("inf")
+        head: List[tuple] = []
+        for tier in s.tiers:  # fine -> coarse
+            if earliest <= since:
+                break
+            # only buckets that END before the finer data begins: a
+            # bucket overlapping finer coverage would double-count the
+            # samples the finer tier already contributes
+            older = [p for p in tier.points()
+                     if p[0] >= since and p[0] + tier.step <= earliest]
+            head = older + head
+            if older:
+                earliest = older[0][0]
+        return sorted(head) + raw
+
+    def value_at(self, name: str, t_target: float) -> Optional[float]:
+        """The series value at-or-before ``t_target`` (bucket ``last``
+        for rolled-up history).  When the recorder holds nothing that
+        old, the OLDEST sample stands in — so a counter delta over a
+        window longer than the recorded history degrades to "delta since
+        recording began", which is the right answer for a fresh plane."""
+        with self._lock:
+            s = self._series.get(name)
+            if s is None:
+                return None
+            if s.first is not None and t_target < s.first[0]:
+                # genuinely before the series began: the first value IS
+                # the value then (a counter that hadn't counted yet)
+                return s.first[1]
+            if s.raw and s.raw[0][0] <= t_target:
+                ts = [t for t, _v in s.raw]
+                i = bisect.bisect_right(ts, t_target) - 1
+                return s.raw[i][1]
+            best: Optional[tuple] = None      # newest bucket <= target
+            oldest: Optional[tuple] = None    # absolute oldest bucket
+            for tier in s.tiers:
+                for p in tier.points():
+                    if oldest is None or p[0] < oldest[0]:
+                        oldest = p
+                    if p[0] <= t_target and (best is None
+                                             or p[0] > best[0]):
+                        best = p
+            if best is not None:
+                return best[3]
+            # after the series began but older than anything RETAINED
+            # (overflow dropped it): the oldest bucket's MIN stands in —
+            # for a monotone counter that is the bucket's first value
+            if s.raw and (oldest is None or s.raw[0][0] <= oldest[0]):
+                return s.raw[0][1]
+            return oldest[1] if oldest is not None else None
+
+    def delta(self, name: str, window_s: float,
+              now: Optional[float] = None) -> Optional[float]:
+        """Counter increase over the trailing window (clamped at 0 — a
+        counter reset reads as no increase, not a negative burn)."""
+        now = self._clock() if now is None else now
+        last = self.latest(name)
+        if last is None:
+            return None
+        then = self.value_at(name, now - window_s)
+        if then is None:
+            return None
+        return max(0.0, last[1] - then)
+
+    def mean(self, name: str, window_s: float,
+             now: Optional[float] = None) -> Optional[float]:
+        now = self._clock() if now is None else now
+        with self._lock:
+            pts = self._points(name, now - window_s)
+        n = sum(p[5] for p in pts)
+        if not n:
+            return None
+        return sum(p[4] for p in pts) / n
+
+    def max(self, name: str, window_s: float,
+            now: Optional[float] = None) -> Optional[float]:
+        now = self._clock() if now is None else now
+        with self._lock:
+            pts = self._points(name, now - window_s)
+        return max((p[2] for p in pts), default=None)
+
+    def slope(self, name: str, window_s: float,
+              now: Optional[float] = None) -> Optional[float]:
+        """Simple end-to-end slope (units/second) over the window —
+        enough to extrapolate a memory ramp toward its limit."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            pts = self._points(name, now - window_s)
+        if len(pts) < 2:
+            return None
+        dt = pts[-1][0] - pts[0][0]
+        if dt <= 0:
+            return None
+        return (pts[-1][3] - pts[0][3]) / dt
+
+    def changes(self, name: str, window_s: float,
+                now: Optional[float] = None) -> int:
+        """Adjacent-sample value changes in the window — the flap
+        counter (e.g. a circuit-state series transitioning)."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            pts = self._points(name, now - window_s)
+        vals = [p[3] for p in pts]
+        return sum(1 for a, b in zip(vals, vals[1:]) if a != b)
+
+    def tail(self, name: str,
+             limit: Optional[int] = None) -> List[Tuple[float, float]]:
+        """Newest raw samples (the ``?series=`` timeline payload)."""
+        with self._lock:
+            s = self._series.get(name)
+            pts = list(s.raw) if s is not None else []
+        if limit is not None and limit >= 0:
+            pts = pts[len(pts) - min(limit, len(pts)):]
+        return [(round(t, 3), v) for t, v in pts]
+
+    def dump(self, name: str) -> Dict[str, List[tuple]]:
+        """Every tier of one series (tests assert the rollup math)."""
+        with self._lock:
+            s = self._series.get(name)
+            if s is None:
+                return {}
+            out: Dict[str, List[tuple]] = {"raw": list(s.raw)}
+            for tier in s.tiers:
+                out[f"r{int(round(tier.step / self.step_s))}"] = \
+                    tier.points()
+            return out
+
+
+# -- watchdog rules ---------------------------------------------------------
+
+
+@dataclass
+class WatchdogRule:
+    """One declarative health rule.  ``check(ring, now)`` returns None
+    while healthy, else ``{"reason": str, "value": float}``.  The
+    sampler owns the firing/cleared state machine: a rule FIRES on the
+    first violating tick and CLEARS after ``clear_for_s`` consecutive
+    healthy seconds (hysteresis against boundary flapping)."""
+
+    name: str
+    severity: str = "warn"            # "page" flips /healthz degraded
+    check: Callable[[TimeSeriesRing, float], Optional[dict]] = None
+    clear_for_s: float = 0.0
+    description: str = ""
+
+
+def burn_rate_rule(name: str, viol_series: str, total_series: str,
+                   slo_frac: float = SLO_BUDGET_FRAC,
+                   threshold: float = BURN_THRESHOLD,
+                   fast_s: Optional[float] = None,
+                   slow_s: Optional[float] = None,
+                   severity: str = "page") -> WatchdogRule:
+    """Multi-window SLO burn rate (the SRE alerting pattern): burn =
+    (violations / finished) / budget over a window.  Fire only when the
+    FAST and the SLOW window both exceed ``threshold`` — fast alone
+    pages on every blip, slow alone takes the whole window to notice AND
+    to clear; together, detection and clearing both track the fast
+    window while the slow window filters noise."""
+    fast = fast_s if fast_s is not None else burn_windows()[0]
+    slow = slow_s if slow_s is not None else burn_windows()[1]
+
+    def check(ring: TimeSeriesRing, now: float) -> Optional[dict]:
+        dn_f = ring.delta(total_series, fast, now)
+        dn_s = ring.delta(total_series, slow, now)
+        if not dn_f or not dn_s:
+            return None  # no finishing traffic: nothing is burning
+        bf = (ring.delta(viol_series, fast, now) or 0.0) / dn_f / slo_frac
+        bs = (ring.delta(viol_series, slow, now) or 0.0) / dn_s / slo_frac
+        if bf >= threshold and bs >= threshold:
+            return {
+                "reason": (
+                    f"burning {bf:.1f}x ({int(fast)}s) / {bs:.1f}x "
+                    f"({int(slow)}s) of the {slo_frac:.0%} error budget"
+                ),
+                "value": round(min(bf, bs), 3),
+            }
+        return None
+
+    return WatchdogRule(
+        name, severity, check,
+        description=f"{viol_series}/{total_series} multi-window burn",
+    )
+
+
+def circuit_rule(state_series: str = "store.circuit",
+                 flap_n: int = 4,
+                 flap_window_s: Optional[float] = None,
+                 severity: str = "page") -> WatchdogRule:
+    """Fires while the store circuit is OPEN (code 1) or when the state
+    series changed ≥ ``flap_n`` times inside the flap window — a breaker
+    bouncing closed↔open↔half-open is a store that keeps half-dying,
+    which steady-state dashboards smooth over.  ``flap_n`` defaults to
+    4: ONE outage-and-recovery cycle is at most 3 changes
+    (closed→open→half-open→closed) and is recovery, not flapping.  The
+    window defaults to 5× the fast burn window (300 s at stock knobs),
+    so the whole rule family tightens together under the env knobs."""
+    window = (flap_window_s if flap_window_s is not None
+              else 5 * burn_windows()[0])
+
+    def check(ring: TimeSeriesRing, now: float) -> Optional[dict]:
+        last = ring.latest(state_series)
+        if last is not None and last[1] == 1.0:
+            return {"reason": "store circuit open", "value": 1.0}
+        flaps = ring.changes(state_series, window, now)
+        if flaps >= flap_n:
+            return {
+                "reason": f"circuit flapped {flaps} times in "
+                          f"{int(window)}s",
+                "value": float(flaps),
+            }
+        return None
+
+    return WatchdogRule("circuit_flap", severity, check,
+                        description="store circuit open or flapping")
+
+
+def spike_rule(name: str, series: str, threshold: float,
+               window_s: Optional[float] = None, severity: str = "warn",
+               what: str = "events") -> WatchdogRule:
+    """Counter increase ≥ ``threshold`` inside the (fast) window."""
+    window = window_s if window_s is not None else burn_windows()[0]
+
+    def check(ring: TimeSeriesRing, now: float) -> Optional[dict]:
+        d = ring.delta(series, window, now)
+        if d is not None and d >= threshold:
+            return {"reason": f"{int(d)} {what} in {int(window)}s",
+                    "value": d}
+        return None
+
+    return WatchdogRule(name, severity, check,
+                        description=f"{series} spike")
+
+
+def level_rule(name: str, series: str, threshold: float,
+               window_s: Optional[float] = None, severity: str = "warn",
+               what: str = "level") -> WatchdogRule:
+    """Windowed mean ≥ ``threshold`` (sustained-level gauge rules)."""
+    window = window_s if window_s is not None else burn_windows()[0]
+
+    def check(ring: TimeSeriesRing, now: float) -> Optional[dict]:
+        v = ring.mean(series, window, now)
+        if v is not None and v >= threshold:
+            return {"reason": f"{what} at {v:.2f} (≥{threshold:.2f}) "
+                              f"over {int(window)}s", "value": round(v, 4)}
+        return None
+
+    return WatchdogRule(name, severity, check,
+                        description=f"{series} sustained level")
+
+
+def streamer_rule(severity: str = "warn") -> WatchdogRule:
+    """The store streamer parked on an error, or a dropped-push spike:
+    KV pushes are silently not durable — future prefixes will miss."""
+    fast = burn_windows()[0]
+
+    def check(ring: TimeSeriesRing, now: float) -> Optional[dict]:
+        parked = ring.latest("store.streamer.parked")
+        if parked is not None and parked[1] >= 1.0:
+            return {"reason": "store streamer parked on an error",
+                    "value": 1.0}
+        d = ring.delta("store.push_dropped", fast, now)
+        if d is not None and d >= 4:
+            return {"reason": f"{int(d)} KV pushes dropped in "
+                              f"{int(fast)}s", "value": d}
+        return None
+
+    return WatchdogRule("streamer_stall", severity, check,
+                        description="parked streamer / dropped-push spike")
+
+
+def retrace_rule(severity: str = "warn") -> WatchdogRule:
+    """Retrace-rate regression: trace-cache misses during STEADY serving
+    mean shape-polymorphic churn is eating steps (warmup is excluded by
+    requiring real step progress alongside)."""
+    slow = burn_windows()[1]
+
+    def check(ring: TimeSeriesRing, now: float) -> Optional[dict]:
+        dr = ring.delta("engine.retraces", slow, now)
+        ds = ring.delta("engine.steps", slow, now)
+        if dr is not None and ds is not None and ds >= 20 and dr >= 25:
+            return {"reason": f"{int(dr)} retraces over {int(ds)} steps "
+                              f"in {int(slow)}s", "value": dr}
+        return None
+
+    return WatchdogRule("retrace_rate", severity, check,
+                        description="retraces during steady serving")
+
+
+def host_stall_rule(severity: str = "warn") -> WatchdogRule:
+    """Host-stall trend: the instantaneous stall fraction (windowed
+    deltas of the profiler's sampled stall/wall totals) running high AND
+    well above its slow-window norm — the step loop has gone
+    device-bound relative to its own recent history."""
+    fast, slow = burn_windows()
+
+    def check(ring: TimeSeriesRing, now: float) -> Optional[dict]:
+        dw_f = ring.delta("engine.sampled_wall_s", fast, now)
+        dw_s = ring.delta("engine.sampled_wall_s", slow, now)
+        if not dw_f or not dw_s:
+            return None
+        f = (ring.delta("engine.stall_s", fast, now) or 0.0) / dw_f
+        s = (ring.delta("engine.stall_s", slow, now) or 0.0) / dw_s
+        if f >= 0.75 and f >= 1.5 * s + 0.1:
+            return {"reason": f"host-stall frac {f:.2f} "
+                              f"(slow-window norm {s:.2f})",
+                    "value": round(f, 4)}
+        return None
+
+    return WatchdogRule("host_stall_trend", severity, check,
+                        description="sampled device-drain share trending up")
+
+
+def mem_slope_rule(horizon_s: float = 600.0,
+                   severity: str = "warn") -> WatchdogRule:
+    """Device-memory slope toward OOM: live bytes ramping such that the
+    backend's limit is reached within the horizon.  Needs a real
+    ``limit_bytes`` (TPU/GPU ``memory_stats``); the CPU live-array
+    fallback has no limit and never fires."""
+    slow = burn_windows()[1]
+
+    def check(ring: TimeSeriesRing, now: float) -> Optional[dict]:
+        lim = ring.latest("engine.mem.limit_bytes")
+        live = ring.latest("engine.mem.live_bytes")
+        if lim is None or live is None or lim[1] <= 0:
+            return None
+        sl = ring.slope("engine.mem.live_bytes", slow, now)
+        if sl is None or sl <= 0:
+            return None
+        t_to_oom = (lim[1] - live[1]) / sl
+        if 0 <= t_to_oom <= horizon_s:
+            return {"reason": f"device memory reaches its limit in "
+                              f"~{t_to_oom:.0f}s at the current slope",
+                    "value": round(t_to_oom, 1)}
+        return None
+
+    return WatchdogRule("device_mem_slope", severity, check,
+                        description="live device memory ramping to limit")
+
+
+def default_serve_rules() -> List[WatchdogRule]:
+    """The serving plane's watchdog set."""
+    return [
+        burn_rate_rule("ttft_burn", "serve.viol_ttft", "serve.finished"),
+        burn_rate_rule("tpot_burn", "serve.viol_tpot", "serve.decoded"),
+        circuit_rule(),
+        streamer_rule(),
+        spike_rule("integrity_spike", "store.integrity_failures",
+                   threshold=3, what="integrity failures"),
+        retrace_rule(),
+        host_stall_rule(),
+        mem_slope_rule(),
+    ]
+
+
+def default_store_rules() -> List[WatchdogRule]:
+    """The store manage plane's watchdog set (warn-severity: the store
+    ``/healthz`` already owns its hard degraded conditions)."""
+    return [
+        spike_rule("scrub_corrupt_spike", "store.scrub_corrupt",
+                   threshold=1, what="corrupt entries quarantined"),
+        spike_rule("evict_errors", "store.evict_errors",
+                   threshold=1, what="failed evict passes"),
+        level_rule("pool_pressure", "store.usage", threshold=0.97,
+                   what="pool occupancy"),
+        spike_rule("reap_spike", "store.reaped", threshold=8,
+                   what="reservations reaped"),
+    ]
+
+
+# -- probe construction -----------------------------------------------------
+
+_CIRCUIT_CODE = {"closed": 0.0, "open": 1.0, "half-open": 2.0,
+                 "partial": 3.0}
+
+
+def serve_probes(server) -> Dict[str, Callable[[], Any]]:
+    """The serving plane's probe set over live server state: scheduler
+    depths, SLO counters (this server's registry), circuit/streamer
+    state (this server's OWN engine — never the process-global breaker
+    gauges, which outlive dead test engines), step-profiler totals, and
+    the process-default resilience/integrity counters (delta-evaluated
+    only, so stale state from other engines cancels out)."""
+    sched = server.sched
+    eng = server.engine
+    prof = server.stepprof
+    sreg = server.metrics
+    dreg = _metrics.default_registry()
+
+    def circuit() -> Optional[float]:
+        br = getattr(eng, "breaker", None)
+        if br is None:
+            return None
+        return _CIRCUIT_CODE.get(getattr(br, "state", None))
+
+    def streamer() -> Optional[dict]:
+        st = getattr(eng, "_streamer", None)
+        if st is None:
+            return None
+        return {"backlog": st._q.qsize(),
+                "parked": 1.0 if st._err is not None else 0.0}
+
+    def finished() -> Optional[float]:
+        h = sreg.family_hist("istpu_serve_ttft_seconds")
+        return h[0] if h else None
+
+    def decoded() -> Optional[float]:
+        h = sreg.family_hist("istpu_serve_tpot_seconds")
+        return h[0] if h else None
+
+    return {
+        "serve.queue_depth": lambda: len(sched.pending),
+        "serve.inflight": lambda: (len(sched.active)
+                                   + len(sched._prefilling)),
+        "serve.requests": lambda: server.stats["requests"],
+        "serve.completed": lambda: server.stats["completed"],
+        "serve.free_pages": lambda: eng.free_pages,
+        "serve.finished": finished,
+        "serve.decoded": decoded,
+        # counter probes default to 0.0 (not None) so each series exists
+        # BEFORE its first event — a delta must see the whole burst, not
+        # start mid-burst at the first nonzero sample
+        "serve.viol_ttft": lambda: sreg.family_value(
+            "istpu_serve_slo_violations_total",
+            where={"slo": "ttft"}) or 0.0,
+        "serve.viol_tpot": lambda: sreg.family_value(
+            "istpu_serve_slo_violations_total",
+            where={"slo": "tpot"}) or 0.0,
+        "store.circuit": circuit,
+        "store.streamer": streamer,
+        "store.push_dropped": lambda: dreg.family_value(
+            "istpu_store_push_dropped_total") or 0.0,
+        "store.integrity_failures": lambda: dreg.family_value(
+            "istpu_integrity_failures_total") or 0.0,
+        "engine.steps": lambda: prof.steps,
+        "engine.retraces": lambda: _total_traces(),
+        # dict probe: fans out to engine.stall_s / engine.sampled_wall_s
+        "engine": lambda: _stall_probe(prof),
+        # dict probe: engine.mem.live_bytes / .peak_bytes / .limit_bytes
+        "engine.mem": lambda: prof.mem_last(),
+    }
+
+
+def _total_traces() -> int:
+    from .engine import stepprof as _sp
+
+    return _sp.total_traces()
+
+
+def _stall_probe(prof) -> dict:
+    stall, wall = prof.stall_totals()
+    return {"stall_s": stall, "sampled_wall_s": wall}
+
+
+def store_probes(server) -> Dict[str, Callable[[], Any]]:
+    """The store manage plane's probe set over live ``Store`` state."""
+    st = server.store
+
+    return {
+        "store.usage": st.usage,
+        "store.fragmentation": lambda: st.mm.frag_stats()["fragmentation"],
+        "store.leases": st.active_leases,
+        "store.entries": st.kvmap_len,
+        "store.pending": lambda: len(st.pending),
+        "store.evicted": lambda: st.stats.evicted,
+        "store.evict_errors": lambda: server._c_evict_err.value,
+        "store.reaped": lambda: st.stats.reservations_reaped,
+        "store.scrub_pages": lambda: st.stats.scrub_pages,
+        "store.scrub_corrupt": lambda: st.stats.scrub_corrupt,
+        "store.faults_armed": lambda: len(server.faults.snapshot()),
+    }
+
+
+# -- probe name flattening: a dict-returning probe fans out -----------------
+
+
+def _observe_probe(ring: TimeSeriesRing, name: str, value: Any,
+                   t: float) -> None:
+    if value is None:
+        return
+    if isinstance(value, dict):
+        for k, v in value.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                ring.observe(f"{name}.{k}", float(v), t)
+        return
+    ring.observe(name, float(value), t)
+
+
+# -- the sampler ------------------------------------------------------------
+
+
+class HealthSampler:
+    """Background sampler + watchdog evaluator + ``/debug/health``
+    snapshot source.  One per serving plane (``ServingServer``) and one
+    per store plane (``StoreServer``), each over its own probe set,
+    rules, and metrics registry.
+
+    ``tick()`` is callable directly (tests drive it with an injected
+    clock, no thread, no sleeps); ``start()`` runs it on a daemon thread
+    every ``step_s``, recording its own scheduling lag as the
+    ``health.tick_lag_s`` series — a sampler that can't keep a 1 s
+    cadence is itself evidence of a saturated host loop."""
+
+    def __init__(self, probes: Dict[str, Callable[[], Any]],
+                 rules: Sequence[WatchdogRule] = (),
+                 metrics: Optional[_metrics.MetricsRegistry] = None,
+                 step_s: Optional[float] = None,
+                 clock: Callable[[], float] = time.time,
+                 ring: Optional[TimeSeriesRing] = None,
+                 enabled: Optional[bool] = None):
+        self.enabled = (os.environ.get("ISTPU_HEALTH", "1") != "0"
+                        if enabled is None else enabled)
+        self.step_s = step_s if step_s is not None else _env_float(
+            "ISTPU_HEALTH_STEP_S", HEALTH_STEP_S_DEFAULT)
+        self.step_s = max(0.05, self.step_s)
+        self._clock = clock
+        self.ring = ring if ring is not None else TimeSeriesRing(
+            step_s=self.step_s, clock=clock)
+        self.probes = dict(probes)
+        self.rules = list(rules)
+        self.ticks = 0
+        self.probe_errors = 0
+        self._alerts: Dict[str, dict] = {}
+        self._transitions: "deque" = deque(maxlen=128)
+        self._lock = threading.Lock()
+        self._stop_evt = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.metrics = metrics if metrics is not None else \
+            _metrics.default_registry()
+        self._g_active = self.metrics.gauge(
+            "istpu_health_alert_active",
+            "Watchdog rule state: 1 while firing, 0 cleared "
+            "(docs/runbook.md maps each rule to its first debug read)",
+            labelnames=("rule",),
+        )
+        self._c_alerts = self.metrics.counter(
+            "istpu_health_alerts_total",
+            "Watchdog firing transitions, by rule and severity "
+            "(page-severity firings flip /healthz to degraded)",
+            labelnames=("rule", "severity"),
+        )
+        self._g_lag = self.metrics.gauge(
+            "istpu_health_sampler_lag_seconds",
+            "How late the last health sample tick ran vs its schedule — "
+            "a sampler that cannot hold its cadence is itself evidence "
+            "of a saturated host loop",
+        )
+        for rule in self.rules:
+            self._g_active.labels(rule.name).set(0)
+
+    # -- sampling --
+
+    def tick(self, now: Optional[float] = None) -> None:
+        """Run every probe, feed the recorder, evaluate the rules."""
+        if not self.enabled:
+            return
+        now = self._clock() if now is None else now
+        for name, fn in self.probes.items():
+            try:
+                _observe_probe(self.ring, name, fn(), now)
+            except Exception:  # noqa: BLE001 — a probe must never take
+                self.probe_errors += 1  # the plane down
+        self.ticks += 1
+        self._evaluate(now)
+
+    def _evaluate(self, now: float) -> None:
+        for rule in self.rules:
+            try:
+                res = rule.check(self.ring, now)
+            except Exception:  # noqa: BLE001 — same contract as probes
+                self.probe_errors += 1
+                res = None
+            with self._lock:
+                st = self._alerts.setdefault(rule.name, {
+                    "state": "ok", "severity": rule.severity,
+                    "since": None, "reason": None, "value": None,
+                    "peak": 0.0, "fired": 0, "cleared": 0,
+                    "healthy_since": None,
+                })
+                if res is not None:
+                    st["reason"] = res.get("reason")
+                    st["value"] = res.get("value")
+                    if isinstance(st["value"], (int, float)):
+                        st["peak"] = max(st["peak"], float(st["value"]))
+                    st["healthy_since"] = None
+                    if st["state"] != "firing":
+                        st["state"] = "firing"
+                        st["since"] = now
+                        st["fired"] += 1
+                        self._transitions.append({
+                            "t": round(now, 3), "rule": rule.name,
+                            "to": "firing", "severity": rule.severity,
+                            "reason": st["reason"],
+                        })
+                        self._g_active.labels(rule.name).set(1)
+                        self._c_alerts.labels(rule.name,
+                                              rule.severity).inc()
+                elif st["state"] == "firing":
+                    if st["healthy_since"] is None:
+                        st["healthy_since"] = now
+                    if now - st["healthy_since"] >= rule.clear_for_s:
+                        st["state"] = "ok"
+                        st["cleared"] += 1
+                        st["since"] = now
+                        self._transitions.append({
+                            "t": round(now, 3), "rule": rule.name,
+                            "to": "cleared", "severity": rule.severity,
+                        })
+                        self._g_active.labels(rule.name).set(0)
+
+    # -- lifecycle --
+
+    def start(self) -> None:
+        if not self.enabled or self._thread is not None:
+            return
+        self._stop_evt.clear()
+
+        def _run() -> None:
+            next_t = time.monotonic()
+            while not self._stop_evt.is_set():
+                lag = max(0.0, time.monotonic() - next_t)
+                self._g_lag.set(lag)
+                try:
+                    self.ring.observe("health.tick_lag_s", lag)
+                    self.tick()
+                except Exception:  # noqa: BLE001 — keep sampling
+                    self.probe_errors += 1
+                next_t += self.step_s
+                wait = next_t - time.monotonic()
+                if wait <= 0:
+                    next_t = time.monotonic() + self.step_s
+                    wait = self.step_s
+                if self._stop_evt.wait(wait):
+                    break
+
+        self._thread = threading.Thread(
+            target=_run, name="istpu-health", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop_evt.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=2)
+
+    # -- export --
+
+    def firing(self) -> List[dict]:
+        with self._lock:
+            return [
+                {"rule": name, "severity": st["severity"],
+                 "since": st["since"], "reason": st["reason"],
+                 "value": st["value"]}
+                for name, st in self._alerts.items()
+                if st["state"] == "firing"
+            ]
+
+    def page_firing(self) -> bool:
+        """Any PAGE-severity alert firing right now — the one bit
+        ``/healthz`` folds into its degraded verdict."""
+        return any(f["severity"] == "page" for f in self.firing())
+
+    def alerts_fired(self) -> int:
+        with self._lock:
+            return sum(st["fired"] for st in self._alerts.values())
+
+    def snapshot(self, series: Optional[Sequence[str]] = None,
+                 limit: Optional[int] = None) -> Dict[str, Any]:
+        """The ``GET /debug/health`` payload.  ``series`` names (comma
+        string or list) select timeline tails; ``limit`` caps points per
+        series (default 60)."""
+        if not self.enabled:
+            return {"enabled": False}
+        if isinstance(series, str):
+            series = [s for s in series.split(",") if s]
+        with self._lock:
+            alerts = {
+                name: {k: v for k, v in st.items()
+                       if k != "healthy_since"}
+                for name, st in self._alerts.items()
+            }
+            transitions = list(self._transitions)
+        out: Dict[str, Any] = {
+            "enabled": True,
+            "step_s": self.step_s,
+            "ticks": self.ticks,
+            "probe_errors": self.probe_errors,
+            "alerts": alerts,
+            "firing": sorted(n for n, a in alerts.items()
+                             if a["state"] == "firing"),
+            "alerts_fired": sum(a["fired"] for a in alerts.values()),
+            "transitions": transitions[-(limit or 32):],
+            "series": self.ring.names(),
+        }
+        if series:
+            n = 60 if limit is None else limit
+            out["timeline"] = {
+                name: self.ring.tail(name, n) for name in series
+            }
+        return out
+
+
+# -- cluster rollup ---------------------------------------------------------
+
+
+def fetch_json(url: str, timeout: float = 2.0) -> Optional[dict]:
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return json.loads(r.read())
+    except Exception:  # noqa: BLE001 — unreachable nodes degrade, below
+        return None
+
+
+def cluster_rollup(manage_urls: Sequence[str],
+                   timeout: float = 2.0) -> Dict[str, Any]:
+    """Poll every store node's manage plane (``/healthz`` +
+    ``/debug/health``) and fold the answers into one fleet verdict.
+    Unreachable nodes degrade the rollup instead of failing it — a node
+    the health plane cannot reach is exactly the node to surface."""
+    nodes: List[dict] = []
+    worst = "ok"
+    for url in manage_urls:
+        base = url if url.startswith("http") else f"http://{url}"
+        hz = fetch_json(base + "/healthz", timeout)
+        if hz is None:
+            nodes.append({"endpoint": url, "reachable": False,
+                          "status": "unreachable"})
+            worst = "degraded"
+            continue
+        node = {"endpoint": url, "reachable": True,
+                "status": hz.get("status", "?")}
+        dh = fetch_json(base + "/debug/health", timeout)
+        if dh is not None and dh.get("enabled"):
+            node["firing"] = dh.get("firing", [])
+            node["alerts_fired"] = dh.get("alerts_fired", 0)
+        if node["status"] != "ok" or node.get("firing"):
+            worst = "degraded"
+        nodes.append(node)
+    return {"status": worst, "nodes": nodes}

@@ -264,6 +264,18 @@ class StoreServer:
             fn=lambda: st.stats.scrub_corrupt)
         self._integrity_task = None
         self.faults = FaultInjector()
+        # fleet health plane, store half: the sampler feeds the flight
+        # recorder from cheap Store reads every ISTPU_HEALTH_STEP_S and
+        # evaluates the store watchdogs (scrub-corrupt spike, failing
+        # evict loop, pool pressure, reservation-reap spike); exported
+        # at the manage plane's GET /debug/health.  Built here, started
+        # by start() (ISTPU_HEALTH=0 kills it).
+        from .health import HealthSampler, default_store_rules, store_probes
+
+        self.health_sampler = HealthSampler(
+            probes=store_probes(self), rules=default_store_rules(),
+            metrics=self.metrics,
+        )
         env_faults = os.environ.get("ISTPU_FAULTS")
         if env_faults:
             try:
@@ -315,6 +327,7 @@ class StoreServer:
             self._handle_conn, host, self.config.service_port, reuse_address=True
         )
         self.start_integrity_worker()
+        self.health_sampler.start()
         Logger.info(f"pyserver listening on {host}:{self.config.service_port}")
 
     async def serve_forever(self) -> None:
@@ -384,6 +397,7 @@ class StoreServer:
         return rep
 
     async def close(self) -> None:
+        self.health_sampler.stop()
         if self._evict_task:
             self._evict_task.cancel()
         if self._integrity_task:

@@ -69,7 +69,8 @@ class ServingServer:
                  prefill_concurrency: int = 4,
                  slo_ttft_s: Optional[float] = None,
                  slo_tpot_s: Optional[float] = None,
-                 ledger_ring: Optional[int] = None):
+                 ledger_ring: Optional[int] = None,
+                 store_manage_endpoints: Optional[List[str]] = None):
         """``tokenizer``: any object with ``encode(str) -> [int]`` and
         ``decode([int]) -> str`` (an HF tokenizer qualifies) — enables
         string prompts, text responses, and string stop sequences.
@@ -111,6 +112,26 @@ class ServingServer:
                                slo_ttft_s=slo_ttft_s, slo_tpot_s=slo_tpot_s,
                                stepprof=self.stepprof)
         self._register_metrics()
+        # fleet health plane (infinistore_tpu/health.py): a background
+        # sampler feeds the flight-recorder ring from cheap probes every
+        # ISTPU_HEALTH_STEP_S and evaluates the watchdog rules; exported
+        # at GET /debug/health, folded into /healthz (a firing PAGE
+        # alert => degraded).  ISTPU_HEALTH=0 kills it.
+        from .health import (
+            HealthSampler,
+            default_serve_rules,
+            serve_probes,
+        )
+
+        self.health_sampler = HealthSampler(
+            probes=serve_probes(self), rules=default_serve_rules(),
+            metrics=self.metrics,
+        )
+        # store manage-plane endpoints ("host:manage_port") the health
+        # rollup polls — the serving side only knows SERVICE ports, so
+        # the manage plane must be named explicitly
+        # (--store-manage-endpoints / ISTPU_STORE_MANAGE_ENDPOINTS)
+        self.store_manage_endpoints = list(store_manage_endpoints or [])
         self._cv = threading.Condition()
         self._staged: List[Dict[str, Any]] = []   # submissions from handlers
         self._cancels: List[int] = []
@@ -144,9 +165,11 @@ class ServingServer:
         threading.Thread(
             target=self.httpd.serve_forever, name="istpu-http", daemon=True
         ).start()
+        self.health_sampler.start()
         Logger.info(f"serving {self.model_id} on :{self.port}")
 
     def close(self) -> None:
+        self.health_sampler.stop()
         with self._cv:
             self._stop = True
             self._cv.notify()
@@ -746,13 +769,18 @@ class ServingServer:
 
     def health(self) -> Dict[str, Any]:
         """The /healthz payload: ``degraded`` while the store circuit is
-        not closed or the last store flush failed — serving keeps
+        not closed, the last store flush failed, or a PAGE-severity
+        watchdog alert is firing (docs/runbook.md) — serving keeps
         answering (recompute path), but prefix reuse and KV durability
         are impaired and operators should look at the store tier."""
         br = getattr(self.engine, "breaker", None)
         circuit = br.state if br is not None else None
+        hs = self.health_sampler
+        firing = hs.firing() if hs.enabled else []
+        page = [f for f in firing if f["severity"] == "page"]
         degraded = (circuit not in (None, "closed")
-                    or self._degraded_reason is not None)
+                    or self._degraded_reason is not None
+                    or bool(page))
         out: Dict[str, Any] = {
             "status": "degraded" if degraded else "ok",
         }
@@ -760,6 +788,34 @@ class ServingServer:
             out["store_circuit"] = circuit
         if self._degraded_reason is not None:
             out["reason"] = self._degraded_reason
+        if hs.enabled:
+            out["alerts"] = {
+                "firing": len(firing), "page": len(page),
+                "rules": sorted(f["rule"] for f in firing),
+            }
+        return out
+
+    def debug_health(self, series: Optional[str] = None,
+                     limit: Optional[int] = None) -> Dict[str, Any]:
+        """The /debug/health payload: the sampler's alert/timeline
+        snapshot, plus the CLUSTER rollup — per-node circuit states from
+        the routed pool and, when store manage endpoints are configured,
+        each node's own /healthz + /debug/health verdicts (unreachable
+        nodes degrade the rollup instead of failing it)."""
+        from .health import cluster_rollup
+
+        out = self.health_sampler.snapshot(series=series, limit=limit)
+        cl = self.cluster_report()
+        cluster: Dict[str, Any] = {}
+        if cl.get("enabled"):
+            cluster["ring"] = [
+                {"endpoint": n["endpoint"], "state": n["state"]}
+                for n in cl.get("nodes", ())
+            ]
+        if self.store_manage_endpoints:
+            cluster.update(cluster_rollup(self.store_manage_endpoints))
+        if cluster:
+            out["cluster"] = cluster
         return out
 
     def debug_traces_json(self, limit: Optional[int] = None) -> str:
@@ -1094,6 +1150,22 @@ def _make_handler(server: ServingServer):
                 except (KeyError, ValueError, IndexError):
                     limit = None
                 self._json(200, server.stepprof.snapshot(limit=limit))
+            elif self.path.split("?", 1)[0] == "/debug/health":
+                # the fleet health plane: watchdog alerts (firing/
+                # cleared, transitions) + the flight recorder's series
+                # (?series=a,b selects timeline tails, ?limit=N caps
+                # points) + the cluster health rollup.  /healthz is the
+                # one-bit summary; this is the history behind it.
+                from urllib.parse import parse_qs, urlsplit
+
+                q = parse_qs(urlsplit(self.path).query)
+                try:
+                    limit = int(q["limit"][0])
+                except (KeyError, ValueError, IndexError):
+                    limit = None
+                series = q.get("series", [None])[0]
+                self._json(200, server.debug_health(series=series,
+                                                    limit=limit))
             elif self.path.split("?", 1)[0] == "/debug/cluster":
                 # the store-cluster view: ring ownership, per-node
                 # circuit state, request/replica-read counters, and the
@@ -1628,6 +1700,14 @@ def main(argv: Optional[List[str]] = None) -> None:
                     help="request-ledger ring capacity for "
                          "/debug/requests (default env "
                          "ISTPU_LEDGER_RING, else 256)")
+    ap.add_argument("--store-manage-endpoints", default=None,
+                    help="store MANAGE-plane endpoints "
+                         "(host:manage_port, comma-separated; default "
+                         "env ISTPU_STORE_MANAGE_ENDPOINTS) for the "
+                         "/debug/health cluster rollup and istpu-doctor "
+                         "node discovery — the serving side only knows "
+                         "service ports, so the manage plane is named "
+                         "explicitly")
     ap.add_argument("--log-level", default="info")
     args = ap.parse_args(argv)
     Logger.set_log_level(args.log_level)
@@ -1787,6 +1867,11 @@ def main(argv: Optional[List[str]] = None) -> None:
     if args.ngram_spec and draft_engine is not None:
         raise SystemExit("--ngram-spec and --draft-model are mutually "
                          "exclusive speculation modes")
+    manage_spec = args.store_manage_endpoints or os.environ.get(
+        "ISTPU_STORE_MANAGE_ENDPOINTS"
+    )
+    manage_eps = [e.strip() for e in (manage_spec or "").split(",")
+                  if e.strip()]
     srv = ServingServer(engine, host=args.host, port=args.port,
                         max_batch=args.max_batch, model_id=model_id,
                         tokenizer=tokenizer, draft_engine=draft_engine,
@@ -1795,7 +1880,8 @@ def main(argv: Optional[List[str]] = None) -> None:
                         ngram_spec=args.ngram_spec, spec_g=args.spec_g,
                         prefill_concurrency=args.prefill_concurrency,
                         slo_ttft_s=args.slo_ttft, slo_tpot_s=args.slo_tpot,
-                        ledger_ring=args.ledger_ring)
+                        ledger_ring=args.ledger_ring,
+                        store_manage_endpoints=manage_eps)
     srv.start()
     try:
         while True:

@@ -163,18 +163,44 @@ def _manage_handler(server_ref):
                 # liveness for probes/load-balancers (reference parity
                 # with InfiniStore's FastAPI manage plane), plus the
                 # degraded signal: armed fault rules / a failing evict
-                # loop mean the instance is deliberately or silently
-                # misbehaving (docs/robustness.md)
+                # loop / a firing PAGE-severity watchdog alert mean the
+                # instance is deliberately or silently misbehaving
+                # (docs/robustness.md, docs/runbook.md)
                 srv = server_ref()
                 degraded = bool(
                     srv is not None
                     and getattr(srv, "degraded", None)
                     and srv.degraded()
                 )
+                hs = getattr(srv, "health_sampler", None)
                 payload = {"status": "degraded" if degraded else "ok"}
+                if hs is not None and hs.enabled:
+                    firing = hs.firing()
+                    page = [f for f in firing
+                            if f["severity"] == "page"]
+                    if page:
+                        payload["status"] = "degraded"
+                    payload["alerts"] = {
+                        "firing": len(firing), "page": len(page),
+                        "rules": sorted(f["rule"] for f in firing),
+                    }
                 if srv is not None and hasattr(srv, "faults"):
                     payload["faults_armed"] = len(srv.faults.snapshot())
                 self._json(payload)
+            elif path == "/debug/health":
+                # the store half of the fleet health plane: watchdog
+                # alerts + the flight recorder's series (?series=a,b
+                # timeline tails, ?limit=N caps points).  Python
+                # backend only — the native runtime has no sampler.
+                srv = server_ref()
+                hs = getattr(srv, "health_sampler", None)
+                if hs is None:
+                    self._json({"error": "health plane requires the "
+                                         "python backend"}, 501)
+                else:
+                    series = query.get("series", [None])[0]
+                    limit = qint("limit", 0) or None
+                    self._json(hs.snapshot(series=series, limit=limit))
             elif path == "/faults":
                 srv = server_ref()
                 if srv is None or not hasattr(srv, "faults"):

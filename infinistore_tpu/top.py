@@ -4,9 +4,9 @@
         --store-url http://127.0.0.1:18080 --interval 1
 
 Polls the serving front-end's ``/metrics`` + ``/healthz`` +
-``/debug/requests`` + ``/debug/engine`` and the store manage plane's
-``/metrics`` + ``/debug/cache`` + ``/healthz`` and renders one screen
-per interval:
+``/debug/requests`` + ``/debug/engine`` + ``/debug/health`` and the
+store manage plane's ``/metrics`` + ``/debug/cache`` + ``/healthz`` and
+renders one screen per interval:
 pool occupancy, hit ratio, prefix-reuse token split, circuit/degraded
 state, the serving-SLO view (per-frame arrival/completion deltas,
 inflight and queue depth, a per-lane TTFT/TPOT table with sparklines and
@@ -78,7 +78,8 @@ class Snapshot:
                  integrity: Optional[dict] = None,
                  requests: Optional[dict] = None,
                  cluster: Optional[dict] = None,
-                 engine: Optional[dict] = None):
+                 engine: Optional[dict] = None,
+                 health: Optional[dict] = None):
         self.serve = serve_metrics or {}
         self.store = store_metrics or {}
         self.cache = cache
@@ -91,6 +92,8 @@ class Snapshot:
         self.cluster = cluster
         # the serving /debug/engine payload (step-profiler summary)
         self.engine = engine
+        # the serving /debug/health payload (watchdog alerts)
+        self.health = health
 
     def lanes(self) -> List[str]:
         """Priority lanes seen in the serving TTFT family, numeric
@@ -318,6 +321,38 @@ class Console:
         out.append(line)
         return out
 
+    def _alerts(self, snap: Snapshot) -> List[str]:
+        """The fleet-health row (serving /debug/health): firing watchdog
+        rules with severity and reason, plus the per-frame delta of
+        alert firing transitions — a rule that fired and cleared between
+        frames still shows as +N here."""
+        health = snap.health or {}
+        if not health.get("enabled"):
+            return []
+        alerts = health.get("alerts") or {}
+        firing = health.get("firing") or []
+        fired = health.get("alerts_fired", 0)
+        d_fired = self.deltas.setdefault("alerts_fired", _Delta()).update(
+            float(fired))
+        out = [""]
+        out.append(
+            "alerts   firing {:>3}  fired {:>4} ({}/frame)  "
+            "probe-errs {:>3}".format(
+                len(firing), int(fired),
+                "-" if d_fired is None else f"+{d_fired:.0f}",
+                int(health.get("probe_errors", 0)),
+            )
+        )
+        for rule in firing:
+            a = alerts.get(rule, {})
+            out.append(
+                "  ! {:20s} [{:4s}] {}".format(
+                    rule, str(a.get("severity", "?"))[:4],
+                    str(a.get("reason") or "firing"),
+                )
+            )
+        return out
+
     def _cluster(self, snap: Snapshot) -> List[str]:
         """The store-cluster section (serving /debug/cluster): one row
         per endpoint — circuit state, ring-ownership share, ok/error
@@ -451,6 +486,7 @@ class Console:
                    if pages is not None else "")
             )
         out.extend(self._serving_slo(snap))
+        out.extend(self._alerts(snap))
         out.extend(self._engine(snap))
         out.extend(self._cluster(snap))
         # -- latency sparklines --
@@ -518,6 +554,9 @@ def poll(serve_url: Optional[str], store_url: Optional[str]) -> Snapshot:
     engine = js(serve_url, "/debug/engine?limit=0")  # summary only
     if engine is not None and not engine.get("enabled"):
         engine = None  # profiler off (ISTPU_STEPPROF=0): no view
+    health = js(serve_url, "/debug/health")
+    if health is not None and not health.get("enabled"):
+        health = None  # health plane off (ISTPU_HEALTH=0): no row
     return Snapshot(
         serve_metrics=prom(serve_url, "/metrics"),
         store_metrics=prom(store_url, "/metrics"),
@@ -528,6 +567,7 @@ def poll(serve_url: Optional[str], store_url: Optional[str]) -> Snapshot:
         requests=js(serve_url, "/debug/requests?limit=8"),
         cluster=cluster,
         engine=engine,
+        health=health,
     )
 
 

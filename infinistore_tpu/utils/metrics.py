@@ -337,6 +337,47 @@ class MetricsRegistry:
         with self.lock:
             return frozenset(self._metrics)
 
+    def family_value(self, name: str,
+                     where: Optional[Dict[str, str]] = None,
+                     agg: str = "sum") -> Optional[float]:
+        """Scrape-free read of one counter/gauge family: the ``agg``
+        (``sum``/``max``) over its children, optionally restricted to
+        children whose labels match every ``where`` item.  The health
+        sampler polls families this way once per second — parsing the
+        whole text exposition per tick would be silly.  Returns None for
+        unknown names and histograms (use ``family_hist``)."""
+        with self.lock:
+            m = self._metrics.get(name)
+            if m is None or isinstance(m, Histogram):
+                return None
+            if getattr(m, "_fn", None) is not None:
+                return float(m._fn())
+            vals = []
+            for lv, child in m._children.items():
+                if where is not None:
+                    labels = dict(zip(m.labelnames, lv))
+                    if any(labels.get(k) != v for k, v in where.items()):
+                        continue
+                vals.append(child.value.v)
+            if not vals:
+                return None
+            return max(vals) if agg == "max" else sum(vals)
+
+    def family_hist(self, name: str) -> Optional[Tuple[float, float]]:
+        """``(count, sum)`` totals over a histogram family's children
+        (every label combination), or None when the family is absent —
+        the observation count is what windowed rates (e.g. the burn-rate
+        watchdog's "requests finished" denominator) are computed from."""
+        with self.lock:
+            m = self._metrics.get(name)
+            if not isinstance(m, Histogram):
+                return None
+            count = total = 0.0
+            for child in m._children.values():
+                count += child.count
+                total += child.sum
+            return count, total
+
     def to_prometheus_text(self, exclude=frozenset()) -> str:
         """Prometheus text exposition (version 0.0.4) of every family.
         ``exclude``: family names to skip — a server concatenating the

@@ -63,6 +63,11 @@ METRICS = {
     # is flagged here, not argued about
     "host_stall_frac": ("down", "serving host-stall frac"),
     "retraces_per_100_steps": ("down", "retraces / 100 steps"),
+    # the health plane's verdict on the serving run (bench_serve.py
+    # `health` block): watchdog firing transitions during the sweep —
+    # a round that starts paging under the same load is a regression
+    # even when the raw latency rows stay green
+    "alerts_fired": ("down", "serve alerts fired"),
     # the multi-node cluster leg (bench.py --endpoints N): aggregate
     # fleet bandwidth through the consistent-hash router
     "cluster_put_gbps": ("up", "cluster put GB/s (aggregate)"),

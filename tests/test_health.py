@@ -1,0 +1,746 @@
+"""Fleet health plane (infinistore_tpu/health.py + doctor.py).
+
+Pure halves first — downsampling tier roll-up, windowed reads across
+tier fallback, multi-window burn-rate evaluation, the watchdog
+firing/cleared state machine, ring overflow, ``?series=``/``?limit=``
+handling — all under an injected clock (no sleeps, no live server).
+Then the live halves: ``/debug/health`` on both planes, THE chaos-alert
+acceptance walk (FaultInjector outage under live load → circuit +
+burn-rate watchdogs fire and flip ``/healthz`` degraded within the fast
+window, then clear after recovery — asserted from scraped ``/metrics``
++ ``/debug/health``, the PR-3 chaos pattern), and the ``istpu-doctor``
+bundle whose ``SUMMARY.md`` joins a slow request to its ``step_ids``
+and trace id (ledger ↔ ``/debug/engine`` ↔ stitched trace).
+"""
+
+import http.client
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tarfile
+import time
+import urllib.request
+
+import pytest
+
+from infinistore_tpu.health import (
+    HealthSampler,
+    TimeSeriesRing,
+    WatchdogRule,
+    burn_rate_rule,
+    circuit_rule,
+    spike_rule,
+)
+from infinistore_tpu.utils import metrics as m
+from infinistore_tpu.utils.metrics import MetricsRegistry
+
+
+# ---------------------------------------------------------------------------
+# the flight recorder (pure, injected clock)
+# ---------------------------------------------------------------------------
+
+
+def test_ring_rollup_tiers_aggregate_correctly():
+    """Raw 1 s samples roll into 10-step and 60-step buckets whose
+    min/max/last/sum/count are exact."""
+    r = TimeSeriesRing(step_s=1.0, clock=lambda: 0.0)
+    for i in range(65):
+        r.observe("v", float(i), t=float(i))
+    d = r.dump("v")
+    assert len(d["raw"]) == 65
+    # first closed 10-step bucket covers samples 0..9
+    t0, vmin, vmax, vlast, vsum, n = d["r10"][0]
+    assert (t0, vmin, vmax, vlast, n) == (0.0, 0.0, 9.0, 9.0, 10)
+    assert vsum == sum(range(10))
+    # the 60-step tier: one closed bucket (0..59) + the open one
+    t0, vmin, vmax, vlast, vsum, n = d["r60"][0]
+    assert (t0, vmin, vmax, vlast, n) == (0.0, 0.0, 59.0, 59.0, 60)
+    assert d["r60"][-1][0] == 60.0  # open bucket holds 60..64
+
+
+def test_ring_overflow_is_fixed_memory():
+    """Every tier is capacity-bounded; overflow drops the OLDEST."""
+    r = TimeSeriesRing(step_s=1.0, rollups=(10,), caps=(8, 4),
+                       clock=lambda: 0.0)
+    for i in range(200):
+        r.observe("v", float(i), t=float(i))
+    d = r.dump("v")
+    assert len(d["raw"]) == 8 and d["raw"][0][0] == 192.0
+    assert len(d["r10"]) <= 5  # 4 closed (deque cap) + the open bucket
+    # a series the recorder never saw reads as absent, not zero
+    assert r.latest("nope") is None and r.delta("nope", 10) is None
+
+
+def test_windowed_reads_fall_back_to_rollup_tiers():
+    """delta/mean keep answering after raw history scrolled away, and a
+    window predating ALL history degrades to delta-since-start."""
+    r = TimeSeriesRing(step_s=1.0, rollups=(10,), caps=(5, 50),
+                       clock=lambda: 0.0)
+    for i in range(100):
+        r.observe("c", float(i), t=float(i))
+    # raw holds only 95..99; t-90 resolves through the 10-step tier
+    assert r.delta("c", 90, now=99.0) == pytest.approx(90.0)
+    # before everything: earliest value (0.0) stands in
+    assert r.value_at("c", -50.0) == 0.0
+    assert r.delta("c", 10_000, now=99.0) == pytest.approx(99.0)
+    # the window is inclusive at its left edge: [95, 99] -> mean 97
+    assert r.mean("c", 4, now=99.0) == pytest.approx(97.0)
+
+
+def test_changes_and_slope():
+    r = TimeSeriesRing(step_s=1.0, clock=lambda: 0.0)
+    for i, v in enumerate([0, 0, 1, 1, 2, 0, 0]):
+        r.observe("state", float(v), t=float(i))
+    assert r.changes("state", 100, now=6.0) == 3  # 0->1, 1->2, 2->0
+    for i in range(10):
+        r.observe("mem", 100.0 + 10.0 * i, t=10.0 + i)
+    assert r.slope("mem", 100, now=19.0) == pytest.approx(10.0)
+
+
+# ---------------------------------------------------------------------------
+# burn-rate math (pure)
+# ---------------------------------------------------------------------------
+
+
+def _feed(r, t, finished, viol):
+    r.observe("fin", float(finished), t=t)
+    r.observe("viol", float(viol), t=t)
+
+
+def test_burn_rate_requires_both_windows():
+    """An OLD burst (outside the fast window) must not fire even though
+    the slow window still burns; a live sustained burn fires; recovery
+    clears as soon as the fast window is clean."""
+    rule = burn_rate_rule("b", "viol", "fin", fast_s=10, slow_s=60)
+    r = TimeSeriesRing(step_s=1.0, clock=lambda: 0.0)
+    # t=0..9: a violation burst (every request misses)
+    fin = viol = 0
+    for t in range(10):
+        fin += 2
+        viol += 2
+        _feed(r, float(t), fin, viol)
+    assert rule.check(r, 9.0) is not None  # live burst: both windows burn
+    # t=10..39: healthy traffic; the burst ages out of the fast window
+    for t in range(10, 40):
+        fin += 2
+        _feed(r, float(t), fin, viol)
+    res = rule.check(r, 39.0)
+    assert res is None, res  # slow window still remembers; fast is clean
+    # no traffic at all -> nothing is burning (never fire on silence)
+    r2 = TimeSeriesRing(step_s=1.0, clock=lambda: 0.0)
+    assert rule.check(r2, 50.0) is None
+
+
+def test_burn_rate_threshold_and_budget_math():
+    """burn = (violations/finished)/budget per window; both ≥ threshold
+    fires, reported value = min(fast, slow)."""
+    rule = burn_rate_rule("b", "viol", "fin", slo_frac=0.1,
+                          threshold=2.0, fast_s=10, slow_s=10)
+    r = TimeSeriesRing(step_s=1.0, clock=lambda: 0.0)
+    fin = viol = 0
+    for t in range(10):
+        fin += 10
+        viol += 3  # 30% violating = 3.0x the 10% budget
+        _feed(r, float(t), fin, viol)
+    res = rule.check(r, 9.0)
+    assert res is not None and res["value"] == pytest.approx(3.0, rel=0.2)
+    # 15% violating = 1.5x budget: under the 2x threshold
+    r2 = TimeSeriesRing(step_s=1.0, clock=lambda: 0.0)
+    fin = viol = 0
+    for t in range(10):
+        fin += 20
+        viol += 3
+        _feed(r2, float(t), fin, viol)
+    assert rule.check(r2, 9.0) is None
+
+
+def test_circuit_rule_open_and_flap():
+    rule = circuit_rule(flap_n=4, flap_window_s=100)
+    r = TimeSeriesRing(step_s=1.0, clock=lambda: 0.0)
+    for t in range(5):
+        r.observe("store.circuit", 0.0, t=float(t))
+    assert rule.check(r, 4.0) is None
+    r.observe("store.circuit", 1.0, t=5.0)  # open
+    res = rule.check(r, 5.0)
+    assert res is not None and "open" in res["reason"]
+    # one outage cycle (closed->open->half-open->closed = 3 changes)
+    # is recovery, not flapping...
+    r.observe("store.circuit", 2.0, t=6.0)
+    r.observe("store.circuit", 0.0, t=7.0)
+    assert rule.check(r, 7.0) is None
+    # ...a second cycle inside the window IS flapping
+    r.observe("store.circuit", 1.0, t=8.0)
+    r.observe("store.circuit", 0.0, t=9.0)
+    res = rule.check(r, 9.0)
+    assert res is not None and "flapped" in res["reason"]
+
+
+# ---------------------------------------------------------------------------
+# the sampler + watchdog state machine (pure, injected clock)
+# ---------------------------------------------------------------------------
+
+
+def test_sampler_fire_clear_transitions_and_metrics():
+    """Probes feed the ring, rules fire and clear with hysteresis, the
+    istpu_health_* families track transitions, and the snapshot carries
+    fired counts + peak values."""
+    now = [0.0]
+    state = {"viol": 0.0, "fin": 0.0}
+    reg = MetricsRegistry()
+    sampler = HealthSampler(
+        probes={"fin": lambda: state["fin"],
+                "viol": lambda: state["viol"],
+                "boom": lambda: 1 / 0},  # a raising probe is skipped
+        rules=[burn_rate_rule("burn", "viol", "fin",
+                              fast_s=5, slow_s=20),
+               spike_rule("spike", "viol", threshold=100, window_s=5)],
+        metrics=reg, clock=lambda: now[0], enabled=True, step_s=1.0,
+    )
+    for i in range(5):  # healthy traffic
+        now[0] = float(i)
+        state["fin"] += 10
+        sampler.tick()
+    assert sampler.firing() == [] and sampler.probe_errors >= 5
+    for i in range(5, 10):  # every request violates
+        now[0] = float(i)
+        state["fin"] += 10
+        state["viol"] += 10
+        sampler.tick()
+    firing = sampler.firing()
+    assert [f["rule"] for f in firing] == ["burn"]
+    assert firing[0]["severity"] == "page" and sampler.page_firing()
+    text = reg.to_prometheus_text()
+    assert 'istpu_health_alert_active{rule="burn"} 1' in text
+    assert ('istpu_health_alerts_total{rule="burn",severity="page"} 1'
+            in text)
+    # recovery: healthy fast window clears it
+    for i in range(10, 18):
+        now[0] = float(i)
+        state["fin"] += 10
+        sampler.tick()
+    assert sampler.firing() == [] and not sampler.page_firing()
+    assert 'istpu_health_alert_active{rule="burn"} 0' in \
+        reg.to_prometheus_text()
+    snap = sampler.snapshot()
+    assert snap["alerts"]["burn"]["fired"] == 1
+    assert snap["alerts"]["burn"]["cleared"] == 1
+    assert snap["alerts"]["burn"]["peak"] >= 2.0
+    assert snap["alerts_fired"] == 1
+    tos = [t["to"] for t in snap["transitions"]
+           if t["rule"] == "burn"]
+    assert tos == ["firing", "cleared"]
+
+
+def test_clear_hysteresis_holds_until_clear_for_s():
+    now = [0.0]
+    bad = [True]
+    rule = WatchdogRule(
+        "r", "warn",
+        check=lambda ring, t: {"reason": "x"} if bad[0] else None,
+        clear_for_s=5.0,
+    )
+    sampler = HealthSampler(probes={}, rules=[rule],
+                            metrics=MetricsRegistry(),
+                            clock=lambda: now[0], enabled=True)
+    sampler.tick()
+    assert [f["rule"] for f in sampler.firing()] == ["r"]
+    bad[0] = False
+    for t in (1.0, 3.0, 4.9):
+        now[0] = t
+        sampler.tick()
+        assert sampler.firing(), "must hold through the hysteresis window"
+    now[0] = 6.0
+    sampler.tick()
+    assert sampler.firing() == []
+
+
+def test_snapshot_series_limit_and_kill_switch(monkeypatch):
+    now = [0.0]
+    sampler = HealthSampler(probes={"a": lambda: now[0],
+                                    "b": lambda: 1.0},
+                            metrics=MetricsRegistry(),
+                            clock=lambda: now[0], enabled=True)
+    for i in range(30):
+        now[0] = float(i)
+        sampler.tick()
+    snap = sampler.snapshot(series="a,b", limit=5)
+    assert set(snap["timeline"]) == {"a", "b"}
+    assert len(snap["timeline"]["a"]) == 5
+    assert snap["timeline"]["a"][-1][1] == 29.0
+    assert "a" in snap["series"] and snap["ticks"] == 30
+    # no series asked for -> no timeline key (alerts stay cheap to poll)
+    assert "timeline" not in sampler.snapshot()
+    # kill switch: the sampler is inert and says so
+    monkeypatch.setenv("ISTPU_HEALTH", "0")
+    off = HealthSampler(probes={"a": lambda: 1.0},
+                        metrics=MetricsRegistry())
+    assert off.enabled is False
+    off.tick()
+    off.start()
+    assert off.snapshot() == {"enabled": False} and off.ticks == 0
+    assert off._thread is None
+
+
+def test_env_knobs(monkeypatch):
+    monkeypatch.setenv("ISTPU_HEALTH_STEP_S", "0.5")
+    monkeypatch.setenv("ISTPU_BURN_FAST_S", "7")
+    monkeypatch.setenv("ISTPU_BURN_SLOW_S", "77")
+    sampler = HealthSampler(probes={}, metrics=MetricsRegistry())
+    assert sampler.step_s == 0.5
+    from infinistore_tpu.health import burn_windows
+
+    assert burn_windows() == (7.0, 77.0)
+
+
+# ---------------------------------------------------------------------------
+# doctor summary (pure)
+# ---------------------------------------------------------------------------
+
+
+def _plane(url, entries):
+    out = {"url": url}
+    for name, path, fname, payload in entries:
+        data = json.dumps(payload).encode() if payload is not None else None
+        out[name] = {"path": path, "file": fname, "ok": data is not None,
+                     "error": None if data is not None else "unreachable",
+                     "bytes": len(data or b""), "data": data}
+    return out
+
+
+def test_doctor_summary_joins_requests_to_steps(tmp_path):
+    """summarize_capture joins the slowest ledger record to its step
+    records and trace id, and write_bundle round-trips through the
+    tarball with a manifest that names every capture."""
+    from infinistore_tpu.doctor import (
+        SERVE_ENDPOINTS,
+        STORE_ENDPOINTS,
+        summarize_capture,
+        write_bundle,
+    )
+
+    requests = {"records": [
+        {"req_id": 7, "lane": "0", "outcome": "done", "e2e_s": 1.75,
+         "ttft_s": 1.2, "trace_id": "abcd-42", "step_ids": [11, 12],
+         "shares": {"queue": 0.1, "store": 0.0, "prefill": 0.6,
+                    "decode": 0.3}},
+        {"req_id": 8, "lane": "0", "outcome": "done", "e2e_s": 0.01,
+         "ttft_s": 0.005, "trace_id": "abcd-50", "step_ids": [13]},
+    ]}
+    engine = {
+        "records": [
+            {"step": 11, "kind": "prefill", "dur_s": 1.1,
+             "dispatches": {"prefill": 4}, "tokens": 0,
+             "host_stall_s": 0.4},
+            {"step": 12, "kind": "decode", "dur_s": 0.5,
+             "dispatches": {"decode": 2}, "tokens": 8},
+        ],
+        "summary": {"steps": 12, "host_stall_frac": 0.3,
+                    "retraces_per_100_steps": 8.0,
+                    "retraces": {"decode_many": 3, "prefill_forward": 1}},
+    }
+    health = {"enabled": True, "firing": ["ttft_burn"],
+              "alerts_fired": 2,
+              "alerts": {"ttft_burn": {"severity": "page",
+                                       "reason": "burning 5x"}}}
+    serve_payloads = {
+        "/metrics": None, "/healthz": {"status": "degraded"},
+        "/debug/requests": requests, "/debug/engine": engine,
+        "/debug/traces": {"traceEvents": []},
+        "/debug/cluster": {"enabled": False}, "/debug/health": health,
+    }
+    cap = {
+        "fetched_at": 1754000000.0,
+        "serve": _plane("http://s:8000", [
+            (name, path, fname, serve_payloads[path])
+            for name, path, fname in SERVE_ENDPOINTS
+        ]),
+        "stores": [_plane("http://st:18080", [
+            (name, path, fname, None)  # fully unreachable node
+            for name, path, fname in STORE_ENDPOINTS
+        ])],
+    }
+    text = summarize_capture(cap)
+    # the join: the slowest request, its trace id, its step ids, and the
+    # per-step engine records under it
+    assert "req 7" in text and "trace_id abcd-42" in text
+    assert "step_ids 11,12" in text
+    assert "step 11: kind=prefill" in text and "host_stall 0.400s" in text
+    assert "step 12: kind=decode" in text
+    assert "**ttft_burn** [page]" in text and "burning 5x" in text
+    assert "decode_many: 3" in text
+    assert "UNREACHABLE" in text  # the dead store degrades, not fails
+    out = tmp_path / "bundle.tar.gz"
+    manifest = write_bundle(cap, str(out))
+    with tarfile.open(out) as tar:
+        names = set(tar.getnames())
+        assert {"SUMMARY.md", "manifest.json"} <= names
+        assert "serve/debug_requests.json" in names
+        back = tar.extractfile("SUMMARY.md").read().decode()
+    assert back == text
+    assert manifest["stores"][0]["endpoints"][0]["ok"] is False
+
+
+# ---------------------------------------------------------------------------
+# live halves: serve + store planes, the chaos walk, the doctor bundle
+# ---------------------------------------------------------------------------
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+import infinistore_tpu as ist  # noqa: E402
+from infinistore_tpu.engine import InferenceEngine  # noqa: E402
+from infinistore_tpu.kv import PagedCacheConfig  # noqa: E402
+from infinistore_tpu.models import TINY, init_params, scaled  # noqa: E402
+from infinistore_tpu.serve import ServingServer  # noqa: E402
+
+CFG = scaled(TINY, dtype=jnp.float32)
+PARAMS = init_params(CFG, jax.random.PRNGKey(7))
+T = 4
+PROMPT = [11, 42, 7, 99, 5, 3, 17, 28, 64, 1, 2]
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _boot(port, mport, extra_env=None):
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "infinistore_tpu.server",
+         "--service-port", str(port), "--manage-port", str(mport),
+         "--prealloc-size", "1", "--minimal-allocate-size", "16",
+         "--log-level", "warning", "--backend", "python"],
+        env={**os.environ, "JAX_PLATFORMS": "cpu", **(extra_env or {})},
+    )
+    deadline = time.time() + 25
+    for p in (port, mport):
+        while True:
+            if proc.poll() is not None:
+                pytest.fail("server process failed to start")
+            try:
+                socket.create_connection(("127.0.0.1", p),
+                                         timeout=0.5).close()
+                break
+            except OSError:
+                if time.time() >= deadline:
+                    proc.kill()
+                    pytest.fail(f"server port {p} did not come up")
+                time.sleep(0.1)
+    return proc
+
+
+def _stop(proc):
+    proc.send_signal(signal.SIGINT)
+    try:
+        proc.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+
+
+def _arm(mport, rules):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{mport}/faults", method="POST",
+        data=json.dumps(rules).encode(),
+    )
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return json.load(r)
+
+
+def _post(port, body, timeout=180, path="/v1/completions"):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    conn.request("POST", path, json.dumps(body),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, json.loads(data)
+
+
+def _get(port, path):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, data
+
+
+def make_pc(n_blocks=128):
+    return PagedCacheConfig(
+        n_layers=CFG.n_layers, n_kv_heads=CFG.n_kv_heads,
+        head_dim=CFG.head_dim, n_blocks=n_blocks, block_tokens=T,
+        dtype=CFG.dtype,
+    )
+
+
+HEALTH_ENV = {
+    # tight windows so the chaos walk fires and clears in test time:
+    # 0.2 s sampling, 3 s fast / 15 s slow burn windows
+    "ISTPU_HEALTH_STEP_S": "0.2",
+    "ISTPU_BURN_FAST_S": "3",
+    "ISTPU_BURN_SLOW_S": "15",
+}
+
+
+@pytest.fixture(scope="module")
+def health_stack():
+    """A serving server (tight SLO, fast health windows) attached to a
+    dedicated store subprocess whose manage endpoint is registered for
+    the cluster rollup — the stack the chaos walk and the doctor run
+    against."""
+    old = {k: os.environ.get(k) for k in HEALTH_ENV}
+    os.environ.update(HEALTH_ENV)
+    port, mport = _free_port(), _free_port()
+    proc = _boot(port, mport, extra_env=HEALTH_ENV)
+    conn = ist.InfinityConnection(ist.ClientConfig(
+        host_addr="127.0.0.1", service_port=port,
+        connection_type=ist.TYPE_SHM, op_timeout_s=0.6,
+        log_level="error",
+    ))
+    conn.connect()
+    eng = InferenceEngine(
+        PARAMS, CFG, make_pc(), conn=conn, model_id="health-serve",
+        store_durability="relaxed",
+    )
+    eng.decode_chunk = 4
+    eng.breaker.failure_threshold = 2
+    eng.breaker.cooldown_s = 1.0
+    srv = ServingServer(
+        eng, port=0, max_batch=4, model_id="health-serve",
+        slo_ttft_s=0.3,
+        store_manage_endpoints=[f"127.0.0.1:{mport}"],
+    )
+    srv.start()
+    yield srv, proc, port, mport
+    srv.close()
+    conn.close()
+    _stop(proc)
+    for k, v in old.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+
+def _health(srv_port):
+    st, data = _get(srv_port, "/debug/health")
+    assert st == 200
+    return json.loads(data)
+
+
+def _wait_firing(srv_port, rule, want=True, deadline_s=15.0,
+                 tick=None):
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        h = _health(srv_port)
+        if (rule in h.get("firing", [])) == want:
+            return h
+        if tick is not None:
+            tick()
+        time.sleep(0.2)
+    return _health(srv_port)
+
+
+def test_serve_debug_health_live(health_stack):
+    """The serving /debug/health: sampler running, series recorded,
+    ?series=/?limit= honored, the cluster rollup reaches the store's
+    manage plane, and the istpu_health_* families are on /metrics."""
+    srv, _proc, _port, mport = health_stack
+    n = [0]
+
+    def ask():
+        p = [60 + n[0]] + PROMPT[1:]
+        n[0] += 1
+        st, body = _post(srv.port, {"prompt": p, "max_tokens": 4,
+                                    "temperature": 0})
+        assert st == 200, body
+
+    ask()
+    time.sleep(0.8)  # a few sampler ticks
+    h = _health(srv.port)
+    assert h["enabled"] and h["ticks"] >= 2
+    assert "serve.finished" in h["series"]
+    assert {"ttft_burn", "tpot_burn", "circuit_flap",
+            "streamer_stall"} <= set(h["alerts"])
+    st, data = _get(srv.port,
+                    "/debug/health?series=serve.finished&limit=3")
+    tl = json.loads(data)["timeline"]["serve.finished"]
+    assert 1 <= len(tl) <= 3
+    # cluster rollup polled the store's manage plane
+    assert h["cluster"]["nodes"][0]["endpoint"] == f"127.0.0.1:{mport}"
+    assert h["cluster"]["nodes"][0]["reachable"] is True
+    # store-side plane answers too
+    raw = urllib.request.urlopen(
+        f"http://127.0.0.1:{mport}/debug/health?series=store.usage&limit=2",
+        timeout=10,
+    ).read()
+    sh = json.loads(raw)
+    assert sh["enabled"] and "store.usage" in sh["series"]
+    assert "pool_pressure" in sh["alerts"]
+    assert len(sh["timeline"]["store.usage"]) <= 2
+    # metric families on both expositions
+    st, data = _get(srv.port, "/metrics")
+    assert b"istpu_health_alert_active" in data
+    assert b"istpu_health_sampler_lag_seconds" in data
+    mtext = urllib.request.urlopen(
+        f"http://127.0.0.1:{mport}/metrics", timeout=10).read()
+    assert b"istpu_health_alert_active" in mtext
+
+
+def test_chaos_outage_fires_burn_and_circuit_then_clears(health_stack):
+    """THE acceptance chaos walk: a FaultInjector store outage under
+    live load makes the burn-rate and circuit watchdogs fire in
+    /debug/health and flip /healthz degraded within the fast window,
+    then clear after recovery — asserted from scraped /metrics +
+    /debug/health."""
+    srv, _proc, _port, mport = health_stack
+    n = [100]
+
+    def ask():
+        p = [50 + n[0] % 400] + PROMPT[1:]
+        n[0] += 1
+        st, body = _post(srv.port, {"prompt": p, "max_tokens": 4,
+                                    "temperature": 0})
+        assert st == 200, body
+
+    # phase 0: healthy traffic, then let the first-compile TTFT blip age
+    # out of the 3 s fast window so the baseline is clean
+    for _ in range(3):
+        ask()
+    h = _wait_firing(srv.port, "ttft_burn", want=False, deadline_s=10)
+    assert "ttft_burn" not in h["firing"], h["alerts"]["ttft_burn"]
+    st, data = _get(srv.port, "/healthz")
+    assert json.loads(data)["status"] == "ok", data
+
+    # phase 1: the store answers LATE (0.45 s per op — an outage that
+    # breaks the SLO without tripping the breaker): every request's
+    # lookup drags TTFT past the 0.3 s target -> burn-rate fires
+    _arm(mport, [{"op": "*", "action": "delay", "delay_s": 0.45}])
+    for _ in range(6):
+        ask()
+    h = _wait_firing(srv.port, "ttft_burn", want=True, deadline_s=10,
+                     tick=ask)
+    assert "ttft_burn" in h["firing"], h["alerts"]["ttft_burn"]
+    burn = h["alerts"]["ttft_burn"]
+    assert burn["severity"] == "page" and burn["peak"] >= 2.0
+
+    # a firing page alert flips /healthz degraded (the circuit is still
+    # CLOSED — this degradation is the health plane's own verdict)
+    st, data = _get(srv.port, "/healthz")
+    hz = json.loads(data)
+    assert hz["status"] == "degraded", hz
+    assert "ttft_burn" in hz["alerts"]["rules"], hz
+    assert hz.get("store_circuit", "closed") == "closed", hz
+
+    # phase 2: the store HANGS -> breaker opens -> the circuit watchdog
+    # fires on the state the sampler recorded
+    _arm(mport, [{"op": "*", "action": "stall"}])
+    for _ in range(3):
+        ask()  # completes via recompute; failures feed the breaker
+    deadline = time.time() + 10
+    while srv.engine.breaker.state != "open" and time.time() < deadline:
+        ask()
+        time.sleep(0.05)
+    assert srv.engine.breaker.state == "open"
+    h = _wait_firing(srv.port, "circuit_flap", want=True, deadline_s=10)
+    assert "circuit_flap" in h["firing"], h["alerts"]["circuit_flap"]
+    assert "open" in h["alerts"]["circuit_flap"]["reason"]
+
+    # the whole verdict is scrapeable from /metrics (the PR-3 pattern)
+    st, data = _get(srv.port, "/metrics")
+    parsed = m.parse_prometheus_text(data.decode())
+    assert parsed.get(("istpu_health_alert_active",
+                       (("rule", "ttft_burn"),))) == 1.0
+    assert parsed.get(("istpu_health_alert_active",
+                       (("rule", "circuit_flap"),))) == 1.0
+    assert parsed.get(("istpu_health_alerts_total",
+                       (("rule", "ttft_burn"),
+                        ("severity", "page")))) >= 1.0
+
+    # phase 3: recovery — faults cleared, the circuit closes on the
+    # half-open probe, healthy traffic flushes the fast window, and
+    # every watchdog clears
+    _arm(mport, [])
+    time.sleep(srv.engine.breaker.cooldown_s + 0.1)
+    deadline = time.time() + 30
+    while srv.engine.breaker.state != "closed" and time.time() < deadline:
+        ask()
+        time.sleep(0.05)
+    assert srv.engine.breaker.state == "closed"
+    h = _wait_firing(srv.port, "ttft_burn", want=False, deadline_s=25,
+                     tick=ask)
+    assert "ttft_burn" not in h["firing"], h["alerts"]["ttft_burn"]
+    # the flap branch may truthfully hold while the outage's state
+    # changes are still inside its window (5x fast = 15 s here); it must
+    # age out and clear well inside the deadline
+    h = _wait_firing(srv.port, "circuit_flap", want=False, deadline_s=30)
+    assert "circuit_flap" not in h["firing"], h["alerts"]["circuit_flap"]
+    # fired AND cleared transitions are on the record
+    tos = {(t["rule"], t["to"]) for t in h["transitions"]}
+    assert ("ttft_burn", "firing") in tos and ("ttft_burn", "cleared") in tos
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        st, data = _get(srv.port, "/healthz")
+        hz = json.loads(data)
+        if hz["status"] == "ok":
+            break
+        time.sleep(0.3)
+    assert hz["status"] == "ok", hz
+    assert parsed.get(("istpu_health_alerts_total",
+                       (("rule", "circuit_flap"),
+                        ("severity", "page")))) >= 1.0
+
+
+def test_doctor_bundle_joins_slow_request_to_steps(health_stack,
+                                                   tmp_path):
+    """THE doctor acceptance: one istpu-doctor invocation against the
+    live serve (+store, auto-discovered from the cluster rollup)
+    produces a bundle whose SUMMARY.md joins at least one slow request
+    to its step_ids and trace id — read back from the tarball."""
+    from infinistore_tpu import doctor
+
+    srv, _proc, _port, mport = health_stack
+    for i in range(3):
+        st, body = _post(srv.port, {"prompt": [200 + i] + PROMPT[1:],
+                                    "max_tokens": 6, "temperature": 0})
+        assert st == 200, body
+    time.sleep(0.6)  # sampler ticks + ledger settles
+    out = tmp_path / "incident.tar.gz"
+    rc = doctor.main(["--serve-url", f"http://127.0.0.1:{srv.port}",
+                      "--out", str(out)])
+    assert rc == 0 and out.exists()
+    with tarfile.open(out) as tar:
+        names = set(tar.getnames())
+        summary = tar.extractfile("SUMMARY.md").read().decode()
+        manifest = json.load(tar.extractfile("manifest.json"))
+        requests = json.load(tar.extractfile("serve/debug_requests.json"))
+        engine = json.load(tar.extractfile("serve/debug_engine.json"))
+    # the store's manage plane was DISCOVERED from the serve rollup
+    assert any(name.startswith("store-0/") for name in names), names
+    assert "serve/debug_health.json" in names
+    assert manifest["stores"][0]["url"].endswith(str(mport))
+    # the join, asserted against the live payloads: the slowest ledger
+    # record's trace id and step ids all appear in SUMMARY.md, and its
+    # steps resolve in the captured /debug/engine ring
+    recs = [r for r in requests["records"] if r.get("e2e_s") is not None]
+    assert recs, requests
+    slowest = max(recs, key=lambda r: r["e2e_s"])
+    assert slowest["trace_id"] and slowest["step_ids"], slowest
+    assert f"trace_id {slowest['trace_id']}" in summary
+    joined = ",".join(str(s) for s in slowest["step_ids"])
+    assert f"step_ids {joined}" in summary
+    known_steps = {r.get("step") for r in engine["records"]}
+    assert set(slowest["step_ids"][-3:]) & known_steps
+    for sid in slowest["step_ids"][-3:]:
+        if sid in known_steps:
+            assert f"step {sid}:" in summary
+    # per-endpoint manifest entries say what was (and wasn't) captured
+    serve_ok = {e["endpoint"]: e["ok"]
+                for e in manifest["serve"]["endpoints"]}
+    assert serve_ok["/debug/requests"] and serve_ok["/debug/health"]

@@ -159,18 +159,33 @@ def test_instrumentation_overhead_within_5pct(server, monkeypatch):
     # sampling — the ≤5% guard now covers the whole attribution plane
     # (tracing + metrics + per-step profiling), not just tracing
     prof = StepProfiler()
+    # ...and so does the HEALTH SAMPLER: a live background sampler at
+    # its default cadence, scraping the registry the measured ops feed,
+    # proves the fleet-health plane rides inside the same 5% envelope
+    # (the acceptance criterion's "with the sampler ON" form)
+    from infinistore_tpu.health import HealthSampler
+
+    sampler = HealthSampler(probes={
+        "client.write_count": lambda: (m.default_registry().family_hist(
+            "istpu_client_op_seconds") or (0, 0))[0],
+        "engine.steps": lambda: prof.steps,
+    })
+    sampler.start()
     best_put = best_get = float("inf")
-    for it in range(4):
-        blocks = [(f"ovh-{it}-{i}", i * blk) for i in range(n)]
-        with tracer.trace("perf.request", iteration=it):
-            with prof.step(kind_hint="perf"):
-                t0 = time.perf_counter()
-                conn.write_cache(blocks, blk, buf.ctypes.data)
-                best_put = min(best_put, time.perf_counter() - t0)
-                t0 = time.perf_counter()
-                conn.read_cache(blocks, blk, dst.ctypes.data)
-                best_get = min(best_get, time.perf_counter() - t0)
-        conn.delete_keys([k for k, _ in blocks])
+    try:
+        for it in range(4):
+            blocks = [(f"ovh-{it}-{i}", i * blk) for i in range(n)]
+            with tracer.trace("perf.request", iteration=it):
+                with prof.step(kind_hint="perf"):
+                    t0 = time.perf_counter()
+                    conn.write_cache(blocks, blk, buf.ctypes.data)
+                    best_put = min(best_put, time.perf_counter() - t0)
+                    t0 = time.perf_counter()
+                    conn.read_cache(blocks, blk, dst.ctypes.data)
+                    best_get = min(best_get, time.perf_counter() - t0)
+            conn.delete_keys([k for k, _ in blocks])
+    finally:
+        sampler.stop()
     conn.close()
     assert np.array_equal(buf, dst)
     assert prof.summary()["steps"] == 4
@@ -194,8 +209,14 @@ def test_instrumentation_overhead_within_5pct(server, monkeypatch):
     if prof_path:
         import json
 
+        summary = prof.summary()
+        # host load at capture time (docs/robustness.md §host-load):
+        # a flaked perf guard on the 1-vCPU runner is triaged from this
+        # one artifact read instead of re-running under a profiler
+        summary["loadavg"] = list(os.getloadavg())
+        summary["health_ticks"] = sampler.ticks
         with open(prof_path, "w") as f:
-            json.dump(prof.summary(), f, indent=2)
+            json.dump(summary, f, indent=2)
 
     floor = PUT_FLOOR_GBPS * 0.95
     put_gbps = nbytes / 1e9 / best_put
@@ -273,7 +294,11 @@ def test_store_attached_prefill_within_budget(server, monkeypatch):
     S, C = 256, 64  # 4 chunks: 3 stream while later chunks compute
     rng = np.random.RandomState(3)
 
-    def med3(conn, tag):
+    def med5(conn, tag):
+        # median-of-5 (was 3): the docs/robustness.md §host-load flake —
+        # ~1-in-3 runs landing ~1 ms over budget under 1-vCPU scheduler
+        # jitter — is sample noise, and the documented remedy is MORE
+        # samples, never a looser budget
         eng = InferenceEngine(
             params, cfg, pc, conn=conn, model_id=f"psmoke-{tag}",
             prefill_chunk=C, store_durability="relaxed",
@@ -284,7 +309,7 @@ def test_store_attached_prefill_within_budget(server, monkeypatch):
         eng.store_flush()
         eng.release(st)
         times = []
-        for _ in range(3):
+        for _ in range(5):
             p = [int(x) for x in rng.randint(1, cfg.vocab_size, size=S)]
             t0 = time.perf_counter()
             with prof.step(kind_hint=None):
@@ -294,15 +319,15 @@ def test_store_attached_prefill_within_budget(server, monkeypatch):
             eng.store_flush()
             eng.release(st)
         times.sort()
-        return times[1]
+        return times[2]
 
-    t_detached = med3(None, "detached")
+    t_detached = med5(None, "detached")
     conn = ist.InfinityConnection(ist.ClientConfig(
         host_addr="127.0.0.1", service_port=server,
         connection_type=ist.TYPE_SHM, log_level="warning"))
     conn.connect()
     try:
-        t_attached = med3(conn, "attached")
+        t_attached = med5(conn, "attached")
     finally:
         conn.close()
     # +10 ms absolute slack: TINY prefills are tens of ms on this host,
@@ -311,5 +336,6 @@ def test_store_attached_prefill_within_budget(server, monkeypatch):
     assert t_attached <= budget, (
         f"store-attached prefill {t_attached * 1e3:.1f} ms exceeded "
         f"{ATTACHED_PREFILL_BUDGET}x the detached {t_detached * 1e3:.1f} ms "
-        f"(+10 ms slack) — the push critical path grew"
+        f"(+10 ms slack) — the push critical path grew "
+        f"(loadavg at failure: {os.getloadavg()})"
     )

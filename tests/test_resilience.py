@@ -714,6 +714,10 @@ def test_serve_healthz_without_store():
     try:
         st, data = _get(srv.port, "/healthz")
         body = json.loads(data)
-        assert st == 200 and body == {"status": "ok"}
+        assert st == 200 and body["status"] == "ok"
+        # no store -> no circuit field, nothing degraded (the health
+        # plane's alerts block rides along with zero firing)
+        assert "store_circuit" not in body and "reason" not in body
+        assert body.get("alerts", {}).get("firing", 0) == 0
     finally:
         srv.close()

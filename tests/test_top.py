@@ -143,6 +143,41 @@ def test_console_renders_engine_view():
     assert "engine " not in Console().frame(Snapshot())
 
 
+def test_console_renders_alerts_row():
+    """The fleet-health section (serving /debug/health): firing rules
+    with severity+reason and the per-frame delta of alert firing
+    transitions — a rule that fired and cleared between frames still
+    shows as +N."""
+    from infinistore_tpu.top import Console, Snapshot
+
+    def health(fired, firing):
+        return {
+            "enabled": True, "step_s": 1.0, "ticks": 120,
+            "probe_errors": 0, "alerts_fired": fired,
+            "firing": firing,
+            "alerts": {
+                "ttft_burn": {"state": "firing" if "ttft_burn" in firing
+                              else "ok", "severity": "page",
+                              "reason": "burning 5.0x (60s) / 3.1x "
+                                        "(600s) of the 10% error budget",
+                              "fired": fired},
+                "circuit_flap": {"state": "ok", "severity": "page",
+                                 "fired": 0},
+            },
+            "transitions": [], "series": ["serve.finished"],
+        }
+
+    console = Console()
+    console.frame(Snapshot(health=health(1, [])))
+    out = console.frame(Snapshot(health=health(3, ["ttft_burn"])))
+    assert "alerts   firing   1" in out
+    assert "fired    3 (+2/frame)" in out
+    assert "! ttft_burn" in out and "[page]" in out
+    assert "burning 5.0x" in out
+    # health plane off (ISTPU_HEALTH=0 / old server): row absent
+    assert "alerts   firing" not in Console().frame(Snapshot())
+
+
 def test_sparkline_and_bar_helpers():
     from infinistore_tpu.top import bar, fmt_dur, sparkline
 
