@@ -263,6 +263,17 @@ def main(argv=None) -> int:
         # the same way `slo`/`config` do — stable keys, documented in
         # docs/observability.md §engine-attribution
         record["stepprof"] = stepprof
+        # dispatch-economy mirrors for the trend table
+        # (scripts/bench_history.py): compiled programs per decoded
+        # token over the whole sweep (down is good) and accepted spec
+        # tokens per fused dispatch (up is good; absent when the server
+        # never speculated)
+        if stepprof.get("dispatches_per_token") is not None:
+            record["dispatches_per_token"] = \
+                stepprof["dispatches_per_token"]
+        if stepprof.get("spec_accept_per_dispatch") is not None:
+            record["spec_accept_per_dispatch"] = \
+                stepprof["spec_accept_per_dispatch"]
     if health is not None:
         # health-plane block (infinistore_tpu/health.py): alert
         # transitions + burn-rate peak during the run.  alerts_fired is

@@ -520,6 +520,13 @@ def leg_serving(out: dict) -> None:
     out["retraces_per_100_steps"] = s["retraces_per_100_steps"]
     out["stepprof_steps"] = s["steps"]
     out["stepprof_dispatch_total"] = s["dispatch_total"]
+    # dispatch economy (docs/tpu_perf_notes.md §dispatch-budget):
+    # compiled programs per decoded token and blocking host syncs over
+    # the leg — the pair the single-sync speculation work is judged by
+    out["dispatches_per_token"] = s["dispatches_per_token"]
+    out["stepprof_syncs_total"] = s["syncs_total"]
+    if s.get("spec_accept_per_dispatch") is not None:
+        out["spec_accept_per_dispatch"] = s["spec_accept_per_dispatch"]
 
 
 def leg_speculative(out: dict) -> None:
@@ -1509,6 +1516,30 @@ def main() -> int:
         # cumulative snapshot: if the caller must SIGKILL us mid-leg it can
         # still salvage every completed leg from the last stdout line
         print(json.dumps(out), flush=True)
+
+    # staged on-chip acceptance asserts (ROADMAP item 2): evaluated
+    # ONLY when this run executed on a real chip — the committed
+    # snapshot rides bench.py marked ``tpu_stale`` and a stale copy of
+    # an old number must never masquerade as a fresh pass/fail.  A miss
+    # is recorded in the JSON (and on stderr) instead of a hard exit:
+    # bench.py treats a non-zero rc as "no TPU leg" and would discard
+    # every number alongside the verdict.
+    if platform == "tpu":
+        floors = {"spec_speedup": 1.3, "pallas_speedup_vs_xla": 1.0}
+        checks = {
+            key: {"value": out[key], "floor": floor,
+                  "ok": out[key] >= floor}
+            for key, floor in floors.items()
+            if isinstance(out.get(key), (int, float))
+        }
+        if checks:
+            out["onchip_asserts"] = checks
+            failures = sorted(
+                key for key, c in checks.items() if not c["ok"])
+            if failures:
+                out["onchip_assert_failures"] = failures
+                print(f"# ON-CHIP ASSERTS FAILED: {failures} "
+                      f"(floors: {floors})", file=sys.stderr)
 
     # final line includes any *_skipped markers written on the continue path
     print(json.dumps(out), flush=True)

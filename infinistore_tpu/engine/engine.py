@@ -678,6 +678,29 @@ class InferenceEngine:
             {"cfg": self.cfg, **verify_kw, **lora_kw},
             donate=("cache",),
         )
+        # the last-row-only verify variant: a resync/refresh step that
+        # only needs the next-token distribution skips S-1 wasted
+        # lm_head projections (models/llama.py last_only).  Custom
+        # families opt in by accepting the kwarg; otherwise the full
+        # verify serves both roles (correct either way — callers of the
+        # last-only form read logits[:, -1]).
+        import inspect as _inspect
+
+        _vfn = verify_fn or (
+            verify_forward if self._has_verify else None
+        )
+        if _vfn is not None and (
+            _vfn is verify_forward
+            or "last_only" in _inspect.signature(_vfn).parameters
+        ):
+            self._verify_last_jit = _shared_jit(
+                _vfn,
+                {"cfg": self.cfg, "last_only": True,
+                 **verify_kw, **lora_kw},
+                donate=("cache",),
+            )
+        else:
+            self._verify_last_jit = self._verify_jit
         # tokens per compiled decode dispatch; the scan length is static so
         # distinct chunk sizes compile once each.  32 favors streaming
         # granularity / admission latency; on hosts with an expensive
@@ -686,6 +709,9 @@ class InferenceEngine:
         assert decode_chunk >= 1, decode_chunk
         self.decode_chunk = int(decode_chunk)
         self._decode_many_cache: Dict[Any, object] = {}
+        # zeros logits row for decode batch-dim pad rows (lazy: dtype
+        # follows the model's logits)
+        self._pad_logits: Optional[jax.Array] = None
         self._rng = jax.random.PRNGKey(0)
         # in-place append into the bucketed chunked-prefill KV buffer
         self._kv_append = _KV_APPEND
@@ -1452,10 +1478,37 @@ class InferenceEngine:
             variant = "filter"
         else:
             variant = "plain"
+        # batch-dim bucket: pad every per-row vector (and the block
+        # table) to the next power of two, so continuous-batching
+        # composition changes (a retirement shrinking B from 6 to 5)
+        # reuse the SAME compiled step program instead of retracing.
+        # Pad rows are inert by construction: their block-table entries
+        # are out of bounds (KV scatter dropped, gather clamped — see
+        # _block_table), their sampling params are the greedy defaults,
+        # and nothing host-side ever reads their outputs.
+        Bp = _round_up_pow2(B, 1)
+        npad = Bp - B
+        if npad:
+            greedy_mask = np.concatenate(
+                [greedy_mask, np.ones(npad, bool)]
+            )
+            temp = np.concatenate(
+                [temp, np.ones(npad, np.float32)]
+            )
+            top_k_v = np.concatenate(
+                [top_k_v, np.zeros(npad, np.int32)]
+            )
+            top_p_v = np.concatenate(
+                [top_p_v, np.ones(npad, np.float32)]
+            )
         pres = self._per_row(presence_penalty, B, np.float32)
         freq = self._per_row(frequency_penalty, B, np.float32)
         rep = self._per_row(repetition_penalty, B, np.float32)
         assert np.all(rep > 0.0), rep
+        if npad:
+            pres = np.concatenate([pres, np.zeros(npad, np.float32)])
+            freq = np.concatenate([freq, np.zeros(npad, np.float32)])
+            rep = np.concatenate([rep, np.ones(npad, np.float32)])
         biases = list(logit_bias) if logit_bias is not None else [None] * B
         assert len(biases) == B, (len(biases), B)
         penalized = bool(
@@ -1486,9 +1539,9 @@ class InferenceEngine:
                 pen = hit[1]
             else:
                 V = self.cfg.vocab_size
-                counts = np.zeros((B, V), np.int32)
-                pseen = np.zeros((B, V), bool)
-                bias = np.zeros((B, V), np.float32)
+                counts = np.zeros((Bp, V), np.int32)
+                pseen = np.zeros((Bp, V), bool)
+                bias = np.zeros((Bp, V), np.float32)
                 gs = (
                     [len(st.tokens) for st in states] if gen_start is None
                     else list(gen_start)
@@ -1512,7 +1565,7 @@ class InferenceEngine:
             need = -(-(len(st.tokens) + n_steps) // T)
             if need > len(st.block_ids):
                 st.block_ids.extend(self.pages.acquire(need - len(st.block_ids)))
-        block_table = self._block_table(states)
+        block_table = self._block_table(states, pad_to=Bp)
         if rng is None:
             # advance the engine's own stream: repeated sampling calls must
             # not replay the same draws (compiled split: eager ops stall
@@ -1520,8 +1573,18 @@ class InferenceEngine:
             self._rng, rng = _SPLIT2(self._rng)
 
         out: List[List[int]] = [[] for _ in range(B)]
-        logits = _STACK_ROWS(*[st.last_logits for st in states])  # [B, V]
-        pos = np.asarray([len(st.tokens) for st in states], dtype=np.int32)
+        rows0 = [st.last_logits for st in states]
+        if npad:
+            if self._pad_logits is None or (
+                self._pad_logits.dtype != rows0[0].dtype
+            ):
+                self._pad_logits = jnp.zeros_like(rows0[0])
+            rows0 = rows0 + [self._pad_logits] * npad
+        logits = _STACK_ROWS(*rows0)  # [Bp, V]
+        pos = np.asarray(
+            [len(st.tokens) for st in states] + [0] * npad,
+            dtype=np.int32,
+        )
         # constant across the chunk loop: upload the sampling vectors once
         greedy_d = jnp.asarray(greedy_mask)
         temp_d = jnp.asarray(temp)
@@ -1530,10 +1593,13 @@ class InferenceEngine:
         lora_t = self._lora_tree
         aid_d = (
             None if self.lora is None
-            else jnp.asarray([st.adapter_id for st in states], jnp.int32)
+            else jnp.asarray(
+                [st.adapter_id for st in states] + [0] * npad, jnp.int32
+            )
         )
         seeds = list(seed) if seed is not None else [None] * B
         assert len(seeds) == B, (len(seeds), B)
+        seeds = seeds + [None] * npad
         seeded_mask = np.asarray([s is not None for s in seeds])
         use_seeds = bool(seeded_mask.any())
         seeds_d = mask_d = None
@@ -1598,7 +1664,8 @@ class InferenceEngine:
                     )
             else:
                 toks, logits, self.cache = res
-            host_toks = np.asarray(toks)  # [chunk, B]; one sync/chunk
+            _stepprof.note_sync("decode_tokens")
+            host_toks = np.asarray(toks)  # [chunk, Bp]; one sync/chunk
             for b in range(B):
                 out[b].extend(int(t) for t in host_toks[:, b])
             pos += chunk
@@ -1739,7 +1806,8 @@ class InferenceEngine:
         )
         return _ROW0(logits)
 
-    def _block_table(self, states: Sequence[SequenceState]) -> jax.Array:
+    def _block_table(self, states: Sequence[SequenceState],
+                     pad_to: Optional[int] = None) -> jax.Array:
         # Width = the LONGEST active sequence's page count, in power-of-two
         # buckets (at most log2 table shapes in the jit cache).  It must
         # NOT default to the pool size: the XLA decode-attention path
@@ -1750,11 +1818,21 @@ class InferenceEngine:
         # may exceed the physical pool under SWA reclamation (window-dead
         # prefix pages recycle while their table slots live on, masked) —
         # ``need`` already counts those slots.
+        #
+        # ``pad_to`` > len(states) appends PAD rows (the decode batch-dim
+        # bucket) whose every entry is ``n_blocks`` — one past the pool.
+        # Out-of-bounds scatter indices are DROPPED under jit, so a pad
+        # row's per-step KV write lands nowhere (a 0-filled row would
+        # silently corrupt whatever sequence owns block 0); out-of-bounds
+        # gather indices clamp, so the pad row's attention reads garbage
+        # it then discards.
         need = max((len(st.block_ids) for st in states), default=0)
         width = 8
         while width < need:
             width *= 2
-        table = np.zeros((len(states), width), dtype=np.int32)
+        rows = pad_to if pad_to is not None else len(states)
+        table = np.zeros((rows, width), dtype=np.int32)
+        table[len(states):] = self.pc.n_blocks
         for b, st in enumerate(states):
             table[b, : len(st.block_ids)] = st.block_ids
         return jnp.asarray(table)

@@ -276,6 +276,7 @@ class NgramSpeculator:
                 jnp.asarray([len(st.tokens) for st in sts], jnp.int32),
                 hist,
             )
+            _stepprof.note_sync("spec_tokens")
             h_outs = np.asarray(outs)   # [R, B, k+1]; the one sync
             h_cnts = np.asarray(cnts)   # [R, B]
             lrows = _UNSTACK_ROWS(lgT)

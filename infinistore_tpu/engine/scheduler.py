@@ -549,6 +549,7 @@ class Scheduler:
                 req.done = True
                 self._stream(req, done=True)
                 self._drop_draft(req)
+                self._drop_spec_state(req)
                 self.engine.release(req.state)
                 self.record_latency(req)
                 self._finish(req, "cancelled" if req.cancelled else "done")
@@ -577,6 +578,14 @@ class Scheduler:
         if req._draft_state is not None:
             self.draft.release(req._draft_state)
             req._draft_state = None
+
+    def _drop_spec_state(self, req: Request) -> None:
+        """Forget the speculator's per-request adaptive-R controller (a
+        retired seq id can never recur — ids are monotonic).  No-op for
+        speculators without per-request state (ngram)."""
+        forget = getattr(self.spec, "forget", None)
+        if forget is not None and req.state is not None:
+            forget(req.state.seq_id)
 
     def _draft_state_for(self, req: Request) -> Optional[SequenceState]:
         """The draft's cache state for ``req``, prefilled on (re-)entry to
@@ -949,6 +958,7 @@ class Scheduler:
                 req._draft_state = None
             if req.state is not None:
                 try:
+                    self._drop_spec_state(req)
                     self.engine.release(req.state)
                 except Exception:  # noqa: BLE001
                     pass

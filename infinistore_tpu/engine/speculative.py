@@ -20,6 +20,17 @@ batching already fills the MXU.  Acceptance counters surface in
    cache (rewrites of already-correct slots are harmless — position-masked
    attention and slot overwrite semantics, see ``verify``'s docstring).
 
+SINGLE-SYNC STRUCTURE (round 11): on the fused path an entire decode
+chunk costs ONE blocking host sync — the ``AdaptiveRController`` sizes
+each dispatch's round count from a per-request acceptance EWMA
+(``ISTPU_SPEC_ADAPTIVE`` / ``ISTPU_SPEC_R_BUCKETS``), the compiled
+program clamps emission at the budget and returns bonus logits +
+per-row counts itself (no host-side trim/reconcile dispatches), and
+follow-up dispatches are enqueued from device-resident state before the
+previous tokens land (``copy_to_host_async`` double-buffering).
+``docs/tpu_perf_notes.md`` §dispatch-budget is the field guide;
+tests/test_perf_smoke.py guards the 1-dispatch/1-sync structure.
+
 Decision rules:
 
 * ``sample="greedy"`` (default): accept while the proposal matches the
@@ -44,8 +55,11 @@ Decision rules:
 
 from __future__ import annotations
 
+import math
+import os
+from collections import deque
 from functools import partial
-from typing import List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -65,6 +79,87 @@ from .engine import (
 )
 
 _ROW_NEG1 = jax.jit(lambda l: l[-1])
+
+
+def _parse_r_buckets(spec: Optional[str]) -> Tuple[int, ...]:
+    """Parse ``ISTPU_SPEC_R_BUCKETS`` ("1,2,8") into a sorted, deduped,
+    BOUNDED tuple.  Every bucket compiles a whole fused-rounds program
+    (dozens of inlined forwards), so the set is clamped to at most 4
+    values in [1, 32] — a bounded set is what keeps the steady-state
+    retrace count at zero; garbage falls back to the default."""
+    default = (1, 2, 8)
+    if not spec:
+        return default
+    try:
+        vals = sorted({int(x) for x in spec.split(",") if x.strip()})
+    except ValueError:
+        return default
+    vals = [v for v in vals if 1 <= v <= 32]
+    if not vals:
+        return default
+    return tuple(vals[:4])
+
+
+class AdaptiveRController:
+    """Acceptance-adaptive rounds-per-dispatch: an EWMA of tokens
+    emitted per fused round sizes the next dispatch's round count R
+    from a small FIXED bucket set.
+
+    Why: a fused dispatch costs one host sync however many rounds it
+    runs, so R should be just large enough that the dispatch's expected
+    yield (``R * EWMA``) covers the chunk budget — a strong draft at
+    ~full acceptance covers a 32-token chunk in one 8-round dispatch
+    (one sync), while a weak draft walks the EWMA down and stops paying
+    for rounds that mostly re-verify rejections.  The bucket set stays
+    bounded (⇒ bounded compiled-program count ⇒ bounded retraces); the
+    controller is carried PER REQUEST across scheduler steps
+    (``SpeculativeDecoder._controller``), so acceptance learned on one
+    chunk sizes the next.
+
+    Hysteresis: stepping DOWN to a smaller bucket requires the smaller
+    program's expected yield to beat the remaining budget by a margin
+    (``hysteresis``); staying put and stepping up need none — an EWMA
+    wobbling around a bucket boundary therefore settles instead of
+    flapping between two compiled programs.
+
+    Pure host math (no jax), unit-tested with injected acceptance
+    sequences in tests/test_speculative.py."""
+
+    def __init__(self, k: int, buckets: Sequence[int] = (1, 2, 8),
+                 alpha: float = 0.4, hysteresis: float = 0.25):
+        assert buckets and all(b >= 1 for b in buckets), buckets
+        self.k = k
+        self.buckets = tuple(sorted({int(b) for b in buckets}))
+        self.alpha = float(alpha)
+        self.hysteresis = float(hysteresis)
+        # optimistic start: a fresh request assumes full acceptance, so
+        # its first dispatch is sized to cover the whole chunk (the
+        # single-sync fast path); a weak draft walks the EWMA down
+        self.rate = float(k + 1)
+        self._bucket = self.buckets[-1]
+
+    def update(self, tokens: int, rounds: int) -> None:
+        """Fold one dispatch's observation: ``tokens`` emitted over
+        ``rounds`` effective (unclamped) rounds."""
+        if rounds <= 0:
+            return
+        self.rate += self.alpha * (tokens / rounds - self.rate)
+        self.rate = min(max(self.rate, 1.0), float(self.k + 1))
+
+    def suggest(self, remaining: int) -> int:
+        """Bucket for the next dispatch given ``remaining`` tokens of
+        budget: the smallest bucket whose expected yield covers it
+        (with the down-switch margin), else the largest."""
+        if remaining <= 0:
+            return self.buckets[0]
+        choice = self.buckets[-1]
+        for b in self.buckets:
+            margin = 1.0 + self.hysteresis if b < self._bucket else 1.0
+            if b * self.rate >= remaining * margin:
+                choice = b
+                break
+        self._bucket = choice
+        return choice
 
 
 def _build_fused_rounds(target: InferenceEngine, draft: InferenceEngine,
@@ -95,31 +190,54 @@ def _build_fused_rounds(target: InferenceEngine, draft: InferenceEngine,
     All shapes static: the draft resync always re-verifies a k+1 window
     (rewriting already-correct slots is harmless — position-masked
     attention, idempotent slot writes), so no per-width recompiles.
-    Rounds after the budget is met still execute (a scan has a fixed trip
-    count); the host trims the overshoot exactly like the host loop does.
+
+    DEVICE-RESIDENT RECONCILE: each row carries its budget ``n_max`` and
+    every round's emission count is clamped to it ON DEVICE (``cnt =
+    min(m+1, n_max - n)``), so a chunk never overshoots — the old
+    host-side trim (one ``_resync_draft`` + one ``target.verify``
+    tail-refresh, 2+ dispatches per fused call) is gone.  Rounds at the
+    budget still execute (a scan has a fixed trip count) but emit
+    nothing and leave the carried state untouched; the program's final
+    width-1 verify rewrites the last ACCEPTED token's KV slot and
+    returns the bonus-token logits, so both engines come back
+    decode-ready at exactly the budget inside the same dispatch.  The
+    per-round draft resync and the final refresh use the last-row-only
+    verify binding (``_verify_last_jit``): only the next-token
+    distribution is needed, so k wasted ``[dim, V]`` lm_head
+    projections per round are skipped.
 
     Returns a jitted ``fn(t_params, d_params, t_cache, d_cache,
-    t_table [B, W], d_table [B, W], n0 [B], win0 [B, k+2],
+    t_table [B, W], d_table [B, W], n0 [B], n_max [B], win0 [B, k+2],
     d_logits0 [B, V], key, temp [B], tk [B], tp [B]) ->
-    (outs [R, B, k+1], cnts [R, B], n_final [B], t_logits [B, V],
-    d_logits [B, V], t_cache, d_cache)`` with both caches donated
-    (key/temp/tk/tp are ignored under "greedy").  B is the lockstep
-    speculation batch; the program re-specializes per (B, table width).
+    (outs [R, B, k+1], cnts [R, B], ms [R, B], n_final [B],
+    win_final [B, k+2], t_logits [B, V], d_logits [B, V], t_cache,
+    d_cache)`` with both caches donated (key/temp/tk/tp are ignored
+    under "greedy").  ``cnts`` are budget-clamped emission counts (the
+    tokens the host adopts); ``ms`` the RAW per-round accepted-proposal
+    counts (acceptance accounting must see overshoot rounds too, or a
+    clamped tail round would dilute a perfect draft's rate).
+    ``n_final``/``win_final``/``d_logits`` feed the NEXT dispatch
+    without any host round-trip — the async-readback pipeline enqueues
+    dispatch N+1 from them before dispatch N's tokens land.  B is the
+    lockstep speculation batch; the program re-specializes per (B,
+    table width).
     """
     assert variant in ("greedy", "plain", "filter"), variant
     key = ("spec_fused", target._decode_raw, draft._decode_raw,
-           target._verify_jit, draft._verify_jit,
+           target._verify_jit, draft._verify_last_jit,
+           target._verify_last_jit,
            target.pc.block_tokens, k, R, variant)
     fn = _JIT_CACHE.get(key)
     if fn is not None:
         return fn
     T = target.pc.block_tokens
     t_verify = target._verify_jit
-    d_verify = draft._verify_jit
+    t_verify_last = target._verify_last_jit
+    d_verify_last = draft._verify_last_jit
     d_decode = draft._decode_raw
 
     def rounds(t_params, d_params, t_cache, d_cache, t_table, d_table,
-               n0, win0, d_logits0, base_key, temp, tk, tp):
+               n0, n_max, win0, d_logits0, base_key, temp, tk, tp):
         # Everything is BATCHED over B rows in lockstep: n/win/d_logits
         # carry a leading [B]; the draft/verify forwards are the engines'
         # ordinary batched steps; acceptance runs per row.  temp/tk/tp are
@@ -255,7 +373,12 @@ def _build_fused_rounds(target: InferenceEngine, draft: InferenceEngine,
                     jnp.arange(k + 1)[None] == m[:, None],
                     repl[:, None], tail,
                 )
-            cnt = m + 1  # [B]
+            # device-resident reconcile: clamp emission at each row's
+            # budget.  A row at n == n_max keeps executing (static trip
+            # count) but emits 0 and carries its state unchanged — the
+            # proposals it still writes land past the budget, in pages
+            # the caller sized for exactly this overshoot (rem + k).
+            cnt = jnp.minimum(m + 1, n_max - n)  # [B]
             n2 = n + cnt
             # newest k+2 accepted ids per row: win ++ e[:cnt], last k+2
             allw = jnp.concatenate([win, e], axis=1)  # [B, 2k+3]
@@ -269,31 +392,33 @@ def _build_fused_rounds(target: InferenceEngine, draft: InferenceEngine,
             # MEASURED SLOWER here: branching on the carried paged cache
             # makes XLA materialize cache copies that dwarf the saved
             # forward.  Rewriting already-correct slots is harmless.
+            # Last-row-only logits: the resync only needs the
+            # next-token distribution to seed the next round's draft.
             poss_d = n2[:, None] - 1 - k + jnp.arange(k + 1)[None]
             blks_d = row_gather(d_table, poss_d // T)
-            dlgs, d_cache = d_verify(
+            dlgs, d_cache = d_verify_last(
                 d_params, tokens=win2[:, 1:], positions=poss_d,
                 cache=d_cache, block_table=d_table,
                 slot_block_ids=blks_d, slot_ids=poss_d % T,
             )
-            return (t_cache, d_cache, n2, win2, dlgs[:, -1]), (e, cnt)
+            return (t_cache, d_cache, n2, win2, dlgs[:, -1]), (e, cnt, m)
 
         carry0 = (t_cache, d_cache, n0, win0, d_logits0)
-        (t_cache, d_cache, nF, winF, d_logitsF), (outs, cnts) = jax.lax.scan(
-            round_body, carry0, None, length=R
-        )
+        (t_cache, d_cache, nF, winF, d_logitsF), (outs, cnts, ms) = \
+            jax.lax.scan(round_body, carry0, None, length=R)
         # leave the target decode-ready: logits after each row's last
         # accepted token (its KV slot is rewritten in place — same
-        # contract as the host loop's final re-verify, but inside the
-        # same dispatch)
+        # contract as the old host-side tail-refresh verify, but inside
+        # the same dispatch)
         posF = nF[:, None] - 1  # [B, 1]
-        lgT, t_cache = t_verify(
+        lgT, t_cache = t_verify_last(
             t_params, tokens=winF[:, -1:], positions=posF,
             cache=t_cache, block_table=t_table,
             slot_block_ids=row_gather(t_table, posF // T),
             slot_ids=posF % T,
         )
-        return outs, cnts, nF, lgT[:, -1], d_logitsF, t_cache, d_cache
+        return (outs, cnts, ms, nF, winF, lgT[:, -1], d_logitsF,
+                t_cache, d_cache)
 
     fn = jax.jit(rounds, donate_argnums=(2, 3))
     _JIT_CACHE[key] = fn
@@ -362,11 +487,39 @@ class SpeculativeDecoder:
         # greedy rounds fuse into one dispatch per R rounds (see
         # _build_fused_rounds); turn off to force the host round loop
         self.fuse_rounds = True
+        # acceptance-adaptive rounds-per-dispatch (AdaptiveRController):
+        # ISTPU_SPEC_ADAPTIVE=0 pins the legacy static policy (largest
+        # bucket until the tail, no pipelined readback); the bucket SET
+        # comes from ISTPU_SPEC_R_BUCKETS either way, so the compiled-
+        # program universe stays bounded and identical across modes
+        self.adaptive = os.environ.get("ISTPU_SPEC_ADAPTIVE", "1") != "0"
+        self.r_buckets = _parse_r_buckets(
+            os.environ.get("ISTPU_SPEC_R_BUCKETS")
+        )
+        # per-request controllers keyed by TARGET seq id, carried across
+        # scheduler steps (the scheduler forgets them at retirement);
+        # bounded so a library caller who never retires can't grow it
+        self._ctls: Dict[int, AdaptiveRController] = {}
         # round accounting for reporting acceptance rates
         self.rounds = 0
         self.accepted = 0
         self.proposed = 0
         self._rng = jax.random.PRNGKey(0)
+
+    def _controller(self, st: SequenceState) -> AdaptiveRController:
+        ctl = self._ctls.get(st.seq_id)
+        if ctl is None:
+            if len(self._ctls) >= 512:
+                self._ctls.pop(next(iter(self._ctls)))
+            ctl = self._ctls[st.seq_id] = AdaptiveRController(
+                self.k, self.r_buckets
+            )
+        return ctl
+
+    def forget(self, seq_id: int) -> None:
+        """Drop the per-request adaptive-R state (called by the
+        scheduler when the request retires)."""
+        self._ctls.pop(seq_id, None)
 
     def prefill(self, tokens: Sequence[int]) -> Tuple[SequenceState, SequenceState]:
         return self.target.prefill(tokens), self.draft.prefill(tokens)
@@ -537,102 +690,229 @@ class SpeculativeDecoder:
     ) -> List[List[int]]:
         """Speculation with whole rounds compiled on device (greedy or
         stochastic — see _build_fused_rounds), batched over rows in
-        lockstep: each dispatch runs R rounds for every row and costs ONE
-        host sync; the host loop only reconciles tokens and tops up pages
-        between dispatches.  Rows keep generating until the SLOWEST row
-        meets the budget (faster rows' overshoot is trimmed, same as the
-        host loop's)."""
+        lockstep.  One fused chunk costs ONE blocking host sync in the
+        common case:
+
+        * the per-request ``AdaptiveRController`` sizes R so the first
+          dispatch's expected yield covers the whole budget;
+        * the program clamps emission at each row's budget ON DEVICE
+          (no overshoot, so the old 2-dispatch host trim is gone);
+        * when acceptance disappoints and more dispatches are needed,
+          the next one is enqueued from the PREVIOUS dispatch's
+          device-resident outputs (n/window/draft-logits) BEFORE its
+          tokens land on host, and every token download is kicked with
+          ``copy_to_host_async`` at launch — the blocking ``np.asarray``
+          mostly finds the bytes already waiting.
+
+        Pages for the whole chunk (+k overshoot slack) are acquired up
+        front when both pools can hold them; otherwise a degraded
+        SERIAL mode sizes, acquires, and drains per dispatch, stepping R
+        down through the bucket set under pressure (R = smallest bucket
+        that still doesn't fit raises MemoryError out of the acquire —
+        the host loop's "round can't fit" contract, with every
+        completed dispatch's tokens already reconciled)."""
         k = self.k
         B = len(st_ts)
+        T = self.target.pc.block_tokens
         outs_h: List[List[int]] = [[] for _ in range(B)]
+        if n_steps <= 0:
+            return outs_h
         if rng is None:
             rng = jax.random.PRNGKey(0)  # unused under "greedy"
-        temp_v = InferenceEngine._per_row(temperature, B, np.float32)
-        tk_v = InferenceEngine._per_row(top_k, B, np.int32)
-        tp_v = InferenceEngine._per_row(top_p, B, np.float32)
+        temp_d = jnp.asarray(
+            InferenceEngine._per_row(temperature, B, np.float32))
+        tk_d = jnp.asarray(InferenceEngine._per_row(top_k, B, np.int32))
+        tp_d = jnp.asarray(InferenceEngine._per_row(top_p, B, np.float32))
+        lens0 = [len(st.tokens) for st in st_ts]
+        ctls = [self._controller(st) for st in st_ts]
+        buckets = self.r_buckets
 
-        def fits(eng: InferenceEngine, sts: List[SequenceState],
-                 rounds: int) -> bool:
-            T = eng.pc.block_tokens
-            short = 0
-            for st in sts:
-                need = -(-(len(st.tokens) + rounds * (k + 1)) // T)
-                short += max(0, need - len(st.block_ids))
-            return short <= eng.free_pages
+        def fits(grows: List[int]) -> bool:
+            """Can both pools absorb per-row token growth ``grows``?
+            Draft rows size from the TARGET length (stale-shorter
+            drafts must never undersize their block tables)."""
+            short_t = sum(
+                max(0, -(-(len(st.tokens) + g) // T) - len(st.block_ids))
+                for st, g in zip(st_ts, grows)
+            )
+            if short_t > self.target.free_pages:
+                return False
+            short_d = sum(
+                max(0, -(-(len(t.tokens) + g) // T) - len(d.block_ids))
+                for t, d, g in zip(st_ts, st_ds, grows)
+            )
+            return short_d <= self.draft.free_pages
 
-        while min(len(o) for o in outs_h) < n_steps:
-            # THREE round-count buckets only ({8, 2, 1}): each fused program
-            # inlines dozens of forwards, so every extra R bucket is a
-            # large compile; 8 is the steady-state program, 2 keeps tail
-            # calls from overshooting ~a full dispatch of work (rounds
-            # past the budget execute and get trimmed, like the host
-            # loop's overshoot).  Degrades below 2 only when a pool can't
-            # hold every row's growth (R=1 that still doesn't fit raises
-            # out of the acquire below — the host loop's "round can't
-            # fit" contract).
-            remaining = n_steps - min(len(o) for o in outs_h)
-            R = 8 if remaining > 2 * (k + 1) else 2
-            # memory-pressure degrade steps THROUGH the buckets (8 -> 2
-            # -> 1), never 4: each fused program is a large compile, so
-            # the bucket set stays exactly {8, 2, 1}
-            while R > 1 and not (fits(self.target, st_ts, R)
-                                 and fits(self.draft, st_ds, R)):
-                R = 2 if R == 8 else 1
-            grow = R * (k + 1)
-            for st in st_ts:
-                self._acquire_for(self.target, st, grow)
-            for st_t, st in zip(st_ts, st_ds):
-                self._acquire_for(self.draft, st, grow,
+        def acquire(grows: List[int]) -> None:
+            for st, g in zip(st_ts, grows):
+                self._acquire_for(self.target, st, g)
+            for st_t, st, g in zip(st_ts, st_ds, grows):
+                self._acquire_for(self.draft, st, g,
                                   base_len=len(st_t.tokens))
-            fn = _build_fused_rounds(self.target, self.draft, k, R, variant)
-            # one compiled dispatch = R complete propose/verify/resync
-            # rounds for every row — the unit the step profiler's
+
+        # device-carried loop state: after the first dispatch these are
+        # the previous program's outputs, so a follow-up dispatch needs
+        # no host round-trip at all
+        n_dev = jnp.asarray(lens0, jnp.int32)
+        win_dev = jnp.asarray(
+            [st.tokens[-(k + 2):] for st in st_ts], jnp.int32)
+        dlog_dev = _STACK_ROWS(*[st.last_logits for st in st_ds])
+        t_lg_dev = None
+        t_table = d_table = n_max_d = None
+        inflight: "deque" = deque()  # (outs, cnts, ms, R)
+        # per-row progress bounds over confirmed + in-flight work:
+        # floor assumes 1 token/round (every round emits >= 1 until the
+        # budget clamp), exp uses the controller's EWMA
+        floor_rows = [0] * B
+        exp_rows = [0.0] * B
+
+        def launch(R: int) -> None:
+            nonlocal n_dev, win_dev, dlog_dev, t_lg_dev
+            fn = _build_fused_rounds(
+                self.target, self.draft, k, R, variant)
+            # one compiled dispatch = R complete propose/verify/accept/
+            # resync rounds for every row — the unit the step profiler's
             # accepted-per-dispatch attribution divides by
             _stepprof.note_dispatch("spec_round")
-            outs, cnts, nF, t_lg, d_lg, t_cache, d_cache = fn(
+            (outs, cnts, ms, n_dev, win_dev, t_lg_dev, dlog_dev,
+             t_cache, d_cache) = fn(
                 self.target.params, self.draft.params,
                 self.target.cache, self.draft.cache,
-                self.target._block_table(st_ts),
-                self.draft._block_table(st_ds),
-                jnp.asarray([len(st.tokens) for st in st_ts], jnp.int32),
-                jnp.asarray(
-                    [st.tokens[-(k + 2):] for st in st_ts], jnp.int32
-                ),
-                _STACK_ROWS(*[st.last_logits for st in st_ds]),
-                rng,
-                jnp.asarray(temp_v),
-                jnp.asarray(tk_v),
-                jnp.asarray(tp_v),
+                t_table, d_table, n_dev, n_max_d, win_dev, dlog_dev,
+                rng, temp_d, tk_d, tp_d,
             )
             self.target.cache = t_cache
             self.draft.cache = d_cache
-            t_rows = _UNSTACK_ROWS(t_lg)
-            d_rows = _UNSTACK_ROWS(d_lg)
-            h_outs = np.asarray(outs)   # [R, B, k+1]; the call's one sync
-            h_cnts = np.asarray(cnts)   # [R, B]
+            # async readback: kick the token D2H now, so the follow-up
+            # dispatch (and the eventual blocking read) overlap it
+            for arr in (outs, cnts, ms):
+                try:
+                    arr.copy_to_host_async()
+                except AttributeError:  # non-array backends (tests)
+                    pass
+            inflight.append((outs, cnts, ms, R))
+            for b in range(B):
+                floor_rows[b] = min(n_steps, floor_rows[b] + R)
+                exp_rows[b] = min(
+                    float(n_steps), exp_rows[b] + R * ctls[b].rate)
+
+        def drain() -> None:
+            outs, cnts, ms, R = inflight.popleft()
+            # the chunk's one BLOCKING host sync (the structural
+            # single-sync guard in tests/test_perf_smoke.py counts it)
+            _stepprof.note_sync("spec_tokens")
+            h_outs = np.asarray(outs)   # [R, B, k+1]
+            h_cnts = np.asarray(cnts)   # [R, B] budget-clamped
+            h_ms = np.asarray(ms)       # [R, B] raw accepted proposals
             for b in range(B):
                 new_toks: List[int] = []
                 for r in range(R):
-                    cnt = int(h_cnts[r, b])
-                    new_toks.extend(int(t) for t in h_outs[r, b, :cnt])
+                    c = int(h_cnts[r, b])
+                    if c:
+                        new_toks.extend(
+                            int(t) for t in h_outs[r, b, :c])
                 outs_h[b].extend(new_toks)
                 st_ts[b].tokens.extend(new_toks)
                 st_ds[b].tokens = list(st_ts[b].tokens)
-                st_ts[b].last_logits = t_rows[b]
-                st_ds[b].last_logits = d_rows[b]
+                eff = int((h_cnts[:, b] > 0).sum())
+                if eff:
+                    ctls[b].update(len(new_toks), eff)
             self.rounds += R * B
             self.proposed += R * B * k
-            self.accepted += int(h_cnts.sum()) - R * B
-        for b in range(B):
-            excess = len(outs_h[b]) - n_steps
-            if excess:
-                del outs_h[b][n_steps:]
-                del st_ts[b].tokens[-excess:]
-                self._resync_draft(st_ds[b], list(st_ts[b].tokens))
-                st_ts[b].last_logits = _ROW_NEG1(self.target.verify(
-                    st_ts[b], [st_ts[b].tokens[-1]],
-                    len(st_ts[b].tokens) - 1,
-                ))
+            self.accepted += int(h_ms.sum())
+            infl_R = sum(r for *_a, r in inflight)
+            for b in range(B):
+                conf = len(outs_h[b])
+                floor_rows[b] = min(n_steps, conf + infl_R)
+                exp_rows[b] = min(
+                    float(n_steps), conf + infl_R * ctls[b].rate)
+
+        def choose_R() -> int:
+            if self.adaptive:
+                return max(
+                    ctls[b].suggest(
+                        int(math.ceil(n_steps - exp_rows[b])))
+                    for b in range(B)
+                )
+            # legacy static policy: largest bucket until the tail
+            rem = n_steps - min(floor_rows)
+            return (buckets[-1] if rem > 2 * (k + 1)
+                    else buckets[min(1, len(buckets) - 1)])
+
+        def settle_logits() -> None:
+            # both engines decode-ready: the newest dispatch's final
+            # in-program verify rewrote each row's last accepted token's
+            # KV slot and produced the logits after it
+            t_rows = _UNSTACK_ROWS(t_lg_dev)
+            d_rows = _UNSTACK_ROWS(dlog_dev)
+            for b in range(B):
+                st_ts[b].last_logits = t_rows[b]
+                st_ds[b].last_logits = d_rows[b]
+
+        try:
+            if fits([n_steps + k] * B):
+                # fast path: the whole chunk's pages up front (budget +
+                # k slack for the clamped rounds' past-budget writes),
+                # one block table, one device-resident budget —
+                # dispatches can pipeline freely
+                acquire([n_steps + k] * B)
+                t_table = self.target._block_table(st_ts)
+                d_table = self.draft._block_table(st_ds)
+                n_max_d = jnp.asarray(
+                    [l + n_steps for l in lens0], jnp.int32)
+                while True:
+                    if (min(len(o) for o in outs_h) >= n_steps
+                            and not inflight):
+                        break
+                    if not inflight:
+                        launch(choose_R())
+                    # double-buffer: when the in-flight work's EXPECTED
+                    # yield still leaves budget, enqueue the next
+                    # dispatch before this one's tokens land (adaptive
+                    # mode only — the legacy policy keeps the old
+                    # serial cadence)
+                    if (self.adaptive and len(inflight) < 2
+                            and min(exp_rows) < n_steps):
+                        launch(choose_R())
+                    drain()
+            else:
+                # degraded serial mode (memory pressure): size,
+                # acquire, and drain per dispatch; R steps DOWN through
+                # the bucket set until the growth fits, and the
+                # smallest bucket that still doesn't fit raises out of
+                # the acquire with every completed dispatch already
+                # reconciled
+                while min(len(o) for o in outs_h) < n_steps:
+                    rems = [n_steps - len(o) for o in outs_h]
+                    R = choose_R()
+                    while True:
+                        grows = [min(R * (k + 1), r + k) for r in rems]
+                        if R == buckets[0] or fits(grows):
+                            break
+                        R = max(b for b in buckets if b < R)
+                    acquire(grows)
+                    t_table = self.target._block_table(st_ts)
+                    d_table = self.draft._block_table(st_ds)
+                    n_dev = jnp.asarray(
+                        [len(st.tokens) for st in st_ts], jnp.int32)
+                    n_max_d = jnp.asarray(
+                        [len(st.tokens) + min(r, R * (k + 1))
+                         for st, r in zip(st_ts, rems)], jnp.int32)
+                    launch(R)
+                    drain()
+        except MemoryError:
+            # a pool ran dry mid-chunk (degraded mode raises from the
+            # acquire BEFORE a dispatch): every completed dispatch's
+            # tokens are already on st.tokens, so restoring
+            # decode-readiness is all that's left before the caller's
+            # fallback takes over
+            while inflight:
+                drain()
+            if t_lg_dev is not None:
+                settle_logits()
+            raise
+
+        settle_logits()
         return outs_h
 
     def _rounds(self, st_t, st_d, n_steps, sample, temperature, top_k,

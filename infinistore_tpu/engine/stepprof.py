@@ -191,6 +191,20 @@ def note_tokens(n: int) -> None:
         rec["tokens"] += n
 
 
+def note_sync(kind: str, n: int = 1) -> None:
+    """Count ``n`` BLOCKING host syncs of ``kind`` against the active
+    step record — device→host downloads the step loop actually waited
+    on (the decode chunk's token landing, the fused-spec chunk's token
+    landing).  Dispatches say how often the host talked to the device;
+    syncs say how often it STOPPED for it — the single-sync speculation
+    guard asserts exactly one per fused chunk.  One contextvar read
+    when inactive."""
+    rec = _ACTIVE.get()
+    if rec is not None:
+        s = rec["syncs"]
+        s[kind] = s.get(kind, 0) + n
+
+
 def current_step() -> Optional[int]:
     """The active step record's id (None outside a profiled step) — the
     scheduler stamps it onto a request at RETIREMENT, before the ledger
@@ -274,6 +288,11 @@ class StepProfiler:
         # lifetime aggregates behind summary()/the metric callbacks
         self._by_kind: Dict[str, int] = {}
         self._dispatch_totals: Dict[str, int] = {}
+        self._sync_totals: Dict[str, int] = {}
+        # lifetime speculation deltas (summed from per-step ``spec``
+        # blocks): accepted tokens PER spec_round DISPATCH is the one
+        # number that explains a sub-1x spec speedup at high acceptance
+        self._spec_totals = {"rounds": 0, "proposed": 0, "accepted": 0}
         self.tokens = 0
         self._wall_s = 0.0
         self._sampled_wall_s = 0.0
@@ -306,6 +325,14 @@ class StepProfiler:
             "Compiled step programs launched, by kind (decode scan "
             "chunk, prefill chunk forward, verify/draft forward, fused "
             "speculation round)",
+            labelnames=("kind",),
+        )
+        self._c_sync = reg.counter(
+            "istpu_engine_syncs_total",
+            "Blocking device->host downloads the step loop waited on, "
+            "by kind (decode_tokens: a decode chunk's token landing; "
+            "spec_tokens: a fused-spec chunk's token landing) — the "
+            "single-sync speculation budget is one per fused chunk",
             labelnames=("kind",),
         )
         self._c_retrace = reg.counter(
@@ -374,6 +401,7 @@ class StepProfiler:
             "trace_id": tracing.current_trace_id(),
             "dispatches": {},
             "tokens": 0,
+            "syncs": {},
             "retraces": {},
             "sampled": sampled,
         }
@@ -440,6 +468,9 @@ class StepProfiler:
                 "proposed": spec1[1] - spec0[1],
                 "accepted": spec1[2] - spec0[2],
             }
+            with self._lock:
+                for key in self._spec_totals:
+                    self._spec_totals[key] += rec["spec"][key]
         # store-hop stages: attach the transfer's per-stage breakdown
         # when it changed under this step (push commits land on the
         # streamer thread, so attribution is best-effort by design)
@@ -463,6 +494,8 @@ class StepProfiler:
             for k, n in rec["dispatches"].items():
                 self._dispatch_totals[k] = \
                     self._dispatch_totals.get(k, 0) + n
+            for k, n in rec["syncs"].items():
+                self._sync_totals[k] = self._sync_totals.get(k, 0) + n
             self.tokens += rec["tokens"]
             self._wall_s += dur
             if sampled:
@@ -477,6 +510,8 @@ class StepProfiler:
         self._h_step.labels(kind, "wall").observe(dur)
         for k, n in rec["dispatches"].items():
             self._c_dispatch.labels(k).inc(n)
+        for k, n in rec["syncs"].items():
+            self._c_sync.labels(k).inc(n)
         for fname, n in rec["retraces"].items():
             self._c_retrace.labels(fname).inc(n)
         if sampled:
@@ -544,6 +579,8 @@ class StepProfiler:
             steps = self.steps
             by_kind = dict(self._by_kind)
             dispatches = dict(self._dispatch_totals)
+            syncs = dict(self._sync_totals)
+            spec_tot = dict(self._spec_totals)
             tokens = self.tokens
             wall = self._wall_s
             s_wall, stall, sampled = (self._sampled_wall_s, self._stall_s,
@@ -557,11 +594,19 @@ class StepProfiler:
             compiles = _COMPILES - self._compiles0
             compile_s = _COMPILE_S - self._compile_s0
         n_retr = sum(retraces.values())
-        return {
+        dispatch_total = sum(dispatches.values())
+        out = {
             "steps": steps,
             "by_kind": by_kind,
             "dispatches": dispatches,
-            "dispatch_total": sum(dispatches.values()),
+            "dispatch_total": dispatch_total,
+            "syncs": syncs,
+            "syncs_total": sum(syncs.values()),
+            # dispatch economy: compiled programs launched per decoded
+            # token — THE number the single-sync speculation work moves
+            # (directions in scripts/bench_history.py: down is good)
+            "dispatches_per_token": round(dispatch_total / tokens, 4)
+            if tokens else 0.0,
             "tokens": tokens,
             "wall_s": round(wall, 4),
             "sampled_steps": sampled,
@@ -575,6 +620,15 @@ class StepProfiler:
             "compile_s": round(compile_s, 4),
             "mem": mem,
         }
+        # speculation economy: accepted tokens per fused dispatch, the
+        # read that explained r4's "0.53x at 0.938 acceptance" (up is
+        # good; absent when no spec step ever ran)
+        n_spec_disp = dispatches.get("spec_round", 0)
+        if n_spec_disp and spec_tot["proposed"]:
+            out["spec_accept_per_dispatch"] = round(
+                spec_tot["accepted"] / n_spec_disp, 3
+            )
+        return out
 
     def tail(self, limit: Optional[int] = None) -> List[dict]:
         with self._lock:

@@ -416,6 +416,7 @@ def verify_forward(
     lora=None,
     adapter_ids: jax.Array | None = None,
     lora_scale: float = 1.0,
+    last_only: bool = False,
 ) -> Tuple[jax.Array, jax.Array]:
     """Multi-token paged step: process a short run of tokens against the
     paged cache in ONE forward (the speculative-decode verify step — the
@@ -426,7 +427,18 @@ def verify_forward(
     [L, 2, Hkv, n_blocks, T, D]; block_table: [B, max_pages].  The tokens'
     K/V are scattered into their page slots first, then each token attends
     to the paged history plus the run causally by absolute position.
-    Returns (logits [B, S, V], updated cache).
+    Returns (logits [B, S, V], updated cache).  The row after the FINAL
+    token is the bonus-token distribution speculative decoding samples
+    from — the device-resident reconcile in engine/speculative.py reads
+    it straight out of the same compiled program instead of re-verifying
+    on the host.
+
+    ``last_only=True`` (static) projects only the final position through
+    ``lm_head`` and returns logits [B, 1, V]: a resync/refresh step that
+    only needs the next-token distribution skips S-1 wasted [dim, V]
+    projections — at Llama vocab sizes the lm_head matmul dominates a
+    short verify, so the fused rounds' per-round draft resync uses this
+    form.
     """
     from ..kv.cache import write_tokens_kv
 
@@ -454,6 +466,8 @@ def verify_forward(
             m = _norm(cfg, m, layer["ln_post_mlp"])
         x = x + m
     x = _norm(cfg, x, params["ln_out"])
+    if last_only:
+        x = x[:, -1:]
     return _final_logits(params, cfg, x), cache
 
 

@@ -1,4 +1,18 @@
-"""Pallas TPU kernel: paged decode attention.
+"""Pallas TPU kernels: paged decode + flash prefill attention.
+
+STATUS: DOCUMENTED EXPERIMENT (round 11; docs/tpu_perf_notes.md
+§pallas-verdict).  Both kernels pass their Mosaic acceptance tests on
+chip but ship opt-in-OFF (``ISTPU_PALLAS_DECODE`` /
+``ISTPU_PALLAS_PREFILL``): every in-model measurement on the tunneled
+v5e lost to XLA (paged decode 0.69x, jax's bundled kernel 0.19-0.21x —
+two independent kernels losing the same way points at per-pallas_call
+invocation overhead on this runtime, not kernel math), and the engine
+is dispatch-bound, not device-bound (``host_stall_frac`` ≈ 0).  The
+re-entry path at the next live TPU capture is
+``scripts/pallas_tune.py`` — a block-size/layout sweep vs XLA over the
+acceptance shapes whose JSON verdict (``pallas_speedup_vs_xla``) the
+staged bench_tpu assert settles on; flip the defaults only on a
+replicated >1x from that sweep.
 
 The decode hot loop reads every cached K/V page of every active sequence per
 token -- purely HBM-bandwidth-bound.  The XLA version

@@ -280,6 +280,8 @@ class Console:
             summ.get("tokens"))
         d_steps = self.deltas.setdefault("eng_steps", _Delta()).update(
             summ.get("steps"))
+        d_disp = self.deltas.setdefault("eng_disp", _Delta()).update(
+            summ.get("dispatch_total"))
         if d_tok is not None:
             self._series("eng_tok").append(d_tok)
         by_kind = summ.get("by_kind") or {}
@@ -287,13 +289,22 @@ class Console:
             f"{k}:{by_kind[k]}" for k in
             ("prefill", "decode", "spec", "mixed", "idle") if k in by_kind
         )
+        # per-frame dispatch economy: compiled programs launched per
+        # token THIS frame (the single-sync speculation work's live
+        # readout — the summary's dispatches_per_token is the lifetime
+        # aggregate, too damped to watch a regression land)
+        disp_tok = (
+            "-" if d_disp is None or not d_tok
+            else f"{d_disp / d_tok:.2f}"
+        )
         out.append(
             "engine   tok/frame {:>6}  {}  steps/frame {:>4}  "
-            "dispatches {:>7}  ({})".format(
+            "dispatches {:>7}  disp/tok {:>5}  ({})".format(
                 "-" if d_tok is None else int(d_tok),
                 sparkline(list(self._series("eng_tok")), 16),
                 "-" if d_steps is None else int(d_steps),
                 int(summ.get("dispatch_total", 0)),
+                disp_tok,
                 kinds or "no steps yet",
             )
         )

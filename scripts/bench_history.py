@@ -63,6 +63,12 @@ METRICS = {
     # is flagged here, not argued about
     "host_stall_frac": ("down", "serving host-stall frac"),
     "retraces_per_100_steps": ("down", "retraces / 100 steps"),
+    # dispatch economy (the single-sync speculation work): compiled
+    # programs launched per decoded token, and accepted draft tokens
+    # per fused spec dispatch — the two numbers that turn "spec is
+    # 0.53x at 0.938 acceptance" into an attributable regression
+    "dispatches_per_token": ("down", "dispatches / decoded token"),
+    "spec_accept_per_dispatch": ("up", "spec accepted / dispatch"),
     # the health plane's verdict on the serving run (bench_serve.py
     # `health` block): watchdog firing transitions during the sweep —
     # a round that starts paging under the same load is a regression

@@ -1138,3 +1138,116 @@ def test_scheduler_batches_distinct_top_p():
     res = sched.run()
     assert set(res) == {a, b}
     assert all(len(v) == 4 for v in res.values())
+
+
+# ---- round 11: batch-dim bucketed decode programs ----
+
+
+def test_decode_batch_pad_rows_are_inert():
+    """A non-pow2 batch rides a padded program whose pad rows must not
+    corrupt ANY real sequence: greedy decode_batch at B=3 (padded to 4)
+    must equal each row's solo decode — in particular, the sequence
+    owning block 0, which a zero-filled pad table row would silently
+    scribble on (the pad sentinel is out-of-bounds instead: scatter
+    drops, gather clamps)."""
+    prompts = [
+        [11, 42, 7, 99, 5, 3, 17],
+        [2, 4, 6, 8, 10, 12, 14, 16, 18],
+        [9, 1, 9, 2, 9, 3],
+    ]
+    wants = []
+    for p in prompts:
+        solo = InferenceEngine(PARAMS, CFG, make_pc())
+        wants.append(solo.generate(p, 12))
+
+    eng = InferenceEngine(PARAMS, CFG, make_pc())
+    states = [eng.prefill(p) for p in prompts]
+    outs = eng.decode_batch(states, 12)
+    assert outs == wants
+
+
+def test_decode_batch_bucketed_batch_dim_never_retraces():
+    """The steady-state retrace guard: batch compositions inside one
+    power-of-two bucket (B=3 and B=4 both ride the Bp=4 program) must
+    reuse the SAME compiled decode scan — zero new decode_many traces
+    after the bucket is warm.  This is what keeps
+    ``retraces_per_100_steps`` flat when continuous batching churns the
+    active set."""
+    from infinistore_tpu.engine import stepprof as _sp
+
+    eng = InferenceEngine(PARAMS, CFG, make_pc())
+    prompts = [
+        [11, 42, 7, 99, 5, 3, 17],
+        [2, 4, 6, 8, 10, 12, 14, 16],
+        [9, 1, 9, 2, 9, 3],
+        [5, 6, 7, 8, 9, 10, 11],
+    ]
+    states = [eng.prefill(p) for p in prompts]
+    # warm the Bp=4 bucket (and its block-table width) at full width
+    eng.decode_batch(states, 8)
+    t0 = _sp.trace_counts().get("decode_many", 0)
+    # composition churn INSIDE the bucket: 3 rows, then 4 again —
+    # same padded program, no new traces
+    eng.decode_batch(states[:3], 8)
+    eng.decode_batch(states, 8)
+    assert _sp.trace_counts().get("decode_many", 0) == t0, (
+        "decode scan retraced inside a warm batch bucket"
+    )
+
+
+def test_decode_batch_seeded_rows_reproduce_across_compositions():
+    """A seeded row's stream is pinned by PRNGKey(seed) + absolute
+    position, so its tokens must be identical whether it decodes among
+    2 batchmates or 3 (different pad widths included)."""
+    seeded_prompt = [3, 1, 4, 1, 5, 9, 2, 6]
+
+    def run(n_mates):
+        eng = InferenceEngine(PARAMS, CFG, make_pc())
+        sts = [eng.prefill(seeded_prompt)]
+        for i in range(n_mates):
+            sts.append(eng.prefill([7 + i, 8, 9, 10, 11, 12]))
+        outs = eng.decode_batch(
+            sts, 10, sample="categorical", temperature=1.1,
+            seed=[123] + [None] * n_mates,
+        )
+        return outs[0]
+
+    assert run(1) == run(2) == run(3)
+
+
+def test_scheduler_zero_retraces_after_warmup_under_churn():
+    """The /debug/engine acceptance criterion: with batch-dim, chunk,
+    and table-width bucketing in place, a batch-composition-varying
+    serving phase must run at retraces_per_100_steps == 0 once the
+    bucket universe is warm — every admission/retirement recomposition
+    reuses a compiled program."""
+    from infinistore_tpu.engine import Scheduler
+    from infinistore_tpu.engine.stepprof import StepProfiler
+    from infinistore_tpu.utils.metrics import MetricsRegistry
+
+    eng = InferenceEngine(PARAMS, CFG, make_pc(n_blocks=256))
+    sched = Scheduler(eng, max_batch=4)
+    rng = np.random.RandomState(0)
+
+    def prompt():
+        return [int(x) for x in rng.randint(1, CFG.vocab_size, size=9)]
+
+    def drive():
+        # 3-wide wave + a mid-flight admission (chunked prefill), with
+        # retirements staggering the batch through compositions 1..4
+        for _ in range(3):
+            sched.submit(prompt(), max_new_tokens=64)
+        steps = 0
+        while sched.has_work:
+            sched.step()
+            steps += 1
+            if steps == 1:
+                sched.submit(prompt(), max_new_tokens=64)
+
+    drive()  # warmup: compiles every bucket the pattern touches
+    prof = StepProfiler(metrics=MetricsRegistry(), sample=1000)
+    sched.stepprof = prof
+    drive()  # steady state: same dynamics, zero new programs
+    summ = prof.snapshot(limit=0)["summary"]  # the /debug/engine payload
+    assert summ["steps"] > 0
+    assert summ["retraces_per_100_steps"] == 0.0, summ["retraces"]

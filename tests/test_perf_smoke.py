@@ -265,6 +265,56 @@ def test_shm_push_performs_zero_intermediate_host_copies(server,
     conn.close()
 
 
+def test_fused_spec_chunk_single_sync_structural():
+    """STRUCTURAL: one fused-speculation chunk at full acceptance must
+    cost exactly ONE compiled dispatch, ONE blocking host sync, and
+    ZERO host-side reconcile dispatches (verify/draft) — the
+    single-sync contract of the device-resident reconcile
+    (engine/speculative.py).  A regression that reintroduces the
+    host-side trim (a ``_resync_draft`` or tail-refresh ``verify``
+    after the fused program) flips these counters long before it shows
+    up as tokens/s."""
+    import jax
+    import jax.numpy as jnp
+
+    from infinistore_tpu.engine import InferenceEngine
+    from infinistore_tpu.engine.speculative import SpeculativeDecoder
+    from infinistore_tpu.engine.stepprof import StepProfiler
+    from infinistore_tpu.kv import PagedCacheConfig
+    from infinistore_tpu.models import TINY, init_params, scaled
+
+    cfg = scaled(TINY, dtype=jnp.float32)
+    params = init_params(cfg, jax.random.PRNGKey(7))
+
+    def eng():
+        pc = PagedCacheConfig(
+            n_layers=cfg.n_layers, n_kv_heads=cfg.n_kv_heads,
+            head_dim=cfg.head_dim, n_blocks=64, block_tokens=4,
+            dtype=cfg.dtype,
+        )
+        return InferenceEngine(params, cfg, pc)
+
+    # self-draft: acceptance 1, so the adaptive controller's first
+    # dispatch covers the whole chunk — the single-sync fast path
+    spec = SpeculativeDecoder(eng(), eng(), k=3)
+    prompt = [11, 42, 7, 99, 5, 3, 17, 28, 64, 1, 2]
+    st_t, st_d = spec.prefill(prompt)
+    spec.decode(st_t, st_d, 24)  # warm: compile outside the guard
+    st_t2, st_d2 = spec.prefill(prompt + [29, 31])
+    prof = StepProfiler(sample=1)
+    with prof.step(kind_hint="spec") as rec:
+        out = spec.decode(st_t2, st_d2, 24)
+    assert len(out) == 24
+    assert rec["dispatches"] == {"spec_round": 1}, (
+        f"one fused chunk must be ONE dispatch with zero reconcile "
+        f"(verify/draft) dispatches — got {rec['dispatches']}"
+    )
+    assert rec["syncs"] == {"spec_tokens": 1}, (
+        f"one fused chunk must block on the host exactly once — got "
+        f"{rec['syncs']}"
+    )
+
+
 def test_store_attached_prefill_within_budget(server, monkeypatch):
     """The commit-after-respond contract, measured: with relaxed
     durability the prefill critical path carries only the cheap half of

@@ -632,3 +632,172 @@ def test_distilled_draft_learns_target_outputs():
     spec = SpeculativeDecoder(
         make_engine(tparams, CFG), make_engine(dparams, dcfg), k=4)
     assert spec.generate(prompt, 16) == want
+
+
+# ---- round 11: single-sync restructure (adaptive R, device reconcile) ----
+
+
+def test_adaptive_controller_bucket_choices_follow_acceptance():
+    """Injected acceptance sequences → bucket choices: a fresh
+    controller is optimistic (covers the chunk with the smallest
+    sufficient bucket); a weak draft walks the EWMA down and the
+    suggestion up toward the largest bucket; recovery walks it back."""
+    from infinistore_tpu.engine.speculative import AdaptiveRController
+
+    ctl = AdaptiveRController(k=4, buckets=(1, 2, 8))
+    assert ctl.rate == 5.0  # optimistic start: full acceptance
+    # 32-token chunk at rate 5 needs ~7 rounds -> bucket 8
+    assert ctl.suggest(32) == 8
+    # a short tail the EWMA covers in one round -> smallest bucket
+    assert ctl.suggest(4) == 1
+    # feed a weak draft: 1 token/round for a while
+    for _ in range(20):
+        ctl.update(1, 1)
+    assert ctl.rate < 1.5
+    # now even a short remaining budget needs the big program
+    assert ctl.suggest(8) == 8
+    # recovery: full rounds again
+    for _ in range(20):
+        ctl.update(5, 1)
+    assert ctl.rate > 4.5
+    # (7 not 8: at remaining=8 the down-switch margin 2*rate >= 8*1.25
+    # sits exactly at the EWMA's asymptote — by-design hysteresis)
+    assert ctl.suggest(7) == 2
+
+
+def test_adaptive_controller_bounded_set_and_hysteresis():
+    """Suggestions never leave the configured bucket set, and the
+    down-switch margin keeps an EWMA wobbling around a bucket boundary
+    from flapping between two compiled programs."""
+    from infinistore_tpu.engine.speculative import AdaptiveRController
+
+    ctl = AdaptiveRController(k=4, buckets=(2, 4, 8), hysteresis=0.25)
+    seen = set()
+    accept = [5, 1, 3, 5, 5, 1, 1, 4, 2, 5] * 4
+    for a in accept:
+        ctl.update(a, 1)
+        seen.add(ctl.suggest(16))
+    assert seen <= {2, 4, 8}
+
+    # hysteresis: remaining=8, boundary between bucket 2 (needs rate 4)
+    # and bucket 4.  At rate exactly 4.0 a DOWN-switch from 4 needs
+    # 2 * 4.0 >= 8 * 1.25 — not met, so the controller stays at 4; a
+    # margin-free controller would flip to 2 and back as the EWMA
+    # wobbles across 4.0
+    ctl2 = AdaptiveRController(k=4, buckets=(2, 4, 8), hysteresis=0.25)
+    ctl2.rate, ctl2._bucket = 4.0, 4
+    assert ctl2.suggest(8) == 4
+    ctl2.rate = 4.4   # still inside the margin band (needs >= 5.0)
+    assert ctl2.suggest(8) == 4
+    ctl2.rate = 5.0   # clears the band: now the smaller program is safe
+    assert ctl2.suggest(8) == 2
+    # ...and staying down needs no margin even if the rate dips a bit
+    ctl2.rate = 4.2
+    assert ctl2.suggest(8) == 2
+
+
+def test_r_bucket_env_parsing_is_bounded():
+    """ISTPU_SPEC_R_BUCKETS parsing: sorted/deduped, clamped to at most
+    4 values in [1, 32]; garbage falls back to the default — every
+    bucket is a whole compiled program, so the set must stay bounded."""
+    from infinistore_tpu.engine.speculative import _parse_r_buckets
+
+    assert _parse_r_buckets(None) == (1, 2, 8)
+    assert _parse_r_buckets("") == (1, 2, 8)
+    assert _parse_r_buckets("8,2,1,2") == (1, 2, 8)
+    assert _parse_r_buckets("4") == (4,)
+    assert _parse_r_buckets("1,2,4,8,16,32") == (1, 2, 4, 8)  # clamped
+    assert _parse_r_buckets("0,33,7") == (7,)  # out-of-range dropped
+    assert _parse_r_buckets("nonsense") == (1, 2, 8)
+    assert _parse_r_buckets("-3,0") == (1, 2, 8)
+
+
+def test_stochastic_fused_tokens_invariant_across_r_buckets(monkeypatch):
+    """The per-request-seed contract under changing R: stochastic draws
+    fold the base key with the token's absolute position (draft) or the
+    round's accepted length (accept/resample), so a fixed rng must
+    reproduce the SAME tokens whatever the bucket set groups rounds
+    into — across plain AND filter variants, and across call
+    boundaries."""
+    outs = {}
+    for buckets in ("8", "1", "2,4"):
+        monkeypatch.setenv("ISTPU_SPEC_R_BUCKETS", buckets)
+        for kw in (
+            {"temperature": 0.9},
+            {"temperature": 0.9, "top_k": 12, "top_p": 0.85},
+        ):
+            spec = SpeculativeDecoder(
+                make_engine(TARGET_PARAMS, CFG),
+                make_engine(DRAFT_PARAMS, DRAFT_CFG), k=4,
+            )
+            st_t, st_d = spec.prefill(PROMPT)
+            toks = spec.decode(
+                st_t, st_d, 17, sample="categorical",
+                rng=jax.random.PRNGKey(5), **kw,
+            )
+            key = tuple(sorted(kw.items()))
+            outs.setdefault(key, []).append(toks)
+    for key, runs in outs.items():
+        assert all(r == runs[0] for r in runs), (key, runs)
+    # chunk-boundary invariance: one 16-token call == two 8-token calls
+    # under the same base rng (draws fold by absolute position/length)
+    monkeypatch.setenv("ISTPU_SPEC_R_BUCKETS", "2,8")
+
+    def run(chunks):
+        spec = SpeculativeDecoder(
+            make_engine(TARGET_PARAMS, CFG),
+            make_engine(DRAFT_PARAMS, DRAFT_CFG), k=4,
+        )
+        st_t, st_d = spec.prefill(PROMPT)
+        toks = []
+        for c in chunks:
+            toks += spec.decode(st_t, st_d, c, sample="categorical",
+                                temperature=0.9,
+                                rng=jax.random.PRNGKey(11))
+        return toks
+
+    assert run([16]) == run([8, 8])
+
+
+def test_adaptive_controller_carried_per_request_and_forgotten():
+    """The controller is carried per TARGET seq id across scheduler
+    steps (acceptance learned on one chunk sizes the next) and dropped
+    at retirement — a retired id's state must not leak."""
+    sched = make_spec_scheduler()
+    # 70 tokens = at least three 32-token chunks, so the controller
+    # must survive across steps before retirement drops it
+    rid = sched.submit(PROMPT, max_new_tokens=70)
+    sched.step()
+    assert sched.spec.adaptive
+    assert len(sched.spec._ctls) == 1
+    (ctl,) = sched.spec._ctls.items()
+    seq_id, c0 = ctl
+    rate_after_step1 = c0.rate
+    assert rate_after_step1 < 5.0  # the weak draft moved the EWMA
+    sched.step()
+    assert sched.spec._ctls.get(seq_id) is c0, "controller not carried"
+    sched.run()
+    assert sched.spec._ctls == {}, "controller leaked past retirement"
+
+
+def test_fused_batch_single_dispatch_at_full_acceptance():
+    """Self-draft (acceptance 1) + adaptive R: a whole chunk must cost
+    exactly ONE fused dispatch and ONE blocking sync, with ZERO host
+    reconcile dispatches (verify/draft) — the structural core of the
+    single-sync restructure, asserted from the step profiler record."""
+    from infinistore_tpu.engine import stepprof as _sp
+    from infinistore_tpu.engine.stepprof import StepProfiler
+
+    spec = SpeculativeDecoder(
+        make_engine(TARGET_PARAMS, CFG),
+        make_engine(TARGET_PARAMS, CFG), k=3,
+    )
+    st_t, st_d = spec.prefill(PROMPT)
+    spec.decode(st_t, st_d, 24)  # warm: compile the bucket programs
+    st_t2, st_d2 = spec.prefill(list(PROMPT) + [29, 31])
+    prof = StepProfiler(sample=1)
+    with prof.step(kind_hint="spec") as rec:
+        out = spec.decode(st_t2, st_d2, 24)
+    assert len(out) == 24
+    assert rec["dispatches"] == {"spec_round": 1}, rec["dispatches"]
+    assert rec["syncs"] == {"spec_tokens": 1}, rec["syncs"]

@@ -450,3 +450,61 @@ def test_single_stitched_trace_http_to_device(tiny_engine_parts, live_store,
         srv.close()
         conn.close()
         prod_conn.close()
+
+
+# ---- round 11: blocking-sync accounting + dispatch economy ----
+
+
+def test_note_sync_counts_and_summary_economy():
+    """note_sync lands on the active record, aggregates into lifetime
+    totals and the istpu_engine_syncs_total family, and the summary
+    derives dispatches_per_token from dispatches over tokens."""
+    from infinistore_tpu.engine import stepprof as sp
+
+    prof = _prof(sample=1000)
+    with prof.step(kind_hint="spec") as rec:
+        sp.note_dispatch("spec_round")
+        sp.note_tokens(24)
+        sp.note_sync("spec_tokens")
+    assert rec["syncs"] == {"spec_tokens": 1}
+    with prof.step(kind_hint="decode") as rec2:
+        sp.note_dispatch("decode", 3)
+        sp.note_tokens(96)
+        sp.note_sync("decode_tokens", 3)
+    s = prof.summary()
+    assert s["syncs"] == {"spec_tokens": 1, "decode_tokens": 3}
+    assert s["syncs_total"] == 4
+    assert s["dispatches_per_token"] == round(4 / 120, 4)
+    text = prof.metrics.to_prometheus_text()
+    assert 'istpu_engine_syncs_total{kind="spec_tokens"} 1' in text
+    assert 'istpu_engine_syncs_total{kind="decode_tokens"} 3' in text
+    # no sync outside an active record: silently dropped, no crash
+    sp.note_sync("spec_tokens")
+    assert prof.summary()["syncs_total"] == 4
+
+
+def test_summary_spec_accept_per_dispatch():
+    """The lifetime spec aggregates fold per-step deltas of the
+    scheduler's speculator counters; accepted-per-dispatch divides by
+    the fused-dispatch count (the r4 '0.53x at 0.938 acceptance'
+    explainer)."""
+
+    class _Spec:
+        rounds = proposed = accepted = 0
+
+    class _Sched:
+        spec = _Spec()
+        active = ()
+        _prefilling = ()
+        pending = ()
+        engine = None
+
+    sched = _Sched()
+    prof = _prof(sample=1000)
+    with prof.step(sched):
+        from infinistore_tpu.engine import stepprof as sp
+
+        sp.note_dispatch("spec_round", 2)
+        _Spec.rounds, _Spec.proposed, _Spec.accepted = 16, 64, 38
+    s = prof.summary()
+    assert s.get("spec_accept_per_dispatch") == round(38 / 2, 3)

@@ -136,6 +136,8 @@ def test_console_renders_engine_view():
     assert "engine" in out
     assert "tok/frame     80" in out       # per-frame delta
     assert "steps/frame    4" in out
+    # per-frame dispatch economy: Δdispatch_total / Δtokens = 4/80
+    assert "disp/tok  0.05" in out
     assert "retraces     5" in out and "+1/frame" in out
     assert "host-stall  42.0%" in out
     assert "mem [" in out and "50/100 MB (peak)" in out
