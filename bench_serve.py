@@ -25,7 +25,11 @@ retrace pressure, dispatch counts for the whole sweep; ``health`` is
 the health plane's verdict (``GET /debug/health``): alert firing
 transitions and the peak burn rate observed, with ``alerts_fired``
 mirrored top-level for the trend table (both absent against servers
-without the endpoints).
+without the endpoints); ``admission`` is the overload-control verdict —
+client-observed 429 shed counts per lane, the server's
+``GET /debug/admission`` shed/quota tallies, and the ``plateau`` flag
+(goodput at the highest offered rate held ≥50% of the curve's peak
+instead of collapsing), with ``goodput_plateau`` mirrored top-level.
 """
 
 from __future__ import annotations
@@ -92,7 +96,8 @@ def self_serve(args):
     eng.decode_chunk = 4
     srv = ServingServer(eng, port=0, max_batch=args.self_serve_batch,
                         model_id="tiny-bench",
-                        slo_ttft_s=args.slo_ttft, slo_tpot_s=args.slo_tpot)
+                        slo_ttft_s=args.slo_ttft, slo_tpot_s=args.slo_tpot,
+                        quotas=args.quotas or None)
     srv.start()
     return srv, f"http://127.0.0.1:{srv.port}", cfg.vocab_size
 
@@ -132,6 +137,15 @@ def main(argv=None) -> int:
                          "the served model's vocab")
     ap.add_argument("--no-stream", action="store_true",
                     help="non-streaming requests (TTFT == e2e)")
+    ap.add_argument("--honor-retry-after", action="store_true",
+                    help="a 429-shed request sleeps the server's "
+                         "Retry-After (capped 10 s) and re-attempts "
+                         "once; default off — the raw shed behavior is "
+                         "the measurement")
+    ap.add_argument("--quota", action="append", default=[],
+                    dest="quotas", metavar="TENANT:TOKS_PER_S[:BURST_S]",
+                    help="--self-serve only: per-tenant token quotas "
+                         "passed through to the in-process server")
     ap.add_argument("--timeout", type=float, default=120.0)
     ap.add_argument("--slo-ttft", type=float,
                     default=float(os.environ.get("ISTPU_SLO_TTFT_S", 2.0)),
@@ -164,6 +178,7 @@ def main(argv=None) -> int:
         n_prefixes=args.prefixes, prefix_len=args.prefix_len,
         prefix_frac=args.prefix_frac, vocab=vocab,
         stream=not args.no_stream, timeout_s=args.timeout,
+        honor_retry_after=args.honor_retry_after,
     )
 
     def show(point):
@@ -176,6 +191,7 @@ def main(argv=None) -> int:
         print(
             f"# rate {point['offered_rate_rps']:>6.2f} rps  "
             f"completed {point['completed']}/{point['n']}  "
+            f"rejected {point.get('rejected', 0)}  "
             f"goodput {point['goodput_rps']:.2f} rps  "
             f"attainment {point['slo_attainment']:.0%}  {lanes}",
             file=sys.stderr,
@@ -241,6 +257,20 @@ def main(argv=None) -> int:
                 }
         except Exception:  # noqa: BLE001 — observability, not the bench
             pass
+        # the admission plane's verdict (best-effort, same contract):
+        # server-side shed/quota tallies next to the client-observed
+        # rejection counts below
+        admission_dbg = None
+        try:
+            import urllib.request
+
+            with urllib.request.urlopen(url + "/debug/admission",
+                                        timeout=5) as r:
+                payload = json.loads(r.read())
+            if payload.get("enabled"):
+                admission_dbg = payload
+        except Exception:  # noqa: BLE001 — observability, not the bench
+            pass
     finally:
         if srv is not None:
             srv.close()
@@ -274,6 +304,35 @@ def main(argv=None) -> int:
         if stepprof.get("spec_accept_per_dispatch") is not None:
             record["spec_accept_per_dispatch"] = \
                 stepprof["spec_accept_per_dispatch"]
+    # admission block (docs/observability.md): shed counts per lane as
+    # the CLIENT saw them (429s per priority lane), the server-side
+    # shed/quota tallies when /debug/admission answered, and the
+    # plateau flag — did goodput at the highest offered rate hold ≥50%
+    # of the curve's peak (a plateau) instead of collapsing?
+    per_lane_shed: dict = {}
+    for pt in curve:
+        for lane, v in pt["lanes"].items():
+            per_lane_shed[lane] = (per_lane_shed.get(lane, 0)
+                                   + (v.get("rejected") or 0))
+    goodputs = [p["goodput_rps"] for p in curve]
+    plateau = bool(len(goodputs) >= 2 and max(goodputs) > 0
+                   and goodputs[-1] >= 0.5 * max(goodputs))
+    record["admission"] = {
+        "rejected_total": sum(p.get("rejected", 0) for p in curve),
+        "per_lane_shed": per_lane_shed,
+        "plateau": plateau,
+    }
+    if admission_dbg is not None:
+        record["admission"]["server"] = {
+            "mode": admission_dbg.get("mode"),
+            "shed_total": admission_dbg.get("shed_total"),
+            "shed_by_reason": admission_dbg.get("shed_by_reason"),
+            "quota_throttled": (admission_dbg.get("quota")
+                                or {}).get("throttled_total"),
+        }
+    # mirrored top-level (0/1) for the scripts/bench_history.py trend
+    # table: an overload round whose plateau flag drops to 0 regressed
+    record["goodput_plateau"] = int(plateau)
     if health is not None:
         # health-plane block (infinistore_tpu/health.py): alert
         # transitions + burn-rate peak during the run.  alerts_fired is

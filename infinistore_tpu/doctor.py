@@ -11,7 +11,9 @@ cluster) into ONE tarball:
 * from the serving front-end: ``/metrics``, ``/healthz``,
   ``/debug/requests`` (the ledger), ``/debug/engine`` (step profiler),
   ``/debug/traces`` (stitched Perfetto), ``/debug/cluster``,
-  ``/debug/health`` (alerts + flight-recorder series);
+  ``/debug/health`` (alerts + flight-recorder series),
+  ``/debug/admission`` (shed/quota control-loop state — SUMMARY.md
+  answers "are we shedding?" next to the firing alerts);
 * from every reachable store manage plane (``--store-url`` repeated /
   comma-separated, PLUS any node named by the serve's
   ``/debug/health`` cluster rollup — so a clustered deployment is
@@ -50,6 +52,7 @@ SERVE_ENDPOINTS: Tuple[Tuple[str, str, str], ...] = (
     ("traces", "/debug/traces", "debug_traces.json"),
     ("cluster", "/debug/cluster", "debug_cluster.json"),
     ("health", "/debug/health", "debug_health.json"),
+    ("admission", "/debug/admission", "debug_admission.json"),
 )
 STORE_ENDPOINTS: Tuple[Tuple[str, str, str], ...] = (
     ("metrics", "/metrics", "metrics.prom"),
@@ -184,6 +187,46 @@ def summarize_capture(cap: Dict[str, Any], top_n: int = 5) -> str:
                      f"**{hz.get('status', 'unreachable')}**")
         lines.extend(_alert_lines(_json_of(store, "health"), f"store[{i}]"))
     lines.append("")
+
+    # -- admission / shedding state, next to the alerts it reacts to --
+    if serve:
+        lines.append("## Admission / overload control")
+        adm = _json_of(serve, "admission")
+        if not adm or not adm.get("enabled"):
+            lines.append("- admission plane unavailable or disabled "
+                         "(ISTPU_ADMISSION=0)")
+        else:
+            burn = adm.get("burn") or {}
+            shed_lanes = burn.get("shed_lanes") or []
+            mode = adm.get("mode", "?")
+            lines.append(
+                f"- mode **{mode}**"
+                + (f" — SHEDDING lanes {', '.join(shed_lanes)} "
+                   f"(burn {burn.get('value')})" if shed_lanes else "")
+            )
+            sheds = adm.get("shed_by_reason") or {}
+            if sheds:
+                for reason, per_lane in sorted(sheds.items()):
+                    total = sum(per_lane.values())
+                    by = ", ".join(f"lane {ln}: {n}"
+                                   for ln, n in sorted(per_lane.items()))
+                    lines.append(f"- shed[{reason}]: {total} ({by})")
+            else:
+                lines.append("- no submissions shed or throttled")
+            quota = adm.get("quota") or {}
+            for tenant, t in sorted((quota.get("tenants") or {}).items()):
+                lines.append(
+                    f"- quota tenant {tenant}: "
+                    f"{t.get('used_frac', 0):.0%} used of "
+                    f"{t.get('burst_tokens')} tok burst at "
+                    f"{t.get('rate_toks_per_s')} tok/s, "
+                    f"throttled {t.get('throttled', 0)}"
+                )
+            pf = adm.get("prefill_throttle") or {}
+            if pf.get("active"):
+                lines.append(f"- degraded-mode prefill throttle ACTIVE "
+                             f"({pf.get('budget_tokens')} tok/step)")
+        lines.append("")
 
     # -- slowest requests, joined to their steps and traces --
     if serve:

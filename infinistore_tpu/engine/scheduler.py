@@ -147,8 +147,17 @@ class Scheduler:
                  ledger=None,
                  slo_ttft_s: Optional[float] = None,
                  slo_tpot_s: Optional[float] = None,
-                 stepprof=None):
+                 stepprof=None, admission=None):
         self.engine = engine
+        # SLO-aware admission control (infinistore_tpu/admission.py):
+        # when attached, submit() sheds/throttles over-budget or
+        # shed-lane work with AdmissionShed (429 + Retry-After at the
+        # serving layer), and _step_inner caps prefill chunk tokens per
+        # step in degraded mode (queued work always drains — see the
+        # note in _admit).  None (the library default) = every
+        # submission admitted, zero overhead.  ServingServer attaches
+        # its controller right after construction.
+        self.admission = admission
         # per-step engine/device attribution (engine/stepprof.py): when a
         # StepProfiler is attached, every step() emits one structured
         # record, participating requests collect the step ids for the
@@ -317,6 +326,22 @@ class Scheduler:
                 for v in logit_bias.values()
             ):
                 raise ValueError("logit_bias values must be finite and sane")
+        if self.admission is not None:
+            # the admission verdict BEFORE any state is created: a shed
+            # request never holds a queue slot, never charges pages, and
+            # (being pre-admission) is never a mid-stream cancellation.
+            # Raises AdmissionShed -> the serving layer's 429.
+            d = self.admission.check_submit(
+                lane=priority, tokens=len(tokens) + max_new_tokens)
+            if not d.admitted:
+                from ..admission import AdmissionShed
+
+                raise AdmissionShed(
+                    d.reason, d.retry_after_s,
+                    ("tenant over token quota; retry later"
+                     if d.reason == "quota"
+                     else "server shedding load on this lane; retry later"),
+                )
         if sample == "greedy":
             # greedy ignores these; normalizing keeps greedy requests in one
             # lockstep batch (and one compiled program) regardless of the
@@ -436,6 +461,14 @@ class Scheduler:
         # and a top-p request share one lockstep batch
         if not self.pending:
             return
+        # NOTE on degraded mode: work already in ``pending`` is never
+        # held back by lane here — the queue is priority-sorted, so
+        # protected lanes admit first anyway, and freezing shed-lane
+        # backlog would only let it age into guaranteed SLO violations
+        # that re-ignite the burn the moment it clears (a fire/clear
+        # oscillation).  The admission controller acts at the submit
+        # boundary (shed new work) and via the per-step prefill token
+        # budget (_step_inner); queued work always drains.
         if self.active or self._prefilling:
             # a batch is decoding (or newcomers are already ingesting):
             # admit newcomers via CHUNKED prefill — prefill_start here, one
@@ -819,6 +852,14 @@ class Scheduler:
             self._admit()
         cancelled_prefill: List[Request] = []
         still: List[Tuple[Request, PartialPrefill]] = []
+        # degraded-mode chunked-prefill throttle: while a burn watchdog
+        # fires, only this many prefill chunk tokens advance per step
+        # (None = no cap) — decode keeps its TPOT for the protected
+        # lane, prefill queues.  Cancellations always process (they FREE
+        # resources).
+        pf_budget = (self.admission.prefill_token_budget()
+                     if self.admission is not None else None)
+        chunk_cost = self.engine.prefill_chunk or 1
         for req, pp in self._prefilling:
             if req.cancelled:
                 self.engine.abandon_prefill(pp)
@@ -827,9 +868,14 @@ class Scheduler:
                 self._finish(req, "cancelled")
                 cancelled_prefill.append(req)
                 continue
+            if pf_budget is not None and pf_budget <= 0:
+                still.append((req, pp))  # over budget: hold this step
+                continue
             with tracing.bind(req.trace_id), \
                     tracing.span("sched.prefill_step", req=req.req_id):
                 st = self.engine.prefill_step(pp)  # ONE chunk per step each
+            if pf_budget is not None:
+                pf_budget -= chunk_cost
             if st is not None:
                 req.state = st
                 self.active.append(req)

@@ -181,6 +181,19 @@ class TimeSeriesRing:
         with self._lock:
             return sorted(self._series)
 
+    def began(self, name: str) -> Optional[float]:
+        """Timestamp of the series' first-ever sample (None before
+        any).  Consumers turning a counter ``delta`` into a rate must
+        divide by the span actually covered, not the nominal window —
+        on a plane younger than the window, ``delta`` degrades to
+        "increase since recording began" (see ``value_at``), and the
+        full-window divisor would understate the rate badly."""
+        with self._lock:
+            s = self._series.get(name)
+            if s is None or s.first is None:
+                return None
+            return s.first[0]
+
     def latest(self, name: str) -> Optional[Tuple[float, float]]:
         with self._lock:
             s = self._series.get(name)
@@ -605,6 +618,18 @@ def serve_probes(server) -> Dict[str, Callable[[], Any]]:
         return {"backlog": st._q.qsize(),
                 "parked": 1.0 if st._err is not None else 0.0}
 
+    def admission(attr: str) -> Callable[[], Optional[float]]:
+        # the controller is attached to the server right AFTER the
+        # sampler is built, so resolve it lazily at tick time; probes
+        # answer None (series absent) while admission is disabled
+        def probe() -> Optional[float]:
+            a = getattr(server, "admission", None)
+            if a is None or not a.enabled:
+                return None
+            return float(getattr(a, attr)())
+
+        return probe
+
     def finished() -> Optional[float]:
         h = sreg.family_hist("istpu_serve_ttft_seconds")
         return h[0] if h else None
@@ -631,6 +656,13 @@ def serve_probes(server) -> Dict[str, Callable[[], Any]]:
         "serve.viol_tpot": lambda: sreg.family_value(
             "istpu_serve_slo_violations_total",
             where={"slo": "tpot"}) or 0.0,
+        # admission-control series (infinistore_tpu/admission.py): shed
+        # and quota-throttle counters plus the mode code land in the
+        # flight recorder, so "when did we start shedding" is a
+        # ?series= read and istpu-doctor bundles carry the history
+        "serve.shed": admission("shed_total"),
+        "serve.quota_throttled": admission("throttled_total"),
+        "serve.admission_mode": admission("mode_code"),
         "store.circuit": circuit,
         "store.streamer": streamer,
         "store.push_dropped": lambda: dreg.family_value(

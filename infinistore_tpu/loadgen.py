@@ -91,6 +91,11 @@ class LoadConfig:
     vocab: int = 256          # token ids drawn in [0, vocab)
     stream: bool = True       # SSE streaming (client-observed TTFT)
     timeout_s: float = 120.0  # per-request HTTP timeout
+    # a 429-shed request may honor the server's Retry-After once: sleep
+    # (capped at retry_cap_s) and re-attempt a single time.  Off by
+    # default — the open-loop measurement should see raw shed behavior
+    honor_retry_after: bool = False
+    retry_cap_s: float = 10.0
     extra_body: Dict[str, Any] = field(default_factory=dict)
 
 
@@ -133,18 +138,40 @@ def make_requests(cfg: LoadConfig) -> List[Dict[str, Any]]:
     return out
 
 
-def _http_post(url: str, body: Dict[str, Any],
-               timeout_s: float) -> Dict[str, Any]:
+def _http_post(url: str, body: Dict[str, Any], timeout_s: float,
+               honor_retry_after: bool = False,
+               retry_cap_s: float = 10.0,
+               sleep: Callable[[float], None] = time.sleep
+               ) -> Dict[str, Any]:
+    """POST one completion request (optionally honoring one 429
+    Retry-After).  A shed (429) is a *rejection*, not an error: the
+    result carries ``rejected: True`` + the parsed ``retry_after_s`` so
+    ``summarize`` keeps the goodput math honest."""
+    r = _http_post_once(url, body, timeout_s)
+    if r["rejected"] and honor_retry_after:
+        # a single polite re-attempt at the server's suggested time
+        # (capped): rejected-then-completed counts as completed, with
+        # the wait inside its e2e
+        sleep(min(r.get("retry_after_s") or retry_cap_s, retry_cap_s))
+        r2 = _http_post_once(url, body, timeout_s)
+        r2["reattempted"] = True
+        return r2
+    return r
+
+
+def _http_post_once(url: str, body: Dict[str, Any],
+                    timeout_s: float) -> Dict[str, Any]:
     """POST one completion request; parse the SSE stream for the
     client-observed first-token and last-token stamps.  Returns the raw
     per-request result dict (``ok``/``status``/``ttft_s``/``tpot_s``/
-    ``e2e_s``/``tokens``/``error``)."""
+    ``e2e_s``/``tokens``/``error``/``rejected``/``retry_after_s``)."""
     parts = urlsplit(url)
     t0 = time.perf_counter()
     first = last = None
     tokens = 0
     status = 0
     err = None
+    retry_after = None
     try:
         conn = http.client.HTTPConnection(
             parts.hostname, parts.port, timeout=timeout_s
@@ -156,7 +183,24 @@ def _http_post(url: str, body: Dict[str, Any],
             )
             resp = conn.getresponse()
             status = resp.status
-            if status != 200:
+            if status == 429:
+                # admission shed: Retry-After header first (the HTTP
+                # contract), the JSON body's retry_after_s as fallback
+                raw = resp.read().decode(errors="replace")
+                hdr = resp.getheader("Retry-After")
+                try:
+                    retry_after = float(hdr) if hdr else None
+                except ValueError:
+                    retry_after = None
+                try:
+                    payload = json.loads(raw)
+                    err = str(payload.get("error", raw))[:200]
+                    if retry_after is None:
+                        ra = payload.get("retry_after_s")
+                        retry_after = float(ra) if ra is not None else None
+                except (ValueError, TypeError):
+                    err = raw[:200]
+            elif status != 200:
                 err = resp.read().decode(errors="replace")[:200]
             elif body.get("stream"):
                 for raw in resp:
@@ -192,6 +236,10 @@ def _http_post(url: str, body: Dict[str, Any],
     return {
         "ok": ok, "status": status, "error": err, "tokens": tokens,
         "lane": body.get("priority", 0),
+        # a shed is not a failure: summarize counts it separately so
+        # goodput/error math stays honest under admission control
+        "rejected": status == 429,
+        "retry_after_s": retry_after,
         "ttft_s": (first - t0) if first is not None else None,
         "tpot_s": ((last - first) / (tokens - 1)
                    if ok and first is not None and last is not None
@@ -217,7 +265,9 @@ def run_load(url: str, cfg: LoadConfig,
     offsets = arrival_offsets(cfg.rate, cfg.n_requests, cfg.process,
                               random.Random(cfg.seed))
     bodies = make_requests(cfg)
-    do_post = post or (lambda b: _http_post(url, b, cfg.timeout_s))
+    do_post = post or (lambda b: _http_post(
+        url, b, cfg.timeout_s, honor_retry_after=cfg.honor_retry_after,
+        retry_cap_s=cfg.retry_cap_s))
     results: List[Optional[Dict[str, Any]]] = [None] * cfg.n_requests
     threads: List[threading.Thread] = []
     t0 = clock()
@@ -245,7 +295,8 @@ def run_load(url: str, cfg: LoadConfig,
         if r is None:
             results[i] = {
                 "ok": False, "status": 0, "error": "timeout", "tokens": 0,
-                "lane": bodies[i].get("priority", 0), "ttft_s": None,
+                "lane": bodies[i].get("priority", 0), "rejected": False,
+                "retry_after_s": None, "ttft_s": None,
                 "tpot_s": None, "e2e_s": None,
                 "sched_off_s": round(offsets[i], 6), "late_s": 0.0,
             }
@@ -276,8 +327,13 @@ def summarize(results: List[Dict[str, Any]], makespan_s: float,
               slo_ttft_s: float, slo_tpot_s: float,
               rate: Optional[float] = None) -> Dict[str, Any]:
     """One run's summary: counts, achieved/goodput rates, SLO
-    attainment, and per-lane TTFT/TPOT percentiles."""
+    attainment, and per-lane TTFT/TPOT percentiles.  A 429-shed request
+    counts as ``rejected``, NOT as an error — shedding is the server
+    keeping its promise under overload, and conflating it with failures
+    would make the goodput math lie in both directions."""
     ok = [r for r in results if r.get("ok")]
+    rejected = [r for r in results
+                if r.get("rejected") and not r.get("ok")]
     met = [r for r in ok if meets_slo(r, slo_ttft_s, slo_tpot_s)]
     lanes: Dict[str, Dict[str, Any]] = {}
     for lane in sorted({r["lane"] for r in results}):
@@ -287,6 +343,7 @@ def summarize(results: List[Dict[str, Any]], makespan_s: float,
         lanes[str(lane)] = {
             "n": len([r for r in results if r["lane"] == lane]),
             "completed": len(rs),
+            "rejected": len([r for r in rejected if r["lane"] == lane]),
             "slo_met": len([r for r in rs
                             if meets_slo(r, slo_ttft_s, slo_tpot_s)]),
             "ttft": _pcts(ttfts) if ttfts else None,
@@ -297,7 +354,8 @@ def summarize(results: List[Dict[str, Any]], makespan_s: float,
         "offered_rate_rps": rate,
         "n": len(results),
         "completed": len(ok),
-        "errors": len(results) - len(ok),
+        "rejected": len(rejected),
+        "errors": len(results) - len(ok) - len(rejected),
         "makespan_s": round(makespan_s, 3),
         "achieved_rps": round(len(ok) / makespan_s, 3),
         "goodput_rps": round(len(met) / makespan_s, 3),

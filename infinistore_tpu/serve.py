@@ -49,6 +49,8 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional
 
+from .admission import AdmissionShed as _AdmissionShed
+from .admission import retry_after_header as _retry_after_header
 from .engine import Scheduler
 from .ledger import RequestLedger
 from .utils import metrics as _metrics
@@ -70,7 +72,8 @@ class ServingServer:
                  slo_ttft_s: Optional[float] = None,
                  slo_tpot_s: Optional[float] = None,
                  ledger_ring: Optional[int] = None,
-                 store_manage_endpoints: Optional[List[str]] = None):
+                 store_manage_endpoints: Optional[List[str]] = None,
+                 quotas=None):
         """``tokenizer``: any object with ``encode(str) -> [int]`` and
         ``decode([int]) -> str`` (an HF tokenizer qualifies) — enables
         string prompts, text responses, and string stop sequences.
@@ -127,6 +130,21 @@ class ServingServer:
             probes=serve_probes(self), rules=default_serve_rules(),
             metrics=self.metrics,
         )
+        # SLO-aware admission control (infinistore_tpu/admission.py):
+        # reads the sampler's burn state, the scheduler's queue depth,
+        # and the KV pool, and sheds/throttles new submissions with 429
+        # + Retry-After instead of queueing past collapse.  Per-tenant
+        # token quotas ride the priority-lane label (``quotas`` /
+        # --quota / ISTPU_QUOTAS); ISTPU_ADMISSION=0 is the kill
+        # switch.  Exported at GET /debug/admission and as a compact
+        # /healthz "admission" block.
+        from .admission import AdmissionController
+
+        self.admission = AdmissionController(
+            sched=self.sched, engine=engine, sampler=self.health_sampler,
+            metrics=self.metrics, quotas=quotas,
+        )
+        self.sched.admission = self.admission
         # store manage-plane endpoints ("host:manage_port") the health
         # rollup polls — the serving side only knows SERVICE ports, so
         # the manage plane must be named explicitly
@@ -710,6 +728,12 @@ class ServingServer:
                 # queued right after the id, so handlers see them before
                 # any token event (no scheduler step has run yet)
                 q.put(("prompt_lp", item["prompt_lp"]))
+        except _AdmissionShed as e:
+            # the admission controller refused the submission (quota /
+            # shed-on-burn): a 429 + Retry-After, not an error — the
+            # request never held scheduler state
+            q.put(("shed", {"error": str(e), "reason": e.reason,
+                            "retry_after_s": e.retry_after_s}))
         except Exception as e:
             q.put(("error", str(e)))
 
@@ -793,6 +817,13 @@ class ServingServer:
                 "firing": len(firing), "page": len(page),
                 "rules": sorted(f["rule"] for f in firing),
             }
+        adm = getattr(self, "admission", None)
+        if adm is not None and adm.enabled:
+            # "are we shedding?" belongs on the first read an operator
+            # makes.  NOTE the /healthz payload grows over time — assert
+            # fields, never the exact body (scripts/healthz_assert_lint
+            # .py enforces this in CI).
+            out["admission"] = adm.health_block()
         return out
 
     def debug_health(self, series: Optional[str] = None,
@@ -1090,11 +1121,14 @@ def _make_handler(server: ServingServer):
         def log_message(self, fmt, *args):  # route through our logger
             Logger.debug("http " + fmt % args)
 
-        def _json(self, code: int, obj: Dict[str, Any]) -> None:
+        def _json(self, code: int, obj: Dict[str, Any],
+                  headers: Optional[Dict[str, str]] = None) -> None:
             data = json.dumps(obj).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(data)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(data)
 
@@ -1166,6 +1200,13 @@ def _make_handler(server: ServingServer):
                 series = q.get("series", [None])[0]
                 self._json(200, server.debug_health(series=series,
                                                     limit=limit))
+            elif self.path.split("?", 1)[0] == "/debug/admission":
+                # the admission-control plane: mode (normal/shed), burn
+                # state and the current shed-lane ladder, decision and
+                # shed tallies, per-tenant quota buckets, the prefill
+                # throttle, and the live queue/drain/pool inputs.
+                # Answers {"enabled": false} under ISTPU_ADMISSION=0.
+                self._json(200, server.admission.snapshot())
             elif self.path.split("?", 1)[0] == "/debug/cluster":
                 # the store-cluster view: ring ownership, per-node
                 # circuit state, request/replica-read counters, and the
@@ -1249,7 +1290,7 @@ def _make_handler(server: ServingServer):
                 )
                 for i in range(n)
             ]
-            req_ids, err, busy, fault = [], None, None, None
+            req_ids, err, busy, fault, shed = [], None, None, None, None
             for q in qs:
                 kind, val = q.get()
                 if kind == "error":
@@ -1260,12 +1301,26 @@ def _make_handler(server: ServingServer):
                     fault = val
                 elif kind == "busy":
                     busy = val
+                elif kind == "shed":
+                    # the admission controller refused it (quota /
+                    # shed-on-burn): 429 + Retry-After below
+                    shed = val
                 else:
                     req_ids.append(val)
-            if err is not None or busy is not None or fault is not None:
+            if (err is not None or busy is not None or fault is not None
+                    or shed is not None):
                 for rid in req_ids:
                     server.cancel(rid)
-                if busy is not None:
+                if shed is not None:
+                    ra = _retry_after_header(shed.get("retry_after_s"))
+                    self._json(
+                        429,
+                        {"error": shed["error"],
+                         "reason": shed.get("reason"),
+                         "retry_after_s": shed.get("retry_after_s")},
+                        headers={"Retry-After": ra} if ra else None,
+                    )
+                elif busy is not None:
                     self._json(429, {"error": busy})
                 elif fault is not None:
                     self._json(500, {"error": fault})
@@ -1611,6 +1666,15 @@ def main(argv: Optional[List[str]] = None) -> None:
                     help="admission cap: more than this many requests in "
                          "the system answers 429 instead of queueing "
                          "without bound")
+    ap.add_argument("--quota", action="append", default=[],
+                    dest="quotas", metavar="TENANT:TOKS_PER_S[:BURST_S]",
+                    help="per-tenant token-rate quota (the priority-lane "
+                         "label is the tenant axis), repeatable / comma "
+                         "lists accepted — e.g. --quota 0:500 --quota "
+                         "10:2000.  Over-budget tenants answer 429 + "
+                         "Retry-After before any global shed.  Default "
+                         "env ISTPU_QUOTAS; ISTPU_ADMISSION=0 disables "
+                         "the whole admission controller")
     ap.add_argument("--n-blocks", type=int, default=512)
     ap.add_argument("--block-tokens", type=int, default=16)
     ap.add_argument("--prefill-chunk", type=int, default=None)
@@ -1881,7 +1945,8 @@ def main(argv: Optional[List[str]] = None) -> None:
                         prefill_concurrency=args.prefill_concurrency,
                         slo_ttft_s=args.slo_ttft, slo_tpot_s=args.slo_tpot,
                         ledger_ring=args.ledger_ring,
-                        store_manage_endpoints=manage_eps)
+                        store_manage_endpoints=manage_eps,
+                        quotas=args.quotas or None)
     srv.start()
     try:
         while True:

@@ -4,7 +4,8 @@
         --store-url http://127.0.0.1:18080 --interval 1
 
 Polls the serving front-end's ``/metrics`` + ``/healthz`` +
-``/debug/requests`` + ``/debug/engine`` + ``/debug/health`` and the
+``/debug/requests`` + ``/debug/engine`` + ``/debug/health`` +
+``/debug/admission`` and the
 store manage plane's ``/metrics`` + ``/debug/cache`` + ``/healthz`` and
 renders one screen per interval:
 pool occupancy, hit ratio, prefix-reuse token split, circuit/degraded
@@ -79,7 +80,8 @@ class Snapshot:
                  requests: Optional[dict] = None,
                  cluster: Optional[dict] = None,
                  engine: Optional[dict] = None,
-                 health: Optional[dict] = None):
+                 health: Optional[dict] = None,
+                 admission: Optional[dict] = None):
         self.serve = serve_metrics or {}
         self.store = store_metrics or {}
         self.cache = cache
@@ -94,6 +96,8 @@ class Snapshot:
         self.engine = engine
         # the serving /debug/health payload (watchdog alerts)
         self.health = health
+        # the serving /debug/admission payload (shed/quota control loop)
+        self.admission = admission
 
     def lanes(self) -> List[str]:
         """Priority lanes seen in the serving TTFT family, numeric
@@ -364,6 +368,54 @@ class Console:
             )
         return out
 
+    def _admission(self, snap: Snapshot) -> List[str]:
+        """The admission-control row (serving /debug/admission): mode,
+        per-frame shed and quota-throttle deltas, the active shed-lane
+        ladder, and a per-tenant quota usage bar."""
+        adm = snap.admission or {}
+        if not adm.get("enabled"):
+            return []
+        d_shed = self.deltas.setdefault("adm_shed", _Delta()).update(
+            float(adm.get("shed_total", 0)))
+        quota = adm.get("quota") or {}
+        d_thr = self.deltas.setdefault("adm_thr", _Delta()).update(
+            float(quota.get("throttled_total", 0)))
+        burn = adm.get("burn") or {}
+        shed_lanes = burn.get("shed_lanes") or []
+        pf = adm.get("prefill_throttle") or {}
+        out = [""]
+        line = (
+            "admission  mode {:7s} shed {:>5} ({}/frame)  "
+            "throttled {:>4} ({}/frame)".format(
+                str(adm.get("mode", "?")),
+                int(adm.get("shed_total", 0)),
+                "-" if d_shed is None else f"+{d_shed:.0f}",
+                int(quota.get("throttled_total", 0)),
+                "-" if d_thr is None else f"+{d_thr:.0f}",
+            )
+        )
+        if shed_lanes:
+            line += "  shedding lanes: " + ",".join(shed_lanes)
+        if pf.get("active"):
+            line += f"  prefill-cap {pf.get('budget_tokens')} tok/step"
+        ra = adm.get("retry_after_last_s")
+        if ra is not None:
+            line += f"  retry-after {ra:.1f}s"
+        out.append(line)
+        for tenant, t in sorted((quota.get("tenants") or {}).items()):
+            out.append(
+                "  quota {:6s} [{}] {:5.1%} used  {:>7.0f}/{:>7.0f} tok"
+                "  {:.0f} tok/s  throttled {:>4}".format(
+                    tenant, bar(t.get("used_frac", 0.0), 12),
+                    t.get("used_frac", 0.0),
+                    max(0.0, t.get("available", 0.0)),
+                    t.get("burst_tokens", 0.0),
+                    t.get("rate_toks_per_s", 0.0),
+                    int(t.get("throttled", 0)),
+                )
+            )
+        return out
+
     def _cluster(self, snap: Snapshot) -> List[str]:
         """The store-cluster section (serving /debug/cluster): one row
         per endpoint — circuit state, ring-ownership share, ok/error
@@ -498,6 +550,7 @@ class Console:
             )
         out.extend(self._serving_slo(snap))
         out.extend(self._alerts(snap))
+        out.extend(self._admission(snap))
         out.extend(self._engine(snap))
         out.extend(self._cluster(snap))
         # -- latency sparklines --
@@ -568,6 +621,9 @@ def poll(serve_url: Optional[str], store_url: Optional[str]) -> Snapshot:
     health = js(serve_url, "/debug/health")
     if health is not None and not health.get("enabled"):
         health = None  # health plane off (ISTPU_HEALTH=0): no row
+    admission = js(serve_url, "/debug/admission")
+    if admission is not None and not admission.get("enabled"):
+        admission = None  # controller off (ISTPU_ADMISSION=0): no row
     return Snapshot(
         serve_metrics=prom(serve_url, "/metrics"),
         store_metrics=prom(store_url, "/metrics"),
@@ -579,6 +635,7 @@ def poll(serve_url: Optional[str], store_url: Optional[str]) -> Snapshot:
         cluster=cluster,
         engine=engine,
         health=health,
+        admission=admission,
     )
 
 

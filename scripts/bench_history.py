@@ -74,6 +74,11 @@ METRICS = {
     # a round that starts paging under the same load is a regression
     # even when the raw latency rows stay green
     "alerts_fired": ("down", "serve alerts fired"),
+    # the admission plane's verdict (bench_serve.py `admission` block):
+    # 1 = goodput at the highest offered rate held ≥50% of the curve's
+    # peak (graceful degradation), 0 = collapse — a round that loses
+    # the plateau regressed the control loop itself
+    "goodput_plateau": ("up", "goodput plateau under overload"),
     # the multi-node cluster leg (bench.py --endpoints N): aggregate
     # fleet bandwidth through the consistent-hash router
     "cluster_put_gbps": ("up", "cluster put GB/s (aggregate)"),

@@ -1,0 +1,64 @@
+#!/usr/bin/env python3
+"""House-rule lint: no exact-body ``/healthz`` asserts in tests.
+
+The ``/healthz`` payload GROWS over time — PR 10 added the ``alerts``
+block and broke a test that compared the whole body, PR 12 grows it
+again with the ``admission`` block.  The standing rule (ROADMAP.md house
+rules) is **field-level asserts only**: ``json.loads(data)["status"] ==
+"ok"`` is fine, ``json.loads(data) == {"status": "ok"}`` is a time bomb.
+
+Heuristic scan, tuned against the real suite: flag any equality/
+inequality comparison against a dict literal within a few lines of a
+``/healthz`` mention.  Synthetic *payload construction* (``"/healthz":
+{"status": ...}`` fixtures) does not match — only comparisons do.
+Exit 1 on any hit, printing file:line for each.
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+TESTS = REPO / "tests"
+
+# how many lines after a /healthz mention a whole-body compare is
+# considered "about" that payload
+WINDOW = 6
+
+_HEALTHZ = re.compile(r"/healthz|healthz\s*\(")
+# an equality compare against a dict literal: `== {` / `!= {` (fixture
+# construction `"/healthz": {...}` and dict.get defaults don't match)
+_BODY_EQ = re.compile(r"[=!]=\s*\{")
+
+
+def scan_file(path: Path):
+    lines = path.read_text().splitlines()
+    hits = []
+    mentions = [i for i, ln in enumerate(lines) if _HEALTHZ.search(ln)]
+    for i in mentions:
+        for j in range(i, min(len(lines), i + WINDOW + 1)):
+            if _BODY_EQ.search(lines[j]):
+                hits.append((j + 1, lines[j].strip()))
+    return sorted(set(hits))
+
+
+def main() -> int:
+    bad = []
+    for path in sorted(TESTS.glob("test_*.py")):
+        for lineno, text in scan_file(path):
+            bad.append(f"{path.relative_to(REPO)}:{lineno}: {text}")
+    if bad:
+        print("exact-body /healthz asserts found (house rule: the "
+              "payload grows — assert FIELDS, never the whole body):")
+        for b in bad:
+            print(f"  {b}")
+        return 1
+    print("healthz assert lint OK: no exact-body /healthz compares in "
+          f"{len(list(TESTS.glob('test_*.py')))} test files")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -345,11 +345,24 @@ def test_doctor_summary_joins_requests_to_steps(tmp_path):
               "alerts_fired": 2,
               "alerts": {"ttft_burn": {"severity": "page",
                                        "reason": "burning 5x"}}}
+    admission = {
+        "enabled": True, "mode": "shed",
+        "burn": {"value": 5.0, "shed_lanes": ["0"]},
+        "shed_by_reason": {"burn": {"0": 7}, "quota": {"3": 2}},
+        "shed_total": 7,
+        "quota": {"tenants": {"3": {"rate_toks_per_s": 100.0,
+                                    "burst_tokens": 200.0,
+                                    "available": -5.0, "used_frac": 1.0,
+                                    "throttled": 2}},
+                  "throttled_total": 2},
+        "prefill_throttle": {"active": True, "budget_tokens": 64},
+    }
     serve_payloads = {
         "/metrics": None, "/healthz": {"status": "degraded"},
         "/debug/requests": requests, "/debug/engine": engine,
         "/debug/traces": {"traceEvents": []},
         "/debug/cluster": {"enabled": False}, "/debug/health": health,
+        "/debug/admission": admission,
     }
     cap = {
         "fetched_at": 1754000000.0,
@@ -372,6 +385,12 @@ def test_doctor_summary_joins_requests_to_steps(tmp_path):
     assert "**ttft_burn** [page]" in text and "burning 5x" in text
     assert "decode_many: 3" in text
     assert "UNREACHABLE" in text  # the dead store degrades, not fails
+    # the admission plane's state sits next to the alerts it reacts to
+    assert "Admission / overload control" in text
+    assert "SHEDDING lanes 0" in text
+    assert "shed[burn]: 7 (lane 0: 7)" in text
+    assert "quota tenant 3" in text and "throttled 2" in text
+    assert "prefill throttle ACTIVE (64 tok/step)" in text
     out = tmp_path / "bundle.tar.gz"
     manifest = write_bundle(cap, str(out))
     with tarfile.open(out) as tar:
@@ -485,6 +504,11 @@ HEALTH_ENV = {
     "ISTPU_HEALTH_STEP_S": "0.2",
     "ISTPU_BURN_FAST_S": "3",
     "ISTPU_BURN_SLOW_S": "15",
+    # this walk tests DETECTION (the watchdogs firing/clearing) — the
+    # admission controller ACTING on the same burn would shed the
+    # induced overload with 429s and change what the walk observes;
+    # the acting side has its own chaos walk in tests/test_admission.py
+    "ISTPU_ADMISSION": "0",
 }
 
 

@@ -165,11 +165,22 @@ def test_instrumentation_overhead_within_5pct(server, monkeypatch):
     # (the acceptance criterion's "with the sampler ON" form)
     from infinistore_tpu.health import HealthSampler
 
+    adm = None
     sampler = HealthSampler(probes={
         "client.write_count": lambda: (m.default_registry().family_hist(
             "istpu_client_op_seconds") or (0, 0))[0],
         "engine.steps": lambda: prof.steps,
+        "admission.mode": lambda: (adm.mode_code()
+                                   if adm is not None else None),
     })
+    # ...and the ADMISSION CONTROLLER: one live submit-time verdict per
+    # measured op (its real cadence — per request, not per byte), quota
+    # ledger charging, watchdog read and all, INSIDE the timed window —
+    # the acceptance criterion's "with the controller live" form
+    from infinistore_tpu.admission import AdmissionController
+
+    adm = AdmissionController(sampler=sampler, metrics=m.default_registry(),
+                              quotas={"0": (1e9, 2.0)}, enabled=True)
     sampler.start()
     best_put = best_get = float("inf")
     try:
@@ -178,9 +189,11 @@ def test_instrumentation_overhead_within_5pct(server, monkeypatch):
             with tracer.trace("perf.request", iteration=it):
                 with prof.step(kind_hint="perf"):
                     t0 = time.perf_counter()
+                    assert adm.check_submit(lane=0, tokens=blk).admitted
                     conn.write_cache(blocks, blk, buf.ctypes.data)
                     best_put = min(best_put, time.perf_counter() - t0)
                     t0 = time.perf_counter()
+                    assert adm.check_submit(lane=0, tokens=blk).admitted
                     conn.read_cache(blocks, blk, dst.ctypes.data)
                     best_get = min(best_get, time.perf_counter() - t0)
             conn.delete_keys([k for k, _ in blocks])
@@ -189,6 +202,9 @@ def test_instrumentation_overhead_within_5pct(server, monkeypatch):
     conn.close()
     assert np.array_equal(buf, dst)
     assert prof.summary()["steps"] == 4
+    # the controller really was live: every verdict recorded and charged
+    assert adm.snapshot()["decisions"]["admit"]["0"] == 8
+    assert adm.quota.available("0") is not None
 
     # instrumentation proof: the trace recorded the op and stage spans...
     last = tracer.recent()[-1]

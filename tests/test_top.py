@@ -180,6 +180,49 @@ def test_console_renders_alerts_row():
     assert "alerts   firing" not in Console().frame(Snapshot())
 
 
+def test_console_renders_admission_row():
+    """The admission-control section (serving /debug/admission): mode,
+    per-frame shed/throttle deltas, the active shed-lane ladder, and a
+    per-tenant quota usage bar."""
+    from infinistore_tpu.top import Console, Snapshot
+
+    def admission(shed, throttled, mode="shed"):
+        return {
+            "enabled": True, "mode": mode,
+            "burn": {"value": 4.2,
+                     "shed_lanes": ["0", "3"] if mode == "shed" else []},
+            "shed_total": shed,
+            "retry_after_last_s": 2.5,
+            "prefill_throttle": {"active": mode == "shed",
+                                 "budget_tokens": 64},
+            "quota": {
+                "throttled_total": throttled,
+                "tenants": {"10": {"rate_toks_per_s": 500.0,
+                                   "burst_tokens": 1000.0,
+                                   "available": 380.0,
+                                   "used_frac": 0.62,
+                                   "throttled": throttled}},
+            },
+        }
+
+    console = Console()
+    console.frame(Snapshot(admission=admission(5, 1)))
+    out = console.frame(Snapshot(admission=admission(9, 3)))
+    assert "admission  mode shed" in out
+    assert "shed     9 (+4/frame)" in out
+    assert "throttled    3 (+2/frame)" in out
+    assert "shedding lanes: 0,3" in out
+    assert "prefill-cap 64 tok/step" in out
+    assert "retry-after 2.5s" in out
+    # per-tenant quota usage bar
+    assert "quota 10" in out and "62.0% used" in out
+    assert "500 tok/s" in out
+    # controller off (ISTPU_ADMISSION=0 / old server): row absent
+    assert "admission  mode" not in Console().frame(Snapshot())
+    assert "admission  mode" not in Console().frame(
+        Snapshot(admission={"enabled": False}))
+
+
 def test_sparkline_and_bar_helpers():
     from infinistore_tpu.top import bar, fmt_dur, sparkline
 
