@@ -71,6 +71,81 @@ def parse_lanes(s: str):
     return lanes
 
 
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _spawn_store():
+    """A python-backend store node in a subprocess (the disagg fleet's
+    KV transport).  Returns ``(proc, service_port)``; caller SIGINTs."""
+    import socket
+    import subprocess
+
+    port, mport = _free_port(), _free_port()
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "infinistore_tpu.server",
+         "--service-port", str(port), "--manage-port", str(mport),
+         "--prealloc-size", "1", "--minimal-allocate-size", "16",
+         "--log-level", "warning", "--backend", "python"],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    deadline = time.time() + 25
+    while True:
+        if proc.poll() is not None:
+            raise RuntimeError("store server failed to start")
+        try:
+            socket.create_connection(("127.0.0.1", port),
+                                     timeout=0.5).close()
+            return proc, port
+        except OSError:
+            if time.time() >= deadline:
+                proc.kill()
+                raise RuntimeError("store server did not come up")
+            time.sleep(0.1)
+
+
+def self_disagg(args):
+    """The zero-setup disaggregated fleet: one store node (subprocess)
+    + N in-process prefill workers + M decode workers behind a
+    ``FrontDoor`` — the target the ``disagg`` block is measured
+    against.  Returns ``(close, url, vocab, fleet_workers)``."""
+    import signal
+
+    import jax.numpy as jnp
+
+    from infinistore_tpu.frontdoor import local_fleet
+    from infinistore_tpu.models import TINY, scaled
+
+    proc, store_port = _spawn_store()
+    try:
+        fd, workers, close_fleet = local_fleet(
+            store_port, args.prefill_workers, args.decode_workers,
+            n_blocks=args.self_serve_blocks,
+            max_batch=args.self_serve_batch,
+        )
+    except BaseException:
+        proc.send_signal(signal.SIGINT)
+        proc.wait(timeout=10)
+        raise
+
+    def close():
+        close_fleet()
+        proc.send_signal(signal.SIGINT)
+        try:
+            proc.wait(timeout=10)
+        except Exception:  # noqa: BLE001
+            proc.kill()
+
+    cfg = scaled(TINY, dtype=jnp.float32)
+    return close, f"http://127.0.0.1:{fd.port}", cfg.vocab_size, workers
+
+
 def self_serve(args):
     """An in-process tiny-model ServingServer on a free port: the
     zero-setup target for smokes — real HTTP, real scheduler, no
@@ -102,15 +177,85 @@ def self_serve(args):
     return srv, f"http://127.0.0.1:{srv.port}", cfg.vocab_size
 
 
+def _lane_pct(point, which, key):
+    """Completed-weighted mean of one lane percentile across a point's
+    lanes — the cross-lane headline the disagg ratios compare on."""
+    tot = n = 0.0
+    for v in point["lanes"].values():
+        stats = v.get(which) or {}
+        if stats.get(key) is not None and v.get("completed"):
+            tot += stats[key] * v["completed"]
+            n += v["completed"]
+    return (tot / n) if n else None
+
+
+def _gather_disagg(url, workers, args):
+    """The ``disagg`` block's fleet-side half: the front door's
+    /debug/fleet (handoff percentiles, per-role counts) plus the decode
+    workers' ledgers (per-request adoption provenance — the store/local
+    split is process-global in-process, the ledger is per-worker)."""
+    import urllib.request
+
+    fleet = None
+    try:
+        with urllib.request.urlopen(url + "/debug/fleet", timeout=5) as r:
+            fleet = json.loads(r.read())
+    except Exception:  # noqa: BLE001 — observability, not the bench
+        pass
+    adopted = total = 0
+    for s in (workers or {}).get("decode", ()):
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{s.port}/debug/requests",
+                    timeout=5) as r:
+                recs = json.loads(r.read()).get("records") or []
+        except Exception:  # noqa: BLE001
+            continue
+        for rec in recs:
+            st = rec.get("store") or {}
+            total += 1
+            if (st.get("reused_chunks") or 0) > 0:
+                adopted += 1
+    out = {
+        "prefill_workers": args.prefill_workers,
+        "decode_workers": args.decode_workers,
+        "adoption": {
+            "requests": total, "adopted": adopted,
+            "hit_rate": round(adopted / total, 4) if total else None,
+        },
+    }
+    if fleet and fleet.get("enabled"):
+        out["handoff_ms"] = fleet.get("handoff")
+        out["fleet_adoption_tokens"] = fleet.get("adoption")
+        out["router_requests"] = fleet.get("requests")
+    return out
+
+
 def main(argv=None) -> int:
     from infinistore_tpu.loadgen import LoadConfig, sweep
 
     ap = argparse.ArgumentParser("bench_serve.py")
     ap.add_argument("--url", default=None,
                     help="serving front-end base URL (http://host:8000)")
+    ap.add_argument("--target", dest="url",
+                    help="alias of --url: point it at a disaggregated "
+                         "front door (istpu-frontdoor) to drive a fleet")
     ap.add_argument("--self-serve", action="store_true",
                     help="spin up an in-process tiny-model server to "
                          "load instead of --url (CI smoke mode)")
+    ap.add_argument("--self-disagg", action="store_true",
+                    help="spin up a whole in-process disaggregated "
+                         "fleet (store node + prefill + decode workers "
+                         "+ front door), sweep it, then sweep a "
+                         "same-decode-budget monolith and report the "
+                         "TTFT/TPOT ratios in a `disagg` block")
+    ap.add_argument("--prefill-workers", type=int, default=1,
+                    help="--self-disagg: prefill pool size")
+    ap.add_argument("--decode-workers", type=int, default=1,
+                    help="--self-disagg: decode pool size")
+    ap.add_argument("--no-monolith-baseline", action="store_true",
+                    help="--self-disagg: skip the monolith comparison "
+                         "sweep (faster; no ratio in the output)")
     ap.add_argument("--self-serve-blocks", type=int, default=512)
     ap.add_argument("--self-serve-batch", type=int, default=8)
     ap.add_argument("--rates", type=parse_rates, default=[2.0, 4.0, 8.0],
@@ -164,13 +309,20 @@ def main(argv=None) -> int:
                          "docs/observability.md schema)")
     args = ap.parse_args(argv)
 
-    if bool(args.url) == bool(args.self_serve):
-        ap.error("pass exactly one of --url or --self-serve")
+    modes = sum(map(bool, (args.url, args.self_serve, args.self_disagg)))
+    if modes != 1:
+        ap.error("pass exactly one of --url/--target, --self-serve, "
+                 "or --self-disagg")
     srv = None
+    fleet_close = None
+    fleet_workers = None
     url = args.url
     vocab = args.vocab
     if args.self_serve:
         srv, url, model_vocab = self_serve(args)
+        vocab = min(vocab, model_vocab)
+    elif args.self_disagg:
+        fleet_close, url, model_vocab, fleet_workers = self_disagg(args)
         vocab = min(vocab, model_vocab)
     base = LoadConfig(
         rate=args.rates[0], n_requests=args.n, process=args.process,
@@ -198,6 +350,7 @@ def main(argv=None) -> int:
         )
 
     t0 = time.time()
+    disagg = None
     try:
         if args.warmup:
             from dataclasses import replace
@@ -271,9 +424,54 @@ def main(argv=None) -> int:
                 admission_dbg = payload
         except Exception:  # noqa: BLE001 — observability, not the bench
             pass
+        disagg = None
+        if args.self_disagg:
+            disagg = _gather_disagg(url, fleet_workers, args)
     finally:
         if srv is not None:
             srv.close()
+        if fleet_close is not None:
+            fleet_close()
+    # the same-budget monolith comparison: one server whose max_batch
+    # equals the decode pool's total (equal decode throughput), swept on
+    # the SAME schedule AFTER the fleet is torn down (fresh server, no
+    # CPU contention between the two measurements)
+    if disagg is not None and not args.no_monolith_baseline:
+        import argparse as _argparse
+        from dataclasses import replace
+
+        from infinistore_tpu.loadgen import _http_post, make_requests
+
+        mono_args = _argparse.Namespace(**{
+            **vars(args),
+            "self_serve_batch":
+                args.self_serve_batch * max(1, args.decode_workers),
+            "quotas": [],
+        })
+        msrv, murl, _mv = self_serve(mono_args)
+        try:
+            if args.warmup:
+                for body in make_requests(
+                    replace(base, n_requests=args.warmup,
+                            seed=base.seed - 1)
+                ):
+                    _http_post(murl, body, args.timeout)
+            mono_curve = sweep(murl, base, args.rates, args.slo_ttft,
+                               args.slo_tpot, cooldown_s=args.cooldown)
+        finally:
+            msrv.close()
+        top, mtop = curve[-1], mono_curve[-1]
+        d_ttft = _lane_pct(top, "ttft", "p99_ms")
+        m_ttft = _lane_pct(mtop, "ttft", "p99_ms")
+        d_tpot = _lane_pct(top, "tpot", "p99_ms")
+        m_tpot = _lane_pct(mtop, "tpot", "p99_ms")
+        disagg["ttft_p99_ms"] = {"disagg": d_ttft, "monolith": m_ttft}
+        disagg["tpot_p99_ms"] = {"disagg": d_tpot, "monolith": m_tpot}
+        disagg["monolith_curve"] = mono_curve
+        if d_ttft and m_ttft:
+            disagg["ttft_ratio"] = round(d_ttft / m_ttft, 4)
+        if d_tpot and m_tpot:
+            disagg["tpot_burst_ratio"] = round(d_tpot / m_tpot, 4)
     record = {
         "run_id": uuid.uuid4().hex[:8],
         "kind": "serve_load",
@@ -333,6 +531,18 @@ def main(argv=None) -> int:
     # mirrored top-level (0/1) for the scripts/bench_history.py trend
     # table: an overload round whose plateau flag drops to 0 regressed
     record["goodput_plateau"] = int(plateau)
+    if disagg is not None:
+        # disaggregation block (docs/observability.md): per-role worker
+        # counts, handoff leg percentiles, decode-pool adoption hit
+        # rate, and the TTFT/TPOT-vs-monolith ratios at the top offered
+        # rate — the headline ratios mirror top-level for
+        # scripts/bench_history.py (direction: down; < 1.0 means the
+        # fleet beat the same-decode-budget monolith)
+        record["disagg"] = disagg
+        if disagg.get("ttft_ratio") is not None:
+            record["ttft_ratio"] = disagg["ttft_ratio"]
+        if disagg.get("tpot_burst_ratio") is not None:
+            record["tpot_burst_ratio"] = disagg["tpot_burst_ratio"]
     if health is not None:
         # health-plane block (infinistore_tpu/health.py): alert
         # transitions + burn-rate peak during the run.  alerts_fired is

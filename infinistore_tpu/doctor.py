@@ -53,6 +53,10 @@ SERVE_ENDPOINTS: Tuple[Tuple[str, str, str], ...] = (
     ("cluster", "/debug/cluster", "debug_cluster.json"),
     ("health", "/debug/health", "debug_health.json"),
     ("admission", "/debug/admission", "debug_admission.json"),
+    # the disaggregated-fleet view: answered by a front door (role
+    # router), a 404 everywhere else — per-endpoint degradation keeps
+    # the bundle whole either way
+    ("fleet", "/debug/fleet", "debug_fleet.json"),
 )
 STORE_ENDPOINTS: Tuple[Tuple[str, str, str], ...] = (
     ("metrics", "/metrics", "metrics.prom"),
@@ -187,6 +191,32 @@ def summarize_capture(cap: Dict[str, Any], top_n: int = 5) -> str:
                      f"**{hz.get('status', 'unreachable')}**")
         lines.extend(_alert_lines(_json_of(store, "health"), f"store[{i}]"))
     lines.append("")
+
+    # -- disaggregated fleet (front-door bundles only) --
+    fleet = _json_of(serve, "fleet") if serve else None
+    if fleet and fleet.get("enabled"):
+        lines.append("## Fleet (prefill/decode disaggregation)")
+        for role, rec in sorted((fleet.get("rollup") or {}).items()):
+            lines.append(
+                f"- {role}: {rec.get('ok', 0)}/{rec.get('workers', 0)} ok, "
+                f"{rec.get('unreachable', 0)} unreachable, "
+                f"{rec.get('circuit_open', 0)} circuit open"
+            )
+        for w in fleet.get("workers", []):
+            lines.append(
+                f"- {w.get('role')}@{w.get('endpoint')}: "
+                f"{w.get('status')} circuit={w.get('circuit')} "
+                f"inflight={w.get('inflight')}"
+            )
+        ho = fleet.get("handoff") or {}
+        ad = fleet.get("adoption") or {}
+        lines.append(
+            f"- handoff p50/p99 {ho.get('p50_ms')}/{ho.get('p99_ms')} ms "
+            f"({ho.get('count', 0)} legs); adoption store-tokens "
+            f"{ad.get('store_tokens', 0):.0f} local-tokens "
+            f"{ad.get('local_tokens', 0):.0f}"
+        )
+        lines.append("")
 
     # -- admission / shedding state, next to the alerts it reacts to --
     if serve:

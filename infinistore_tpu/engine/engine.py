@@ -289,15 +289,19 @@ class _StoreStreamer:
         # kicks their D2H DMAs (dispatch-only), so the prefill thread
         # pays microseconds while the transfers overlap the next chunk's
         # compute; everything that can block — materialize, pool copy,
-        # COMMIT_PUT — happens in push_commit on the worker
+        # COMMIT_PUT — happens in push_commit on the worker.  The
+        # submitting request's trace id rides along: the scheduler binds
+        # the request trace around prefill work, so the worker thread can
+        # attribute the push to the REQUEST that paid for it (the PD
+        # handoff chain needs store pushes under one trace id end to end)
         self._q.put((self._transfer.push_begin(pages, chunk_keys_),
-                     chunk_keys_))
+                     chunk_keys_, tracing.current_trace_id()))
 
     def _run(self) -> None:
         from ..utils import resilience as _res
 
         while True:
-            token, keys = self._q.get()
+            token, keys, tid = self._q.get()
             try:
                 if self._err is not None:
                     # parked error: skip queued items until the next
@@ -314,23 +318,33 @@ class _StoreStreamer:
                     self._dropped += 1
                     _res.count_push_dropped("circuit_open")
                 else:
-                    self._push_one(token, keys, _res)
+                    self._push_one(token, keys, tid, _res)
             finally:
                 self._q.task_done()
 
-    def _push_one(self, token, keys, _res) -> None:
+    def _push_one(self, token, keys, tid, _res) -> None:
         breaker = self._transfer.breaker
         attempts = 2 if self._durability == "strict" else 1
         for attempt in range(attempts):
             try:
-                # own trace: this thread has no request context, but
-                # async pushes should still show up in /debug/traces
-                # (kv.push_pages and the write_cache stages nest here).
                 # push_commit is the off-critical-path half: the token's
                 # D2H DMAs were kicked at submit time on the engine
                 # thread, so this worker mostly finds the bytes waiting.
-                with tracing.trace("store.push_async", chunks=len(keys)):
-                    self._transfer.push_commit(token)
+                # When the submitting request's trace is still
+                # addressable (it is, whenever a flush barrier gates the
+                # response — the PD prefill-worker contract), the push
+                # span lands IN that trace, keeping the whole handoff
+                # chain under one trace id; otherwise the push opens its
+                # own trace so async work still shows in /debug/traces.
+                with tracing.bind(tid) as owner:
+                    if owner is not None:
+                        with tracing.span("store.push_async",
+                                          chunks=len(keys)):
+                            self._transfer.push_commit(token)
+                    else:
+                        with tracing.trace("store.push_async",
+                                           chunks=len(keys)):
+                            self._transfer.push_commit(token)
                 breaker.record_success()
                 return
             except BaseException as e:  # noqa: BLE001 — reported at flush()

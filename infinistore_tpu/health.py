@@ -966,6 +966,11 @@ def cluster_rollup(manage_urls: Sequence[str],
             continue
         node = {"endpoint": url, "reachable": True,
                 "status": hz.get("status", "?")}
+        # fleet role label (serve.py --role / the front door's
+        # "router"): lets one rollup cover a disaggregated fleet and
+        # group verdicts per role below
+        if hz.get("role"):
+            node["role"] = hz["role"]
         dh = fetch_json(base + "/debug/health", timeout)
         if dh is not None and dh.get("enabled"):
             node["firing"] = dh.get("firing", [])
@@ -973,4 +978,21 @@ def cluster_rollup(manage_urls: Sequence[str],
         if node["status"] != "ok" or node.get("firing"):
             worst = "degraded"
         nodes.append(node)
-    return {"status": worst, "nodes": nodes}
+    out: Dict[str, Any] = {"status": worst, "nodes": nodes}
+    roles: Dict[str, Dict[str, int]] = {}
+    for n in nodes:
+        role = n.get("role", "store")
+        rec = roles.setdefault(role, {"nodes": 0, "ok": 0, "degraded": 0,
+                                      "unreachable": 0})
+        rec["nodes"] += 1
+        if not n.get("reachable"):
+            rec["unreachable"] += 1
+        elif n["status"] == "ok" and not n.get("firing"):
+            rec["ok"] += 1
+        else:
+            rec["degraded"] += 1
+    if any(r != "store" for r in roles):
+        # role grouping only when a role label actually appeared —
+        # pure-store rollups keep their pre-fleet payload shape
+        out["roles"] = roles
+    return out

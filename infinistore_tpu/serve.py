@@ -73,7 +73,7 @@ class ServingServer:
                  slo_tpot_s: Optional[float] = None,
                  ledger_ring: Optional[int] = None,
                  store_manage_endpoints: Optional[List[str]] = None,
-                 quotas=None):
+                 quotas=None, role: str = "monolith"):
         """``tokenizer``: any object with ``encode(str) -> [int]`` and
         ``decode([int]) -> str`` (an HF tokenizer qualifies) — enables
         string prompts, text responses, and string stop sequences.
@@ -85,6 +85,27 @@ class ServingServer:
         self.engine = engine
         self.model_id = model_id
         self.tokenizer = tokenizer
+        # fleet role (disaggregated serving, docs/design.md
+        # §disaggregation): "monolith" serves everything; "prefill"
+        # workers additionally advertise the PD handoff contract
+        # (POST /v1/prefill computes + flushes, never decodes for the
+        # client); "decode" workers adopt store-resident prefixes.  The
+        # role is a LABEL — every endpoint stays live on every role, so
+        # a shrinking fleet can degrade to fewer specialized workers
+        # without redeploying — surfaced on /healthz, /metrics, and the
+        # router's rollup.
+        if role not in ("monolith", "prefill", "decode"):
+            raise ValueError(f"unknown role {role!r}")
+        self.role = role
+        # serve-plane fault injection (house rule: every failure mode
+        # the fleet claims to survive gets a FaultInjector action before
+        # a mitigation).  Armed via POST /debug/faults with the store
+        # injector's rule grammar, matched on the request PATH — the
+        # worker-death chaos walks drive drop_conn/stall/delay through
+        # this before (or instead of) killing the process.
+        from .pyserver import FaultInjector
+
+        self.faults = FaultInjector()
         # admission control: with more than this many requests in the
         # system, new submissions answer 429 instead of queueing without
         # bound (None = unbounded)
@@ -753,6 +774,10 @@ class ServingServer:
         def lat(name):
             return lambda: self.sched.latency_metrics[name]
 
+        reg.gauge("istpu_serve_role",
+                  "Fleet role of this serving process (1 on the active "
+                  "label: monolith/prefill/decode)",
+                  labelnames=("role",)).labels(self.role).set(1)
         reg.counter("istpu_serve_requests_total",
                     "Requests submitted", fn=stat("requests"))
         reg.counter("istpu_serve_completed_total",
@@ -807,6 +832,9 @@ class ServingServer:
                     or bool(page))
         out: Dict[str, Any] = {
             "status": "degraded" if degraded else "ok",
+            # fleet role label: the router's rollup (and the PR-10
+            # cluster rollup) group by this
+            "role": self.role,
         }
         if circuit is not None:
             out["store_circuit"] = circuit
@@ -870,6 +898,16 @@ class ServingServer:
         return trace_stitch.stitched_chrome_json(
             tracing.TRACER, conns, limit=limit
         )
+
+    def debug_traces_raw(self, limit: Optional[int] = None) -> Dict[str, Any]:
+        """Raw span-ring dump with process-clock stamps plus ``clock`` =
+        now on the same clock — the HTTP twin of the wire
+        ``OP_TRACE_DUMP`` (``/debug/traces?raw=1``).  The fleet front
+        door polls this from every worker and maps the stamps into its
+        own timeline (round-trip-midpoint offset estimate, the HELLO
+        clock-sync trick over HTTP), which is what turns N worker rings
+        into ONE stitched Perfetto file."""
+        return tracing.TRACER.dump(limit)
 
     def cluster_report(self) -> Dict[str, Any]:
         """The /debug/cluster payload: ring + per-node state when the
@@ -1121,6 +1159,39 @@ def _make_handler(server: ServingServer):
         def log_message(self, fmt, *args):  # route through our logger
             Logger.debug("http " + fmt % args)
 
+        def _fault_gate(self) -> bool:
+            """Apply an armed serve-plane fault rule to this request
+            (the worker-death chaos machinery).  Rules match on the
+            request path (``{"op": "/v1/prefill", "action":
+            "drop_conn"}``); ``/debug/faults`` itself is exempt so a
+            ``*`` rule can never lock out its own clear.  Returns True
+            when the request should proceed."""
+            if not server.faults.armed:
+                return True
+            rule = server.faults.match(self.path.split("?", 1)[0].upper())
+            if rule is None:
+                return True
+            action = rule["action"]
+            if action == "delay":
+                time.sleep(rule["delay_s"])
+                return True
+            if action == "stall":
+                # the hang no socket error surfaces: held until the rule
+                # is cleared (the router's leg timeout is the escape)
+                while server.faults.active(rule["id"]):
+                    time.sleep(0.05)
+                return True
+            if action == "drop_conn":
+                try:
+                    self.connection.close()
+                except OSError:
+                    pass
+                return False
+            if action == "error":
+                self._json(500, {"error": "injected fault"})
+                return False
+            return True  # "corrupt" is a store-plane action: no-op here
+
         def _json(self, code: int, obj: Dict[str, Any],
                   headers: Optional[Dict[str, str]] = None) -> None:
             data = json.dumps(obj).encode()
@@ -1133,6 +1204,8 @@ def _make_handler(server: ServingServer):
             self.wfile.write(data)
 
         def do_GET(self):
+            if not self._fault_gate():
+                return
             if self.path == "/v1/models":
                 cards = [{"id": server.model_id, "object": "model",
                           "owned_by": "infinistore-tpu"}]
@@ -1227,6 +1300,11 @@ def _make_handler(server: ServingServer):
                     limit = int(q["limit"][0])
                 except (KeyError, ValueError, IndexError):
                     limit = None
+                if q.get("raw", ["0"])[0] not in ("0", ""):
+                    # raw dump (process-clock stamps + `clock`): the
+                    # front door's cross-process stitch input
+                    self._json(200, server.debug_traces_raw(limit=limit))
+                    return
                 data = server.debug_traces_json(limit=limit).encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
@@ -1237,15 +1315,38 @@ def _make_handler(server: ServingServer):
                 self._json(404, {"error": "not found"})
 
         def do_POST(self):
-            if self.path not in ("/v1/completions", "/v1/chat/completions"):
+            if self.path.split("?", 1)[0] == "/debug/faults":
+                # arm/clear serve-plane fault rules (chaos only; never
+                # itself fault-matched — see _fault_gate)
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    rules = json.loads(self.rfile.read(n) or b"[]")
+                    armed = server.faults.arm(rules)
+                except (ValueError, TypeError) as e:
+                    self._json(400, {"error": str(e)})
+                    return
+                self._json(200, {"armed": armed})
+                return
+            if not self._fault_gate():
+                return
+            if self.path not in ("/v1/completions", "/v1/chat/completions",
+                                 "/v1/prefill"):
                 self._json(404, {"error": "not found"})
                 return
             # request-scoped trace on the handler thread: covers prep,
             # submit, and the wait/stream phases.  Engine-thread compute
             # shows up in the per-step "engine.step" traces next to it in
-            # /debug/traces (same ring, own trace ids).
-            with tracing.trace("http.request", path=self.path):
-                self._handle_completions()
+            # /debug/traces (same ring, own trace ids).  An X-Istpu-Trace
+            # header CONTINUES the caller's trace (the fleet front door
+            # propagates one id through prefill handoff, store push, and
+            # decode adoption — the stitched single-trace contract).
+            tid = self.headers.get("X-Istpu-Trace") or None
+            with tracing.TRACER.trace("http.request", trace_id=tid,
+                                      path=self.path):
+                if self.path == "/v1/prefill":
+                    self._handle_prefill()
+                else:
+                    self._handle_completions()
 
         def _handle_completions(self):
             chat = self.path == "/v1/chat/completions"
@@ -1353,6 +1454,104 @@ def _make_handler(server: ServingServer):
             else:
                 self._collect(req_ids, qs, accums, chat, model_name,
                               prompt_len, lp_k, echo_ids, echo_text)
+
+        def _handle_prefill(self):
+            """PD handoff, prefill side (docs/design.md §disaggregation):
+            ingest the prompt through the STANDARD scheduler path —
+            admission verdicts, chunked prefill interleaving, ledger,
+            metrics all apply — while the prefill streams KV to the
+            store chunk by chunk, then run the store_flush durability
+            barrier before answering, so the pushed prefix is visible to
+            ``get_match_last_index`` on the decode pool the moment the
+            router dispatches decode.  Generates ONE throwaway token
+            (the cheapest way to ride the scheduler end to end; the
+            client's tokens come from the decode pool)."""
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(n) or b"{}")
+            except ValueError:
+                self._json(400, {"error": "invalid JSON body"})
+                return
+            if not isinstance(body, dict):
+                self._json(400, {"error": "body must be a JSON object"})
+                return
+            body.pop("_chat", None)
+            try:
+                body = server.prepare_body(body, "messages" in body)
+            except ValueError as e:
+                self._json(400, {"error": str(e)})
+                return
+            prompt = body.get("prompt") or []
+            # strip generation-shaping params that don't apply to a
+            # handoff (echo would reroute through the scoring path);
+            # priority/model stay — lanes and adapter namespaces matter
+            for k in ("echo", "logprobs", "top_logprobs", "stream", "n",
+                      "stop", "stop_token_ids"):
+                body.pop(k, None)
+            body.update(max_tokens=1, temperature=0)
+            q = server.submit(body)
+            req_id = None
+            while True:
+                try:
+                    kind, val = q.get(timeout=1.0)
+                except queue.Empty:
+                    if self._client_gone():
+                        # router gave up (leg timeout / died): free the
+                        # slot; already-pushed chunks stay — they are
+                        # content-addressed future hits, not leaks
+                        if req_id is not None:
+                            server.cancel(req_id)
+                        return
+                    continue
+                if kind == "id":
+                    req_id = val
+                elif kind == "busy":
+                    self._json(429, {"error": val})
+                    return
+                elif kind == "shed":
+                    ra = _retry_after_header(val.get("retry_after_s"))
+                    self._json(
+                        429,
+                        {"error": val["error"], "reason": val.get("reason"),
+                         "retry_after_s": val.get("retry_after_s")},
+                        headers={"Retry-After": ra} if ra else None,
+                    )
+                    return
+                elif kind == "error":
+                    self._json(400, {"error": val})
+                    return
+                elif kind == "fault":
+                    self._json(500, {"error": val})
+                    return
+                elif kind == "done":
+                    break
+                # "tokens"/"lp" events: dropped — decode is not our job
+            flushed = False
+            flush_error = None
+            if server.engine.transfer is not None:
+                try:
+                    # the durability barrier of the handoff contract
+                    # (relaxed-mode pushes drain here); thread-safe —
+                    # flush() is a queue join
+                    with tracing.span("engine.store_flush"):
+                        server.engine.store_flush()
+                    flushed = True
+                except Exception as e:  # noqa: BLE001 — degrade, don't 500:
+                    # the router falls back to recompute-on-decode
+                    flush_error = repr(e)
+            T = server.engine.pc.block_tokens
+            out = {
+                "object": "prefill", "model_id": server.model_id,
+                "role": server.role, "prompt_tokens": len(prompt),
+                # complete chunks a decode worker can discover; its own
+                # prefill re-probes (and caps reuse at (S-1)//T)
+                "chunks": len(prompt) // T, "block_tokens": T,
+                "store": server.engine.transfer is not None,
+                "flushed": flushed,
+            }
+            if flush_error is not None:
+                out["flush_error"] = flush_error
+            self._json(200, out)
 
         def _client_gone(self) -> bool:
             """A request-less peek at the socket: readable + EOF means the
@@ -1651,10 +1850,36 @@ def _make_handler(server: ServingServer):
 
 def main(argv: Optional[List[str]] = None) -> None:
     import argparse
+    import sys as _sys
+
+    argv = list(_sys.argv[1:] if argv is None else argv)
+    # `--role router` is the front door, a different program entirely
+    # (no engine, no checkpoint): delegate before this parser rejects
+    # the router's own flags.  istpu-frontdoor is the same entry point.
+    for i, a in enumerate(argv):
+        if (a == "--role" and i + 1 < len(argv)
+                and argv[i + 1] == "router"):
+            from . import frontdoor
+
+            return frontdoor.main(argv[:i] + argv[i + 2:])
+        if a == "--role=router":
+            from . import frontdoor
+
+            return frontdoor.main(argv[:i] + argv[i + 1:])
 
     ap = argparse.ArgumentParser("infinistore_tpu.serve")
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=8000)
+    ap.add_argument("--role",
+                    choices=["monolith", "prefill", "decode", "router"],
+                    default="monolith",
+                    help="fleet role (docs/design.md §disaggregation): "
+                         "monolith serves everything; prefill/decode "
+                         "label this worker for a disaggregated fleet "
+                         "(the role rides /healthz and the router's "
+                         "rollup; every endpoint stays live on every "
+                         "role).  'router' starts the front door instead "
+                         "— see istpu-frontdoor --help for its flags")
     ap.add_argument("--model", default="tiny",
                     help="'tiny' (random-init demo) or a local HF checkpoint dir")
     ap.add_argument("--tokenizer", default=None,
@@ -1946,7 +2171,11 @@ def main(argv: Optional[List[str]] = None) -> None:
                         slo_ttft_s=args.slo_ttft, slo_tpot_s=args.slo_tpot,
                         ledger_ring=args.ledger_ring,
                         store_manage_endpoints=manage_eps,
-                        quotas=args.quotas or None)
+                        quotas=args.quotas or None, role=args.role)
+    if args.role == "prefill" and conn is None:
+        Logger.warn("--role prefill without a store: handoffs will "
+                    "answer flushed=false and decode workers recompute "
+                    "(attach --store-endpoints / --store-host)")
     srv.start()
     try:
         while True:

@@ -81,7 +81,8 @@ class Snapshot:
                  cluster: Optional[dict] = None,
                  engine: Optional[dict] = None,
                  health: Optional[dict] = None,
-                 admission: Optional[dict] = None):
+                 admission: Optional[dict] = None,
+                 fleet: Optional[dict] = None):
         self.serve = serve_metrics or {}
         self.store = store_metrics or {}
         self.cache = cache
@@ -98,6 +99,8 @@ class Snapshot:
         self.health = health
         # the serving /debug/admission payload (shed/quota control loop)
         self.admission = admission
+        # the front door's /debug/fleet payload (disaggregated roles)
+        self.fleet = fleet
 
     def lanes(self) -> List[str]:
         """Priority lanes seen in the serving TTFT family, numeric
@@ -454,6 +457,53 @@ class Console:
             )
         return out
 
+    def _fleet(self, snap: Snapshot) -> List[str]:
+        """The disaggregated-fleet section (front door /debug/fleet):
+        one row per worker — role / state / circuit / inflight — with a
+        per-frame adoption-hit delta (Δ of that worker's store-loaded
+        prompt tokens), plus the handoff-latency and request headline."""
+        fl = snap.fleet or {}
+        if not fl.get("enabled") or not fl.get("workers"):
+            return []
+        out: List[str] = [""]
+        roll = fl.get("rollup") or {}
+        ho = fl.get("handoff") or {}
+        reqs = fl.get("requests") or {}
+        pools = "  ".join(
+            f"{role} {rec.get('ok', 0)}/{rec.get('workers', 0)} ok"
+            for role, rec in sorted(roll.items())
+        )
+        out.append(
+            "fleet    {}  handoff p50/p99 {}/{} ms  "
+            "2xx {}  4xx {}  5xx {}".format(
+                pools, ho.get("p50_ms", "-"), ho.get("p99_ms", "-"),
+                int(reqs.get("2xx", 0)), int(reqs.get("4xx", 0)),
+                int(reqs.get("5xx", 0)),
+            )
+        )
+        out.append(f"  {'role':8s} {'endpoint':22s} {'state':12s} "
+                   f"{'circuit':10s} {'inflight':>8s} {'req':>8s}  "
+                   f"Δadopt-tok/frame")
+        for w in fl["workers"]:
+            ep = w.get("endpoint", "?")
+            store_tok = (w.get("prefix_tokens") or {}).get("store")
+            d_tok = self.deltas.setdefault(
+                f"fd_adopt:{ep}", _Delta()).update(store_tok)
+            state = w.get("status", "?")
+            if w.get("shedding"):
+                state += "+shed"
+            circuit = w.get("circuit", "?")
+            out.append(
+                "  {:8s} {:22s} {:12s} {:10s} {:>8d} {:>8d}  {}".format(
+                    w.get("role", "?")[:8], ep[:22], state[:12],
+                    "OPEN" if circuit == "open" else circuit,
+                    int(w.get("inflight") or 0),
+                    int(w.get("requests_total") or 0),
+                    "-" if d_tok is None else f"+{d_tok:.0f}",
+                )
+            )
+        return out
+
     def frame(self, snap: Snapshot) -> str:
         out: List[str] = []
         w = 24
@@ -553,6 +603,7 @@ class Console:
         out.extend(self._admission(snap))
         out.extend(self._engine(snap))
         out.extend(self._cluster(snap))
+        out.extend(self._fleet(snap))
         # -- latency sparklines --
         out.append("")
         out.append(f"{'op latency (interval mean)':28s} {'now':>6s}  trend")
@@ -624,6 +675,10 @@ def poll(serve_url: Optional[str], store_url: Optional[str]) -> Snapshot:
     admission = js(serve_url, "/debug/admission")
     if admission is not None and not admission.get("enabled"):
         admission = None  # controller off (ISTPU_ADMISSION=0): no row
+    # a front door answers /debug/fleet; plain workers 404 → no section
+    fleet = js(serve_url, "/debug/fleet")
+    if fleet is not None and not fleet.get("enabled"):
+        fleet = None
     return Snapshot(
         serve_metrics=prom(serve_url, "/metrics"),
         store_metrics=prom(store_url, "/metrics"),
@@ -636,6 +691,7 @@ def poll(serve_url: Optional[str], store_url: Optional[str]) -> Snapshot:
         engine=engine,
         health=health,
         admission=admission,
+        fleet=fleet,
     )
 
 

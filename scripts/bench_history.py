@@ -69,6 +69,13 @@ METRICS = {
     # 0.53x at 0.938 acceptance" into an attributable regression
     "dispatches_per_token": ("down", "dispatches / decoded token"),
     "spec_accept_per_dispatch": ("up", "spec accepted / dispatch"),
+    # the disaggregation verdict (bench_serve.py `disagg` block): fleet
+    # TTFT/TPOT p99 over the same-decode-budget monolith's at the top
+    # offered rate — < 1.0 means prefill/decode separation is paying;
+    # the PD acceptance is ttft_ratio <= 1.0 with tpot_burst_ratio
+    # measurably below it under a prefill-heavy mix
+    "ttft_ratio": ("down", "disagg/monolith TTFT p99"),
+    "tpot_burst_ratio": ("down", "disagg/monolith TPOT p99"),
     # the health plane's verdict on the serving run (bench_serve.py
     # `health` block): watchdog firing transitions during the sweep —
     # a round that starts paging under the same load is a regression

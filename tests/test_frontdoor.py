@@ -1,0 +1,557 @@
+"""The disaggregated-serving fleet (`frontdoor.py` + serve.py roles).
+
+Unit half (no sockets): placement policy — rendezvous affinity
+stability and minimal rebalance, least-loaded prefill ordering with
+shedding/circuit-aware demotion, affinity-stem derivation — and the
+doctor's fleet summary section from a synthetic capture.
+
+Live half: a real store node (subprocess) under an in-process fleet —
+1 prefill + 1 decode behind a FrontDoor for the functional walk
+(handoff → adoption provenance → byte parity with a locally-computed
+monolith answer, roles on every /healthz, the role-grouped
+cluster_rollup, the /v1/prefill contract, and THE single-trace-id
+stitched Perfetto chain http.request → prefill worker → store push →
+decode adoption), plus a separate 2-prefill fleet for THE chaos walk:
+FaultInjector action first (house rule), then a prefill-worker kill
+mid-flood → every in-flight request recomputes/fails over on the
+survivor with zero 5xx, only the victim's breaker opens, and recovery
+serves adoption hits again — all asserted from /metrics.
+"""
+
+import json
+import http.client
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from infinistore_tpu.utils.metrics import parse_prometheus_text
+
+
+# ---------------------------------------------------------------------------
+# placement policy (pure)
+# ---------------------------------------------------------------------------
+
+
+def _worker(endpoint, role="decode", inflight=0, shedding=False,
+            reachable=True, circuit="closed"):
+    """A WorkerState stand-in with scripted placement inputs."""
+    from infinistore_tpu.frontdoor import WorkerState
+    from infinistore_tpu.utils.metrics import MetricsRegistry
+
+    w = WorkerState(f"http://{endpoint}", role, MetricsRegistry())
+    w.reachable = reachable
+    w._inflight = inflight
+    if shedding:
+        w.healthz = {"admission": {"mode": "shed"}}
+    if circuit == "open":
+        for _ in range(w.breaker.failure_threshold):
+            w.breaker.record_failure()
+        assert w.breaker.state == "open"
+    return w
+
+
+def test_rendezvous_affinity_sticky_and_minimal_rebalance():
+    from infinistore_tpu.frontdoor import rendezvous_order
+
+    pool = [_worker(f"10.0.0.{i}:80") for i in range(4)]
+    stems = [f"stem-{i}" for i in range(64)]
+    first = {s: rendezvous_order(pool, s)[0].endpoint for s in stems}
+    # sticky: same pool, same answer
+    assert first == {s: rendezvous_order(pool, s)[0].endpoint
+                     for s in stems}
+    # removing one worker moves ONLY that worker's stems (the
+    # rendezvous property the HashRing relies on, per key)
+    gone = pool[1]
+    shrunk = [w for w in pool if w is not gone]
+    for s in stems:
+        head = rendezvous_order(shrunk, s)[0].endpoint
+        if first[s] != gone.endpoint:
+            assert head == first[s], s
+    # ~1/N of stems lived on the removed worker (loose sanity bound)
+    moved = sum(1 for s in stems if first[s] == gone.endpoint)
+    assert 0 < moved < len(stems) // 2, moved
+
+
+def test_rendezvous_demotes_shedding_but_keeps_affinity_within_group():
+    from infinistore_tpu.frontdoor import rendezvous_order
+
+    ok = [_worker(f"10.0.1.{i}:80") for i in range(2)]
+    shed = _worker("10.0.1.9:80", shedding=True)
+    order = rendezvous_order(ok + [shed], "stem-x")
+    assert order[-1] is shed  # shedding sorts last
+    assert [w.endpoint for w in order[:2]] == \
+        [w.endpoint for w in rendezvous_order(ok, "stem-x")]
+
+
+def test_prefill_candidates_least_loaded_shedding_last_circuit_skipped():
+    from infinistore_tpu.frontdoor import FrontDoor
+
+    fd = FrontDoor.__new__(FrontDoor)  # placement needs only the pool
+    busy = _worker("10.0.2.1:80", role="prefill", inflight=5)
+    idle = _worker("10.0.2.2:80", role="prefill", inflight=0)
+    shed = _worker("10.0.2.3:80", role="prefill", shedding=True)
+    opened = _worker("10.0.2.4:80", role="prefill", circuit="open")
+    down = _worker("10.0.2.5:80", role="prefill", reachable=False)
+    fd.prefill = [busy, shed, opened, idle, down]
+    cands = fd.prefill_candidates()
+    assert [w.endpoint for w in cands] == \
+        [idle.endpoint, busy.endpoint, shed.endpoint]
+
+
+def test_affinity_stem_shapes():
+    from infinistore_tpu.frontdoor import affinity_stem
+
+    ids = affinity_stem({"prompt": list(range(40))}, tokens=16)
+    assert ids == ",".join(str(t) for t in range(16))
+    # same leading stem, different tails -> same key
+    assert ids == affinity_stem({"prompt": list(range(16)) + [9, 9]},
+                                tokens=16)
+    assert affinity_stem({"prompt": "x" * 100}) == "x" * 64
+    assert affinity_stem({"messages": [{"role": "user",
+                                        "content": "hi"}]}) == "hi"
+    assert affinity_stem({}) is None
+
+
+def test_doctor_summary_renders_fleet_section():
+    from infinistore_tpu.doctor import summarize_capture
+
+    fleet = {
+        "enabled": True,
+        "rollup": {"prefill": {"workers": 2, "ok": 1, "unreachable": 1,
+                               "circuit_open": 1, "degraded": 0},
+                   "decode": {"workers": 1, "ok": 1, "unreachable": 0,
+                              "circuit_open": 0, "degraded": 0}},
+        "workers": [{"role": "prefill", "endpoint": "h:1",
+                     "status": "ok", "circuit": "closed", "inflight": 2}],
+        "handoff": {"count": 9, "p50_ms": 12.0, "p99_ms": 80.0},
+        "adoption": {"store_tokens": 128.0, "local_tokens": 64.0},
+    }
+    cap = {"fetched_at": 0, "stores": [], "serve": {
+        "url": "http://x", "fleet": {
+            "ok": True, "data": json.dumps(fleet).encode()}}}
+    text = summarize_capture(cap)
+    assert "## Fleet (prefill/decode disaggregation)" in text
+    assert "prefill: 1/2 ok, 1 unreachable, 1 circuit open" in text
+    assert "handoff p50/p99 12.0/80.0 ms" in text
+
+
+def test_cluster_rollup_groups_roles():
+    """Role labels on /healthz group the PR-10 rollup; pure-store
+    rollups keep their pre-fleet shape (no `roles` block)."""
+    from infinistore_tpu import health as health_mod
+
+    payloads = {
+        "http://a:1/healthz": {"status": "ok", "role": "prefill"},
+        "http://b:2/healthz": {"status": "ok", "role": "decode"},
+        "http://c:3/healthz": {"status": "ok"},
+    }
+
+    def fake_fetch(url, timeout=2.0):
+        return payloads.get(url)
+
+    orig = health_mod.fetch_json
+    health_mod.fetch_json = fake_fetch
+    try:
+        out = health_mod.cluster_rollup(["a:1", "b:2", "c:3"])
+        assert out["roles"]["prefill"]["ok"] == 1
+        assert out["roles"]["decode"]["ok"] == 1
+        assert out["roles"]["store"]["nodes"] == 1  # unlabeled = store
+        assert out["nodes"][0]["role"] == "prefill"
+        # pure-store fleet: no roles block at all
+        out2 = health_mod.cluster_rollup(["c:3"])
+        assert "roles" not in out2
+    finally:
+        health_mod.fetch_json = orig
+
+
+# ---------------------------------------------------------------------------
+# live fleet
+# ---------------------------------------------------------------------------
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture(scope="module")
+def live_store():
+    port, mport = _free_port(), _free_port()
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "infinistore_tpu.server",
+         "--service-port", str(port), "--manage-port", str(mport),
+         "--prealloc-size", "1", "--minimal-allocate-size", "16",
+         "--log-level", "warning", "--backend", "python"],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    deadline = time.time() + 25
+    while True:
+        if proc.poll() is not None:
+            pytest.fail("store server failed to start")
+        try:
+            socket.create_connection(("127.0.0.1", port), timeout=0.5).close()
+            break
+        except OSError:
+            if time.time() >= deadline:
+                proc.kill()
+                pytest.fail("store server did not come up")
+            time.sleep(0.1)
+    yield port
+    proc.send_signal(signal.SIGINT)
+    try:
+        proc.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+
+
+@pytest.fixture(scope="module")
+def fleet(live_store):
+    """1 prefill + 1 decode behind a front door.  SLO targets loosened
+    for the whole module so the CPU jit-compile storm can never trip the
+    burn watchdogs into shedding — these tests assert behavior, not
+    latency."""
+    from infinistore_tpu.frontdoor import local_fleet
+
+    saved = {k: os.environ.get(k)
+             for k in ("ISTPU_SLO_TTFT_S", "ISTPU_SLO_TPOT_S")}
+    os.environ["ISTPU_SLO_TTFT_S"] = "60"
+    os.environ["ISTPU_SLO_TPOT_S"] = "10"
+    fd, workers, close = local_fleet(live_store, 1, 1, poll_s=0.3)
+    # warm both legs (compiles) so no test measures a compile storm
+    status, _ = _post(fd.port, "/v1/completions",
+                      {"prompt": [7, 7, 7, 7, 7], "max_tokens": 2,
+                       "temperature": 0})
+    assert status == 200
+    yield fd, workers
+    close()
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+
+def _post(port, path, body, headers=None, timeout=120.0):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("POST", path, json.dumps(body),
+                     {"Content-Type": "application/json", **(headers or {})})
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read() or b"{}")
+    finally:
+        conn.close()
+
+
+def _get(port, path, timeout=30.0):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+def _metric(prom_text, family, **labels):
+    parsed = parse_prometheus_text(prom_text)
+    key = (family, tuple(sorted((k, str(v)) for k, v in labels.items())))
+    return parsed.get(key)
+
+
+def test_fleet_adoption_and_byte_parity(fleet):
+    """A routed request completes with store-adoption provenance, and
+    its greedy tokens byte-match the same prompt computed monolithically
+    (the prefill worker's own completions path never adopts — it IS the
+    local-compute oracle)."""
+    fd, workers = fleet
+    prompt = list(range(3, 19))  # 4 complete chunks at block_tokens=4
+    status, routed = _post(fd.port, "/v1/completions",
+                           {"prompt": prompt, "max_tokens": 6,
+                            "temperature": 0})
+    assert status == 200, routed
+    routed_ids = routed["choices"][0]["token_ids"]
+    assert len(routed_ids) == 6
+
+    # provenance: the decode worker pulled the prefix from the store
+    dec = workers["decode"][0]
+    _s, data = _get(dec.port, "/debug/requests")
+    rec = json.loads(data)["records"][-1]
+    st = rec.get("store") or {}
+    assert (st.get("store_chunks") or 0) >= 1, rec
+    assert rec["trace_id"], rec
+
+    # byte parity: local compute on the prefill worker answers the same
+    pre = workers["prefill"][0]
+    status, local = _post(pre.port, "/v1/completions",
+                          {"prompt": prompt, "max_tokens": 6,
+                           "temperature": 0})
+    assert status == 200, local
+    assert local["choices"][0]["token_ids"] == routed_ids
+
+    # the router saw it: fleet report rows + adoption totals
+    _s, data = _get(fd.port, "/debug/fleet")
+    fleet_rep = json.loads(data)
+    assert fleet_rep["enabled"]
+    roles = {w["role"] for w in fleet_rep["workers"]}
+    assert roles == {"prefill", "decode"}
+    assert fleet_rep["handoff"]["count"] >= 1
+    deadline = time.time() + 5  # poller refresh
+    while time.time() < deadline:
+        _s, data = _get(fd.port, "/debug/fleet")
+        if json.loads(data)["adoption"]["store_tokens"] > 0:
+            break
+        time.sleep(0.2)
+    assert json.loads(data)["adoption"]["store_tokens"] > 0
+
+
+def test_roles_on_healthz_and_rollup(fleet):
+    fd, workers = fleet
+    _s, data = _get(workers["prefill"][0].port, "/healthz")
+    assert json.loads(data)["role"] == "prefill"
+    _s, data = _get(workers["decode"][0].port, "/healthz")
+    assert json.loads(data)["role"] == "decode"
+    _s, data = _get(fd.port, "/healthz")
+    hz = json.loads(data)
+    assert hz["role"] == "router"
+    assert hz["rollup"]["prefill"]["workers"] == 1
+    assert hz["rollup"]["decode"]["ok"] == 1
+    # the PR-10 rollup groups the same roles from the workers' healthz
+    from infinistore_tpu.health import cluster_rollup
+
+    out = cluster_rollup([f"127.0.0.1:{workers['prefill'][0].port}",
+                          f"127.0.0.1:{workers['decode'][0].port}"])
+    assert out["roles"]["prefill"]["nodes"] == 1
+    assert out["roles"]["decode"]["nodes"] == 1
+    # role metric on the worker exposition
+    _s, data = _get(workers["prefill"][0].port, "/metrics")
+    assert _metric(data.decode(), "istpu_serve_role",
+                   role="prefill") == 1.0
+
+
+def test_v1_prefill_contract(fleet):
+    """The handoff endpoint: scheduler-path prefill + flush barrier;
+    the pushed prefix is immediately discoverable by the decode pool."""
+    fd, workers = fleet
+    pre = workers["prefill"][0]
+    prompt = list(range(100, 112))  # fresh prefix, 3 complete chunks
+    status, out = _post(pre.port, "/v1/prefill",
+                        {"prompt": prompt})
+    assert status == 200, out
+    assert out["object"] == "prefill" and out["role"] == "prefill"
+    assert out["chunks"] == 3 and out["block_tokens"] == 4
+    assert out["store"] and out["flushed"]
+    # discoverable NOW from the decode worker's engine (store probe)
+    from infinistore_tpu.kv.hashing import chunk_keys
+
+    dec = workers["decode"][0]
+    keys = chunk_keys(prompt, dec.engine.model_id, chunk_tokens=4)
+    assert dec.engine.transfer.guarded_lookup_prefix(keys) == 3
+    # bad request still 400s through the same endpoint
+    status, out = _post(pre.port, "/v1/prefill", {"prompt": []})
+    assert status == 400
+
+
+def test_stitched_single_trace_chain(fleet):
+    """THE acceptance criterion: the router's /debug/traces export
+    carries http.request → prefill handoff → store push → decode
+    adoption under ONE trace id, loaded and asserted from the JSON."""
+    fd, workers = fleet
+    prompt = list(range(40, 56))
+    status, _body = _post(fd.port, "/v1/completions",
+                          {"prompt": prompt, "max_tokens": 4,
+                           "temperature": 0})
+    assert status == 200
+    # the worker-side ledgers carry the ROUTER's trace id (propagated
+    # via X-Istpu-Trace on both legs)
+    _s, data = _get(workers["decode"][0].port, "/debug/requests")
+    trace_id = json.loads(data)["records"][-1]["trace_id"]
+    assert trace_id
+    _s, data = _get(fd.port, "/debug/traces")
+    export = json.loads(data)
+    mine = [e for e in export["traceEvents"] if e.get("ph") == "X"
+            and e.get("args", {}).get("trace_id") == trace_id]
+    names = {e["name"] for e in mine}
+    # the chain: router request + handoff legs, the prefill worker's
+    # compute + store push, the decode worker's adoption load
+    assert {"http.request", "fd.prefill_handoff", "fd.decode_dispatch",
+            "engine.prefill", "store.push_async",
+            "kv.load_pages"} <= names, sorted(names)
+    # the http.request leg propagated over a REAL socket hop on each
+    # leg: prefill, decode, and router all opened one (the in-process
+    # fleet shares one ring, so count spans, not pids — the
+    # cross-process offset mapping is covered by
+    # test_stitch_maps_remote_worker_dump below)
+    assert sum(1 for e in mine if e["name"] == "http.request") >= 3
+
+
+def test_stitch_maps_remote_worker_dump(monkeypatch):
+    """The router's cross-process gather: a worker dump with its own
+    pid and a skewed clock lands in the export on its own process row,
+    mapped onto the router timeline by the round-trip-midpoint offset."""
+    from infinistore_tpu.frontdoor import FrontDoor, WorkerState
+    from infinistore_tpu.utils.metrics import MetricsRegistry
+
+    fd = FrontDoor.__new__(FrontDoor)
+    w = WorkerState("http://127.0.0.1:1", "prefill", MetricsRegistry())
+    w.reachable = True
+    fd.prefill, fd.decode = [w], []
+
+    now = time.perf_counter()
+    skew = 1234.5  # worker clock runs far ahead of the router's
+    dump = {
+        "pid": 99999, "clock": now + skew, "dropped": 0,
+        "traces": [{"trace_id": "tr-x", "name": "http.request",
+                    "events": [["kv.push_pages", now + skew - 0.010,
+                                now + skew - 0.004, 7, {}]]}],
+    }
+    monkeypatch.setattr(FrontDoor, "_fetch_json",
+                        classmethod(lambda cls, _w, _p, timeout: dump))
+    export = json.loads(fd.stitched_traces_json())
+    remote = [e for e in export["traceEvents"] if e.get("ph") == "X"
+              and e["pid"] == 99999]
+    assert remote and remote[0]["name"] == "kv.push_pages"
+    assert remote[0]["args"]["trace_id"] == "tr-x"
+    # offset-mapped: the span sits within ~the fetch RTT of "10ms ago"
+    # on the ROUTER clock, nowhere near the +1234.5s raw stamp
+    meta_pids = {e["pid"] for e in export["traceEvents"]
+                 if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert 99999 in meta_pids
+    assert remote[0]["dur"] == pytest.approx(6000, rel=0.05)  # µs
+
+
+def test_worker_fault_injector_delay_and_clear(fleet):
+    """The serve-plane FaultInjector hook: an armed delay rule slows
+    the matched path, clear() restores it (the chaos walk's lever)."""
+    fd, workers = fleet
+    pre = workers["prefill"][0]
+    status, out = _post(pre.port, "/debug/faults",
+                        [{"op": "/v1/prefill", "action": "delay",
+                          "delay_s": 0.4, "times": 1}])
+    assert status == 200 and out["armed"] == 1
+    t0 = time.perf_counter()
+    status, _ = _post(pre.port, "/v1/prefill",
+                      {"prompt": list(range(60, 72))})
+    assert status == 200
+    assert time.perf_counter() - t0 >= 0.4
+    status, out = _post(pre.port, "/debug/faults", [])
+    assert status == 200 and out["armed"] == 0
+
+
+# ---------------------------------------------------------------------------
+# THE chaos walk: prefill-worker kill mid-flood
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_chaos_prefill_worker_kill_mid_flood(live_store):
+    """House rule (FaultInjector action first): the victim's death is
+    driven through an armed drop_conn rule — every in-flight and
+    subsequent handoff to it dies at the socket — followed by the real
+    httpd kill.  Mid-flood: zero errors and zero 5xx (in-flight
+    requests recompute/fail over on the survivor), ONLY the victim's
+    breaker opens, and afterwards adoption hits keep being served — all
+    asserted from the router's /metrics."""
+    from infinistore_tpu.frontdoor import local_fleet
+    from infinistore_tpu.loadgen import LoadConfig, run_load, summarize
+
+    saved = {k: os.environ.get(k)
+             for k in ("ISTPU_SLO_TTFT_S", "ISTPU_SLO_TPOT_S")}
+    os.environ["ISTPU_SLO_TTFT_S"] = "60"
+    os.environ["ISTPU_SLO_TPOT_S"] = "10"
+    fd, workers, close = local_fleet(live_store, 2, 1, poll_s=0.3)
+    try:
+        url = f"http://127.0.0.1:{fd.port}"
+        victim, survivor = workers["prefill"]
+        v_ep = f"prefill@127.0.0.1:{victim.port}"
+        s_ep = f"prefill@127.0.0.1:{survivor.port}"
+        # warm both prefill workers and the decode path (compiles)
+        for w in (victim, survivor):
+            status, _ = _post(w.port, "/v1/prefill",
+                              {"prompt": [1, 2, 3, 4, 5]})
+            assert status == 200
+        status, _ = _post(fd.port, "/v1/completions",
+                          {"prompt": [1, 2, 3, 4, 5], "max_tokens": 2,
+                           "temperature": 0})
+        assert status == 200
+
+        # the FaultInjector action FIRST (house rule): every
+        # /v1/prefill on the victim dies at the socket mid-op — the
+        # in-flight shape of a worker death, while /healthz still
+        # answers (so the router keeps picking it until its BREAKER
+        # learns, which is exactly what the breaker is for)
+        status, out = _post(victim.port, "/debug/faults",
+                            [{"op": "/v1/prefill",
+                              "action": "drop_conn", "times": -1}])
+        assert status == 200 and out["armed"] == 1
+        # keep the opened circuit visible at assert time (no half-open
+        # probe mid-flood)
+        victim_state = next(w for w in fd.prefill
+                            if w.port == victim.port)
+        victim_state.breaker.cooldown_s = 300.0
+
+        # mid-flood: open-loop load through the router; every request
+        # that hits the victim fails over to the survivor IN-REQUEST
+        results, makespan = run_load(url, LoadConfig(
+            rate=6.0, n_requests=16, vocab=256,
+            mix=[(1.0, 16, 4)], timeout_s=300.0))
+        point = summarize(results, makespan, 60.0, 10.0, rate=6.0)
+        assert point["completed"] == 16, point
+        assert point["errors"] == 0 and point["rejected"] == 0, point
+
+        _s, data = _get(fd.port, "/metrics")
+        prom = data.decode()
+        # zero 5xx through the death
+        assert _metric(prom, "istpu_fd_requests_total",
+                       **{"class": "5xx"}) == 0.0
+        # victim-only breaker: the victim's circuit is OPEN, the
+        # survivor's stays closed
+        assert _metric(prom, "istpu_store_circuit_state", name=v_ep) == 1.0
+        assert _metric(prom, "istpu_store_circuit_state", name=s_ep) == 0.0
+
+        # now the REAL kill (process death: nothing answers at all) —
+        # the poller marks it unreachable and the rollup shows the
+        # role-down state while the fleet keeps serving
+        victim.httpd.shutdown()
+        victim.httpd.server_close()
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            _s, data = _get(fd.port, "/healthz")
+            hz = json.loads(data)
+            if hz["rollup"]["prefill"]["unreachable"] == 1:
+                break
+            time.sleep(0.2)
+        assert hz["status"] == "degraded" and \
+            hz["rollup"]["prefill"]["unreachable"] == 1, hz
+
+        # recovery: handoffs keep landing on the survivor and adoption
+        # hits keep being served (fresh prefixes adopted via the store)
+        ok_before = _metric(prom, "istpu_fd_handoff_total",
+                            outcome="ok") or 0.0
+        prompt = list(range(200, 216))
+        status, _body = _post(fd.port, "/v1/completions",
+                              {"prompt": prompt, "max_tokens": 4,
+                               "temperature": 0})
+        assert status == 200
+        dec = workers["decode"][0]
+        _s, data = _get(dec.port, "/debug/requests")
+        rec = json.loads(data)["records"][-1]
+        assert ((rec.get("store") or {}).get("store_chunks") or 0) >= 1, rec
+        _s, data = _get(fd.port, "/metrics")
+        prom = data.decode()
+        assert (_metric(prom, "istpu_fd_handoff_total", outcome="ok")
+                or 0.0) > ok_before
+    finally:
+        close()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v

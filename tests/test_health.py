@@ -367,7 +367,9 @@ def test_doctor_summary_joins_requests_to_steps(tmp_path):
     cap = {
         "fetched_at": 1754000000.0,
         "serve": _plane("http://s:8000", [
-            (name, path, fname, serve_payloads[path])
+            # .get: endpoints added later (e.g. /debug/fleet) render as
+            # unreachable here — the summary must degrade per endpoint
+            (name, path, fname, serve_payloads.get(path))
             for name, path, fname in SERVE_ENDPOINTS
         ]),
         "stores": [_plane("http://st:18080", [

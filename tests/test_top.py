@@ -109,6 +109,67 @@ def test_console_renders_synthetic_snapshot():
     assert Console().frame(S())
 
 
+def test_console_renders_fleet_view():
+    """The disaggregated-fleet section (router /debug/fleet): one row
+    per worker with role/state/circuit/inflight, and a per-frame
+    adoption-hit delta from each worker's store-loaded prompt tokens —
+    pure Console.frame in the snapshot, per the established pattern."""
+    from infinistore_tpu.top import Console, Snapshot
+
+    def fleet(store_tok, victim_circuit="closed", victim_status="ok"):
+        def worker(role, ep, circuit="closed", status="ok", tok=0.0,
+                   shedding=False):
+            return {
+                "endpoint": ep, "role": role, "reachable": True,
+                "status": status, "circuit": circuit, "inflight": 2,
+                "shedding": shedding, "requests_total": 40,
+                "completed_total": 38, "free_kv_pages": 200,
+                "prefix_tokens": {"local": 8.0, "store": tok,
+                                  "computed": 64.0},
+            }
+
+        return {
+            "enabled": True, "role": "router",
+            "workers": [
+                worker("prefill", "10.0.0.1:8001",
+                       circuit=victim_circuit, status=victim_status),
+                worker("decode", "10.0.0.3:8003", tok=store_tok,
+                       shedding=True),
+            ],
+            "rollup": {
+                "prefill": {"workers": 1,
+                            "ok": 1 if victim_status == "ok" else 0,
+                            "degraded": 0, "unreachable": 0,
+                            "circuit_open":
+                                1 if victim_circuit == "open" else 0},
+                "decode": {"workers": 1, "ok": 1, "degraded": 0,
+                           "unreachable": 0, "circuit_open": 0},
+            },
+            "handoff": {"count": 12, "p50_ms": 14.2, "p99_ms": 90.5},
+            "adoption": {"store_tokens": store_tok, "local_tokens": 8.0},
+            "requests": {"2xx": 40, "4xx": 1, "5xx": 0, "error": 0},
+        }
+
+    console = Console()
+    first = console.frame(Snapshot(fleet=fleet(96.0)))
+    assert "fleet" in first and "prefill 1/1 ok" in first
+    assert "handoff p50/p99 14.2/90.5 ms" in first
+    assert "10.0.0.1:8001" in first and "10.0.0.3:8003" in first
+    # first frame has no delta yet
+    assert "Δadopt-tok/frame" in first
+    # second frame: +128 adoption tokens on the decode worker, the
+    # victim's circuit now OPEN and its row says so
+    out = console.frame(Snapshot(
+        fleet=fleet(224.0, victim_circuit="open",
+                    victim_status="unreachable")))
+    assert "+128" in out
+    assert "OPEN" in out and "unreachabl" in out
+    assert "ok+shed" in out  # shedding decode worker flagged in-state
+    assert "prefill 0/1 ok" in out
+    # a fleet-less snapshot renders no fleet section
+    assert "fleet" not in Console().frame(Snapshot())
+
+
 def test_console_renders_engine_view():
     """The engine-attribution section (serving /debug/engine): tokens
     and steps per frame, retraces, host-stall share, mem watermark bar."""
