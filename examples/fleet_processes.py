@@ -1,0 +1,194 @@
+"""Subprocess fleet bring-up: the production shape of PD disaggregation.
+
+Boots FOUR separate OS processes — a store node, one prefill worker, one
+decode worker, and the front-door router — exactly as a deployment would
+(``python -m infinistore_tpu.serve --role prefill|decode|router``; the
+in-process ``local_fleet`` used by tests and benches shares one
+interpreter and is NOT this), then drives a few completions through the
+router and verifies the handoff chain end to end:
+
+    client -> router -> prefill worker --(store push + flush)-->
+           -> decode worker --(store adoption)--> SSE tokens back
+
+Usage::
+
+    python examples/fleet_processes.py            # demo: prints progress
+    python examples/fleet_processes.py --smoke    # CI: exit 0 iff every
+                                                  # request completed and
+                                                  # the router served no 5xx
+
+Everything runs on localhost with the tiny random-init model and TCP
+store connections, so it works on any host (no TPU, no checkpoints).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def wait_http(port: int, path: str, deadline: float, proc=None) -> None:
+    while True:
+        if proc is not None and proc.poll() is not None:
+            raise RuntimeError(
+                f"process died while waiting for :{port}{path} "
+                f"(rc={proc.returncode})"
+            )
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=1.0
+            ):
+                return
+        except Exception:
+            if time.time() >= deadline:
+                raise RuntimeError(f"port {port}{path} did not come up")
+            time.sleep(0.2)
+
+
+def wait_tcp(port: int, deadline: float, proc=None) -> None:
+    while True:
+        if proc is not None and proc.poll() is not None:
+            raise RuntimeError(f"process died (rc={proc.returncode})")
+        try:
+            socket.create_connection(("127.0.0.1", port), timeout=0.5).close()
+            return
+        except OSError:
+            if time.time() >= deadline:
+                raise RuntimeError(f"port {port} did not come up")
+            time.sleep(0.1)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI mode: exit nonzero unless every request "
+                         "completes and the router serves zero 5xx")
+    ap.add_argument("--requests", type=int, default=8)
+    args = ap.parse_args()
+
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", "cpu"),
+        # a cold fleet's jit-compile storm must not trip the burn
+        # watchdogs / predictive shed during bring-up
+        "ISTPU_SLO_TTFT_S": os.environ.get("ISTPU_SLO_TTFT_S", "60"),
+        "ISTPU_SLO_TPOT_S": os.environ.get("ISTPU_SLO_TPOT_S", "10"),
+    }
+    store_port, store_mport = free_port(), free_port()
+    pf_port, dec_port, router_port = free_port(), free_port(), free_port()
+    procs = []
+
+    def spawn(label, argv):
+        print(f"[fleet] starting {label}: {' '.join(argv[2:])}",
+              flush=True)
+        p = subprocess.Popen(argv, cwd=REPO, env=env)
+        procs.append(p)
+        return p
+
+    try:
+        store = spawn("store", [
+            sys.executable, "-m", "infinistore_tpu.server",
+            "--service-port", str(store_port),
+            "--manage-port", str(store_mport),
+            "--prealloc-size", "1", "--minimal-allocate-size", "16",
+            "--log-level", "warning", "--backend", "python",
+        ])
+        wait_tcp(store_port, time.time() + 30, store)
+
+        worker_flags = [
+            "--model", "tiny", "--block-tokens", "4", "--n-blocks", "128",
+            "--store-host", "127.0.0.1",
+            "--store-service-port", str(store_port),
+            "--store-connection", "tcp", "--log-level", "warning",
+        ]
+        prefill = spawn("prefill worker", [
+            sys.executable, "-m", "infinistore_tpu.serve",
+            "--role", "prefill", "--port", str(pf_port), *worker_flags,
+        ])
+        decode = spawn("decode worker", [
+            sys.executable, "-m", "infinistore_tpu.serve",
+            "--role", "decode", "--port", str(dec_port), *worker_flags,
+        ])
+        # workers import jax + build engines before listening: generous
+        # deadline, both booting in parallel
+        wait_http(pf_port, "/healthz", time.time() + 180, prefill)
+        wait_http(dec_port, "/healthz", time.time() + 180, decode)
+
+        router = spawn("router", [
+            sys.executable, "-m", "infinistore_tpu.serve",
+            "--role", "router", "--port", str(router_port),
+            "--prefill-workers", f"127.0.0.1:{pf_port}",
+            "--decode-workers", f"127.0.0.1:{dec_port}",
+            "--log-level", "warning",
+        ])
+        wait_http(router_port, "/healthz", time.time() + 30, router)
+
+        url = f"http://127.0.0.1:{router_port}"
+        completed = failed = 0
+        for i in range(args.requests):
+            body = json.dumps({
+                "prompt": [(i * 7 + j) % 200 + 1 for j in range(16)],
+                "max_tokens": 4, "temperature": 0,
+            }).encode()
+            req = urllib.request.Request(
+                url + "/v1/completions", data=body,
+                headers={"Content-Type": "application/json"},
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=300) as r:
+                    out = json.load(r)
+                toks = out["choices"][0]["token_ids"]
+                assert r.status == 200 and len(toks) == 4, out
+                completed += 1
+                print(f"[fleet] request {i}: 200, tokens={toks}",
+                      flush=True)
+            except Exception as e:  # noqa: BLE001 — counted, reported
+                failed += 1
+                print(f"[fleet] request {i} FAILED: {e!r}", flush=True)
+
+        with urllib.request.urlopen(url + "/metrics", timeout=10) as r:
+            prom = r.read().decode()
+        m = re.search(r'istpu_fd_requests_total\{class="5xx"\} (\S+)', prom)
+        fivexx = float(m.group(1)) if m else 0.0
+        with urllib.request.urlopen(url + "/debug/fleet", timeout=10) as r:
+            fleet = json.load(r)
+        print(f"[fleet] done: {completed}/{args.requests} completed, "
+              f"{failed} failed, router 5xx={fivexx:.0f}, workers="
+              f"{[w.get('role') for w in fleet.get('workers', [])]}",
+              flush=True)
+        ok = completed == args.requests and failed == 0 and fivexx == 0.0
+        if args.smoke and not ok:
+            print("[fleet] SMOKE FAILED", flush=True)
+            return 1
+        print("[fleet] OK", flush=True)
+        return 0
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -53,6 +53,7 @@ import bisect
 import hashlib
 import os
 import threading
+import time
 from collections import OrderedDict
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -73,6 +74,13 @@ DEFAULT_REPLICAS = int(os.environ.get("ISTPU_CLUSTER_REPLICAS", "2"))
 # prompts are read-heavy: their stems recur across requests, cold
 # one-off prompts never do)
 DEFAULT_HOT_AFTER = int(os.environ.get("ISTPU_HOT_AFTER", "3"))
+# background migration pacing: copy this many keys per breath, then
+# yield — membership changes run UNDER live traffic, so the migrator
+# must never saturate a node's data plane
+MIGRATE_BATCH = int(os.environ.get("ISTPU_MIGRATE_BATCH", "64"))
+MIGRATE_SLEEP_S = float(os.environ.get("ISTPU_MIGRATE_SLEEP_S", "0.005"))
+
+_MEMBERSHIP_CODE = {"active": 0, "joining": 1, "draining": 2}
 
 _RING_SPACE = float(1 << 64)
 
@@ -128,17 +136,21 @@ class HashRing:
         assert vnodes >= 1
         self.vnodes = vnodes
         self._endpoints: List[str] = []
-        self._points: List[Tuple[int, str]] = []  # sorted (hash, endpoint)
-        self._hashes: List[int] = []
+        # one ATOMICALLY-swapped snapshot (endpoints, hashes, points):
+        # membership changes rebuild a fresh tuple and assign it in one
+        # statement, so a router thread mid-``owner()`` never sees a
+        # half-updated ring (live join/drain mutates under traffic)
+        self._snap: Tuple[Tuple[str, ...], Tuple[int, ...],
+                          Tuple[Tuple[int, str], ...]] = ((), (), ())
         for ep in endpoints:
             self.add(ep)
 
     @property
     def endpoints(self) -> List[str]:
-        return list(self._endpoints)
+        return list(self._snap[0])
 
     def __len__(self) -> int:
-        return len(self._endpoints)
+        return len(self._snap[0])
 
     def _rebuild(self) -> None:
         pts = [
@@ -147,8 +159,11 @@ class HashRing:
             for i in range(self.vnodes)
         ]
         pts.sort()
-        self._points = pts
-        self._hashes = [h for h, _ in pts]
+        self._snap = (tuple(self._endpoints),
+                      tuple(h for h, _ in pts), tuple(pts))
+
+    def clone(self) -> "HashRing":
+        return HashRing(self._snap[0], vnodes=self.vnodes)
 
     def add(self, endpoint: str) -> None:
         if endpoint in self._endpoints:
@@ -165,24 +180,26 @@ class HashRing:
     def owner(self, key: str) -> str:
         """The endpoint owning ``key``'s routing stem: the first virtual
         node at or clockwise of the key's ring position."""
-        if not self._points:
+        _eps, hashes, points = self._snap
+        if not points:
             raise ValueError("empty ring")
         h = ring_hash(route_stem(key))
-        i = bisect.bisect_left(self._hashes, h) % len(self._points)
-        return self._points[i][1]
+        i = bisect.bisect_left(hashes, h) % len(points)
+        return points[i][1]
 
     def successors(self, key: str, n: int) -> List[str]:
         """Up to ``n`` DISTINCT endpoints walking clockwise from the
         key's position — element 0 is the owner, the rest are the
         replica candidates (and the read-failover order)."""
-        if not self._points:
+        eps, hashes, points = self._snap
+        if not points:
             raise ValueError("empty ring")
-        n = min(n, len(self._endpoints))
+        n = min(n, len(eps))
         h = ring_hash(route_stem(key))
-        i = bisect.bisect_left(self._hashes, h)
+        i = bisect.bisect_left(hashes, h)
         out: List[str] = []
-        for k in range(len(self._points)):
-            ep = self._points[(i + k) % len(self._points)][1]
+        for k in range(len(points)):
+            ep = points[(i + k) % len(points)][1]
             if ep not in out:
                 out.append(ep)
                 if len(out) == n:
@@ -192,11 +209,12 @@ class HashRing:
     def ownership(self) -> Dict[str, float]:
         """Fraction of the hash space each endpoint owns (arc lengths of
         its virtual nodes) — the ring-balance gauge."""
-        if not self._points:
+        eps, _hashes, points = self._snap
+        if not points:
             return {}
-        out = {ep: 0.0 for ep in self._endpoints}
-        prev = self._points[-1][0] - (1 << 64)  # wraparound arc
-        for h, ep in self._points:
+        out = {ep: 0.0 for ep in eps}
+        prev = points[-1][0] - (1 << 64)  # wraparound arc
+        for h, ep in points:
             out[ep] += (h - prev) / _RING_SPACE
             prev = h
         return out
@@ -361,7 +379,30 @@ class RoutedStorePool:
         self._req_counts: Dict[Tuple[str, str], int] = {}
         self._replica_counts = {"hit": 0, "miss": 0}
         self._counts_lock = threading.Lock()
+        # live membership: per-endpoint state (active / joining /
+        # draining), the PREVIOUS ring while a transition migrates (its
+        # owner rides the read-failover walk so any placement stays
+        # correct mid-migration), and the migration progress record
+        self._membership: Dict[str, str] = {ep: "active" for ep in eps}
+        self._old_ring: Optional[HashRing] = None
+        self._mig_lock = threading.Lock()
+        self._mig_thread: Optional[threading.Thread] = None
+        self._migration: Dict = {"state": "idle"}
+        self._g_member = reg.gauge(
+            "istpu_cluster_membership",
+            "Per-endpoint membership state: 0 active / 1 joining "
+            "(background migration filling it) / 2 draining (its range "
+            "migrating away while it still serves reads)",
+            labelnames=("endpoint",),
+        )
+        self._c_migrated = reg.counter(
+            "istpu_cluster_migrated_keys_total",
+            "Background membership-migration key copies by result "
+            "(copied / skipped already-present / error)",
+            labelnames=("result",),
+        )
         self._refresh_ring_gauges()
+        self._refresh_membership_gauges()
         if connect:
             for node in self._nodes.values():
                 try:
@@ -413,25 +454,40 @@ class RoutedStorePool:
     def node(self, endpoint: str) -> _Node:
         return self._nodes[endpoint]
 
+    def node_or_none(self, endpoint: str) -> Optional[_Node]:
+        """Tolerant lookup: a candidate list computed mid-transition may
+        name a node the migrator has since let go."""
+        return self._nodes.get(endpoint)
+
     def nodes(self) -> List[_Node]:
-        return [self._nodes[ep] for ep in self.ring.endpoints]
+        out = []
+        for ep in self.ring.endpoints:
+            node = self._nodes.get(ep)
+            if node is not None:
+                out.append(node)
+        return out
 
     def add_endpoint(self, endpoint: str) -> None:
-        """Join a node.  Rebalance is LAZY on purpose: no bytes move —
-        a key whose owner changed is a cache miss that re-pushes under
-        its content-addressed name, and the old copy LRU-ages out."""
+        """Join a node WITHOUT migration.  Rebalance is LAZY: no bytes
+        move — a key whose owner changed is a cache miss that re-pushes
+        under its content-addressed name, and the old copy LRU-ages out.
+        ``join_node`` is the managed, migrating spelling."""
         ep = parse_endpoints([endpoint])[0]
         if ep in self._nodes:
             return
         self._nodes[ep] = _Node(ep, self._make_conn)
+        self._membership[ep] = "active"
         self.ring.add(ep)
         self.replicas = min(max(self.replicas, 1), len(self._nodes))
         self._refresh_ring_gauges()
+        self._refresh_membership_gauges()
 
     def remove_endpoint(self, endpoint: str) -> None:
         node = self._nodes.pop(endpoint, None)
+        self._membership.pop(endpoint, None)
         self.ring.remove(endpoint)
         self._refresh_ring_gauges()
+        self._refresh_membership_gauges()
         if node is not None:
             try:
                 node.conn.close()
@@ -439,8 +495,221 @@ class RoutedStorePool:
                 pass
 
     def _refresh_ring_gauges(self) -> None:
-        for ep, frac in self.ring.ownership().items():
-            self._g_own.labels(ep).set(frac)
+        own = self.ring.ownership()
+        for ep in set(own) | set(self._nodes):
+            self._g_own.labels(ep).set(own.get(ep, 0.0))
+
+    def _refresh_membership_gauges(self) -> None:
+        for ep in self._nodes:
+            self._g_member.labels(ep).set(
+                float(_MEMBERSHIP_CODE.get(
+                    self._membership.get(ep, "active"), 0))
+            )
+
+    # -- live membership: join / drain with background migration --
+
+    def membership(self, endpoint: str) -> str:
+        return self._membership.get(endpoint, "active")
+
+    def migration_report(self) -> Dict:
+        with self._mig_lock:
+            rep = dict(self._migration)
+        if rep.get("started_at") and rep.get("state") == "running":
+            rep["elapsed_s"] = round(time.monotonic() - rep["started_at"], 2)
+        rep.pop("started_at", None)
+        return rep
+
+    def migration_idle(self) -> bool:
+        with self._mig_lock:
+            return self._migration.get("state") != "running"
+
+    def join_node(self, endpoint: str) -> None:
+        """Grow the fleet by one node UNDER TRAFFIC: the node enters the
+        ring immediately (new writes land on it; reads that miss there
+        fail over to the pre-join owner via the extended candidate walk)
+        and a background migrator copies its ~1/N key range over from
+        the old owners.  When the copy finishes the node flips
+        ``active`` and the old ring is dropped."""
+        ep = parse_endpoints([endpoint])[0]
+        with self._mig_lock:
+            if self._migration.get("state") == "running":
+                raise RuntimeError("a membership change is already running")
+            if ep in self._nodes:
+                raise ValueError(f"{ep} is already a member")
+            old = self.ring.clone()
+            node = _Node(ep, self._make_conn)
+            try:
+                node.ensure_connected()
+            except Exception as e:  # noqa: BLE001 — refuse, don't degrade:
+                # joining an unreachable node would shrink every key's
+                # effective replica set for nothing
+                raise RuntimeError(f"cannot join {ep}: {e!r}") from e
+            self._nodes[ep] = node
+            self._membership[ep] = "joining"
+            self.ring.add(ep)
+            self.replicas = min(max(self.replicas, 1), len(self._nodes))
+            self._old_ring = old
+            self._migration = {
+                "state": "running", "mode": "join", "endpoint": ep,
+                "copied": 0, "skipped": 0, "errors": 0, "sources": 0,
+                "started_at": time.monotonic(),
+            }
+            self._refresh_ring_gauges()
+            self._refresh_membership_gauges()
+            self._mig_thread = threading.Thread(
+                target=self._migrate_join, args=(ep, old),
+                name="istpu-migrate", daemon=True,
+            )
+            self._mig_thread.start()
+
+    def drain_node(self, endpoint: str) -> None:
+        """Shrink the fleet by one node UNDER TRAFFIC: the node leaves
+        the ring immediately (no new writes), KEEPS serving reads as the
+        old-ring owner on the extended candidate walk, while the
+        migrator copies its owned range to the new owners; when the copy
+        finishes the node is disconnected and forgotten."""
+        ep = parse_endpoints([endpoint])[0]
+        with self._mig_lock:
+            if self._migration.get("state") == "running":
+                raise RuntimeError("a membership change is already running")
+            if ep not in self._nodes:
+                raise ValueError(f"{ep} is not a member")
+            if len(self.ring.endpoints) <= 1:
+                raise ValueError("cannot drain the last node")
+            old = self.ring.clone()
+            self.ring.remove(ep)
+            self._membership[ep] = "draining"
+            self.replicas = min(self.replicas, len(self.ring.endpoints))
+            self._old_ring = old
+            self._migration = {
+                "state": "running", "mode": "drain", "endpoint": ep,
+                "copied": 0, "skipped": 0, "errors": 0, "sources": 1,
+                "started_at": time.monotonic(),
+            }
+            self._refresh_ring_gauges()
+            self._refresh_membership_gauges()
+            self._mig_thread = threading.Thread(
+                target=self._migrate_drain, args=(ep, old),
+                name="istpu-migrate", daemon=True,
+            )
+            self._mig_thread.start()
+
+    def _node_keys(self, ep: str) -> List[str]:
+        node = self._nodes.get(ep)
+        if node is None:
+            return []
+        with node.lock:
+            node.ensure_connected()
+            return node.conn.list_keys()
+
+    def _copy_key(self, key: str, src_ep: str, dst_ep: str) -> str:
+        """Move one key's bytes src → dst (reads and writes ride the
+        nodes' own reconnect-aware connections).  Returns the counted
+        result: already-present destinations are ``skipped`` (pushes
+        since the ring changed already landed there), a vanished source
+        key too (it LRU-aged out — lazy heal covers it)."""
+        src = self._nodes.get(src_ep)
+        dst = self._nodes.get(dst_ep)
+        if src is None or dst is None:
+            return "error"
+        from .lib import InfiniStoreKeyNotFound
+
+        try:
+            with dst.lock:
+                dst.ensure_connected()
+                if dst.conn.check_exist(key):
+                    return "skipped"
+            with src.lock:
+                data = src.conn.tcp_read_cache(key)
+            with dst.lock:
+                dst.conn.tcp_write_cache(
+                    key, data.ctypes.data, data.nbytes
+                )
+            return "copied"
+        except InfiniStoreKeyNotFound:
+            return "skipped"
+        except Exception:  # noqa: BLE001 — counted; lazy rebalance heals
+            return "error"
+
+    def _migrate_pairs(self, pairs, ep: str) -> None:
+        """Drive the copy loop and settle the transition.  ``pairs`` is
+        an iterable of (key, src, dst)."""
+        copied = skipped = errors = 0
+        for i, (key, src, dst) in enumerate(pairs):
+            result = self._copy_key(key, src, dst)
+            self._c_migrated.labels(result).inc()
+            copied += result == "copied"
+            skipped += result == "skipped"
+            errors += result == "error"
+            with self._mig_lock:
+                self._migration.update(
+                    copied=copied, skipped=skipped, errors=errors)
+            if (i + 1) % MIGRATE_BATCH == 0:
+                time.sleep(MIGRATE_SLEEP_S)  # breathe under live traffic
+
+    def _migrate_join(self, ep: str, old: HashRing) -> None:
+        try:
+            pairs = []
+            sources = 0
+            for src in old.endpoints:
+                try:
+                    keys = self._node_keys(src)
+                    sources += 1
+                except Exception:  # noqa: BLE001 — a dead source's range
+                    # heals lazily (its keys re-push on recompute)
+                    with self._mig_lock:
+                        self._migration["errors"] = (
+                            self._migration.get("errors", 0) + 1)
+                    continue
+                for key in keys:
+                    # copy exactly the new node's range: keys it now owns
+                    # that lived on this (pre-join) owner
+                    if (self.ring.owner(key) == ep
+                            and old.owner(key) == src):
+                        pairs.append((key, src, ep))
+            with self._mig_lock:
+                self._migration["sources"] = sources
+                self._migration["total"] = len(pairs)
+            self._migrate_pairs(pairs, ep)
+        finally:
+            with self._mig_lock:
+                self._membership[ep] = "active"
+                self._old_ring = None
+                self._migration.update(state="done")
+                self._refresh_membership_gauges()
+
+    def _migrate_drain(self, ep: str, old: HashRing) -> None:
+        try:
+            try:
+                keys = self._node_keys(ep)
+            except Exception:  # noqa: BLE001 — draining a dead node:
+                # nothing to copy, its range recomputes (same outcome as
+                # the crash the drain exists to avoid)
+                keys = []
+                with self._mig_lock:
+                    self._migration["errors"] = (
+                        self._migration.get("errors", 0) + 1)
+            pairs = [
+                (key, ep, self.ring.owner(key))
+                for key in keys
+                if old.owner(key) == ep
+            ]
+            with self._mig_lock:
+                self._migration["total"] = len(pairs)
+            self._migrate_pairs(pairs, ep)
+        finally:
+            with self._mig_lock:
+                node = self._nodes.pop(ep, None)
+                self._membership.pop(ep, None)
+                self._old_ring = None
+                self._migration.update(state="done")
+                self._g_member.labels(ep).set(0.0)
+                self._refresh_membership_gauges()
+            if node is not None:
+                try:
+                    node.conn.close()
+                except Exception:  # noqa: BLE001
+                    pass
 
     # -- routing --
 
@@ -449,8 +718,20 @@ class RoutedStorePool:
 
     def candidates(self, key: str) -> List[str]:
         """Read-failover / replica order for a key: owner first, then
-        ring successors, ``replicas`` long."""
-        return self.ring.successors(key, self.replicas)
+        ring successors, ``replicas`` long.  During a membership
+        transition the PRE-CHANGE owner is appended — migration reads
+        ride the normal replica-failover walk, which is what keeps every
+        placement correct while the background copy catches up."""
+        cands = self.ring.successors(key, self.replicas)
+        old = self._old_ring
+        if old is not None and len(old):
+            try:
+                oep = old.owner(key)
+            except ValueError:
+                oep = None
+            if oep is not None and oep not in cands and oep in self._nodes:
+                cands.append(oep)
+        return cands
 
     def write_targets(self, key: str) -> List[str]:
         """Where a chunk's pages go: the owner — plus the replica
@@ -511,13 +792,21 @@ class RoutedStorePool:
             req = dict(self._req_counts)
             replica = dict(self._replica_counts)
         nodes = []
-        for ep in self.ring.endpoints:
-            node = self._nodes[ep]
+        # every known node renders — a DRAINING node has left the ring
+        # but still serves reads, and operators must see it until the
+        # migration lets it go
+        eps = list(self.ring.endpoints)
+        eps += [ep for ep in list(self._nodes) if ep not in eps]
+        for ep in eps:
+            node = self._nodes.get(ep)
+            if node is None:
+                continue
             state = node.breaker.state
             self._g_state.labels(ep).set(node.breaker.state_code)
             nodes.append({
                 "endpoint": ep,
                 "state": state,
+                "membership": self._membership.get(ep, "active"),
                 "connected": node.connected,
                 "epoch": getattr(getattr(node.conn, "conn", None),
                                  "epoch", None),
@@ -534,6 +823,7 @@ class RoutedStorePool:
             "nodes": nodes,
             "replica_reads": replica,
             "hot": self.tracker.snapshot(),
+            "migration": self.migration_report(),
         }
 
     def close(self) -> None:
@@ -751,7 +1041,10 @@ class ClusterTransferEngine:
 
     def _commit_one(self, entry):
         ep, node_token, n_chunks = entry
-        node = self.pool.node(ep)
+        node = self.pool.node_or_none(ep)
+        if node is None:  # drained between begin and commit
+            _resilience.count_push_dropped("circuit_open", n_chunks)
+            return 0, None, None
         if not node.breaker.allow():
             self.pool.record_outcome(ep, "skipped")
             _resilience.count_push_dropped("circuit_open", n_chunks)
@@ -808,7 +1101,11 @@ class ClusterTransferEngine:
         fetched: List[Tuple[List[int], object]] = []
         pending = list(range(n))
         last_exc: Optional[Exception] = None
-        for depth in range(self.pool.replicas):
+        # candidate lists run one PAST the replica count while a
+        # membership transition is live (the old-ring owner rides the
+        # failover walk), so the walk is depth-bounded by the lists
+        max_depth = max((len(c) for c in candidates), default=0)
+        for depth in range(max_depth):
             if not pending:
                 break
             groups: "OrderedDict[str, List[int]]" = OrderedDict()
@@ -831,12 +1128,12 @@ class ClusterTransferEngine:
                     last_exc = err or last_exc
                     pending.extend(idxs)
         if pending:
-            if self.pool.replicas > 1:
+            if max_depth > 1:
                 self.pool.record_replica_read("miss")
             raise (last_exc if isinstance(last_exc, InfiniStoreKeyNotFound)
                    else InfiniStoreKeyNotFound(
                        f"cluster: {len(pending)}/{n} chunks unservable "
-                       f"across {self.pool.replicas} candidates "
+                       f"across {max_depth} candidates "
                        f"({last_exc!r})"))
         for idxs, stacked in fetched:
             cache = self._tpl.scatter_pages(
@@ -856,7 +1153,9 @@ class ClusterTransferEngine:
         )
 
         sub = [chunk_keys_[i] for i in idxs]
-        node = self.pool.node(ep)
+        node = self.pool.node_or_none(ep)
+        if node is None:  # drained away mid-walk: treat as failed hop
+            return None, None
         if not node.breaker.allow():
             self.pool.record_outcome(ep, "skipped")
             return None, None
@@ -913,7 +1212,8 @@ class ClusterTransferEngine:
         served: List[Optional[str]] = [None] * n
         candidates = [self.pool.candidates(k) for k in chunk_keys_]
         pending = list(range(n))
-        for depth in range(self.pool.replicas):
+        max_depth = max((len(c) for c in candidates), default=0)
+        for depth in range(max_depth):
             if not pending:
                 break
             groups: "OrderedDict[str, List[int]]" = OrderedDict()
@@ -948,7 +1248,9 @@ class ClusterTransferEngine:
         caller walks the group to the next ring successor)."""
         from .kv.hashing import layer_key
 
-        node = self.pool.node(ep)
+        node = self.pool.node_or_none(ep)
+        if node is None:  # drained away mid-walk: treat as failed hop
+            return None
         if not node.breaker.allow():
             self.pool.record_outcome(ep, "skipped")
             return None

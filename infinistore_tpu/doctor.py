@@ -336,7 +336,42 @@ def summarize_capture(cap: Dict[str, Any], top_n: int = 5) -> str:
                 + (f"  quarantined {integ.get('quarantined')}"
                    if integ.get("quarantined") else "")
             )
+            disk = cache.get("disk")
+            if disk:
+                lines.append(
+                    f"  - spill tier: {disk.get('entries', 0)} entries "
+                    f"({disk.get('bytes', 0)} B), spilled "
+                    f"{disk.get('spilled', 0)} demoted "
+                    f"{disk.get('demoted', 0)} promoted "
+                    f"{disk.get('promoted', 0)}"
+                    + (f", io-errors {disk['io_errors']}"
+                       if disk.get("io_errors") else "")
+                    + (f", corrupt-dropped {disk['verify_failures']}"
+                       if disk.get("verify_failures") else "")
+                    + (", DEGRADED (DRAM-only)"
+                       if disk.get("degraded") else "")
+                )
         lines.append("")
+
+    # -- cluster membership / live migration --
+    cl = _json_of(serve, "cluster") if serve else None
+    if cl and cl.get("enabled"):
+        transitioning = [n for n in cl.get("nodes", [])
+                        if n.get("membership", "active") != "active"]
+        mig = cl.get("migration") or {}
+        if transitioning or mig.get("state") == "running":
+            lines.append("## Cluster membership")
+            for n in transitioning:
+                lines.append(f"- {n['endpoint']}: **{n['membership']}**")
+            if mig.get("state") == "running":
+                lines.append(
+                    f"- migration {mig.get('mode')} "
+                    f"{mig.get('endpoint')}: {mig.get('copied', 0)}/"
+                    f"{mig.get('total', '?')} copied, "
+                    f"{mig.get('skipped', 0)} skipped, "
+                    f"{mig.get('errors', 0)} errors"
+                )
+            lines.append("")
     return "\n".join(lines) + "\n"
 
 

@@ -20,6 +20,7 @@ stays in Python.
 from __future__ import annotations
 
 import time
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
@@ -264,6 +265,7 @@ class _StoreStreamer:
     def __init__(self, transfer: KVTransferEngine, maxsize: int = 2,
                  durability: str = "strict"):
         import queue
+        import threading
 
         self._transfer = transfer
         self._durability = durability
@@ -275,6 +277,18 @@ class _StoreStreamer:
         self._err: Optional[BaseException] = None
         self._dropped = 0  # chunks dropped since the last flush
         self._started = False
+        # per-request flush markers: every submit is tagged with the
+        # submitting request's trace id, and ``flush(marker=...)`` waits
+        # ONLY on that request's pushes — without this, concurrent
+        # PD-handoff flush barriers join the WHOLE queue and serialize
+        # on each other's pushes.  Counts are guarded by the condition;
+        # per-marker errors are bounded (a marker's error is consumed by
+        # its own flush or aged out by the cap).
+        self._cond = threading.Condition()
+        self._pending: Dict[object, int] = {}
+        self._marker_errs: "OrderedDict[object, BaseException]" = (
+            OrderedDict()
+        )
 
     def submit(self, pages, chunk_keys_) -> None:
         if not self._started:
@@ -294,8 +308,29 @@ class _StoreStreamer:
         # the request trace around prefill work, so the worker thread can
         # attribute the push to the REQUEST that paid for it (the PD
         # handoff chain needs store pushes under one trace id end to end)
+        # — and the same id is the per-request flush marker.
+        tid = tracing.current_trace_id()
+        with self._cond:
+            self._pending[tid] = self._pending.get(tid, 0) + 1
         self._q.put((self._transfer.push_begin(pages, chunk_keys_),
-                     chunk_keys_, tracing.current_trace_id()))
+                     chunk_keys_, tid))
+
+    def _record_marker_err(self, tid, err: BaseException) -> None:
+        if tid is None or err is None:
+            return
+        with self._cond:
+            self._marker_errs[tid] = err
+            while len(self._marker_errs) > 256:
+                self._marker_errs.popitem(last=False)
+
+    def _settle(self, tid) -> None:
+        with self._cond:
+            n = self._pending.get(tid, 1) - 1
+            if n > 0:
+                self._pending[tid] = n
+            else:
+                self._pending.pop(tid, None)
+            self._cond.notify_all()
 
     def _run(self) -> None:
         from ..utils import resilience as _res
@@ -310,8 +345,11 @@ class _StoreStreamer:
                     # not permanently lost: the serving layer's idle
                     # flush clears the error and later pushes resume;
                     # skipped pages are content-addressed, so the cost is
-                    # a future miss.
+                    # a future miss.  The skipped request's own flush
+                    # barrier must see the failure too (its handoff
+                    # contract says "flushed" means durable).
                     self._dropped += 1
+                    self._record_marker_err(tid, self._err)
                     _res.count_push_dropped("parked_error")
                 elif not self._transfer.breaker.allow():
                     # open circuit: don't even touch the wire
@@ -320,6 +358,7 @@ class _StoreStreamer:
                 else:
                     self._push_one(token, keys, tid, _res)
             finally:
+                self._settle(tid)
                 self._q.task_done()
 
     def _push_one(self, token, keys, tid, _res) -> None:
@@ -361,6 +400,7 @@ class _StoreStreamer:
                     continue
                 self._err = e
                 self._dropped += 1
+                self._record_marker_err(tid, e)
                 _res.count_push_dropped("push_error")
                 import logging
 
@@ -370,22 +410,43 @@ class _StoreStreamer:
                 )
                 return
 
-    def flush(self) -> None:
+    def flush(self, marker=None) -> None:
         """Wait for every submitted push; re-raise the first push error
         (its message carries how many queued chunks were dropped with
-        it).  Clears the parked state, so pushes resume afterwards."""
-        self._q.join()
-        err, self._err = self._err, None
-        dropped, self._dropped = self._dropped, 0
+        it).  Clears the parked state, so pushes resume afterwards.
+
+        With ``marker`` (a request's trace id), wait ONLY on that
+        request's pushes and raise ONLY its error — the per-request
+        flush barrier: two concurrent PD handoffs no longer serialize on
+        each other's queue tails, and a marker flush neither consumes
+        nor clears another request's parked error (the full flush — the
+        serving layer's idle join — still does)."""
+        if marker is None:
+            self._q.join()
+            err, self._err = self._err, None
+            dropped, self._dropped = self._dropped, 0
+            if err is not None:
+                if dropped > 1:
+                    # the count covers the failed push itself plus
+                    # everything skipped behind it — operators see the
+                    # blast radius in the exception, not just the first
+                    # symptom
+                    err.args = (
+                        f"{err} [{dropped} queued store pushes dropped "
+                        f"with this error]",
+                    )
+                raise err
+            return
+        with self._cond:
+            # None-marked pushes come from multi-request prefill waves
+            # (genuinely shared work bound to no single trace) — a
+            # request's barrier must cover those too, conservatively;
+            # what it skips is only OTHER requests' tagged pushes
+            while (self._pending.get(marker, 0) > 0
+                   or self._pending.get(None, 0) > 0):
+                self._cond.wait()
+            err = self._marker_errs.pop(marker, None)
         if err is not None:
-            if dropped > 1:
-                # the count covers the failed push itself plus everything
-                # skipped behind it — operators see the blast radius in
-                # the exception, not just the first symptom
-                err.args = (
-                    f"{err} [{dropped} queued store pushes dropped "
-                    f"with this error]",
-                )
             raise err
 
 
@@ -1028,15 +1089,18 @@ class InferenceEngine:
         )
         return pin(keys)
 
-    def store_flush(self) -> None:
+    def store_flush(self, marker=None) -> None:
         """Durability barrier: wait until every queued store push has
         landed, re-raising the first push error.  A no-op without a
         store.  Under ``store_durability="relaxed"`` this is the point
         where a prefill's pages become visible to ``check_exist`` /
         ``get_match_last_index`` on other hosts — PD-disagg prefill
-        nodes call it before signaling hand-off."""
+        nodes call it before signaling hand-off.  ``marker`` (a
+        request's trace id) scopes the wait to that request's own
+        pushes, so concurrent handoff barriers never serialize on each
+        other's queues."""
         if self._streamer is not None:
-            self._streamer.flush()
+            self._streamer.flush(marker=marker)
 
     def abandon_prefill(self, pp: "PartialPrefill") -> None:
         """Cancel a partial prefill: release its pages.  No streamer join

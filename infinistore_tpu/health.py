@@ -583,6 +583,14 @@ def default_store_rules() -> List[WatchdogRule]:
                    what="pool occupancy"),
         spike_rule("reap_spike", "store.reaped", threshold=8,
                    what="reservations reaped"),
+        # spill-tier rules (series flat at 0 on DRAM-only stores, so
+        # they can never fire there): repeated disk I/O failures mean
+        # the tier is degrading to DRAM-only (docs/runbook.md), and any
+        # corrupt spill page caught at promote is worth an eye
+        spike_rule("disk_errors", "store.disk_errors", threshold=3,
+                   what="spill-tier I/O errors"),
+        spike_rule("spill_corrupt", "store.spill_verify_failures",
+                   threshold=1, what="corrupt spill pages dropped"),
     ]
 
 
@@ -705,6 +713,19 @@ def store_probes(server) -> Dict[str, Callable[[], Any]]:
         "store.scrub_pages": lambda: st.stats.scrub_pages,
         "store.scrub_corrupt": lambda: st.stats.scrub_corrupt,
         "store.faults_armed": lambda: len(server.faults.snapshot()),
+        # spill tier (0.0 constants on DRAM-only stores so the series
+        # exist and the disk watchdogs evaluate to quiet, not absent;
+        # `is None` checks — an EMPTY DiskTier is falsy via __len__ but
+        # its error counters still matter)
+        "store.disk_entries": lambda: (float(len(st.disk.index))
+                                       if st.disk is not None else 0.0),
+        "store.disk_errors": lambda: (float(st.disk.io_errors)
+                                      if st.disk is not None else 0.0),
+        "store.spill_verify_failures": lambda: (
+            float(st.disk.verify_failures)
+            if st.disk is not None else 0.0),
+        "store.demoted": lambda: float(st.stats.demoted),
+        "store.promoted": lambda: float(st.stats.promoted),
     }
 
 

@@ -1311,6 +1311,14 @@ class Connection:
         status, _ = self._request(P.OP_EVICT, P.pack_evict(min_threshold, max_threshold))
         _raise_for_status(status, "evict")
 
+    def list_keys(self, limit: int = 0) -> List[str]:
+        """Every retrievable key on the server, both tiers (wire
+        OP_LIST_KEYS; python runtimes only) — the membership migration
+        plane's enumeration primitive.  ``limit`` 0 = server-side cap."""
+        status, body = self._request(P.OP_LIST_KEYS, P.pack_i32(limit))
+        _raise_for_status(status, "list_keys")
+        return json.loads(body.decode())
+
     def register_mr(self, ptr: int, size: int) -> int:
         """Record a client buffer region for zero-copy ops.  No NIC to
         register with on a TPU-VM; kept for API parity and sanity checks
@@ -1641,6 +1649,11 @@ class InfinityConnection:
         """Drop every committed entry (wire OP_PURGE; manage-plane /purge
         is the HTTP spelling of the same op)."""
         return self._call("purge")
+
+    def list_keys(self, limit: int = 0) -> List[str]:
+        """Every retrievable key on the server, both tiers (wire
+        OP_LIST_KEYS; python runtimes only)."""
+        return self._call("list_keys", limit)
 
     def evict(self, min_threshold: float, max_threshold: float) -> None:
         """Run one eviction pass with explicit thresholds (wire OP_EVICT).

@@ -72,6 +72,12 @@ OP_TRACE_DUMP = 16
 # release read leases as soon as the client's copy verified, instead of
 # waiting out the timed lease (legacy clients keep the timed behavior)
 OP_RELEASE_DESC = 17
+# membership/migration plane: enumerate retrievable keys (both tiers) as
+# JSON.  A NEW op, so legacy peers are untouched (they never send it and
+# answer INVALID_REQ if one arrives — the python-runtime-only rule the
+# trace/stats dumps already follow).  Body: optional u32 cap (0 = server
+# cap); response body: JSON list of key strings.
+OP_LIST_KEYS = 18
 
 _OP_NAMES = {
     OP_HELLO: "HELLO",
@@ -91,6 +97,7 @@ _OP_NAMES = {
     OP_POOLS: "POOLS",
     OP_TRACE_DUMP: "TRACE_DUMP",
     OP_RELEASE_DESC: "RELEASE_DESC",
+    OP_LIST_KEYS: "LIST_KEYS",
 }
 
 

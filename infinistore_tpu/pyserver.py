@@ -16,7 +16,7 @@ import json
 import os
 import threading
 import time
-from typing import List, Optional
+from typing import List, Optional, Sequence
 
 from . import protocol as P
 from .store import Store
@@ -30,7 +30,12 @@ MAX_INLINE_BODY = 1 << 30
 # backstop so a forgotten rule can never wedge a CI run past its timeout
 _STALL_CAP_S = 120.0
 
-_FAULT_ACTIONS = ("drop_conn", "delay", "error", "stall", "corrupt")
+_FAULT_ACTIONS = ("drop_conn", "delay", "error", "stall", "corrupt",
+                  "disk_error", "disk_slow")
+# disk actions target the SPILL TIER's I/O, not a wire op: they match
+# under the pseudo-op name "DISK" (or "*") and are evaluated by the
+# DiskTier fault hook, never by the per-frame dispatch path
+_DISK_ACTIONS = ("disk_error", "disk_slow")
 
 
 def _fault_keys(op: int, body: memoryview):
@@ -125,13 +130,23 @@ class FaultInjector:
         with self._lock:
             return bool(self._rules)
 
-    def match(self, op_name: str) -> Optional[dict]:
+    def match(self, op_name: str,
+              actions: Optional[Sequence[str]] = None) -> Optional[dict]:
         """First active rule matching ``op_name``; consumes one ``after``
         skip or one ``times`` charge.  Returns a copy (the caller acts on
-        it outside the lock)."""
+        it outside the lock).  ``actions`` selects WHICH action families
+        this call site evaluates: the wire dispatch path passes None
+        (everything except the disk actions), the DiskTier fault hook
+        passes ``_DISK_ACTIONS`` — so a ``{"op": "*"}`` disk rule can
+        never fire on a wire frame, and vice versa."""
         with self._lock:
             for r in self._rules:
                 if r["op"] not in ("*", op_name) or r["times"] == 0:
+                    continue
+                if actions is None:
+                    if r["action"] in _DISK_ACTIONS:
+                        continue
+                elif r["action"] not in actions:
                     continue
                 if r["after"] > 0:
                     r["after"] -= 1
@@ -263,7 +278,50 @@ class StoreServer:
             "quarantined (key dropped, blocks deferred-freed)",
             fn=lambda: st.stats.scrub_corrupt)
         self._integrity_task = None
+        self._tier_task = None
         self.faults = FaultInjector()
+        # spill tier, server half: the DiskTier's fault hook rides the
+        # injector (actions disk_error / disk_slow under op "DISK"), a
+        # corrupt spill page found at promote counts as an integrity
+        # failure with its own cause, and the tier's occupancy/flow
+        # counters join the registry.  All conditional — a DRAM-only
+        # store's /metrics is unchanged.
+        if st.disk is not None:
+            self._c_spill_integrity = reg.counter(
+                "istpu_integrity_failures_total",
+                "KV integrity failures detected by this store, by cause "
+                "(spill = a corrupt spill page caught by its checksum at "
+                "promote; quarantined, served as a miss)",
+                labelnames=("cause",))
+            st.disk.fault = self._disk_fault
+            st.disk.corrupt_sink = (
+                lambda _key: self._c_spill_integrity.labels("spill").inc()
+            )
+            reg.gauge("istpu_store_disk_entries",
+                      "Entries resident in the spill tier",
+                      fn=lambda: float(len(st.disk.index)))
+            reg.gauge("istpu_store_disk_bytes",
+                      "Payload bytes resident in the spill tier",
+                      fn=lambda: float(st.disk.used_bytes()))
+            reg.counter("istpu_store_spills_total",
+                        "Entries spilled to disk at eviction (pressure)",
+                        fn=lambda: st.stats.spilled)
+            reg.counter("istpu_store_demotions_total",
+                        "Cold entries demoted to disk by the background "
+                        "tier worker (never on the put critical path)",
+                        fn=lambda: st.stats.demoted)
+            reg.counter("istpu_store_promotions_total",
+                        "Spilled entries promoted back to DRAM on access "
+                        "(checksum verified)",
+                        fn=lambda: st.stats.promoted)
+            reg.counter("istpu_store_disk_errors_total",
+                        "Spill-tier I/O failures (enough consecutive ones "
+                        "degrade the tier to DRAM-only for a cooldown)",
+                        fn=lambda: st.disk.io_errors)
+            reg.counter("istpu_store_spill_verify_failures_total",
+                        "Corrupt spill pages caught by checksum at promote "
+                        "and dropped (a counted miss, never served bytes)",
+                        fn=lambda: st.disk.verify_failures)
         # fleet health plane, store half: the sampler feeds the flight
         # recorder from cheap Store reads every ISTPU_HEALTH_STEP_S and
         # evaluates the store watchdogs (scrub-corrupt spike, failing
@@ -286,6 +344,23 @@ class StoreServer:
                 )
             except (ValueError, TypeError) as e:
                 raise ValueError(f"bad ISTPU_FAULTS: {e}") from e
+
+    def _disk_fault(self, kind: str) -> None:
+        """The DiskTier's injectable fault hook: evaluated on every
+        spill-tier I/O.  ``disk_error`` raises (the tier counts it and
+        degrades to DRAM-only after enough in a row); ``disk_slow``
+        sleeps the rule's delay — a dying-not-dead disk."""
+        if not self.faults.armed:
+            return
+        act = self.faults.match("DISK", actions=_DISK_ACTIONS)
+        if act is None:
+            return
+        self._c_faults.labels("DISK", act["action"]).inc()
+        Logger.warn(f"fault injected: {act['action']} on DISK {kind}")
+        if act["action"] == "disk_slow":
+            time.sleep(min(act["delay_s"], 5.0))
+            return
+        raise OSError(5, f"injected spill-tier fault ({kind})")
 
     def degraded(self) -> bool:
         """The store manage plane's /healthz degraded signal: armed fault
@@ -327,6 +402,7 @@ class StoreServer:
             self._handle_conn, host, self.config.service_port, reuse_address=True
         )
         self.start_integrity_worker()
+        self.start_tier_worker()
         self.health_sampler.start()
         Logger.info(f"pyserver listening on {host}:{self.config.service_port}")
 
@@ -388,6 +464,31 @@ class StoreServer:
 
         self._integrity_task = asyncio.get_running_loop().create_task(_loop())
 
+    def start_tier_worker(self) -> None:
+        """Launch the background spill-tier task: bounded analytics-
+        driven demotion passes (cold committed entries move to disk
+        while the pool is above the watermark — so pressure eviction
+        finds room already made, and demotion NEVER runs on the put
+        critical path) plus periodic manifest saves, so a crash loses at
+        most a couple of seconds of spill index."""
+        if self.store.disk is None or self._tier_task is not None:
+            return
+
+        async def _loop():
+            st = self.store
+            while True:
+                try:
+                    n = st.demote_step()
+                    st.disk.maybe_save(2.0)
+                    await asyncio.sleep(0.05 if n else 0.5)
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:  # noqa: BLE001 — worker must survive
+                    Logger.error(f"tier worker failed: {e!r}")
+                    await asyncio.sleep(1.0)
+
+        self._tier_task = asyncio.get_running_loop().create_task(_loop())
+
     def integrity_report(self) -> dict:
         rep = self.store.integrity_report()
         rep["worker_running"] = bool(
@@ -402,6 +503,8 @@ class StoreServer:
             self._evict_task.cancel()
         if self._integrity_task:
             self._integrity_task.cancel()
+        if self._tier_task:
+            self._tier_task.cancel()
         if self._server:
             self._server.close()
             await self._server.wait_closed()
@@ -589,6 +692,11 @@ class StoreServer:
         if op == P.OP_TRACE_DUMP:
             return P.pack_resp(
                 P.FINISH, json.dumps(self.tracer.dump()).encode()
+            )
+        if op == P.OP_LIST_KEYS:
+            limit = P.unpack_i32(body) if len(body) >= 4 else 0
+            return P.pack_resp(
+                P.FINISH, json.dumps(st.list_keys(limit)).encode()
             )
         if op == P.OP_POOLS:
             return P.pack_resp(P.FINISH, P.pack_pool_table(st.mm.pool_table()))

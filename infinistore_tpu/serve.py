@@ -868,9 +868,13 @@ class ServingServer:
         cluster: Dict[str, Any] = {}
         if cl.get("enabled"):
             cluster["ring"] = [
-                {"endpoint": n["endpoint"], "state": n["state"]}
+                {"endpoint": n["endpoint"], "state": n["state"],
+                 "membership": n.get("membership", "active")}
                 for n in cl.get("nodes", ())
             ]
+            mig = cl.get("migration")
+            if mig and mig.get("state") != "idle":
+                cluster["migration"] = mig
         if self.store_manage_endpoints:
             cluster.update(cluster_rollup(self.store_manage_endpoints))
         if cluster:
@@ -1327,6 +1331,39 @@ def _make_handler(server: ServingServer):
                     return
                 self._json(200, {"armed": armed})
                 return
+            if self.path.split("?", 1)[0] == "/debug/cluster":
+                # live membership control: join/drain one store node
+                # with background migration of its ~1/N key range while
+                # serving ({"action": "join"|"drain", "endpoint":
+                # "host:port"}).  Never fault-gated — it IS the ops
+                # plane operators use while chaos rules are armed.
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                except ValueError:
+                    self._json(400, {"error": "invalid JSON body"})
+                    return
+                pool = getattr(server.engine.transfer, "pool", None)
+                if pool is None:
+                    self._json(400, {"error": "store is not clustered "
+                                              "(no RoutedStorePool)"})
+                    return
+                action = body.get("action")
+                endpoint = body.get("endpoint") or ""
+                try:
+                    if action == "join":
+                        pool.join_node(endpoint)
+                    elif action == "drain":
+                        pool.drain_node(endpoint)
+                    else:
+                        self._json(400, {"error": "action must be "
+                                                  "join or drain"})
+                        return
+                except (ValueError, RuntimeError) as e:
+                    self._json(409, {"error": str(e)})
+                    return
+                self._json(200, server.cluster_report())
+                return
             if not self._fault_gate():
                 return
             if self.path not in ("/v1/completions", "/v1/chat/completions",
@@ -1531,10 +1568,14 @@ def _make_handler(server: ServingServer):
             if server.engine.transfer is not None:
                 try:
                     # the durability barrier of the handoff contract
-                    # (relaxed-mode pushes drain here); thread-safe —
-                    # flush() is a queue join
+                    # (relaxed-mode pushes drain here) — scoped to THIS
+                    # request's pushes by its trace id (the marker the
+                    # streamer tagged each submit with), so concurrent
+                    # handoffs never wait on each other's queue tails
                     with tracing.span("engine.store_flush"):
-                        server.engine.store_flush()
+                        server.engine.store_flush(
+                            marker=tracing.current_trace_id()
+                        )
                     flushed = True
                 except Exception as e:  # noqa: BLE001 — degrade, don't 500:
                     # the router falls back to recompute-on-decode

@@ -241,6 +241,18 @@ def _manage_handler(server_ref):
                 Logger.info("clear kvmap")
                 num = store.purge() if store else 0
                 self._json({"status": "ok", "num": num})
+            elif self.path == "/spill":
+                # graceful pre-restart drain: demote every committed,
+                # unleased entry to the spill tier and persist the
+                # manifest — a deploy that calls this hands its whole
+                # prefix cache to the next boot (docs/design.md §tiered
+                # store; python backend with a disk tier only)
+                if (store is None or getattr(store, "disk", None) is None
+                        or not hasattr(store, "demote_all")):
+                    self._json({"error": "no spill tier attached"}, 400)
+                else:
+                    Logger.info("spill: demoting all committed entries")
+                    self._json({"status": "ok", "demoted": store.demote_all()})
             elif self.path == "/faults":
                 # arm/replace the fault-injection rule set (python
                 # backend; the C runtime has no injector).  Body: a JSON

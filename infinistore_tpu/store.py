@@ -26,21 +26,33 @@ to shm clients, so committed entries carry a short *lease* after a GET_DESC
 and the evictor skips leased entries.  The reference has the same window with
 in-flight RDMA reads and relies on LRU touch alone.
 
-Second storage tier: with ``disk_tier_path`` set, LRU-evicted entries SPILL
-to a file-backed slab instead of vanishing, and any access (read, exist,
-prefix match) PROMOTES them back into DRAM — the reference design's
-"Historical KVCache in DRAM and SSD" (reference docs/source/design.rst:36).
-The tier is transparent to the wire protocol: clients only ever see pool
+Second storage tier: with ``disk_tier_path`` set, cold entries live in
+mmap'd spill files — one slab per power-of-two sizeclass — instead of
+vanishing, and any access (read, exist, prefix match) PROMOTES them back
+into DRAM — the reference design's "Historical KVCache in DRAM and SSD"
+(reference docs/source/design.rst:36).  Entries reach the tier two ways:
+the evictor SPILLS what it pops under pressure, and the background tier
+worker DEMOTES entries the age-band analytics call cold before pressure
+ever forces the choice (never on the put critical path).  Every spilled
+record carries the entry's checksum and is re-verified on promote, so a
+torn write from a crash or bit rot becomes a counted miss, never served
+bytes.  A small manifest persists the tier's index across process death:
+a restarted node boots as a WARM cache (the epoch fence already remaps
+clients), which is what turns the store from a process-lifetime artifact
+into fleet infrastructure that survives deploys.  The tier is
+transparent to the wire protocol: clients only ever see pool
 descriptors, never disk state.
 """
 
 from __future__ import annotations
 
+import json
+import mmap
 import os
 import time
 from collections import OrderedDict, deque
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from . import protocol as P
 from .mempool import MM
@@ -95,7 +107,8 @@ class Stats:
     evicted: int = 0
     bytes_in: int = 0
     bytes_out: int = 0
-    spilled: int = 0    # DRAM -> disk tier
+    spilled: int = 0    # DRAM -> disk tier at eviction (pressure)
+    demoted: int = 0    # DRAM -> disk tier by the background tier worker
     promoted: int = 0   # disk tier -> DRAM
     contig_batches: int = 0  # batch allocs served as one contiguous run
     scrub_pages: int = 0    # entries re-verified by the background scrubber
@@ -149,138 +162,434 @@ AGE_BANDS = ((1.0, "<1s"), (10.0, "<10s"), (60.0, "<1m"),
              (600.0, "<10m"), (float("inf"), ">=10m"))
 
 
+# the disk tier degrades to DRAM-only after this many CONSECUTIVE I/O
+# failures, for a cooldown — a dying disk must cost spilled entries,
+# never wedge the evict/promote paths in an error loop
+DISK_DEGRADE_AFTER = 3
+DISK_COOLDOWN_S = float(os.environ.get("ISTPU_DISK_COOLDOWN_S", "10"))
+# admission gate sample floor: the dead-on-arrival ratio only refuses
+# never-read entries once this many evictions have been attributed
+# (a handful of early DOAs must not blind the tier)
+DISK_DOA_MIN_SAMPLES = 64
+MANIFEST_NAME = "spill_manifest.json"
+_SPILL_PREFIX = "spill_"
+
+
+@dataclass
+class _SpillRec:
+    cls: int   # sizeclass (slot bytes, pow2 multiple of block_size)
+    slot: int  # slot index inside the sizeclass slab
+    size: int  # payload bytes (<= cls)
+    crc: int   # content checksum, verified on every promote
+
+
+class _Slab:
+    """One mmap'd spill file holding fixed-size slots of one sizeclass.
+
+    Uniform slots per file is the point of classing: allocation is a
+    free-list pop, never a run search, and the file grows in slot
+    batches (``ftruncate`` + ``mmap.resize``) only when the free list is
+    dry.  Existing files are reopened without truncation — the warm-
+    restart path."""
+
+    def __init__(self, path: str, slot_size: int, grow_slots: int = 16):
+        self.path = path
+        self.slot_size = slot_size
+        self._grow = grow_slots
+        exists = os.path.exists(path)
+        self._f = open(path, "r+b" if exists else "w+b")
+        self.slots = (os.path.getsize(path) // slot_size) if exists else 0
+        self._map: Optional[mmap.mmap] = None
+        if self.slots:
+            self._remap()
+        self.free: List[int] = []
+        self._next = 0  # high-water mark (warm boot resets it)
+
+    def _remap(self) -> None:
+        if self._map is not None:
+            self._map.close()
+        self._map = mmap.mmap(self._f.fileno(), self.slots * self.slot_size)
+
+    def alloc(self) -> int:
+        """A free slot, growing the file when none is.  Raises OSError
+        on a full disk (the ``ftruncate``) — the caller's admission
+        failure, never a torn record."""
+        if self.free:
+            return self.free.pop()
+        slot = self._next
+        if slot >= self.slots:
+            self._f.truncate((self.slots + max(self._grow, 1))
+                             * self.slot_size)
+            self.slots += max(self._grow, 1)
+            self._remap()
+        self._next += 1
+        return slot
+
+    def release(self, slot: int) -> None:
+        self.free.append(slot)
+
+    def write(self, slot: int, data: bytes) -> None:
+        off = slot * self.slot_size
+        self._map[off:off + len(data)] = data
+
+    def read(self, slot: int, size: int) -> bytes:
+        off = slot * self.slot_size
+        return bytes(self._map[off:off + size])
+
+    def used(self) -> int:
+        return self._next - len(self.free)
+
+    def reset(self) -> None:
+        self.free = []
+        self._next = 0
+        if self._map is not None:
+            self._map.close()
+            self._map = None
+        self._f.truncate(0)
+        self.slots = 0
+
+    def close(self) -> None:
+        if self._map is not None:
+            self._map.close()
+            self._map = None
+        self._f.close()
+
+
 class DiskTier:
-    """File-backed slab for the cold half of the cache hierarchy.
+    """The file-backed cold half of the cache hierarchy.
 
-    Fixed ``block_size`` slots in one sparse file (same allocation
-    granularity as the DRAM pools, so any DRAM entry fits exactly one
-    slot); an OrderedDict doubles as the tier's own LRU — when the slab is
-    full the oldest spilled entry is dropped for good, which is the
-    reference hierarchy's behavior at the bottom of the stack.  I/O is
-    pread/pwrite on slot offsets: no fsync (a cache tier, not a database —
-    host crash loses only re-computable KV).
-    """
+    mmap'd spill files per sizeclass (``spill_<bytes>.dat``), an
+    OrderedDict doubling as the tier's own LRU — at capacity the oldest
+    spilled entry is dropped for good, the reference hierarchy's
+    behavior at the bottom of the stack — and a small JSON manifest that
+    persists the index across process death, so a restarted node boots
+    warm.  Every record carries its content checksum and is re-verified
+    on promote: a torn write from a crash, bit rot, or an injected
+    corruption answers a counted miss, never bad KV.  No fsync anywhere
+    (a cache tier, not a database — a crash loses at most the entries
+    spilled since the last manifest save, and re-computable KV at that).
 
-    def __init__(self, path: str, capacity_bytes: int, block_size: int):
+    Failure containment: ``fault`` is the injectable disk-fault hook
+    (pyserver wires it to the ``disk_error``/``disk_slow`` FaultInjector
+    actions); after ``DISK_DEGRADE_AFTER`` consecutive I/O failures the
+    tier answers DRAM-only for a cooldown instead of paying the error on
+    every access."""
+
+    def __init__(self, path: str, capacity_bytes: int, block_size: int,
+                 alg: Optional[int] = None,
+                 clock: Callable[[], float] = time.monotonic):
         os.makedirs(path, exist_ok=True)
-        self.path = os.path.join(path, "istpu_disk_tier.dat")
-        self._f = open(self.path, "w+b")
+        self.path = path  # the tier DIRECTORY (slabs + manifest live here)
+        self.manifest_path = os.path.join(path, MANIFEST_NAME)
         self.block_size = block_size
-        self.capacity_slots = max(1, capacity_bytes // block_size)
-        # key -> (slot, size); insertion order = spill LRU (head = oldest).
-        # Entries span ceil(size/block) CONSECUTIVE slots — DRAM regions
-        # are contiguous multi-block runs, so the slab must hold them too.
-        self.index: "OrderedDict[bytes, Tuple[int, int]]" = OrderedDict()
-        self._free: List[int] = []  # sorted free slot list
-        self._next_slot = 0
-        self._bytes = 0
+        self.capacity_bytes = max(block_size, capacity_bytes)
+        self.alg = _checksum.alg_id("sum64") if alg is None else alg
+        self._clock = clock
+        # key -> record; insertion order = spill LRU (head = oldest)
+        self.index: "OrderedDict[bytes, _SpillRec]" = OrderedDict()
+        self._slabs: Dict[int, _Slab] = {}
+        self._bytes = 0       # payload bytes resident
+        self._slot_bytes = 0  # allocated slot bytes (the capacity unit)
         self.dropped = 0
+        self.io_errors = 0
+        self.verify_failures = 0
+        self.orphans_reaped = 0
+        self.warm_entries = 0
+        self.fault: Optional[Callable[[str], None]] = None
+        self.corrupt_sink: Optional[Callable[[bytes], None]] = None
+        self._consec_errors = 0
+        self._degraded_until = 0.0
+        self._dirty = False
+        self._last_save = 0.0
+        self._load_manifest()
+
+    # -- presence / accounting --
 
     def __contains__(self, key: bytes) -> bool:
-        return key in self.index
+        return key in self.index and not self.degraded()
 
     def __len__(self) -> int:
         return len(self.index)
 
-    def _slots_for(self, size: int) -> int:
-        return max(1, -(-size // self.block_size))
+    def used_bytes(self) -> int:
+        return self._bytes
 
-    def _release_run(self, slot: int, size: int) -> None:
-        import bisect
+    def degraded(self) -> bool:
+        return self._clock() < self._degraded_until
 
-        for s in range(slot, slot + self._slots_for(size)):
-            bisect.insort(self._free, s)
+    def _cls(self, size: int) -> int:
+        c = self.block_size
+        while c < size:
+            c <<= 1
+        return c
 
-    def _find_run(self, n: int) -> Optional[int]:
-        """First-fit run of ``n`` consecutive slots in the sorted free
-        list; removed from the list when found."""
-        count, start_i = 0, 0
-        prev = None
-        for i, s in enumerate(self._free):
-            if prev is not None and s == prev + 1:
-                count += 1
-            else:
-                start_i, count = i, 1
-            prev = s
-            if count == n:
-                start = self._free[start_i]
-                del self._free[start_i:start_i + n]
-                return start
-        return None
+    def _slab(self, cls: int) -> _Slab:
+        slab = self._slabs.get(cls)
+        if slab is None:
+            slab = _Slab(
+                os.path.join(self.path, f"{_SPILL_PREFIX}{cls}.dat"), cls
+            )
+            self._slabs[cls] = slab
+        return slab
 
-    def _alloc_run(self, n: int) -> Optional[int]:
-        if n > self.capacity_slots:
-            return None
-        while True:
-            start = self._find_run(n)
-            if start is not None:
-                return start
-            if self._next_slot + n <= self.capacity_slots:
-                start = self._next_slot
-                self._next_slot += n
-                return start
-            if not self.index:
-                return None
-            # slab full: the coldest spilled entries leave the hierarchy
-            # until a big-enough run frees up
-            _, (slot, size) = self.index.popitem(last=False)
-            self._bytes -= size
-            self.dropped += 1
-            self._release_run(slot, size)
+    # -- fault plumbing --
 
-    def put(self, key: bytes, data) -> bool:
-        self.pop(key)  # an old copy's run goes back to the free list
-        slot = self._alloc_run(self._slots_for(len(data)))
-        if slot is None:
+    def _io(self, kind: str) -> None:
+        if self.fault is not None:
+            self.fault(kind)  # may raise OSError or sleep (injection)
+
+    def _io_failed(self) -> None:
+        self.io_errors += 1
+        self._consec_errors += 1
+        if self._consec_errors >= DISK_DEGRADE_AFTER:
+            # mitigation: stop touching the disk for a cooldown — the
+            # hierarchy degrades to DRAM-only, requests never fail
+            self._degraded_until = self._clock() + DISK_COOLDOWN_S
+
+    def _io_ok(self) -> None:
+        self._consec_errors = 0
+
+    # -- data path --
+
+    def put(self, key: bytes, data, crc: Optional[int] = None) -> bool:
+        """Admit one entry (spill or demotion).  False = not admitted
+        (full beyond what dropping the cold tail frees, degraded, or the
+        disk failed) — the caller's eviction simply continues and the
+        entry leaves the hierarchy, exactly the DRAM-only behavior."""
+        if self.degraded():
             return False
         payload = bytes(data)
-        try:
-            n = os.pwrite(self._f.fileno(), payload, slot * self.block_size)
-        except OSError:
-            n = -1
-        if n != len(payload):
-            # disk full / IO error / short write: the entry simply doesn't
-            # spill (the caller's eviction continues; a truncated record
-            # must never sit in the index to promote back as corrupt KV)
-            self._release_run(slot, len(payload))
+        size = len(payload)
+        cls = self._cls(size)
+        if size == 0 or cls > self.capacity_bytes:
             return False
-        self.index[key] = (slot, len(payload))
-        self._bytes += len(payload)
+        self.pop(key)  # an old copy's slot goes back to the free list
+        while self._slot_bytes + cls > self.capacity_bytes and self.index:
+            self._drop_oldest()
+        if self._slot_bytes + cls > self.capacity_bytes:
+            return False
+        try:
+            self._io("write")
+            slab = self._slab(cls)
+            slot = slab.alloc()
+            slab.write(slot, payload)
+        except OSError:
+            # disk full / IO error: the entry simply doesn't spill (a
+            # truncated record must never sit in the index to promote
+            # back as corrupt KV — alloc raises BEFORE write maps it)
+            self._io_failed()
+            return False
+        self._io_ok()
+        if crc is None:
+            crc = _checksum.checksum(payload, self.alg)
+        self.index[key] = _SpillRec(cls, slot, size, crc)
+        self._bytes += size
+        self._slot_bytes += cls
+        self._dirty = True
         return True
 
     def get(self, key: bytes) -> Optional[bytes]:
+        """Read one entry back, VERIFYING its checksum.  A mismatch
+        drops the record (counted, ``corrupt_sink`` fired) and answers
+        None — the promote path's miss, which the engine serves by
+        recompute."""
         rec = self.index.get(key)
-        if rec is None:
+        if rec is None or self.degraded():
             return None
-        slot, size = rec
-        return os.pread(self._f.fileno(), size, slot * self.block_size)
+        try:
+            self._io("read")
+            data = self._slabs[rec.cls].read(rec.slot, rec.size)
+        except (OSError, KeyError):
+            self._io_failed()
+            return None
+        self._io_ok()
+        if _checksum.checksum(data, self.alg) != rec.crc:
+            # torn write across a crash, bit rot, or injected damage:
+            # quarantine the record — it must never promote
+            self.pop(key)
+            self.verify_failures += 1
+            self._dirty = True
+            if self.corrupt_sink is not None:
+                self.corrupt_sink(key)
+            return None
+        self.index.move_to_end(key)  # tier-local LRU touch
+        return data
 
     def pop(self, key: bytes) -> bool:
         """Drop an entry; True when one was present."""
         rec = self.index.pop(key, None)
         if rec is None:
             return False
-        self._bytes -= rec[1]
-        self._release_run(*rec)
+        self._bytes -= rec.size
+        self._slot_bytes -= rec.cls
+        slab = self._slabs.get(rec.cls)
+        if slab is not None:
+            slab.release(rec.slot)
+        self._dirty = True
         return True
+
+    def _drop_oldest(self) -> None:
+        key, rec = self.index.popitem(last=False)
+        self._bytes -= rec.size
+        self._slot_bytes -= rec.cls
+        slab = self._slabs.get(rec.cls)
+        if slab is not None:
+            slab.release(rec.slot)
+        self.dropped += 1
+        self._dirty = True
 
     def clear(self) -> int:
         n = len(self.index)
         self.index.clear()
-        self._free = []
-        self._next_slot = 0
+        for slab in self._slabs.values():
+            try:
+                slab.reset()
+            except OSError:
+                self._io_failed()
         self._bytes = 0
+        self._slot_bytes = 0
+        self._dirty = True
+        try:
+            self.save_manifest()  # a purge must not resurrect at boot
+        except OSError:
+            self._io_failed()
         return n
 
-    def used_bytes(self) -> int:
-        return self._bytes
+    # -- persistence (the warm-restart contract) --
+
+    def save_manifest(self) -> None:
+        """Atomically persist the index.  Entries spilled after the last
+        save are lost to a crash (re-computable cache, acceptable); a
+        torn DATA write is caught by the per-record checksum on promote,
+        and the manifest itself is tmp+rename so it is never torn."""
+        doc = {
+            "version": 1,
+            "block_size": self.block_size,
+            "alg": self.alg,
+            "slabs": {str(cls): slab.slots
+                      for cls, slab in self._slabs.items()},
+            "entries": [
+                [k.hex(), rec.cls, rec.slot, rec.size, rec.crc]
+                for k, rec in self.index.items()
+            ],
+        }
+        tmp = self.manifest_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, self.manifest_path)
+        self._dirty = False
+        self._last_save = self._clock()
+
+    def maybe_save(self, min_interval_s: float = 2.0) -> bool:
+        if not self._dirty:
+            return False
+        if self._clock() - self._last_save < min_interval_s:
+            return False
+        try:
+            self.save_manifest()
+        except OSError:
+            self._io_failed()
+            return False
+        return True
+
+    def _spill_files(self) -> List[str]:
+        try:
+            return [f for f in os.listdir(self.path)
+                    if f.startswith(_SPILL_PREFIX) and f.endswith(".dat")]
+        except OSError:
+            return []
+
+    def _reap_all_spill_files(self) -> None:
+        for f in self._spill_files():
+            try:
+                os.unlink(os.path.join(self.path, f))
+                self.orphans_reaped += 1
+            except OSError:
+                pass
+
+    def _load_manifest(self) -> None:
+        """Boot: rebuild the index from the manifest when one matches
+        this tier's geometry, reaping every spill file the manifest does
+        not vouch for (orphans from a crashed demotion, a geometry
+        change, or a different run)."""
+        try:
+            with open(self.manifest_path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            doc = None
+        if (not isinstance(doc, dict) or doc.get("version") != 1
+                or doc.get("block_size") != self.block_size
+                or doc.get("alg") != self.alg):
+            # cold boot (no/alien manifest): leftover slabs are orphans
+            self._reap_all_spill_files()
+            return
+        known = {f"{_SPILL_PREFIX}{cls}.dat" for cls in doc["slabs"]}
+        for f in self._spill_files():
+            if f not in known:
+                try:
+                    os.unlink(os.path.join(self.path, f))
+                    self.orphans_reaped += 1
+                except OSError:
+                    pass
+        used: Dict[int, set] = {}
+        for item in doc.get("entries", []):
+            try:
+                k, cls, slot, size, crc = item
+                key = bytes.fromhex(k)
+                cls, slot, size, crc = (int(cls), int(slot), int(size),
+                                        int(crc))
+            except (ValueError, TypeError):
+                continue
+            if (cls < self.block_size or cls & (cls - 1) or size > cls
+                    or slot < 0):
+                continue
+            slab_path = os.path.join(self.path, f"{_SPILL_PREFIX}{cls}.dat")
+            if not os.path.exists(slab_path):
+                continue
+            if (slot + 1) * cls > os.path.getsize(slab_path):
+                continue  # the slab lost a tail (torn truncate)
+            self.index[key] = _SpillRec(cls, slot, size, crc)
+            self._bytes += size
+            self._slot_bytes += cls
+            used.setdefault(cls, set()).add(slot)
+        for cls, slots in used.items():
+            slab = self._slab(cls)
+            top = max(slots) + 1
+            slab._next = top
+            slab.free = [s for s in range(top) if s not in slots]
+        self.warm_entries = len(self.index)
+
+    def report(self) -> dict:
+        """The spill-tier breakdown of ``/debug/cache``."""
+        return {
+            "entries": len(self.index),
+            "bytes": self._bytes,
+            "slot_bytes": self._slot_bytes,
+            "capacity_bytes": self.capacity_bytes,
+            "dropped": self.dropped,
+            "io_errors": self.io_errors,
+            "verify_failures": self.verify_failures,
+            "orphans_reaped": self.orphans_reaped,
+            "warm_entries": self.warm_entries,
+            "degraded": self.degraded(),
+            "sizeclasses": {
+                str(cls): {"slots": slab.slots, "used": slab.used()}
+                for cls, slab in sorted(self._slabs.items())
+            },
+        }
 
     def close(self) -> None:
+        """Persist and release — the spill files STAY on disk (the whole
+        point: the next boot is warm).  ``clear()`` is the deliberate
+        way to forget."""
         try:
-            self._f.close()
-        finally:
+            if self._dirty:
+                self.save_manifest()
+        except OSError:
+            pass
+        for slab in self._slabs.values():
             try:
-                os.unlink(self.path)
+                slab.close()
             except OSError:
                 pass
 
@@ -309,8 +618,10 @@ class Store:
         self._clock = time.monotonic
         self.analytics = CacheAnalytics()
         self._init_integrity(config)
-        # second tier: LRU-evicted entries spill here and promote back on
-        # access ("Historical KVCache in DRAM and SSD")
+        # second tier: cold entries spill/demote here and promote back
+        # on access ("Historical KVCache in DRAM and SSD").  Same
+        # checksum alg as the integrity plane so spill records reuse the
+        # stamped entry checksums and every promote re-verifies.
         self.disk: Optional[DiskTier] = None
         tier_path = getattr(config, "disk_tier_path", "") or ""
         if tier_path:
@@ -318,6 +629,8 @@ class Store:
                 tier_path,
                 int(getattr(config, "disk_tier_size", 64)) << 30,
                 self.mm.block_size,
+                alg=self.checksum_alg,
+                clock=self._clock,
             )
 
     def _init_integrity(self, config) -> None:
@@ -352,6 +665,23 @@ class Store:
         # measured put path (the perf-smoke floor)
         self._unstamped: deque = deque()
         self._scrub_keys: List[bytes] = []  # current scrub pass snapshot
+        # spill-tier knobs (initialized here so hand-built test stores
+        # get them too): an entry is DEMOTABLE once it has sat untouched
+        # this long AND the pool is at least this full; the DOA gate
+        # refuses disk admission for never-read entries once the
+        # eviction record says most writes here buy nothing
+        self.demote_after_s = float(
+            getattr(config, "demote_after_s", 0)
+            or os.environ.get("ISTPU_DEMOTE_AFTER_S", 0) or 20.0
+        )
+        self.demote_watermark = float(
+            getattr(config, "demote_watermark", 0)
+            or os.environ.get("ISTPU_DEMOTE_WATERMARK", 0) or 0.5
+        )
+        self.disk_doa_gate = float(
+            getattr(config, "disk_doa_gate", 0)
+            or os.environ.get("ISTPU_DISK_DOA_GATE", 0) or 0.8
+        )
 
     # ---- helpers ----
 
@@ -432,13 +762,10 @@ class Store:
                 self.analytics.on_evict(
                     now - (e.last_access or now), e.hits == 0
                 )
-                if self.disk is not None:
-                    # spill before the blocks are reused: the entry is not
-                    # leased (checked above), so the bytes are stable
-                    if self.disk.put(
-                        key, self.mm.view(e.pool_idx, e.offset, e.size)
-                    ):
-                        self.stats.spilled += 1
+                # spill before the blocks are reused: the entry is not
+                # leased (checked above), so the bytes are stable
+                if self._spill_entry(key, e):
+                    self.stats.spilled += 1
                 self._free(e)
                 evicted += 1
         self.stats.evicted += evicted
@@ -470,15 +797,118 @@ class Store:
                 continue
             del self.kv[key]
             self.analytics.on_evict(now - (e.last_access or now), e.hits == 0)
-            if self.disk is not None:
-                if self.disk.put(
-                    key, self.mm.view(e.pool_idx, e.offset, e.size)
-                ):
-                    self.stats.spilled += 1
+            if self._spill_entry(key, e):
+                self.stats.spilled += 1
             self._free(e)
             evicted += 1
         self.stats.evicted += evicted
         return evicted
+
+    # ---- spill tier: admission, demotion ----
+
+    def _disk_admit(self, e: Entry) -> bool:
+        """Disk admission gate, driven by the PR-4 eviction attribution:
+        an entry that HAS been read always earns a slot; a never-read
+        one is refused once the observed dead-on-arrival ratio says most
+        writes here buy nothing — spilling those would just move the
+        waste from DRAM to disk I/O."""
+        if e.hits > 0:
+            return True
+        a = self.analytics
+        total = a.dead_on_arrival + a.evicted_read
+        if total < DISK_DOA_MIN_SAMPLES:
+            return True  # not enough evidence to refuse anyone yet
+        return a.dead_on_arrival / total < self.disk_doa_gate
+
+    def _spill_entry(self, key: bytes, e: Entry) -> bool:
+        """Write one committed entry's bytes to the spill tier (the
+        caller frees the DRAM).  Reuses the stamped checksum when the
+        integrity worker already computed it."""
+        if self.disk is None or not self._disk_admit(e):
+            return False
+        crc = e.crc if e.crc is not None else self._checksum_entry(e)
+        return self.disk.put(
+            key, self.mm.view(e.pool_idx, e.offset, e.size), crc=crc
+        )
+
+    def demote_step(self, max_entries: int = 8,
+                    now: Optional[float] = None) -> int:
+        """One bounded pass of ANALYTICS-DRIVEN demotion: move the
+        coldest committed entries (age-band cold — untouched for
+        ``demote_after_s``) to the spill tier and free their DRAM while
+        the pool is above ``demote_watermark``, so pressure eviction
+        finds room already made.  Runs ONLY from the background tier
+        worker — never on the put critical path.  Returns entries
+        demoted."""
+        if self.disk is None or self.disk.degraded():
+            return 0
+        if now is None:
+            now = self._clock()
+        if self.mm.usage() < self.demote_watermark:
+            return 0
+        done = 0
+        for key, e in list(self.kv.items()):  # LRU head first = coldest
+            if done >= max_entries:
+                break
+            age = now - (e.last_access or e.created or now)
+            if age < self.demote_after_s:
+                break  # LRU order: everything behind is younger still
+            if e.busy or e.lease > now:
+                continue
+            if not self._disk_admit(e):
+                continue
+            if not self._spill_entry(key, e):
+                break  # tier refused (full / failing disk): stop the pass
+            del self.kv[key]
+            self._free(e)
+            self.stats.demoted += 1
+            done += 1
+        return done
+
+    def demote_all(self) -> int:
+        """Demote EVERY committed, unleased entry and persist the
+        manifest — the graceful pre-restart drain (``POST /spill``): a
+        deploy that calls this hands its full prefix cache to the next
+        boot."""
+        if self.disk is None:
+            return 0
+        now = self._clock()
+        done = 0
+        for key, e in list(self.kv.items()):
+            if e.busy or e.lease > now:
+                continue
+            crc = e.crc if e.crc is not None else self._checksum_entry(e)
+            if not self.disk.put(
+                key, self.mm.view(e.pool_idx, e.offset, e.size), crc=crc
+            ):
+                continue
+            del self.kv[key]
+            self._free(e)
+            self.stats.demoted += 1
+            done += 1
+        try:
+            self.disk.save_manifest()
+        except OSError:
+            self.disk._io_failed()
+        return done
+
+    def list_keys(self, limit: int = 0) -> List[str]:
+        """Every retrievable key, both tiers (wire OP_LIST_KEYS — the
+        migration plane's enumeration primitive).  Bounded: 0 means the
+        server-side cap."""
+        cap = limit if 0 < limit < 100_000 else 100_000
+        out: List[str] = []
+        for k in self.kv:
+            if len(out) >= cap:
+                return out
+            out.append(k.decode(errors="replace"))
+        if self.disk is not None:
+            for k in self.disk.index:
+                if len(out) >= cap:
+                    break
+                if k not in self.kv:
+                    out.append(k.decode(errors="replace"))
+        return out
 
     def _allocate(self, size: int, n: int):
         """On-demand-evict + allocate + auto-extend-retry (+ class-
@@ -540,8 +970,11 @@ class Store:
     def _promote(self, key: bytes) -> Optional[Entry]:
         """Pull a spilled entry back into a DRAM pool (the tier's read
         path): allocate (which may itself evict-and-spill colder keys),
-        copy the bytes up, commit at the MRU end.  None when the key isn't
-        on disk or DRAM truly can't fit it."""
+        copy the bytes up, commit at the MRU end.  ``disk.get`` verifies
+        the record's checksum first — a corrupt spill page is dropped
+        and counted, and this answers None (a miss the engine serves by
+        recompute), never bad KV.  Also None when the key isn't on disk
+        or DRAM truly can't fit it."""
         if self.disk is None:
             return None
         data = self.disk.get(key)
@@ -848,7 +1281,7 @@ class Store:
     # from what stats_dict() actually returns.
     STATS_GAUGES = frozenset({
         "kvmap_len", "pending", "usage", "pools", "block_size",
-        "disk_entries", "disk_bytes",
+        "disk_entries", "disk_bytes", "disk_degraded",
         "active_read_leases", "deferred_frees", "fragmentation",
         "free_bytes", "largest_free_run_bytes", "free_runs",
         "epoch", "stamp_backlog",
@@ -883,7 +1316,14 @@ class Store:
         hot = sorted(entries, key=lambda kv: kv[1].hits, reverse=True)
         cold = sorted(entries, key=lambda kv: kv[1].last_access or 0.0)
         gets = self.stats.hits + self.stats.misses
+        disk = None
+        if self.disk is not None:
+            disk = self.disk.report()
+            disk.update(spilled=self.stats.spilled,
+                        demoted=self.stats.demoted,
+                        promoted=self.stats.promoted)
         return {
+            **({"disk": disk} if disk is not None else {}),
             "entries": len(self.kv),
             "bytes": sum(e.size for _k, e in entries),
             "usage": self.mm.usage(),
@@ -928,11 +1368,17 @@ class Store:
         d.update(self.mm.frag_stats())
         if self.disk is not None:
             d.update({
-                "disk_entries": len(self.disk),
+                "disk_entries": len(self.disk.index),
                 "disk_bytes": self.disk.used_bytes(),
                 "disk_spilled": s.spilled,
+                "disk_demoted": s.demoted,
                 "disk_promoted": s.promoted,
                 "disk_dropped": self.disk.dropped,
+                "disk_io_errors": self.disk.io_errors,
+                "disk_verify_failures": self.disk.verify_failures,
+                "disk_orphans_reaped": self.disk.orphans_reaped,
+                "disk_warm_entries": self.disk.warm_entries,
+                "disk_degraded": int(self.disk.degraded()),
             })
         return d
 

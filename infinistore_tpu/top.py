@@ -446,13 +446,28 @@ class Console:
             d_ok = self.deltas.setdefault(
                 f"cl_ok:{ep}", _Delta()).update(req.get("ok"))
             state = node.get("state", "?")
+            state_s = "OPEN" if state == "open" else state
+            member = node.get("membership", "active")
+            if member != "active":  # a transition state shouts
+                state_s = member.upper()
             out.append(
                 "  {:22s} {:10s} {:>5.1f}% {:>8d} {:>6d} {:>6d}  {}".format(
-                    ep[:22], "OPEN" if state == "open" else state,
+                    ep[:22], state_s,
                     100.0 * node.get("ownership", 0.0),
                     int(req.get("ok", 0)), int(req.get("error", 0)),
                     int(req.get("skipped", 0)),
                     "-" if d_ok is None else f"+{d_ok:.0f}",
+                )
+            )
+        mig = cl.get("migration") or {}
+        if mig.get("state") == "running":
+            out.append(
+                "  migration {} {}: {}/{} copied  {} skipped  {} errors"
+                .format(
+                    mig.get("mode", "?"), mig.get("endpoint", "?"),
+                    int(mig.get("copied", 0)),
+                    int(mig.get("total", 0) or 0),
+                    int(mig.get("skipped", 0)), int(mig.get("errors", 0)),
                 )
             )
         return out
@@ -561,6 +576,35 @@ class Console:
                 )
                 + (f"   verify-fails {int(fails)}" if fails else "")
             )
+        # -- spill tier: occupancy + per-frame demote/promote flow --
+        disk = cache.get("disk")
+        if disk:
+            cap = max(1, disk.get("capacity_bytes", 1))
+            occ = disk.get("slot_bytes", disk.get("bytes", 0)) / cap
+            d_dem = self.deltas.setdefault("spill_dem", _Delta()).update(
+                float(disk.get("demoted", 0) + disk.get("spilled", 0)))
+            d_pro = self.deltas.setdefault("spill_pro", _Delta()).update(
+                float(disk.get("promoted", 0)))
+            line = (
+                "spill tier      [{}] {:6.1%}   entries {:>7}  "
+                "demote {} /frame  promote {} /frame".format(
+                    bar(occ, w), occ, int(disk.get("entries", 0)),
+                    "-" if d_dem is None else f"+{d_dem:.0f}",
+                    "-" if d_pro is None else f"+{d_pro:.0f}",
+                )
+            )
+            extras = []
+            if disk.get("warm_entries"):
+                extras.append(f"warm {int(disk['warm_entries'])}")
+            if disk.get("io_errors"):
+                extras.append(f"io-errors {int(disk['io_errors'])}")
+            if disk.get("verify_failures"):
+                extras.append(f"corrupt {int(disk['verify_failures'])}")
+            if disk.get("degraded"):
+                extras.append("DEGRADED (DRAM-only)")
+            if extras:
+                line += "   " + "  ".join(extras)
+            out.append(line)
         doa = cache.get("dead_on_arrival",
                         snap.value("istpu_cache_dead_on_arrival_total"))
         evicted = cache.get("evicted", snap.value("istpu_store_evicted_total"))

@@ -52,13 +52,19 @@ def _free_port():
 
 
 @pytest.fixture(scope="module")
-def server():
+def server(tmp_path_factory):
     port, mport = _free_port(), _free_port()
+    # the SPILL TIER is attached on purpose: every perf floor below must
+    # hold with it enabled (the acceptance bar for the tiered store —
+    # demotion is background-only and eviction never fires at these
+    # sizes, so the tier must cost the put path nothing)
+    tier_dir = str(tmp_path_factory.mktemp("perf_disk_tier"))
     proc = subprocess.Popen(
         [sys.executable, "-m", "infinistore_tpu.server",
          "--service-port", str(port), "--manage-port", str(mport),
          "--prealloc-size", "1", "--minimal-allocate-size", "16",
-         "--log-level", "warning", "--backend", "python"],
+         "--log-level", "warning", "--backend", "python",
+         "--disk-tier-path", tier_dir, "--disk-tier-size", "1"],
         env={**os.environ, "JAX_PLATFORMS": "cpu"},
     )
     deadline = time.time() + 25

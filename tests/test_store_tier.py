@@ -1,0 +1,570 @@
+"""Elastic tiered store: mmap'd per-sizeclass spill slabs, checksummed
+promotion, analytics-driven demotion, disk fault injection, and the
+warm-restart walk.
+
+Units drive ``DiskTier``/``Store`` directly (injected clocks, no
+sockets); the live half boots python store subprocesses with a spill
+tier and proves the two chaos contracts: a failing disk degrades the
+hierarchy to DRAM-only (never a failed request), and a kill -9 +
+restart on the same spill path boots a WARM cache whose persisted
+prefixes serve store hits again without recompute."""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from infinistore_tpu import protocol as P
+from infinistore_tpu.store import (
+    DISK_DEGRADE_AFTER,
+    DISK_DOA_MIN_SAMPLES,
+    DiskTier,
+    MANIFEST_NAME,
+)
+from infinistore_tpu.utils import checksum as _checksum
+
+from test_store_unit import make_store, make_tiered_store
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BLK = 16 << 10
+
+
+# ---------------------------------------------------------------------------
+# DiskTier units
+# ---------------------------------------------------------------------------
+
+
+def test_slab_per_sizeclass_files_and_roundtrip(tmp_path):
+    """Entries land in one mmap'd slab per power-of-two sizeclass and
+    read back byte-identical (checksum-verified)."""
+    t = DiskTier(str(tmp_path), 1 << 20, 4096)
+    payloads = {
+        b"a": b"x" * 4096,          # class 4096
+        b"b": b"y" * 5000,          # class 8192
+        b"c": b"z" * (12 << 10),    # class 16384
+        b"d": b"w" * 100,           # class 4096 (sub-block payload)
+    }
+    for k, v in payloads.items():
+        assert t.put(k, v)
+    for k, v in payloads.items():
+        assert t.get(k) == v
+    files = sorted(f for f in os.listdir(tmp_path) if f.endswith(".dat"))
+    assert files == ["spill_16384.dat", "spill_4096.dat", "spill_8192.dat"]
+    rep = t.report()
+    assert rep["entries"] == 4 and rep["verify_failures"] == 0
+    assert set(rep["sizeclasses"]) == {"4096", "8192", "16384"}
+    # slot reuse: pop then put the same class reuses the freed slot
+    t.pop(b"a")
+    assert t.put(b"a2", b"q" * 4096)
+    assert t.report()["sizeclasses"]["4096"]["used"] == 2
+    t.close()
+
+
+def test_capacity_drops_oldest_for_good(tmp_path):
+    """At capacity the tier drops its coldest entries — the reference
+    hierarchy's behavior at the bottom of the stack."""
+    t = DiskTier(str(tmp_path), 4 * 4096, 4096)
+    for i in range(6):
+        assert t.put(f"k{i}".encode(), bytes([i]) * 4096)
+    assert len(t) == 4 and t.dropped == 2
+    assert t.get(b"k0") is None and t.get(b"k1") is None
+    assert t.get(b"k5") == bytes([5]) * 4096
+    t.close()
+
+
+def test_manifest_warm_boot_roundtrip(tmp_path):
+    """close() persists the manifest; a fresh DiskTier on the same path
+    boots with the index intact and every payload verified."""
+    t = DiskTier(str(tmp_path), 1 << 20, 4096)
+    data = {f"warm{i}".encode(): bytes([i + 1]) * (4096 + 128 * i)
+            for i in range(8)}
+    for k, v in data.items():
+        assert t.put(k, v)
+    t.close()
+    assert os.path.exists(tmp_path / MANIFEST_NAME)
+
+    t2 = DiskTier(str(tmp_path), 1 << 20, 4096)
+    assert t2.warm_entries == 8 and len(t2) == 8
+    for k, v in data.items():
+        assert k in t2
+        assert t2.get(k) == v
+    assert t2.verify_failures == 0
+    t2.close()
+
+
+def test_orphan_spill_files_reaped_at_boot(tmp_path):
+    """Spill files the manifest does not vouch for are unlinked at boot:
+    leftovers of a crashed demotion, a geometry change, or an alien
+    run must never sit on disk forever."""
+    t = DiskTier(str(tmp_path), 1 << 20, 4096)
+    t.put(b"keep", b"k" * 4096)
+    t.close()
+    # an orphan slab (never in the manifest) and a stray tmp
+    (tmp_path / "spill_999424.dat").write_bytes(b"\0" * 4096)
+    t2 = DiskTier(str(tmp_path), 1 << 20, 4096)
+    assert t2.orphans_reaped == 1
+    assert not os.path.exists(tmp_path / "spill_999424.dat")
+    assert t2.get(b"keep") == b"k" * 4096  # the vouched slab survived
+    t2.close()
+    # geometry change (block_size): EVERYTHING is an orphan — cold boot
+    t3 = DiskTier(str(tmp_path), 1 << 20, 8192)
+    assert len(t3) == 0 and t3.orphans_reaped >= 1
+    t3.close()
+
+
+def test_corrupt_spill_page_caught_on_promote(tmp_path):
+    """A flipped byte in a slab is caught by the per-record checksum at
+    promote: the record is dropped (a counted miss), the sink fires,
+    and the entry never serves bad bytes."""
+    t = DiskTier(str(tmp_path), 1 << 20, 4096)
+    seen = []
+    t.corrupt_sink = seen.append
+    t.put(b"good", b"g" * 4096)
+    t.put(b"bad", b"b" * 4096)
+    rec = t.index[b"bad"]
+    path = os.path.join(str(tmp_path), f"spill_{rec.cls}.dat")
+    with open(path, "r+b") as f:
+        f.seek(rec.slot * rec.cls)
+        f.write(b"\xff")
+    assert t.get(b"bad") is None            # quarantined, not served
+    assert t.verify_failures == 1 and seen == [b"bad"]
+    assert b"bad" not in t                  # record gone for good
+    assert t.get(b"good") == b"g" * 4096    # neighbors unaffected
+    t.close()
+
+
+def test_disk_error_degrades_tier_to_dram_only(tmp_path):
+    """Consecutive I/O failures (the ``disk_error`` fault's shape)
+    degrade the tier for a cooldown: puts/gets answer DRAM-only
+    immediately instead of paying the error every access; the cooldown
+    ends and the tier recovers."""
+    clock = [0.0]
+    t = DiskTier(str(tmp_path), 1 << 20, 4096, clock=lambda: clock[0])
+    t.put(b"pre", b"p" * 4096)
+    boom = [True]
+
+    def fault(kind):
+        if boom[0]:
+            raise OSError(28, "injected ENOSPC")
+
+    t.fault = fault
+    for i in range(DISK_DEGRADE_AFTER):
+        assert not t.put(f"f{i}".encode(), b"x" * 4096)
+    assert t.io_errors == DISK_DEGRADE_AFTER and t.degraded()
+    # degraded: presence and reads answer DRAM-only (miss), no I/O paid
+    assert b"pre" not in t and t.get(b"pre") is None
+    assert not t.put(b"later", b"y" * 4096)
+    # cooldown over + disk healthy again: full service resumes
+    boom[0] = False
+    clock[0] += 1e6
+    assert not t.degraded()
+    assert t.get(b"pre") == b"p" * 4096
+    assert t.put(b"later", b"y" * 4096)
+    t.close()
+
+
+# ---------------------------------------------------------------------------
+# Store-level: demotion, DOA admission gate, disk-full mid-demotion
+# ---------------------------------------------------------------------------
+
+
+def _clocked_tiered_store(tmp_path):
+    s = make_tiered_store(tmp_path)
+    clock = [100.0]
+    s._clock = lambda: clock[0]
+    s.disk._clock = s._clock
+    return s, clock
+
+
+def test_demote_step_moves_cold_entries_off_dram(tmp_path):
+    """The background demotion pass: cold committed entries (age beyond
+    the band threshold, pool above the watermark) move to disk and free
+    their DRAM; young entries stay; access promotes back (verified)."""
+    s, clock = _clocked_tiered_store(tmp_path)
+    s.demote_after_s = 10.0
+    s.demote_watermark = 0.1
+    for i in range(16):
+        assert s.put_inline(f"c{i}".encode(), bytes([i + 1]) * BLK) == P.FINISH
+    clock[0] += 30.0  # everyone is cold now
+    # touch the last four: they become young again (MRU + fresh stamp)
+    for i in range(12, 16):
+        s.get_inline(f"c{i}".encode())
+    before_usage = s.mm.usage()
+    moved = 0
+    while True:
+        n = s.demote_step(max_entries=4)
+        if n == 0:
+            break
+        moved += n
+    assert moved == 12, moved                 # only the cold 12
+    assert s.stats.demoted == 12
+    assert s.mm.usage() < before_usage        # DRAM actually freed
+    for i in range(12):
+        assert s.exist(f"c{i}".encode())      # still present via disk
+        assert f"c{i}".encode() in s.disk.index
+    # promotion on access, byte-identical
+    assert bytes(s.get_inline(b"c3")) == bytes([4]) * BLK
+    assert s.stats.promoted == 1 and b"c3" not in s.disk.index
+    s.close()
+
+
+def test_demote_respects_watermark_and_age(tmp_path):
+    s, clock = _clocked_tiered_store(tmp_path)
+    s.demote_after_s = 10.0
+    s.demote_watermark = 0.9  # pool far below: nothing to make room for
+    for i in range(8):
+        s.put_inline(f"w{i}".encode(), b"x" * BLK)
+    clock[0] += 30.0
+    assert s.demote_step() == 0
+    s.demote_watermark = 0.0
+    clock[0] -= 25.0  # entries now younger than demote_after_s
+    assert s.demote_step() == 0
+    s.close()
+
+
+def test_doa_gate_refuses_never_read_entries(tmp_path):
+    """Disk admission is gated by the eviction attribution: once the
+    record says most writes are dead on arrival, never-read entries are
+    refused (spilling them just moves the waste to disk I/O) while
+    read entries still earn their slot."""
+    s, _clock = _clocked_tiered_store(tmp_path)
+    s.analytics.dead_on_arrival = DISK_DOA_MIN_SAMPLES
+    s.analytics.evicted_read = 0
+    s.put_inline(b"never-read", b"n" * BLK)
+    s.put_inline(b"was-read", b"r" * BLK)
+    s.get_inline(b"was-read")
+    assert not s._disk_admit(s.kv[b"never-read"])
+    assert s._disk_admit(s.kv[b"was-read"])
+    for e in s.kv.values():
+        e.lease = 0
+    s.evict(0.0, 0.0)
+    assert b"was-read" in s.disk.index
+    assert b"never-read" not in s.disk.index
+    # with a healthy read ratio the gate admits everyone again
+    s.analytics.evicted_read = DISK_DOA_MIN_SAMPLES * 9
+    s.put_inline(b"fresh", b"f" * BLK)
+    assert s._disk_admit(s.kv[b"fresh"])
+    s.close()
+
+
+def test_disk_full_mid_demotion_stops_pass_and_keeps_dram_copy(tmp_path):
+    """ENOSPC mid-demotion: the pass stops, the entry KEEPS its DRAM
+    copy (a failed demotion must lose nothing), the error is counted,
+    and enough failures degrade the tier."""
+    s, clock = _clocked_tiered_store(tmp_path)
+    s.demote_after_s = 1.0
+    s.demote_watermark = 0.0
+    for i in range(6):
+        s.put_inline(f"d{i}".encode(), b"x" * BLK)
+    clock[0] += 10.0
+    fails = [0]
+
+    def fault(kind):
+        if kind == "write":
+            fails[0] += 1
+            raise OSError(28, "injected ENOSPC")
+
+    s.disk.fault = fault
+    assert s.demote_step(max_entries=4) == 0
+    assert fails[0] == 1 and s.disk.io_errors == 1
+    assert len(s.kv) == 6           # nothing left DRAM
+    assert len(s.disk.index) == 0   # nothing half-written is indexed
+    # keep failing: the tier degrades and demote_step short-circuits
+    for _ in range(DISK_DEGRADE_AFTER):
+        s.demote_step(max_entries=1)
+    assert s.disk.degraded()
+    assert s.demote_step() == 0 and fails[0] <= DISK_DEGRADE_AFTER + 1
+    s.close()
+
+
+def test_demote_all_then_warm_boot_sees_everything(tmp_path):
+    """The graceful pre-restart drain: demote_all moves every committed
+    entry + saves the manifest; a rebuilt store on the same path
+    answers presence and promotes byte-identical payloads."""
+    s, _clock = _clocked_tiered_store(tmp_path)
+    data = {f"p{i}".encode(): bytes([i + 1]) * BLK for i in range(10)}
+    for k, v in data.items():
+        s.put_inline(k, v)
+    assert s.demote_all() == 10
+    assert s.kvmap_len() == 0 and len(s.disk.index) == 10
+    s.close()
+
+    s2 = make_store()
+    s2.disk = DiskTier(str(tmp_path), 64 * BLK, BLK)
+    assert s2.disk.warm_entries == 10
+    keys = sorted(data)
+    assert s2.match_last_index(keys + [b"absent"]) == len(keys) - 1
+    for k, v in data.items():
+        assert bytes(s2.get_inline(k)) == v
+    assert s2.stats.promoted == 10
+    s2.close()
+
+
+def test_list_keys_spans_both_tiers(tmp_path):
+    s, _clock = _clocked_tiered_store(tmp_path)
+    for i in range(4):
+        s.put_inline(f"dram{i}".encode(), b"a" * BLK)
+    s.put_inline(b"cold", b"c" * BLK)
+    s.demote_all()
+    for i in range(4):
+        s.put_inline(f"dram{i}".encode(), b"a" * BLK)
+    keys = set(s.list_keys())
+    assert keys == {"dram0", "dram1", "dram2", "dram3", "cold"}
+    assert s.list_keys(limit=2) and len(s.list_keys(limit=2)) == 2
+    s.close()
+
+
+def test_console_spill_row():
+    """istpu-top's spill row (per the established Console.frame fixture
+    pattern): occupancy bar, per-frame demote/promote deltas, and the
+    degraded shout."""
+    from infinistore_tpu.top import Console, Snapshot
+
+    disk = {
+        "entries": 42, "bytes": 42 << 14, "slot_bytes": 48 << 14,
+        "capacity_bytes": 96 << 14, "spilled": 30, "demoted": 12,
+        "promoted": 7, "dropped": 0, "io_errors": 0,
+        "verify_failures": 0, "orphans_reaped": 0, "warm_entries": 20,
+        "degraded": False, "sizeclasses": {"16384": {"slots": 48,
+                                                     "used": 42}},
+    }
+    cache = {"entries": 10, "hits": 5, "misses": 1, "evicted": 30,
+             "mean_reuse_s": 0.5, "disk": disk}
+    console = Console()
+    frame1 = console.frame(Snapshot(cache=cache))
+    assert "spill tier" in frame1 and "entries      42" in frame1
+    assert "warm 20" in frame1
+    # second frame: +3 demotions, +2 promotions since the last poll
+    cache2 = json.loads(json.dumps(cache))
+    cache2["disk"]["demoted"] = 15
+    cache2["disk"]["promoted"] = 9
+    frame2 = console.frame(Snapshot(cache=cache2))
+    assert "demote +3 /frame" in frame2 and "promote +2 /frame" in frame2
+    # degraded + errors shout
+    cache2["disk"]["degraded"] = True
+    cache2["disk"]["io_errors"] = 4
+    cache2["disk"]["verify_failures"] = 1
+    frame3 = console.frame(Snapshot(cache=cache2))
+    assert "DEGRADED (DRAM-only)" in frame3
+    assert "io-errors 4" in frame3 and "corrupt 1" in frame3
+
+
+# ---------------------------------------------------------------------------
+# live: disk chaos + THE warm-restart walk
+# ---------------------------------------------------------------------------
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _boot_tiered(port, mport, tier_dir, extra_env=None):
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "infinistore_tpu.server",
+         "--service-port", str(port), "--manage-port", str(mport),
+         "--prealloc-size", "1", "--minimal-allocate-size", "16",
+         "--log-level", "warning", "--backend", "python",
+         "--disk-tier-path", tier_dir, "--disk-tier-size", "1"],
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "ISTPU_DISK_COOLDOWN_S": "1", **(extra_env or {})},
+    )
+    deadline = time.time() + 30
+    for p in (port, mport):
+        while True:
+            if proc.poll() is not None:
+                pytest.fail("tiered store failed to start")
+            try:
+                socket.create_connection(("127.0.0.1", p),
+                                         timeout=0.5).close()
+                break
+            except OSError:
+                if time.time() >= deadline:
+                    proc.kill()
+                    pytest.fail(f"store port {p} did not come up")
+                time.sleep(0.1)
+    return proc
+
+
+def _mget(mport, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{mport}{path}",
+                                timeout=10) as r:
+        return json.loads(r.read())
+
+
+def _mpost(mport, path, payload):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{mport}{path}",
+        data=json.dumps(payload).encode(), method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def test_live_disk_error_chaos_degrades_to_dram_only(tmp_path):
+    """THE disk chaos contract: arm ``disk_error`` → spill/promote I/O
+    fails → the tier degrades to DRAM-only — every client op still
+    answers (a read of a lost entry is a clean KeyNotFound miss, never
+    a hang or a 500-shaped error), faults and io_errors are counted —
+    then clear + cooldown → the tier serves again."""
+    import numpy as np
+
+    import infinistore_tpu as ist
+
+    port, mport = _free_port(), _free_port()
+    proc = _boot_tiered(port, mport, str(tmp_path))
+    try:
+        cfg = ist.ClientConfig(host_addr="127.0.0.1", service_port=port,
+                               connection_type=ist.TYPE_TCP,
+                               log_level="warning", op_timeout_s=15)
+        conn = ist.InfinityConnection(cfg)
+        conn.connect()
+        n = 8
+        buf = np.random.RandomState(3).randint(
+            0, 256, size=n * BLK, dtype=np.uint8)
+        conn.register_mr(buf)
+        keys = [f"chaos-{i}" for i in range(n)]
+        conn.write_cache([(k, i * BLK) for i, k in enumerate(keys)],
+                         BLK, buf.ctypes.data)
+        # arm the fault FIRST (house rule: the failure mode exists
+        # before its mitigation is exercised), then force eviction
+        _mpost(mport, "/faults",
+               [{"op": "DISK", "action": "disk_error"}])
+        conn.evict(0.0, 0.0)
+        stats = _mget(mport, "/stats")
+        assert stats["kvmap_len"] == 0          # eviction proceeded
+        assert stats["disk_entries"] == 0       # nothing spilled
+        assert stats["disk_io_errors"] >= 1
+        assert stats["disk_degraded"] == 1      # DRAM-only now
+        # the data plane still answers: a lost entry is a CLEAN miss
+        out = np.zeros(BLK, dtype=np.uint8)
+        conn.register_mr(out)
+        with pytest.raises(ist.InfiniStoreKeyNotFound):
+            conn.read_cache([(keys[0], 0)], BLK, out.ctypes.data)
+        # and fresh writes work (DRAM tier unaffected)
+        conn.write_cache([("fresh", 0)], BLK, buf.ctypes.data)
+        conn.read_cache([("fresh", 0)], BLK, out.ctypes.data)
+        assert np.array_equal(out, buf[:BLK])
+        mtext = urllib.request.urlopen(
+            f"http://127.0.0.1:{mport}/metrics", timeout=10).read().decode()
+        assert 'istpu_store_faults_injected_total{op="DISK"' in mtext
+        assert "istpu_store_disk_errors_total" in mtext
+        # recovery: clear the fault, wait out the 1 s cooldown, evict
+        # again — the tier spills again.  A NEVER-read key: the read
+        # above left "fresh" under a GET_DESC lease the evictor skips.
+        _mpost(mport, "/faults", [])
+        time.sleep(1.2)
+        conn.write_cache([("fresh2", 0)], BLK, buf.ctypes.data)
+        conn.evict(0.0, 0.0)
+        stats = _mget(mport, "/stats")
+        assert stats["disk_degraded"] == 0
+        assert stats["disk_entries"] >= 1
+        conn.close()
+    finally:
+        proc.kill()
+        proc.wait()
+
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from infinistore_tpu.engine import InferenceEngine  # noqa: E402
+from infinistore_tpu.kv import PagedCacheConfig  # noqa: E402
+from infinistore_tpu.models import TINY, init_params, scaled  # noqa: E402
+from infinistore_tpu.utils import metrics as m  # noqa: E402
+
+CFG = scaled(TINY, dtype=jnp.float32)
+PARAMS = init_params(CFG, jax.random.PRNGKey(7))
+T = 4
+PROMPT = [11, 42, 7, 99, 5, 3, 17, 28, 64, 1, 2]
+
+
+def _pc(n_blocks=64):
+    return PagedCacheConfig(
+        n_layers=CFG.n_layers, n_kv_heads=CFG.n_kv_heads,
+        head_dim=CFG.head_dim, n_blocks=n_blocks, block_tokens=T,
+        dtype=CFG.dtype,
+    )
+
+
+def _engine(port, **kw):
+    import infinistore_tpu as ist
+
+    conn = ist.InfinityConnection(ist.ClientConfig(
+        host_addr="127.0.0.1", service_port=port,
+        connection_type=ist.TYPE_TCP, log_level="warning",
+        op_timeout_s=15,
+    ))
+    conn.connect()
+    kw.setdefault("kv_quant", None)
+    return InferenceEngine(PARAMS, CFG, _pc(), conn=conn,
+                           model_id="tier-serve", **kw)
+
+
+def _epoch_fences():
+    return m.default_registry().family_value(
+        "istpu_integrity_failures_total", where={"cause": "epoch"}) or 0.0
+
+
+def test_warm_restart_serves_persisted_prefixes_without_recompute(tmp_path):
+    """THE warm-restart chaos walk (acceptance): push a prefix → POST
+    /spill (graceful demote-all) → SIGKILL → restart on the same port
+    and spill path → the epoch fence counts on reconnect → the SAME
+    prefix serves a STORE hit (promoted off disk, checksum-verified)
+    with zero recompute — the store survived the deploy as a warm
+    cache."""
+    port, mport = _free_port(), _free_port()
+    proc = _boot_tiered(port, mport, str(tmp_path))
+    try:
+        producer = _engine(port)
+        st = producer.prefill(PROMPT)
+        producer.release(st)
+        producer.store_flush()
+        demoted = _mpost(mport, "/spill", {})
+        assert demoted["demoted"] > 0
+        stats = _mget(mport, "/stats")
+        assert stats["disk_entries"] > 0
+
+        # hard death + restart on the SAME port and spill path
+        proc.send_signal(signal.SIGKILL)
+        proc.wait()
+        proc = _boot_tiered(port, mport, str(tmp_path))
+        stats = _mget(mport, "/stats")
+        assert stats["disk_warm_entries"] > 0   # booted WARM
+        assert stats["kvmap_len"] == 0          # nothing recomputed yet
+
+        # the PRODUCER's long-lived connection reconnects across the
+        # restart: its next op finds the socket dead, reconnects, and
+        # the new HELLO's epoch differs → fence counted (the client
+        # remap the warm restart relies on).  A brand-new connection
+        # has no old epoch to fence against, which is why the fence is
+        # asserted on the survivor, not the fresh consumer.
+        before_fence = _epoch_fences()
+        assert producer.transfer._call("check_exist", "remap-probe") == 1
+        assert _epoch_fences() > before_fence, \
+            "reconnect across the restart must count an epoch fence"
+        # a FRESH engine (no local prefix cache): its prefill finds the
+        # whole persisted prefix in the store tier and LOADS it — store
+        # provenance, zero recompute of the persisted chunks
+        consumer = _engine(port)
+        st2 = consumer.prefill(PROMPT)
+        complete = (len(PROMPT) - 1) // T  # reusable whole chunks
+        assert st2.store_chunks == complete and st2.reused_chunks == complete
+        assert st2.local_chunks == 0
+        stats = _mget(mport, "/stats")
+        assert stats["disk_promoted"] > 0       # pages came OFF DISK
+        assert stats["disk_verify_failures"] == 0
+        consumer.release(st2)
+    finally:
+        proc.kill()
+        proc.wait()

@@ -255,7 +255,9 @@ def test_disk_tier_spill_and_promote(tmp_path):
     s.close()
     import os
 
-    assert not os.path.exists(s.disk.path)  # slab unlinked on close
+    # spill files + manifest PERSIST across close — the warm-restart
+    # contract (a restarted node boots with its spilled index intact)
+    assert os.path.exists(s.disk.manifest_path)
 
 
 def test_disk_tier_serves_get_desc_and_prefix_match(tmp_path):
