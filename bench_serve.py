@@ -62,12 +62,17 @@ def parse_mix(s: str):
 
 
 def parse_lanes(s: str):
-    """``priority:weight`` pairs, comma-separated — e.g. ``10:1,0:4`` =
-    1 in 5 requests rides the high-priority lane."""
+    """``lane:weight`` pairs, comma-separated — e.g. ``10:1,0:4`` = 1
+    in 5 requests rides the high-priority lane.  A lane may be an int
+    priority or a STRING tenant id (``acme:3,bulk:1``): named tenants
+    carry through as the lane label everywhere (metrics, quotas, the
+    usage ledger)."""
     lanes = []
     for part in s.split(","):
-        prio, w = part.split(":")
-        lanes.append((int(prio), float(w)))
+        lane, w = part.rsplit(":", 1)
+        lane = lane.strip()
+        lanes.append((int(lane) if lane.lstrip("-").isdigit() else lane,
+                      float(w)))
     return lanes
 
 
@@ -269,8 +274,11 @@ def main(argv=None) -> int:
                     help="weight:prompt_tokens:max_tokens triples, "
                          "comma-separated (default 1:24:8)")
     ap.add_argument("--lanes", type=parse_lanes, default=[(0, 1.0)],
-                    help="priority:weight pairs, comma-separated "
-                         "(default 0:1 — one lane)")
+                    help="lane:weight pairs, comma-separated (default "
+                         "0:1 — one lane).  Lanes are int priorities OR "
+                         "string tenant ids: '--lanes acme:3,bulk:1' "
+                         "names tenants end to end (metrics, --quota, "
+                         "the usage ledger)")
     ap.add_argument("--prefixes", type=int, default=4,
                     help="shared-prefix population size (0 disables)")
     ap.add_argument("--prefix-len", type=int, default=16)
@@ -424,6 +432,19 @@ def main(argv=None) -> int:
                 admission_dbg = payload
         except Exception:  # noqa: BLE001 — observability, not the bench
             pass
+        # the usage ledger's verdict (best-effort, same contract):
+        # per-tenant occupancy vs tokens-saved as /debug/usage joins it
+        usage_dbg = None
+        try:
+            import urllib.request
+
+            with urllib.request.urlopen(url + "/debug/usage",
+                                        timeout=5) as r:
+                payload = json.loads(r.read())
+            if payload.get("enabled"):
+                usage_dbg = payload
+        except Exception:  # noqa: BLE001 — observability, not the bench
+            pass
         disagg = None
         if args.self_disagg:
             disagg = _gather_disagg(url, fleet_workers, args)
@@ -543,6 +564,26 @@ def main(argv=None) -> int:
             record["ttft_ratio"] = disagg["ttft_ratio"]
         if disagg.get("tpot_burst_ratio") is not None:
             record["tpot_burst_ratio"] = disagg["tpot_burst_ratio"]
+    if usage_dbg is not None:
+        # usage block (docs/observability.md §Usage attribution): the
+        # per-tenant ledger at sweep end — occupancy byte·seconds, token
+        # provenance, economics — with the fleet-wide reuse ratio
+        # mirrored top-level for scripts/bench_history.py (up is good:
+        # more prompt tokens served from the store per byte held)
+        tenants = usage_dbg.get("tenants") or {}
+        tok_store = sum((t.get("tokens") or {}).get("store", 0.0)
+                       for t in tenants.values())
+        tok_all = sum(sum((t.get("tokens") or {}).values())
+                      for t in tenants.values())
+        record["usage"] = {
+            "tenants": tenants,
+            "top_occupants": usage_dbg.get("top_occupants"),
+            "top_savers": usage_dbg.get("top_savers"),
+            "doa_offenders": usage_dbg.get("doa_offenders"),
+            "nodes": usage_dbg.get("nodes"),
+        }
+        if tok_all:
+            record["usage_reuse_ratio"] = round(tok_store / tok_all, 4)
     if health is not None:
         # health-plane block (infinistore_tpu/health.py): alert
         # transitions + burn-rate peak during the run.  alerts_fired is

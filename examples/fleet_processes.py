@@ -19,6 +19,9 @@ Usage::
 
 Everything runs on localhost with the tiny random-init model and TCP
 store connections, so it works on any host (no TPU, no checkpoints).
+The SAME topology spread across real machines — which flags change,
+which don't, and the cross-host gotchas — is documented in
+``docs/fleet_multihost.md``.
 """
 
 from __future__ import annotations

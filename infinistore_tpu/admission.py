@@ -297,9 +297,14 @@ class AdmissionController:
         self.prefill_cap_tokens = (
             prefill_cap_tokens if prefill_cap_tokens is not None
             else int(_env_float("ISTPU_ADMISSION_PREFILL_TOKENS", 0)))
-        # lanes recently offered traffic (lane int -> last seen t):
-        # the shed ladder's rungs
-        self._lanes: Dict[int, float] = {}
+        # lanes recently offered traffic (lane label -> [last seen t,
+        # ordering priority]): the shed ladder's rungs.  Integer lanes
+        # (and numeric strings, normalized to int) order numerically by
+        # their own value; NAMED tenant lanes ("acme") order by the
+        # priority passed alongside (default 0) then lexicographically —
+        # so string tenants keep working end to end while integer lanes
+        # behave exactly as before.
+        self._lanes: Dict[Any, List[float]] = {}
         # decision/shed tallies (python-side mirrors of the labeled
         # counters, for /debug/admission without a registry scrape)
         self._decisions: Dict[Tuple[str, str], int] = {}
@@ -401,22 +406,46 @@ class AdmissionController:
 
     # -- the shed ladder ----------------------------------------------------
 
-    def note_lane(self, lane: int, now: Optional[float] = None) -> None:
+    @staticmethod
+    def _norm_lane(lane):
+        """One lane identity for ``0``, ``"0"`` and friends: numeric
+        labels normalize to int (numeric ordering, the pre-tenant
+        behavior); anything else stays a string tenant label."""
+        if isinstance(lane, str) and lane.lstrip("-").isdigit():
+            return int(lane)
+        return lane
+
+    @staticmethod
+    def _lane_sort_key(lane, prio: float):
+        # int lanes order by value among themselves; string tenants by
+        # (their priority, label) — ints first within equal priority so
+        # mixed fleets shed legacy numeric lanes deterministically
+        if isinstance(lane, int):
+            return (float(lane), 0, "")
+        return (float(prio), 1, str(lane))
+
+    def note_lane(self, lane, now: Optional[float] = None,
+                  priority: Optional[int] = None) -> None:
+        lane = self._norm_lane(lane)
+        if priority is None:
+            priority = lane if isinstance(lane, int) else 0
         now = self._clock() if now is None else now
         with self._lock:
-            self._lanes[int(lane)] = now
+            self._lanes[lane] = [now, float(priority)]
             if len(self._lanes) > 64:  # bound: hostile lane churn
-                for ln, t in list(self._lanes.items()):
+                for ln, (t, _p) in list(self._lanes.items()):
                     if now - t > LANE_TTL_S:
                         del self._lanes[ln]
 
-    def _known_lanes(self, now: float) -> List[int]:
+    def _known_lanes(self, now: float) -> List:
         with self._lock:
-            return sorted(ln for ln, t in self._lanes.items()
-                          if now - t <= LANE_TTL_S)
+            live = [(ln, p) for ln, (t, p) in self._lanes.items()
+                    if now - t <= LANE_TTL_S]
+        live.sort(key=lambda lp: self._lane_sort_key(*lp))
+        return [ln for ln, _p in live]
 
     def shed_lanes(self, burn_value: Optional[float] = None,
-                   now: Optional[float] = None) -> List[int]:
+                   now: Optional[float] = None) -> List:
         """The lanes currently being shed, lowest first.  Empty while
         not burning.  One lane per ``ESCALATE_BURN_STEP`` of burn
         magnitude; the highest lane is protected whenever more than one
@@ -448,15 +477,19 @@ class AdmissionController:
 
     # -- the decision point -------------------------------------------------
 
-    def check_submit(self, lane: int, tokens: int,
-                     now: Optional[float] = None) -> Decision:
+    def check_submit(self, lane, tokens: int,
+                     now: Optional[float] = None,
+                     priority: Optional[int] = None) -> Decision:
         """The submit-time verdict for one request: ``tokens`` is its
-        worst-case footprint (prompt + max_new_tokens).  Order matters:
-        the kill switch, then the tenant's own quota (a noisy tenant
-        throttles before ANY global shed), then burn-driven lane
-        shedding, then pool-pressure shedding."""
+        worst-case footprint (prompt + max_new_tokens); ``lane`` is the
+        lane/tenant label (int or string — the tenant key for quotas
+        either way), ``priority`` the ordering hint for string lanes.
+        Order matters: the kill switch, then the tenant's own quota (a
+        noisy tenant throttles before ANY global shed), then
+        burn-driven lane shedding, then pool-pressure shedding."""
         now = self._clock() if now is None else now
-        self.note_lane(lane, now)
+        lane = self._norm_lane(lane)
+        self.note_lane(lane, now, priority=priority)
         if not self.enabled:
             return self._record(lane, Decision("admit"))
         tenant = str(lane)
@@ -496,7 +529,7 @@ class AdmissionController:
         self.quota.try_charge(tenant, tokens, now)  # admitted: charge
         return self._record(lane, Decision("admit"))
 
-    def _not_protected(self, lane: int, now: float) -> bool:
+    def _not_protected(self, lane, now: float) -> bool:
         """True when ``lane`` is fair game for queue/pressure sheds:
         everything except the highest known lane (which, with a single
         lane, is also fair game — there is nothing to protect
@@ -513,7 +546,7 @@ class AdmissionController:
             return None
         return self._queue_depth() / drain
 
-    def _record(self, lane: int, d: Decision) -> Decision:
+    def _record(self, lane, d: Decision) -> Decision:
         ln = str(lane)
         with self._lock:
             key = (d.action, ln)

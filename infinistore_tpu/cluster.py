@@ -927,10 +927,23 @@ class ClusterTransferEngine:
 
     def _map_nodes(self, items, fn):
         """Run ``fn(item)`` for every item — concurrently when there is
-        more than one (the split-batch issue path)."""
+        more than one (the split-batch issue path).  The calling
+        thread's bound account is re-bound inside each worker:
+        contextvars do not propagate into the pool's executor threads,
+        and losing the binding there would strip usage attribution from
+        every multi-node push/load."""
         items = list(items)
         if len(items) <= 1:
             return [fn(it) for it in items]
+        from .usage import bind_account, current_account
+
+        acct = current_account()
+        if acct is not None:
+            inner = fn
+
+            def fn(it):  # noqa: F811 — deliberate rebind-wrapping
+                with bind_account(acct):
+                    return inner(it)
         return list(self.pool._exec.map(fn, items))
 
     def trace_srcs(self) -> list:

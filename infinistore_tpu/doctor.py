@@ -57,6 +57,8 @@ SERVE_ENDPOINTS: Tuple[Tuple[str, str, str], ...] = (
     # router), a 404 everywhere else — per-endpoint degradation keeps
     # the bundle whole either way
     ("fleet", "/debug/fleet", "debug_fleet.json"),
+    # the tenant usage ledger (per-tenant occupancy vs tokens saved)
+    ("usage", "/debug/usage", "debug_usage.json"),
 )
 STORE_ENDPOINTS: Tuple[Tuple[str, str, str], ...] = (
     ("metrics", "/metrics", "metrics.prom"),
@@ -66,6 +68,7 @@ STORE_ENDPOINTS: Tuple[Tuple[str, str, str], ...] = (
     ("integrity", "/debug/integrity", "debug_integrity.json"),
     ("health", "/debug/health", "debug_health.json"),
     ("traces", "/debug/traces", "debug_traces.json"),
+    ("usage", "/debug/usage", "debug_usage.json"),
 )
 
 
@@ -257,6 +260,48 @@ def summarize_capture(cap: Dict[str, Any], top_n: int = 5) -> str:
                 lines.append(f"- degraded-mode prefill throttle ACTIVE "
                              f"({pf.get('budget_tokens')} tok/step)")
         lines.append("")
+
+    # -- the usage ledger: who fills the cache, and is it paying off --
+    if serve:
+        usage = _json_of(serve, "usage")
+        if usage and usage.get("enabled"):
+            lines.append("## Usage / cache economics (per tenant)")
+
+            def _rank(rows, what, unit):
+                rows = rows or []
+                if not rows:
+                    lines.append(f"- {what}: none recorded")
+                    return
+                lines.append(
+                    f"- {what}: " + ", ".join(
+                        f"**{r.get('tenant')}** ({r.get('value')}{unit})"
+                        for r in rows[:3]
+                    )
+                )
+
+            _rank(usage.get("top_occupants"), "top occupants",
+                  " B·s held")
+            _rank(usage.get("top_savers"), "top savers",
+                  " tok from store")
+            _rank(usage.get("doa_offenders"), "DOA offenders",
+                  " dead-on-arrival writes")
+            for tenant, t in sorted((usage.get("tenants") or {}).items()):
+                bs = t.get("byte_seconds") or {}
+                toks = t.get("tokens") or {}
+                roi = t.get("store_tokens_per_gb_s")
+                lines.append(
+                    f"- tenant {tenant}: held "
+                    f"{bs.get('dram', 0.0):.0f} B·s dram / "
+                    f"{bs.get('disk', 0.0):.0f} B·s spill, tokens "
+                    f"store {toks.get('store', 0):.0f} / computed "
+                    f"{toks.get('computed', 0):.0f} "
+                    f"(reuse {t.get('reuse_ratio', 0.0):.1%}"
+                    + (f", {roi} store-tok/GB·s" if roi is not None
+                       else "")
+                    + f"), evictions {t.get('evictions', 0)} "
+                    f"doa {t.get('dead_on_arrival', 0)}"
+                )
+            lines.append("")
 
     # -- slowest requests, joined to their steps and traces --
     if serve:

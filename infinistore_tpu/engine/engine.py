@@ -47,6 +47,7 @@ from ..models.llama import (
     prefill_forward,
     verify_forward,
 )
+from .. import usage as _usage
 from ..utils import metrics as _metrics
 from ..utils import tracing
 from . import stepprof as _stepprof
@@ -63,6 +64,19 @@ _PREFIX_TOKENS = _metrics.default_registry().counter(
     "Admitted prompt tokens by provenance: local prefix cache, store "
     "tier, or computed",
     labelnames=("source",),
+)
+
+# the tenant-resolved twin (usage-attribution plane): same provenance
+# split with the TENANT dimension — the "tokens saved" side of the
+# per-tenant usage ledger.  A PARALLEL family (not a label on the one
+# above) so existing dashboards/tests keep their label cardinality;
+# only incremented when a request's tenant is bound (usage.bind_account)
+_PREFIX_TOKENS_TENANT = _metrics.default_registry().counter(
+    "istpu_engine_tenant_prefix_tokens_total",
+    "Admitted prompt tokens by tenant and provenance (local prefix "
+    "cache / store tier / computed) — the tokens-saved side of the "
+    "per-tenant cache-economics ledger",
+    labelnames=("tenant", "source"),
 )
 
 
@@ -310,10 +324,14 @@ class _StoreStreamer:
         # handoff chain needs store pushes under one trace id end to end)
         # — and the same id is the per-request flush marker.
         tid = tracing.current_trace_id()
+        # the submitting request's ACCOUNT rides along the same way: the
+        # worker re-binds it around push_commit, so the store's ALLOC_PUT
+        # frames bill the tenant whose prefill produced the pages
+        acct = _usage.current_account()
         with self._cond:
             self._pending[tid] = self._pending.get(tid, 0) + 1
         self._q.put((self._transfer.push_begin(pages, chunk_keys_),
-                     chunk_keys_, tid))
+                     chunk_keys_, tid, acct))
 
     def _record_marker_err(self, tid, err: BaseException) -> None:
         if tid is None or err is None:
@@ -336,7 +354,7 @@ class _StoreStreamer:
         from ..utils import resilience as _res
 
         while True:
-            token, keys, tid = self._q.get()
+            token, keys, tid, acct = self._q.get()
             try:
                 if self._err is not None:
                     # parked error: skip queued items until the next
@@ -356,7 +374,8 @@ class _StoreStreamer:
                     self._dropped += 1
                     _res.count_push_dropped("circuit_open")
                 else:
-                    self._push_one(token, keys, tid, _res)
+                    with _usage.bind_account(acct):
+                        self._push_one(token, keys, tid, _res)
             finally:
                 self._settle(tid)
                 self._q.task_done()
@@ -902,6 +921,19 @@ class InferenceEngine:
         if reused > local_chunks:
             _PREFIX_TOKENS.labels("store").inc((reused - local_chunks) * T)
         _PREFIX_TOKENS.labels("computed").inc(S_total - P)
+        tenant = _usage.current_account()
+        if tenant is not None:
+            # tenant-resolved twin: the scheduler binds each request's
+            # tenant around its prefill admission, so this attribution
+            # is per REQUEST, not per process
+            if local_chunks:
+                _PREFIX_TOKENS_TENANT.labels(tenant, "local").inc(
+                    local_chunks * T)
+            if reused > local_chunks:
+                _PREFIX_TOKENS_TENANT.labels(tenant, "store").inc(
+                    (reused - local_chunks) * T)
+            _PREFIX_TOKENS_TENANT.labels(tenant, "computed").inc(
+                S_total - P)
 
         if reused:
             prefix_kv = _read_prefix_kv(

@@ -41,6 +41,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 
+from .. import usage as _usage
 from ..ledger import MAX_STAMPS
 from ..utils import tracing
 from ..utils.metrics import MetricsRegistry, default_registry, nearest_rank
@@ -85,6 +86,11 @@ class Request:
     # admitted request is never preempted by a later high-priority one
     # (page backpressure/shedding still applies uniformly).
     priority: int = 0
+    # tenant label (usage-attribution plane): the lane label used for
+    # metrics, quotas, and the store usage ledger.  None = integer lane
+    # (the label is then str(priority)); named tenants ("acme") ride
+    # here while ``priority`` keeps carrying admission ORDER.
+    tenant: Optional[str] = None
     adapter_id: int = 0  # LoRA adapter slot (0 = base model)
     # OpenAI logprobs: collect the chosen token's logprob + the top-k
     # alternatives per generated token (0 = off); records land in lp_data
@@ -298,6 +304,7 @@ class Scheduler:
         seed: Optional[int] = None,
         logit_bias: Optional[Dict[int, float]] = None,
         priority: int = 0,
+        tenant: Optional[str] = None,
         adapter_id: int = 0,
         logprobs: int = 0,
         on_token: Optional[Callable[[List[int], bool], None]] = None,
@@ -332,7 +339,9 @@ class Scheduler:
             # (being pre-admission) is never a mid-stream cancellation.
             # Raises AdmissionShed -> the serving layer's 429.
             d = self.admission.check_submit(
-                lane=priority, tokens=len(tokens) + max_new_tokens)
+                lane=(tenant if tenant else priority),
+                tokens=len(tokens) + max_new_tokens,
+                priority=priority)
             if not d.admitted:
                 from ..admission import AdmissionShed
 
@@ -358,7 +367,7 @@ class Scheduler:
             frequency_penalty=frequency_penalty,
             repetition_penalty=repetition_penalty, seed=seed,
             logit_bias=dict(logit_bias) if logit_bias else None,
-            priority=priority, adapter_id=adapter_id,
+            priority=priority, tenant=tenant, adapter_id=adapter_id,
             logprobs=min(max(int(logprobs), 0), self.LOGPROBS_K),
             on_token=on_token, trace_id=trace_id,
         )
@@ -400,6 +409,13 @@ class Scheduler:
                 req.cancelled = True
                 return True
         return False
+
+    @staticmethod
+    def _lane_label(req: Request) -> str:
+        """The request's lane/tenant label — the one axis metrics,
+        quotas, and the usage ledger share: ``"acme"`` for named
+        tenants, ``str(priority)`` for integer lanes."""
+        return req.tenant if req.tenant else str(req.priority)
 
     @staticmethod
     def _visible_len(req: Request) -> int:
@@ -499,7 +515,8 @@ class Scheduler:
                     # bound to the REQUEST's own trace: the admission
                     # store hops (kv.lookup_prefix, kv.load_pages) are
                     # this request's cost, not the ambient engine.step's
-                    with tracing.bind(req.trace_id):
+                    with tracing.bind(req.trace_id), \
+                            _usage.bind_account(self._lane_label(req)):
                         pp = self.engine.prefill_start(
                             req.tokens + req.output,
                             adapter_id=req.adapter_id,
@@ -542,6 +559,8 @@ class Scheduler:
                 # genuinely shared).
                 with tracing.bind(
                     admit[0].trace_id if len(admit) == 1 else None
+                ), _usage.bind_account(
+                    self._lane_label(admit[0]) if len(admit) == 1 else None
                 ):
                     states = self.engine.prefill_batch(
                         [r.tokens + r.output for r in admit],
@@ -872,6 +891,7 @@ class Scheduler:
                 still.append((req, pp))  # over budget: hold this step
                 continue
             with tracing.bind(req.trace_id), \
+                    _usage.bind_account(self._lane_label(req)), \
                     tracing.span("sched.prefill_step", req=req.req_id):
                 st = self.engine.prefill_step(pp)  # ONE chunk per step each
             if pf_budget is not None:
@@ -1031,7 +1051,7 @@ class Scheduler:
                 and (not req.step_ids or req.step_ids[-1] != sid)
                 and len(req.step_ids) < _stepprof.MAX_STEP_IDS):
             req.step_ids.append(sid)
-        lane = str(req.priority)
+        lane = self._lane_label(req)
         n_out = len(req.output)
         if req.t_first:
             ttft = req.t_first - req.t_submit

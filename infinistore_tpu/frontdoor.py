@@ -544,6 +544,28 @@ class FrontDoor:
             "workers": len(self.prefill) + len(self.decode),
         }
 
+    def usage_rollup(self) -> Dict[str, Any]:
+        """The router's ``GET /debug/usage``: poll every worker's
+        joined usage ledger and fold them into ONE fleet ledger
+        (``usage.merge_usage_reports`` — store-side byte·seconds dedupe
+        by max across workers sharing manage endpoints; token counts
+        sum).  Unreachable workers degrade the rollup, never fail it."""
+        from .health import fetch_json
+        from .usage import merge_usage_reports
+
+        reports = []
+        workers = []
+        for w in self.prefill + self.decode:
+            u = fetch_json(w.url + "/debug/usage") if w.usable else None
+            workers.append({"endpoint": w.endpoint, "role": w.role,
+                            "reachable": u is not None})
+            if u:
+                reports.append(u)
+        out = merge_usage_reports(reports)
+        out["role"] = "router"
+        out["workers"] = workers
+        return out
+
     def fleet_report(self) -> Dict[str, Any]:
         """The /debug/fleet payload: one row per worker (role / state /
         inflight / adoption provenance), the per-role rollup, recent
@@ -632,6 +654,10 @@ def _make_handler(fd: FrontDoor):
                 self.wfile.write(data)
             elif path == "/debug/fleet":
                 self._json(200, fd.fleet_report())
+            elif path == "/debug/usage":
+                # the fleet usage ledger: every worker's joined
+                # /debug/usage folded into one per-tenant view
+                self._json(200, fd.usage_rollup())
             elif path == "/debug/traces":
                 from urllib.parse import parse_qs
 

@@ -108,7 +108,9 @@ def build_record(req, outcome: str,
     return {
         "req_id": req.req_id,
         "trace_id": getattr(req, "trace_id", None),
-        "lane": str(req.priority),
+        # lane label = tenant axis: the named tenant when one was given,
+        # the stringified priority otherwise (usage-ledger join key)
+        "lane": (getattr(req, "tenant", None) or str(req.priority)),
         "outcome": outcome,
         "prompt_tokens": len(req.tokens),
         "output_tokens": n_out,

@@ -177,6 +177,14 @@ def _integrity_enabled() -> bool:
     return os.environ.get("ISTPU_INTEGRITY", "verify") != "off"
 
 
+def _account_enabled() -> bool:
+    """Usage-attribution opt-out (ISTPU_ACCOUNT=0): when off, HELLO
+    never asks for the capability and no frame ever carries an account
+    blob — byte-identical to the pre-accounting wire format.  Read per
+    connection, like the trace/integrity gates."""
+    return os.environ.get("ISTPU_ACCOUNT", "1") != "0"
+
+
 def _alloc_first_enabled() -> bool:
     """Alloc-first put opt-out (ISTPU_ALLOC_FIRST=0): when off, HELLO
     never asks for the capability and ``write_cache_into`` stays on the
@@ -332,6 +340,7 @@ class _Channel:
         payload: Sequence[memoryview] = (),
         consumer: Optional[Callable] = None,
         trace_id: Optional[str] = None,
+        account: Optional[str] = None,
     ) -> _Slot:
         """Put one request on the wire without waiting (the pipelined
         banded ops overlap the next band's round-trip with this band's
@@ -341,11 +350,17 @@ class _Channel:
         ``trace_id`` (only ever passed after HELLO negotiation proved the
         server speaks trace context) prepends the ctx blob and sets
         FLAG_TRACE_CTX, so the server records its op spans under the
-        caller's trace."""
+        caller's trace.  ``account`` (same negotiation rule, via
+        HELLO_FLAG_ACCOUNT) prepends the account blob — it rides FIRST
+        on the wire when both are present — so the store's usage ledger
+        attributes this op to the tenant that paid for it."""
         flags = 0
         if trace_id is not None:
             flags = P.FLAG_TRACE_CTX
             body = P.pack_trace_ctx(trace_id) + body
+        if account is not None:
+            flags |= P.FLAG_ACCOUNT
+            body = P.pack_account(account) + body
         slot = _Slot(consumer)
         with self._send_lock:
             if self._err is not None:
@@ -411,8 +426,10 @@ class _Channel:
         payload: Sequence[memoryview] = (),
         consumer: Optional[Callable] = None,
         trace_id: Optional[str] = None,
+        account: Optional[str] = None,
     ) -> Tuple[int, object]:
-        return self.wait(self.submit(op, body, payload, consumer, trace_id))
+        return self.wait(self.submit(op, body, payload, consumer, trace_id,
+                                     account))
 
     def _read_loop(self) -> None:
         slot: Optional[_Slot] = None
@@ -512,6 +529,15 @@ class Connection:
         # or native runtime leaves alloc_first False and pushes staged.
         self.alloc_first = False
         self.reserve_ttl: Optional[float] = None
+        # usage-attribution state (negotiated at HELLO via
+        # HELLO_FLAG_ACCOUNT): when the server answers the ACCT trailer,
+        # data-plane frames carry the account label bound in the ambient
+        # usage context (usage.bind_account) — the serving layer binds
+        # each request's tenant around its store hops.  Fails closed:
+        # legacy peers leave account_ctx False and every frame stays
+        # byte-identical.
+        self.account_ctx = False
+        self.account_max = P.MAX_ACCOUNT_LABEL
         # grow-only scratch for write_cache_into's staged fallback (a
         # fragmented allocation, a non-shm transport, or no negotiation)
         self._scratch: Optional[np.ndarray] = None
@@ -536,6 +562,8 @@ class Connection:
             hello_flags |= P.HELLO_FLAG_INTEGRITY
         if _alloc_first_enabled():
             hello_flags |= P.HELLO_FLAG_ALLOC_FIRST
+        if _account_enabled():
+            hello_flags |= P.HELLO_FLAG_ACCOUNT
         t0 = time.perf_counter()
         status, body = ch0.exchange(
             P.OP_HELLO, P.pack_hello(os.getpid(), hello_flags)
@@ -564,6 +592,15 @@ class Connection:
             if ttl is not None:
                 self.alloc_first = True
                 self.reserve_ttl = ttl
+        if hello_flags & P.HELLO_FLAG_ACCOUNT:
+            # usage-attribution capability answer.  Absent (old server /
+            # native runtime / ISTPU_ACCOUNT=0 server-side) ->
+            # negotiation fails closed, no frame ever carries the blob.
+            max_label = P.unpack_hello_acct(memoryview(body))
+            if max_label is not None:
+                self.account_ctx = True
+                self.account_max = max(1, min(max_label,
+                                              P.MAX_ACCOUNT_LABEL))
         if (hello_flags & P.HELLO_FLAG_TRACE_CTX) and (
                 srv_flags & P.HELLO_FLAG_TRACE_CTX):
             # clock-skew correction: the server stamped t_server while the
@@ -593,7 +630,8 @@ class Connection:
                 # server would answer batched gets in the legacy layout
                 st, _b = ch.exchange(P.OP_HELLO, P.pack_hello(
                     os.getpid(),
-                    P.HELLO_FLAG_INTEGRITY if self.integrity else 0,
+                    (P.HELLO_FLAG_INTEGRITY if self.integrity else 0)
+                    | (P.HELLO_FLAG_ACCOUNT if self.account_ctx else 0),
                 ))
                 _raise_for_status(st, "hello")
                 ch.start_reader()
@@ -637,11 +675,23 @@ class Connection:
             return None
         return _tracing.current_trace_id()
 
+    def _account(self) -> Optional[str]:
+        """Account label to tag the next frame with: the ambient bound
+        account (usage.bind_account) when the server negotiated the
+        capability, else None (frame stays byte-identical)."""
+        if not self.account_ctx:
+            return None
+        from .usage import current_account
+
+        acct = current_account()
+        return acct[: self.account_max] if acct else None
+
     def _request(self, op: int, body: bytes, payload: Sequence[memoryview] = ()) -> Tuple[int, bytes]:
         if not self.channels:
             raise InfiniStoreException("not connected")
         return self.channels[0].request(
-            op, body, payload, trace_id=self._trace_id()
+            op, body, payload, trace_id=self._trace_id(),
+            account=self._account(),
         )
 
     # -- zero-copy batched ops (reference: rdma_write_cache/rdma_read_cache) --
@@ -882,8 +932,9 @@ class Connection:
                 _raise_for_status(status, "commit_put")
         else:
             # captured HERE: the stripe workers run off-thread, where the
-            # contextvar-bound trace is not visible
+            # contextvar-bound trace (and account) is not visible
             tid = self._trace_id()
+            acct = self._account()
 
             def _put(chunk):
                 ch_idx, sub = chunk
@@ -894,6 +945,7 @@ class Connection:
                     P.pack_put_inline_batch(sub_keys, block_size),
                     payload,
                     trace_id=tid,
+                    account=acct,
                 )
                 return st
 
@@ -941,6 +993,7 @@ class Connection:
                     self._release_descs(keys)
         else:
             tid = self._trace_id()  # stripe workers lack the contextvar
+            acct = self._account()
 
             def _get(chunk):
                 ch_idx, sub = chunk
@@ -981,6 +1034,7 @@ class Connection:
                     P.pack_get_inline_batch(sub_keys, block_size),
                     consumer=consumer,
                     trace_id=tid,
+                    account=acct,
                 )
                 return st, res, sub_keys, sub_offs
 
@@ -1039,10 +1093,11 @@ class Connection:
             return total
         ch = self.channels[0]
         tid = self._trace_id()
+        acct = self._account()
         enc = [P.encode_keys([k for k, _ in blocks]) for blocks, _, _ in bands]
         all_keys: List[bytes] = []
         slot = ch.submit(P.OP_ALLOC_PUT, P.pack_alloc_put(enc[0], bands[0][1]),
-                         trace_id=tid)
+                         trace_id=tid, account=acct)
         for i, (blocks, block_size, src) in enumerate(bands):
             with self.latency.timed("write_cache.alloc"):
                 status, body = ch.wait(slot)
@@ -1054,7 +1109,7 @@ class Connection:
             if i + 1 < len(bands):
                 slot = ch.submit(
                     P.OP_ALLOC_PUT, P.pack_alloc_put(enc[i + 1], bands[i + 1][1]),
-                    trace_id=tid,
+                    trace_id=tid, account=acct,
                 )
             descs = P.unpack_descs(memoryview(body))
             offsets = [off for _, off in blocks]
@@ -1116,6 +1171,7 @@ class Connection:
             return info
         ch = self.channels[0]
         tid = self._trace_id()
+        acct = self._account()
         enc = [P.encode_keys([k for k, _ in blocks])
                for blocks, _, _ in bands]
         t_alloc = time.perf_counter()
@@ -1125,7 +1181,7 @@ class Connection:
             # produced (this is what "alloc-first" buys)
             slots = [
                 ch.submit(P.OP_ALLOC_PUT, P.pack_alloc_put(enc[i], b[1]),
-                          trace_id=tid)
+                          trace_id=tid, account=acct)
                 for i, b in enumerate(bands)
             ]
             descs_per = []
@@ -1189,9 +1245,10 @@ class Connection:
             return total
         ch = self.channels[0]
         tid = self._trace_id()
+        acct = self._account()
         enc = [P.encode_keys([k for k, _ in b[0]]) for _, b in live]
         slot = ch.submit(P.OP_GET_DESC, P.pack_alloc_put(enc[0], live[0][1][1]),
-                         trace_id=tid)
+                         trace_id=tid, account=acct)
         for j, (i, (blocks, block_size, ptr)) in enumerate(live):
             with self.latency.timed("read_cache.desc"):
                 status, body = ch.wait(slot)
@@ -1201,7 +1258,7 @@ class Connection:
                 slot = ch.submit(
                     P.OP_GET_DESC,
                     P.pack_alloc_put(enc[j + 1], live[j + 1][1][1]),
-                    trace_id=tid,
+                    trace_id=tid, account=acct,
                 )
             if self.integrity:
                 epoch, descs_ex = P.unpack_desc_resp_ex(memoryview(body))

@@ -83,7 +83,11 @@ class LoadConfig:
     process: str = "poisson"
     seed: int = 0
     mix: Sequence[Tuple[float, int, int]] = ((1.0, 24, 8),)
-    lanes: Sequence[Tuple[int, float]] = ((0, 1.0),)
+    # lane rows are (lane, weight); a lane may be an int priority (the
+    # classic spelling) or a STRING tenant id ("acme") — named tenants
+    # ride the priority field as a string and the server maps them onto
+    # the tenant/lane label (usage ledger, quotas, per-lane SLO metrics)
+    lanes: Sequence[Tuple[Any, float]] = ((0, 1.0),)
     # shared-prefix population: tenant/system-prompt traffic shape
     n_prefixes: int = 4
     prefix_len: int = 16
@@ -130,7 +134,10 @@ def make_requests(cfg: LoadConfig) -> List[Dict[str, Any]]:
         prompt += [rng.randrange(cfg.vocab) for _ in range(need)]
         body = {
             "prompt": prompt, "max_tokens": int(mtok),
-            "temperature": 0, "priority": int(lane),
+            "temperature": 0,
+            # a string lane is a named tenant: the server maps it to the
+            # tenant/lane label; integer lanes keep the classic meaning
+            "priority": lane if isinstance(lane, str) else int(lane),
             "stream": bool(cfg.stream),
         }
         body.update(cfg.extra_body)
@@ -336,7 +343,9 @@ def summarize(results: List[Dict[str, Any]], makespan_s: float,
                 if r.get("rejected") and not r.get("ok")]
     met = [r for r in ok if meets_slo(r, slo_ttft_s, slo_tpot_s)]
     lanes: Dict[str, Dict[str, Any]] = {}
-    for lane in sorted({r["lane"] for r in results}):
+    # lanes may mix ints and named-tenant strings: sort on the string
+    # form so one population can carry both
+    for lane in sorted({r["lane"] for r in results}, key=str):
         rs = [r for r in ok if r["lane"] == lane]
         ttfts = [r["ttft_s"] for r in rs if r["ttft_s"] is not None]
         tpots = [r["tpot_s"] for r in rs if r["tpot_s"] is not None]

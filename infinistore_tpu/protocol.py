@@ -44,6 +44,13 @@ HEADER_SIZE = HEADER.size  # 16
 # HELLO negotiation proved the server understands it — an old server would
 # read the blob as body bytes.
 FLAG_TRACE_CTX = 0x0001
+# The request body is prefixed with an account blob (pack_account): the
+# client is naming the tenant/account this op should be attributed to, so
+# the store's usage ledger can meter occupancy and reads per tenant.
+# Same negotiation rule as FLAG_TRACE_CTX (HELLO_FLAG_ACCOUNT answered by
+# the ACCT trailer); when both blobs ride one frame the account blob
+# comes FIRST.
+FLAG_ACCOUNT = 0x0002
 
 # response: status i32 | body_len u32
 RESP = struct.Struct("<iI")
@@ -254,9 +261,29 @@ HELLO_ALLOC_MAGIC = 0x434F4C41  # "ALOC"
 _ALLOC_TRAILER = struct.Struct("<IId")
 HELLO_ALLOC_SIZE = _ALLOC_TRAILER.size  # 16
 
+# usage-attribution capability: the client may tag data-plane frames with
+# a short account/tenant label (FLAG_ACCOUNT + pack_account), and the
+# server meters per-account occupancy (byte·seconds), reads, and
+# evictions — the wire half of the tenant usage ledger.  Python runtimes
+# only; negotiation fails closed everywhere else, keeping legacy peers
+# byte-identical (the TRAC/EPOC/ALOC rule).
+HELLO_FLAG_ACCOUNT = 0x8
+
+# account capability trailer: marker u32 | flags u32 (reserved) |
+# max_label f64 — the longest account label the server accepts (labels
+# past it are truncated client-side).  Same 16-byte block shape as the
+# other trailers so one scanner walks all four in any order.
+HELLO_ACCT_MAGIC = 0x54434341  # "ACCT"
+_ACCT_TRAILER = struct.Struct("<IId")
+HELLO_ACCT_SIZE = _ACCT_TRAILER.size  # 16
+
+# the longest account label either side ever puts on the wire
+MAX_ACCOUNT_LABEL = 64
+
 # every capability trailer is a 16-byte {magic u32 | ...} block; unknown
 # magics end the scan (a legacy body, or bytes that aren't a trailer)
-_TRAILER_MAGICS = (HELLO_TRAILER_MAGIC, HELLO_EPOCH_MAGIC, HELLO_ALLOC_MAGIC)
+_TRAILER_MAGICS = (HELLO_TRAILER_MAGIC, HELLO_EPOCH_MAGIC,
+                   HELLO_ALLOC_MAGIC, HELLO_ACCT_MAGIC)
 
 
 def pack_alloc_trailer(reserve_ttl_s: float) -> bytes:
@@ -289,6 +316,22 @@ def unpack_hello_epoch(buf: memoryview) -> Optional[Tuple[int, int]]:
     return alg, epoch
 
 
+def pack_acct_trailer(max_label: int = MAX_ACCOUNT_LABEL) -> bytes:
+    return _ACCT_TRAILER.pack(HELLO_ACCT_MAGIC, 0, float(max_label))
+
+
+def unpack_hello_acct(buf: memoryview) -> Optional[int]:
+    """Scan a HELLO response for the ACCT trailer; returns the server's
+    max account-label length, or None when the server did not answer the
+    accounting capability (old server / native runtime / opted out) —
+    negotiation fails closed and the client never sets FLAG_ACCOUNT."""
+    off = _find_hello_trailer(buf, HELLO_ACCT_MAGIC)
+    if off is None:
+        return None
+    _m, _flags, max_label = _ACCT_TRAILER.unpack_from(buf, off)
+    return int(max_label)
+
+
 def unpack_hello_alloc(buf: memoryview) -> Optional[float]:
     """Scan a HELLO response for the ALOC trailer; returns the server's
     pending-reservation TTL in seconds, or None when the server did not
@@ -314,6 +357,21 @@ def unpack_trace_ctx(buf: memoryview) -> Tuple[str, int]:
     (n,) = _U16.unpack_from(buf, 0)
     if n > len(buf) - 2:
         raise ValueError(f"trace ctx length {n} exceeds body")
+    return bytes(buf[2 : 2 + n]).decode(errors="replace"), 2 + n
+
+
+# account blob (prepended to the body when FLAG_ACCOUNT is set in the
+# header, BEFORE any trace-context blob): label_len u16 | label utf-8
+def pack_account(label: str) -> bytes:
+    lb = label.encode()[:MAX_ACCOUNT_LABEL]
+    return _U16.pack(len(lb)) + lb
+
+
+def unpack_account(buf: memoryview) -> Tuple[str, int]:
+    """(account label, bytes consumed)."""
+    (n,) = _U16.unpack_from(buf, 0)
+    if n > len(buf) - 2 or n > 4 * MAX_ACCOUNT_LABEL:
+        raise ValueError(f"account label length {n} exceeds body")
     return bytes(buf[2 : 2 + n]).decode(errors="replace"), 2 + n
 
 

@@ -245,6 +245,12 @@ class StoreServer:
         # well-behaved clients never set the flag.
         self.tracer = tracing.Tracer()
         self.trace_ctx_enabled = os.environ.get("ISTPU_TRACE_CTX", "1") != "0"
+        # usage-attribution capability (HELLO_FLAG_ACCOUNT): clients may
+        # tag data-plane frames with an account label and the store's
+        # UsageMeter bills occupancy/reads per account.  ISTPU_ACCOUNT=0
+        # opts the server out: HELLO stops answering the capability, so
+        # well-behaved clients never set FLAG_ACCOUNT.
+        self.account_enabled = os.environ.get("ISTPU_ACCOUNT", "1") != "0"
         # cache-efficiency analytics: the store attributes every hit/miss/
         # evict (reuse distance, eviction age, dead-on-arrival); the
         # histograms live on this registry, wired in as plain observe sinks
@@ -277,6 +283,35 @@ class StoreServer:
             "Corrupt entries found by checksum re-verification and "
             "quarantined (key dropped, blocks deferred-freed)",
             fn=lambda: st.stats.scrub_corrupt)
+        # usage-attribution families, synced from the store's UsageMeter
+        # at scrape time (the meter is the single source of truth; the
+        # registry children mirror it so /metrics carries per-account
+        # series without double bookkeeping on the data path)
+        self._c_usage_bs = reg.counter(
+            "istpu_store_usage_byte_seconds_total",
+            "Byte-seconds of store occupancy per account per tier "
+            "(shared-prefix bytes split across the sharer set)",
+            labelnames=("account", "tier"))
+        self._g_usage_res = reg.gauge(
+            "istpu_store_usage_resident_bytes",
+            "Bytes currently resident per account per tier (split "
+            "shares of shared entries)",
+            labelnames=("account", "tier"))
+        self._c_usage_hits = reg.counter(
+            "istpu_store_usage_hits_total",
+            "Store reads attributed per account (reader when tagged, "
+            "owner otherwise)",
+            labelnames=("account",))
+        self._c_usage_evict = reg.counter(
+            "istpu_store_usage_evictions_total",
+            "Entries evicted per owning account",
+            labelnames=("account",))
+        self._c_usage_doa = reg.counter(
+            "istpu_store_usage_doa_total",
+            "Entries evicted never-read (dead on arrival) per owning "
+            "account — store writes that bought nothing",
+            labelnames=("account",))
+        self._usage_emitted: dict = {}
         self._integrity_task = None
         self._tier_task = None
         self.faults = FaultInjector()
@@ -322,6 +357,15 @@ class StoreServer:
                         "Corrupt spill pages caught by checksum at promote "
                         "and dropped (a counted miss, never served bytes)",
                         fn=lambda: st.disk.verify_failures)
+            # per-slab occupancy (ROADMAP 4c groundwork): fill fraction
+            # per sizeclass spill slab — the signal the future
+            # compaction pass acts on.  Synced at scrape time next to
+            # the usage families.
+            self._g_slab_fill = reg.gauge(
+                "istpu_store_spill_slab_fill",
+                "Used/allocated slot fraction per sizeclass spill slab "
+                "(low fill on a grown slab = reclaimable file space)",
+                labelnames=("sizeclass",))
         # fleet health plane, store half: the sampler feeds the flight
         # recorder from cheap Store reads every ISTPU_HEALTH_STEP_S and
         # evaluates the store watchdogs (scrub-corrupt spike, failing
@@ -385,6 +429,43 @@ class StoreServer:
         }
         return stats
 
+    def _sync_usage_metrics(self) -> None:
+        """Mirror the UsageMeter (and spill-slab fill) into the labeled
+        registry families.  Called at scrape/report time — counter
+        children advance by the delta since the last sync, so the
+        exposed series stay monotone while the meter remains the single
+        source of truth."""
+        m = self.store.usage_meter
+        with self.metrics.lock:
+            m._accrue()
+            for (a, t), v in m.byte_seconds.items():
+                key = ("bs", a, t)
+                prev = self._usage_emitted.get(key, 0.0)
+                if v > prev:
+                    self._c_usage_bs.labels(a, t).inc(v - prev)
+                    self._usage_emitted[key] = v
+            for (a, t), v in m.resident.items():
+                self._g_usage_res.labels(a, t).set(round(v, 1))
+            for counter, attr in ((self._c_usage_hits, "hits"),
+                                  (self._c_usage_evict, "evictions"),
+                                  (self._c_usage_doa, "doa")):
+                for a, v in getattr(m, attr).items():
+                    key = (attr, a)
+                    prev = self._usage_emitted.get(key, 0)
+                    if v > prev:
+                        counter.labels(a).inc(v - prev)
+                        self._usage_emitted[key] = v
+            if self.store.disk is not None:
+                for cls, slab in self.store.disk._slabs.items():
+                    fill = (slab.used() / slab.slots) if slab.slots else 0.0
+                    self._g_slab_fill.labels(str(cls)).set(round(fill, 4))
+
+    def usage_report(self) -> dict:
+        """The manage plane's ``GET /debug/usage`` payload (also syncs
+        the metric mirrors, so a scrape right after agrees)."""
+        self._sync_usage_metrics()
+        return self.store.usage_meter.report()
+
     def metrics_text(self) -> str:
         """Prometheus exposition for the manage plane's /metrics: the
         registry families (occupancy, fragmentation, leases, eviction,
@@ -392,6 +473,7 @@ class StoreServer:
         ``stats_dict`` counters under their long-standing
         ``infinistore_tpu_`` names (the /metrics.prom schema, kept so
         existing scrapes keep working)."""
+        self._sync_usage_metrics()
         lines = stats_to_prometheus(
             self.store.stats_dict(), "infinistore_tpu_", Store.STATS_GAUGES
         )
@@ -537,6 +619,19 @@ class StoreServer:
                     break
                 t_hdr = time.perf_counter()
                 body = memoryview(await reader.readexactly(body_len)) if body_len else memoryview(b"")
+                account = None
+                if flags & P.FLAG_ACCOUNT:
+                    # usage-attribution blob (always FIRST on the wire
+                    # when both blobs ride one frame); clients only set
+                    # the flag after HELLO negotiation
+                    try:
+                        account, consumed = P.unpack_account(body)
+                        body = body[consumed:]
+                    except ValueError as e:
+                        Logger.error(f"bad account blob: {e}")
+                        break
+                    if not self.account_enabled or not account:
+                        account = None
                 trace_id = None
                 if flags & P.FLAG_TRACE_CTX:
                     # the caller is propagating its trace: strip the ctx
@@ -576,7 +671,8 @@ class StoreServer:
                     if alive and not skip:
                         t0 = time.perf_counter()
                         resp = await self._dispatch(
-                            op, body, reader, writer, conn_pending, cs
+                            op, body, reader, writer, conn_pending, cs,
+                            account,
                         )
                         dt = time.perf_counter() - t0
                 if not alive:
@@ -659,6 +755,7 @@ class StoreServer:
         writer: asyncio.StreamWriter,
         conn_pending: set,
         cs: dict,
+        account: Optional[str] = None,
     ) -> bytes | None:
         st = self.store
         if op == P.OP_HELLO:
@@ -681,6 +778,12 @@ class StoreServer:
                 # epoch-fenced layouts.
                 resp += P.pack_epoch_trailer(st.checksum_alg, st.epoch)
                 cs["integrity"] = True
+            if (cflags & P.HELLO_FLAG_ACCOUNT) and self.account_enabled:
+                # usage-attribution capability answer: the max label
+                # length.  Appended only when asked (legacy HELLOs stay
+                # byte-identical); from here on this connection MAY tag
+                # frames with FLAG_ACCOUNT blobs.
+                resp += P.pack_acct_trailer()
             if cflags & P.HELLO_FLAG_ALLOC_FIRST:
                 # alloc-first capability answer: promise the reservation
                 # TTL, so the client may defer COMMIT_PUT to a background
@@ -705,12 +808,12 @@ class StoreServer:
             payload = body[consumed : consumed + vlen]
             if len(payload) != vlen:
                 return P.pack_resp(P.INVALID_REQ)
-            return P.pack_resp(st.put_inline(key, payload))
+            return P.pack_resp(st.put_inline(key, payload, account=account))
         if op == P.OP_GET_INLINE:
             keys, _ = P.unpack_keys(body)
             if not keys:
                 return P.pack_resp(P.INVALID_REQ)
-            view = st.get_inline(keys[0])
+            view = st.get_inline(keys[0], account=account)
             if view is None:
                 return P.pack_resp(P.KEY_NOT_FOUND)
             if cs["integrity"]:
@@ -720,7 +823,8 @@ class StoreServer:
         if op == P.OP_ALLOC_PUT:
             keys, block_size = P.unpack_alloc_put(body)
             with tracing.span("store.alloc", keys=len(keys)):
-                status, descs = st.alloc_put(keys, block_size)
+                status, descs = st.alloc_put(keys, block_size,
+                                             account=account)
             if status == P.FINISH:
                 conn_pending.update(keys)
             return P.pack_resp(status, P.pack_descs(descs))
@@ -733,7 +837,8 @@ class StoreServer:
         if op == P.OP_GET_DESC:
             keys, block_size = P.unpack_alloc_put(body)
             with tracing.span("store.desc_build", keys=len(keys)):
-                status, descs = st.get_desc(keys, block_size)
+                status, descs = st.get_desc(keys, block_size,
+                                            account=account)
             if cs["integrity"]:
                 if status != P.FINISH:
                     return P.pack_resp(status)
@@ -770,7 +875,7 @@ class StoreServer:
         if op == P.OP_PUT_INLINE_BATCH:
             # body carries block_size+keys; n*block_size payload follows the frame
             keys, block_size = P.unpack_alloc_put(body)
-            status, descs = st.alloc_put(keys, block_size)
+            status, descs = st.alloc_put(keys, block_size, account=account)
             if status != P.FINISH:
                 # drain the payload to keep the stream in sync
                 remaining = block_size * len(keys)
@@ -809,7 +914,7 @@ class StoreServer:
             return P.pack_resp(status, P.pack_i32(count))
         if op == P.OP_GET_INLINE_BATCH:
             keys, block_size = P.unpack_alloc_put(body)
-            status, descs = st.get_desc(keys, block_size)
+            status, descs = st.get_desc(keys, block_size, account=account)
             if status != P.FINISH:
                 return P.pack_resp(status)
             # resp body = n x size:u32 | payloads streamed straight from

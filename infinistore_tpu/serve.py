@@ -534,9 +534,25 @@ class ServingServer:
         if echo and chat:
             raise ValueError("echo is a completions-only parameter")
         prio = body.get("priority", 0)
+        tenant = body.get("tenant")
+        if isinstance(prio, str):
+            # string lane: a NAMED tenant riding the lane field (the
+            # loadgen/bench spelling `--lanes acme:3`); ordering
+            # priority defaults to 0, the explicit "tenant" field wins
+            if tenant is None:
+                tenant = prio
+            prio = 0
         if not (isinstance(prio, int) and not isinstance(prio, bool)
                 and -100 <= prio <= 100):
             raise ValueError("priority must be an integer in [-100, 100]")
+        if tenant is not None:
+            import re as _re
+
+            if not (isinstance(tenant, str) and 1 <= len(tenant) <= 64
+                    and _re.fullmatch(r"[A-Za-z0-9._\-]+", tenant)):
+                raise ValueError(
+                    "tenant must be 1-64 chars of [A-Za-z0-9._-]"
+                )
         raw_bias = body.get("logit_bias")
         logit_bias = None
         if raw_bias is not None:
@@ -645,6 +661,7 @@ class ServingServer:
             "seed": seed,
             "logit_bias": logit_bias,
             "priority": prio,
+            "tenant": tenant,
             "logprobs": lp_k,
         }
 
@@ -921,6 +938,46 @@ class ServingServer:
         if rep is None:
             return {"enabled": False}
         return rep()
+
+    def tenant_tokens(self) -> Dict[str, Dict[str, float]]:
+        """Per-tenant prompt-token provenance from the engine counter
+        (``istpu_engine_tenant_prefix_tokens_total`` — the process
+        registry, where engines register): ``{tenant: {source:
+        tokens}}`` — the "tokens saved" side of the usage ledger."""
+        out: Dict[str, Dict[str, float]] = {}
+        for labels, v in _metrics.default_registry().family_items(
+                "istpu_engine_tenant_prefix_tokens_total"):
+            tenant = labels.get("tenant")
+            src = labels.get("source")
+            if tenant is None or src is None:
+                continue
+            out.setdefault(tenant, {})[src] = (
+                out.get(tenant, {}).get(src, 0.0) + v
+            )
+        return out
+
+    def usage_debug(self) -> Dict[str, Any]:
+        """The serve plane's ``GET /debug/usage``: join every named
+        store node's ``/debug/usage`` with this engine's per-tenant
+        token provenance into one ledger (``usage.usage_report``) —
+        per-tenant byte·seconds held vs tokens served from the store,
+        i.e. "is the cache paying for itself, and for whom"."""
+        from .health import fetch_json
+        from .usage import usage_report
+
+        stores = []
+        store_nodes = []
+        for ep in self.store_manage_endpoints:
+            base = ep if ep.startswith("http") else f"http://{ep}"
+            u = fetch_json(base.rstrip("/") + "/debug/usage")
+            store_nodes.append({"endpoint": ep,
+                                "reachable": u is not None})
+            if u:
+                stores.append(u)
+        out = usage_report(stores, tenant_tokens=self.tenant_tokens())
+        out["store_nodes"] = store_nodes
+        out["role"] = self.role
+        return out
 
     def metrics_text(self) -> str:
         """Prometheus exposition: this server's registry plus the
@@ -1290,6 +1347,13 @@ def _make_handler(server: ServingServer):
                 # hot/pinned prefix tracker ({"enabled": false} when the
                 # store is a single node or absent)
                 self._json(200, server.cluster_report())
+            elif self.path.split("?", 1)[0] == "/debug/usage":
+                # the tenant usage ledger: per-tenant store occupancy
+                # (byte·seconds, both tiers, joined across the named
+                # store nodes) against per-tenant token provenance —
+                # the cache-economics view (docs/observability.md
+                # §Usage attribution)
+                self._json(200, server.usage_debug())
             elif self.path.split("?", 1)[0] == "/debug/traces":
                 # recent completed request/step traces as Chrome trace-
                 # event JSON — stitched with the attached store's server-

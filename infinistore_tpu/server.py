@@ -201,6 +201,17 @@ def _manage_handler(server_ref):
                     series = query.get("series", [None])[0]
                     limit = qint("limit", 0) or None
                     self._json(hs.snapshot(series=series, limit=limit))
+            elif path == "/debug/usage":
+                # the per-account usage ledger: byte·seconds of
+                # occupancy per tier, hits/evictions/DOA per account,
+                # sharer-split residency (python backend only — the
+                # native runtime has no meter)
+                srv = server_ref()
+                if srv is None or not hasattr(srv, "usage_report"):
+                    self._json({"error": "usage attribution requires "
+                                         "the python backend"}, 501)
+                else:
+                    self._json(srv.usage_report())
             elif path == "/faults":
                 srv = server_ref()
                 if srv is None or not hasattr(srv, "faults"):

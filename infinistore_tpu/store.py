@@ -56,6 +56,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from . import protocol as P
 from .mempool import MM
+from .usage import SHARER_CAP, UsageMeter
 from .utils import checksum as _checksum
 
 ON_DEMAND_MIN_THRESHOLD = 0.8  # reference: src/infinistore.cpp:52
@@ -96,6 +97,12 @@ class Entry:
     # release keep the timed behavior)
     crc: Optional[int] = None
     readers: int = 0
+    # usage-attribution plane (usage.py): the account that WROTE this
+    # entry (first writer owns; None = an untagged/legacy client) and
+    # the bounded set of OTHER accounts that have read it — the split
+    # the UsageMeter bills shared-prefix bytes across
+    account: Optional[str] = None
+    sharers: Optional[List[str]] = None
 
 
 @dataclass
@@ -181,6 +188,9 @@ class _SpillRec:
     slot: int  # slot index inside the sizeclass slab
     size: int  # payload bytes (<= cls)
     crc: int   # content checksum, verified on every promote
+    # owning account (usage attribution; persisted in the manifest so a
+    # warm restart keeps billing the right tenant).  None = untagged.
+    account: Optional[str] = None
 
 
 class _Slab:
@@ -297,6 +307,11 @@ class DiskTier:
         self.warm_entries = 0
         self.fault: Optional[Callable[[str], None]] = None
         self.corrupt_sink: Optional[Callable[[bytes], None]] = None
+        # usage attribution: fired on EVERY index insert/remove with
+        # (account, payload bytes, added) — the one place spill-tier
+        # residency changes, so the meter can never drift from the index
+        self.usage_sink: Optional[
+            Callable[[Optional[str], int, bool], None]] = None
         self._consec_errors = 0
         self._degraded_until = 0.0
         self._dirty = False
@@ -351,7 +366,13 @@ class DiskTier:
 
     # -- data path --
 
-    def put(self, key: bytes, data, crc: Optional[int] = None) -> bool:
+    def _usage(self, account: Optional[str], size: int,
+               added: bool) -> None:
+        if self.usage_sink is not None:
+            self.usage_sink(account, size, added)
+
+    def put(self, key: bytes, data, crc: Optional[int] = None,
+            account: Optional[str] = None) -> bool:
         """Admit one entry (spill or demotion).  False = not admitted
         (full beyond what dropping the cold tail frees, degraded, or the
         disk failed) — the caller's eviction simply continues and the
@@ -382,10 +403,11 @@ class DiskTier:
         self._io_ok()
         if crc is None:
             crc = _checksum.checksum(payload, self.alg)
-        self.index[key] = _SpillRec(cls, slot, size, crc)
+        self.index[key] = _SpillRec(cls, slot, size, crc, account=account)
         self._bytes += size
         self._slot_bytes += cls
         self._dirty = True
+        self._usage(account, size, True)
         return True
 
     def get(self, key: bytes) -> Optional[bytes]:
@@ -426,6 +448,7 @@ class DiskTier:
         if slab is not None:
             slab.release(rec.slot)
         self._dirty = True
+        self._usage(rec.account, rec.size, False)
         return True
 
     def _drop_oldest(self) -> None:
@@ -437,9 +460,12 @@ class DiskTier:
             slab.release(rec.slot)
         self.dropped += 1
         self._dirty = True
+        self._usage(rec.account, rec.size, False)
 
     def clear(self) -> int:
         n = len(self.index)
+        for rec in self.index.values():
+            self._usage(rec.account, rec.size, False)
         self.index.clear()
         for slab in self._slabs.values():
             try:
@@ -469,7 +495,8 @@ class DiskTier:
             "slabs": {str(cls): slab.slots
                       for cls, slab in self._slabs.items()},
             "entries": [
-                [k.hex(), rec.cls, rec.slot, rec.size, rec.crc]
+                [k.hex(), rec.cls, rec.slot, rec.size, rec.crc,
+                 rec.account]
                 for k, rec in self.index.items()
             ],
         }
@@ -534,11 +561,17 @@ class DiskTier:
         used: Dict[int, set] = {}
         for item in doc.get("entries", []):
             try:
-                k, cls, slot, size, crc = item
+                # pre-accounting manifests carry 5 fields; the account
+                # rides as an optional 6th (warm restarts keep billing
+                # the right tenant without a format break)
+                k, cls, slot, size, crc = item[:5]
+                account = item[5] if len(item) > 5 else None
+                if account is not None:
+                    account = str(account)
                 key = bytes.fromhex(k)
                 cls, slot, size, crc = (int(cls), int(slot), int(size),
                                         int(crc))
-            except (ValueError, TypeError):
+            except (ValueError, TypeError, IndexError):
                 continue
             if (cls < self.block_size or cls & (cls - 1) or size > cls
                     or slot < 0):
@@ -548,7 +581,8 @@ class DiskTier:
                 continue
             if (slot + 1) * cls > os.path.getsize(slab_path):
                 continue  # the slab lost a tail (torn truncate)
-            self.index[key] = _SpillRec(cls, slot, size, crc)
+            self.index[key] = _SpillRec(cls, slot, size, crc,
+                                        account=account)
             self._bytes += size
             self._slot_bytes += cls
             used.setdefault(cls, set()).add(slot)
@@ -572,8 +606,15 @@ class DiskTier:
             "orphans_reaped": self.orphans_reaped,
             "warm_entries": self.warm_entries,
             "degraded": self.degraded(),
+            # per-slab occupancy (the future compaction pass's signal):
+            # slots allocated in the file vs slots actually holding a
+            # record — fill << 1.0 on a grown slab is reclaimable space
             "sizeclasses": {
-                str(cls): {"slots": slab.slots, "used": slab.used()}
+                str(cls): {
+                    "slots": slab.slots, "used": slab.used(),
+                    "fill": (round(slab.used() / slab.slots, 4)
+                             if slab.slots else 0.0),
+                }
                 for cls, slab in sorted(self._slabs.items())
             },
         }
@@ -632,6 +673,12 @@ class Store:
                 alg=self.checksum_alg,
                 clock=self._clock,
             )
+            # seed the usage meter with the warm-boot residency BEFORE
+            # wiring the sink (the manifest load ran inside DiskTier's
+            # constructor, where no sink existed yet)
+            for rec in self.disk.index.values():
+                self.usage_meter.add([rec.account], rec.size, "disk")
+            self.disk.usage_sink = self._disk_usage
 
     def _init_integrity(self, config) -> None:
         """Integrity-plane state (also called by tests that hand-build
@@ -682,6 +729,29 @@ class Store:
             getattr(config, "disk_doa_gate", 0)
             or os.environ.get("ISTPU_DISK_DOA_GATE", 0) or 0.8
         )
+        # per-account usage ledger (usage.py): byte·seconds of occupancy
+        # per tier, hits/evictions/DOA per account, shared-prefix bytes
+        # split across sharer sets.  Initialized here so hand-built test
+        # stores get it too; reads the store's clock INDIRECTLY so tests
+        # that swap ``_clock`` after construction keep driving it.
+        self.usage_meter = UsageMeter(
+            clock=lambda: getattr(self, "_clock", time.monotonic)()
+        )
+
+    def _disk_usage(self, account: Optional[str], size: int,
+                    added: bool) -> None:
+        """The DiskTier's usage sink: every spill-index insert/remove
+        moves residency on the meter's disk tier."""
+        if added:
+            self.usage_meter.add([account], size, "disk")
+        else:
+            self.usage_meter.sub([account], size, "disk")
+
+    @staticmethod
+    def _entry_accounts(e: Entry) -> List[Optional[str]]:
+        """The accounts an entry's DRAM bytes are split across: the
+        owner plus every recorded sharer."""
+        return [e.account] + (e.sharers or [])
 
     # ---- helpers ----
 
@@ -762,6 +832,10 @@ class Store:
                 self.analytics.on_evict(
                     now - (e.last_access or now), e.hits == 0
                 )
+                self.usage_meter.on_evict(
+                    self._entry_accounts(e), e.account, e.size,
+                    never_read=e.hits == 0,
+                )
                 # spill before the blocks are reused: the entry is not
                 # leased (checked above), so the bytes are stable
                 if self._spill_entry(key, e):
@@ -797,6 +871,10 @@ class Store:
                 continue
             del self.kv[key]
             self.analytics.on_evict(now - (e.last_access or now), e.hits == 0)
+            self.usage_meter.on_evict(
+                self._entry_accounts(e), e.account, e.size,
+                never_read=e.hits == 0,
+            )
             if self._spill_entry(key, e):
                 self.stats.spilled += 1
             self._free(e)
@@ -828,7 +906,8 @@ class Store:
             return False
         crc = e.crc if e.crc is not None else self._checksum_entry(e)
         return self.disk.put(
-            key, self.mm.view(e.pool_idx, e.offset, e.size), crc=crc
+            key, self.mm.view(e.pool_idx, e.offset, e.size), crc=crc,
+            account=e.account,
         )
 
     def demote_step(self, max_entries: int = 8,
@@ -860,6 +939,7 @@ class Store:
             if not self._spill_entry(key, e):
                 break  # tier refused (full / failing disk): stop the pass
             del self.kv[key]
+            self.usage_meter.sub(self._entry_accounts(e), e.size, "dram")
             self._free(e)
             self.stats.demoted += 1
             done += 1
@@ -879,10 +959,12 @@ class Store:
                 continue
             crc = e.crc if e.crc is not None else self._checksum_entry(e)
             if not self.disk.put(
-                key, self.mm.view(e.pool_idx, e.offset, e.size), crc=crc
+                key, self.mm.view(e.pool_idx, e.offset, e.size), crc=crc,
+                account=e.account,
             ):
                 continue
             del self.kv[key]
+            self.usage_meter.sub(self._entry_accounts(e), e.size, "dram")
             self._free(e)
             self.stats.demoted += 1
             done += 1
@@ -941,19 +1023,22 @@ class Store:
 
     # ---- ops ----
 
-    def put_inline(self, key: bytes, data) -> int:
+    def put_inline(self, key: bytes, data,
+                   account: Optional[str] = None) -> int:
         size = len(data)
         regions = self._allocate(size, 1)
         if regions is None:
             return P.OUT_OF_MEMORY
         pool_idx, offset = regions[0]
         self.mm.view(pool_idx, offset, size)[:] = data
-        self._insert_committed(key, Entry(pool_idx, offset, size))
+        self._insert_committed(key, Entry(pool_idx, offset, size,
+                                          account=account))
         self.stats.puts += 1
         self.stats.bytes_in += size
         return P.FINISH
 
-    def alloc_inline_dst(self, key: bytes, size: int) -> Optional[Entry]:
+    def alloc_inline_dst(self, key: bytes, size: int,
+                         account: Optional[str] = None) -> Optional[Entry]:
         """Allocate a region the server will stream an inline payload into."""
         regions = self._allocate(size, 1)
         if regions is None:
@@ -963,7 +1048,8 @@ class Store:
         # pending (no read can lease an uncommitted key, so the field is
         # otherwise idle until commit resets it)
         e = Entry(pool_idx, offset, size,
-                  lease=self._clock() + self.pending_ttl_s)
+                  lease=self._clock() + self.pending_ttl_s,
+                  account=account)
         self.pending[key] = e
         return e
 
@@ -977,6 +1063,7 @@ class Store:
         or DRAM truly can't fit it."""
         if self.disk is None:
             return None
+        rec = self.disk.index.get(key)
         data = self.disk.get(key)
         if data is None:
             return None
@@ -985,13 +1072,16 @@ class Store:
             return None
         pool_idx, offset = regions[0]
         self.mm.view(pool_idx, offset, len(data))[:] = data
-        e = Entry(pool_idx, offset, len(data))
+        # the promoted entry keeps its spill record's owning account
+        # (sharer sets don't persist across tiers; they rebuild on reads)
+        e = Entry(pool_idx, offset, len(data),
+                  account=rec.account if rec is not None else None)
         # _insert_committed drops the disk copy (its supersede rule)
         self._insert_committed(key, e)
         self.stats.promoted += 1
         return e
 
-    def get_inline(self, key: bytes):
+    def get_inline(self, key: bytes, account: Optional[str] = None):
         e = self.kv.get(key)
         if e is None:
             e = self._promote(key)
@@ -1000,6 +1090,7 @@ class Store:
             return None
         self._touch(key)
         self._record_hit(e)
+        self._usage_read(e, account)
         self.stats.gets += 1
         self.stats.hits += 1
         self.stats.bytes_out += e.size
@@ -1014,7 +1105,28 @@ class Store:
         e.last_access = now
         e.hits += 1
 
-    def alloc_put(self, keys: Sequence[bytes], block_size: int):
+    def _usage_read(self, e: Entry, account: Optional[str]) -> None:
+        """Usage-ledger side of a read: count the hit to the reading
+        account (the owner when the frame was untagged), and when a
+        DIFFERENT account reads an entry, record it as a sharer — from
+        then on the entry's byte·seconds split across the sharer set,
+        so a shared system prompt is never double-billed."""
+        m = self.usage_meter
+        m.on_hit(account if account is not None else e.account)
+        if account is None or account == e.account:
+            return
+        cur = e.sharers or []
+        if account in cur:
+            return
+        if 1 + len(cur) >= SHARER_CAP:
+            m.sharer_overflow += 1
+            return
+        before = self._entry_accounts(e)
+        e.sharers = cur + [account]
+        m.reshare(before, self._entry_accounts(e), e.size)
+
+    def alloc_put(self, keys: Sequence[bytes], block_size: int,
+                  account: Optional[str] = None):
         """Batched allocate for zero-copy writes.  Returns (status, descs)."""
         if len(set(keys)) != len(keys):
             return P.INVALID_REQ, []
@@ -1031,9 +1143,10 @@ class Store:
             old = self.pending.pop(key, None)
             if old is not None:
                 self._free(old)
-            # lease = reservation expiry while pending (see reap_pending)
+            # lease = reservation expiry while pending (see reap_pending);
+            # the tagging account becomes the first-writer OWNER at commit
             self.pending[key] = Entry(pool_idx, offset, block_size,
-                                      lease=expiry)
+                                      lease=expiry, account=account)
             descs.append((pool_idx, offset, block_size))
         return P.FINISH, descs
 
@@ -1068,7 +1181,10 @@ class Store:
         if old is not None:
             # overwrite: an shm reader may hold a live lease on the old
             # region; defer the free just like delete/purge do
+            self.usage_meter.sub(self._entry_accounts(old), old.size,
+                                 "dram")
             self._free_or_defer(old, now)
+        self.usage_meter.on_commit(e.account, e.size)
         if self.disk is not None:
             # a fresh commit supersedes any spilled copy (stale data must
             # never promote back over it)
@@ -1080,7 +1196,8 @@ class Store:
             # the checksum pass
             self._unstamped.append((key, e))
 
-    def get_desc(self, keys: Sequence[bytes], block_size: int = 0):
+    def get_desc(self, keys: Sequence[bytes], block_size: int = 0,
+                 account: Optional[str] = None):
         """Batched descriptors for zero-copy reads.  404 if any key missing.
 
         Two passes on purpose: promoting a spilled batchmate allocates,
@@ -1108,6 +1225,7 @@ class Store:
             e = self.kv[key]
             self._touch(key)
             self._record_hit(e)
+            self._usage_read(e, account)
             self.stats.gets += 1
             self.stats.hits += 1
             self.stats.bytes_out += e.size
@@ -1177,6 +1295,7 @@ class Store:
             self.disk.pop(key)
         if e is None:
             return False
+        self.usage_meter.sub(self._entry_accounts(e), e.size, "dram")
         self._free_or_defer(e, now)
         self.stats.scrub_corrupt += 1
         return True
@@ -1253,6 +1372,8 @@ class Store:
             e = self.kv.pop(key, None)
             on_disk = self.disk is not None and self.disk.pop(key)
             if e is not None:
+                self.usage_meter.sub(self._entry_accounts(e), e.size,
+                                     "dram")
                 self._free_or_defer(e, now)
             if e is not None or on_disk:
                 count += 1
@@ -1263,6 +1384,7 @@ class Store:
         now = self._clock()
         self._reap_deferred(now)
         for e in self.kv.values():
+            self.usage_meter.sub(self._entry_accounts(e), e.size, "dram")
             self._free_or_defer(e, now)
         self.kv.clear()
         # keep regions an op is actively streaming into (their op will

@@ -82,7 +82,8 @@ class Snapshot:
                  engine: Optional[dict] = None,
                  health: Optional[dict] = None,
                  admission: Optional[dict] = None,
-                 fleet: Optional[dict] = None):
+                 fleet: Optional[dict] = None,
+                 usage: Optional[dict] = None):
         self.serve = serve_metrics or {}
         self.store = store_metrics or {}
         self.cache = cache
@@ -101,6 +102,8 @@ class Snapshot:
         self.admission = admission
         # the front door's /debug/fleet payload (disaggregated roles)
         self.fleet = fleet
+        # the serve/router /debug/usage payload (per-tenant ledger)
+        self.usage = usage
 
     def lanes(self) -> List[str]:
         """Priority lanes seen in the serving TTFT family, numeric
@@ -519,6 +522,62 @@ class Console:
             )
         return out
 
+    def _usage(self, snap: Snapshot) -> List[str]:
+        """The tenant usage view (serve/router /debug/usage): per-tenant
+        store occupancy vs tokens saved, plus the headline occupant /
+        saver / DOA-offender call-outs."""
+        u = snap.usage
+        if not u or not u.get("enabled"):
+            return []
+        out: List[str] = [""]
+        tenants = u.get("tenants") or {}
+        out.append(
+            f"{'usage (tenant)':16s} {'GB·s':>8s} {'res MB':>8s} "
+            f"{'tok store':>9s} {'tok comp':>9s} {'reuse':>6s} "
+            f"{'evict':>6s} {'doa':>5s}"
+        )
+        ranked = sorted(
+            tenants.items(),
+            key=lambda kv: -(kv[1].get("byte_seconds", {}).get("dram", 0.0)
+                             + kv[1].get("byte_seconds", {}).get("disk", 0.0)),
+        )
+        for tenant, t in ranked[:6]:
+            bs = t.get("byte_seconds") or {}
+            res = t.get("resident_bytes") or {}
+            toks = t.get("tokens") or {}
+            d_hits = self.deltas.setdefault(
+                f"usage_hits:{tenant}", _Delta()).update(
+                    float(t.get("hits", 0)))
+            out.append(
+                "  {:14s} {:>8.3f} {:>8.2f} {:>9.0f} {:>9.0f} "
+                "{:>6.1%} {:>6d} {:>5d}{}".format(
+                    str(tenant)[:14],
+                    (bs.get("dram", 0.0) + bs.get("disk", 0.0)) / 1e9,
+                    (res.get("dram", 0.0) + res.get("disk", 0.0)) / 1e6,
+                    toks.get("store", 0.0), toks.get("computed", 0.0),
+                    t.get("reuse_ratio", 0.0) or 0.0,
+                    int(t.get("evictions", 0)),
+                    int(t.get("dead_on_arrival", 0)),
+                    ("" if d_hits is None else f"  (+{d_hits:.0f} hits)"),
+                )
+            )
+
+        def head(rows, label):
+            rows = rows or []
+            if not rows:
+                return None
+            r = rows[0]
+            return f"{label} {r.get('tenant')} ({r.get('value')})"
+
+        calls = [c for c in (
+            head(u.get("top_occupants"), "top occupant:"),
+            head(u.get("top_savers"), "top saver:"),
+            head(u.get("doa_offenders"), "doa offender:"),
+        ) if c]
+        if calls:
+            out.append("  " + "   ".join(calls))
+        return out
+
     def frame(self, snap: Snapshot) -> str:
         out: List[str] = []
         w = 24
@@ -642,6 +701,7 @@ class Console:
                 + (f"   free pages {int(pages):>6}"
                    if pages is not None else "")
             )
+        out.extend(self._usage(snap))
         out.extend(self._serving_slo(snap))
         out.extend(self._alerts(snap))
         out.extend(self._admission(snap))
@@ -723,6 +783,9 @@ def poll(serve_url: Optional[str], store_url: Optional[str]) -> Snapshot:
     fleet = js(serve_url, "/debug/fleet")
     if fleet is not None and not fleet.get("enabled"):
         fleet = None
+    usage = js(serve_url, "/debug/usage")
+    if usage is not None and not usage.get("enabled"):
+        usage = None
     return Snapshot(
         serve_metrics=prom(serve_url, "/metrics"),
         store_metrics=prom(store_url, "/metrics"),
@@ -736,6 +799,7 @@ def poll(serve_url: Optional[str], store_url: Optional[str]) -> Snapshot:
         health=health,
         admission=admission,
         fleet=fleet,
+        usage=usage,
     )
 
 

@@ -1,0 +1,354 @@
+"""Tenant-resolved capacity attribution: the usage ledger.
+
+The store fleet meters *what* it holds (occupancy, hit ratios, DOA) but
+until this plane existed nobody could say *whose* prefixes occupy the
+DRAM and spill bytes, what each tenant's reuse actually saves, or
+whether eviction pressure is one noisy tenant's doing.  Three pieces:
+
+* the **account context** — a contextvar the serving layer binds around
+  every store hop a request pays for (the scheduler binds the request's
+  tenant around prefill admission/steps; the store streamer carries the
+  submitting request's account onto its worker thread the same way it
+  carries the trace id).  The wire client reads it per frame and — on a
+  connection that negotiated ``HELLO_FLAG_ACCOUNT`` — tags
+  ALLOC_PUT/GET_DESC/inline ops with the label (``protocol.FLAG_ACCOUNT``
+  blob).  Legacy peers never negotiate, so their frames stay
+  byte-identical;
+* the **UsageMeter** — store-side accounting integrated with the
+  clock-injectable analytics: byte·seconds of occupancy per account per
+  tier (DRAM + spill), hits/evictions/dead-on-arrival per account, and
+  shared-prefix bytes SPLIT across the sharer set so two tenants reading
+  one system prompt are each billed half of it, not all of it twice.
+  Exported at the store manage plane's ``GET /debug/usage`` and as the
+  ``istpu_store_usage_*`` metric families;
+* ``usage_report()`` — the pure fleet join: per-node ``/debug/usage``
+  payloads + the engine's per-tenant token provenance
+  (``istpu_engine_tenant_prefix_tokens_total``) fold into one ledger
+  that answers the cache-economics question per tenant: tokens served
+  from the store vs recomputed, against the byte·seconds held — "is the
+  cache paying for itself, and for whom."
+
+Accounts are opaque short labels (≤ ``protocol.MAX_ACCOUNT_LABEL``
+chars).  The serving layer uses the lane/tenant label (PR 12's quota
+axis): integer lanes read ``"0"``, named tenants read ``"acme"``.
+``"-"`` is the unattributed bucket (legacy clients, untagged frames).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import time
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence
+
+from .protocol import MAX_ACCOUNT_LABEL
+
+# an entry's sharer set (owner + readers) is bounded: past this many
+# distinct accounts the split stops refining (counted, not resized — a
+# prefix shared fleet-wide is effectively a public good anyway)
+SHARER_CAP = 8
+
+UNATTRIBUTED = "-"
+
+_ACCOUNT: "contextvars.ContextVar[Optional[str]]" = contextvars.ContextVar(
+    "istpu_account", default=None
+)
+
+
+def current_account() -> Optional[str]:
+    """The account label bound on this thread/context, or None."""
+    return _ACCOUNT.get()
+
+
+@contextlib.contextmanager
+def bind_account(label: Optional[str]):
+    """Bind an account label for the duration of the block.  ``None``
+    is a no-op passthrough (the ambient binding, if any, stays)."""
+    if label is None:
+        yield _ACCOUNT.get()
+        return
+    label = str(label)[:MAX_ACCOUNT_LABEL]
+    tok = _ACCOUNT.set(label)
+    try:
+        yield label
+    finally:
+        _ACCOUNT.reset(tok)
+
+
+class UsageMeter:
+    """Per-account, per-tier capacity accounting with an injectable
+    clock (the store's ``_clock`` — tests drive deterministic
+    timelines).
+
+    The accounting unit is **byte·seconds of residency**: every state
+    change first accrues ``resident_bytes * dt`` into each account's
+    running total, then applies the delta.  An entry shared by k
+    accounts (first writer owns; readers join the sharer set) counts
+    ``size/k`` toward each — so a fleet-wide system prompt is split
+    across its sharers, never double-billed.  Accounts are bounded:
+    past ``max_accounts`` distinct labels, new ones fold into
+    ``"other"`` (hostile label churn cannot grow the meter without
+    bound)."""
+
+    TIERS = ("dram", "disk")
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic,
+                 max_accounts: int = 64):
+        self._clock = clock
+        self.max_accounts = max_accounts
+        self._last: Optional[float] = None
+        # (account, tier) -> resident bytes (float: split shares)
+        self.resident: Dict[tuple, float] = {}
+        # (account, tier) -> accumulated byte*seconds
+        self.byte_seconds: Dict[tuple, float] = {}
+        self.hits: Dict[str, int] = {}
+        self.evictions: Dict[str, int] = {}
+        self.doa: Dict[str, int] = {}
+        self.bytes_written: Dict[str, int] = {}
+        self._known: set = set()
+        self.sharer_overflow = 0
+
+    # -- primitives --
+
+    def _norm(self, account: Optional[str]) -> str:
+        a = account if account else UNATTRIBUTED
+        if a in self._known:
+            return a
+        if len(self._known) >= self.max_accounts:
+            return "other"
+        self._known.add(a)
+        return a
+
+    def _accrue(self, now: Optional[float] = None) -> None:
+        now = self._clock() if now is None else now
+        if self._last is not None:
+            dt = now - self._last
+            if dt > 0:
+                for k, b in self.resident.items():
+                    if b > 0:
+                        self.byte_seconds[k] = (
+                            self.byte_seconds.get(k, 0.0) + b * dt
+                        )
+        self._last = now
+
+    def add(self, accounts: Sequence[Optional[str]], size: int,
+            tier: str) -> None:
+        """Attribute ``size`` resident bytes, split across
+        ``accounts``."""
+        if not accounts or size <= 0:
+            return
+        self._accrue()
+        share = size / len(accounts)
+        for a in accounts:
+            k = (self._norm(a), tier)
+            self.resident[k] = self.resident.get(k, 0.0) + share
+
+    def sub(self, accounts: Sequence[Optional[str]], size: int,
+            tier: str) -> None:
+        if not accounts or size <= 0:
+            return
+        self._accrue()
+        share = size / len(accounts)
+        for a in accounts:
+            k = (self._norm(a), tier)
+            self.resident[k] = max(0.0, self.resident.get(k, 0.0) - share)
+
+    def reshare(self, before: Sequence[Optional[str]],
+                after: Sequence[Optional[str]], size: int) -> None:
+        """Rebalance one DRAM entry's split when its sharer set grows
+        (a second tenant read the shared prefix)."""
+        self.sub(before, size, "dram")
+        self.add(after, size, "dram")
+
+    # -- event hooks (the store calls these from its op paths) --
+
+    def on_commit(self, account: Optional[str], size: int) -> None:
+        a = self._norm(account)
+        self.bytes_written[a] = self.bytes_written.get(a, 0) + size
+        self.add([a], size, "dram")
+
+    def on_hit(self, account: Optional[str]) -> None:
+        a = self._norm(account)
+        self.hits[a] = self.hits.get(a, 0) + 1
+
+    def on_evict(self, accounts: Sequence[Optional[str]],
+                 owner: Optional[str], size: int,
+                 never_read: bool) -> None:
+        self.sub(accounts, size, "dram")
+        o = self._norm(owner)
+        self.evictions[o] = self.evictions.get(o, 0) + 1
+        if never_read:
+            self.doa[o] = self.doa.get(o, 0) + 1
+
+    # -- export --
+
+    def report(self) -> Dict[str, Any]:
+        """The store manage plane's ``GET /debug/usage`` payload."""
+        self._accrue()
+        accounts: Dict[str, Any] = {}
+        names = ({a for a, _t in self.resident}
+                 | {a for a, _t in self.byte_seconds}
+                 | set(self.hits) | set(self.evictions)
+                 | set(self.bytes_written))
+        for a in sorted(names):
+            accounts[a] = {
+                "resident_bytes": {
+                    t: round(self.resident.get((a, t), 0.0), 1)
+                    for t in self.TIERS
+                },
+                "byte_seconds": {
+                    t: round(self.byte_seconds.get((a, t), 0.0), 3)
+                    for t in self.TIERS
+                },
+                "hits": self.hits.get(a, 0),
+                "evictions": self.evictions.get(a, 0),
+                "dead_on_arrival": self.doa.get(a, 0),
+                "bytes_written": self.bytes_written.get(a, 0),
+            }
+        return {
+            "enabled": True,
+            "accounts": accounts,
+            "sharer_overflow": self.sharer_overflow,
+        }
+
+
+# -- the fleet join ---------------------------------------------------------
+
+
+def _blank_tenant() -> Dict[str, Any]:
+    return {
+        "resident_bytes": {"dram": 0.0, "disk": 0.0},
+        "byte_seconds": {"dram": 0.0, "disk": 0.0},
+        "hits": 0, "evictions": 0, "dead_on_arrival": 0,
+        "bytes_written": 0,
+        "tokens": {"store": 0.0, "local": 0.0, "computed": 0.0},
+    }
+
+
+def usage_report(store_usages: Iterable[Dict[str, Any]],
+                 tenant_tokens: Optional[Dict[str, Dict[str, float]]] = None,
+                 top_n: int = 5) -> Dict[str, Any]:
+    """The fleet usage ledger: fold per-node ``/debug/usage`` payloads
+    and the engine's per-tenant token provenance into one per-tenant
+    view with the cache-economics verdict.  Pure in its inputs.
+
+    ``tenant_tokens``: ``{tenant: {"store": n, "local": n,
+    "computed": n}}`` — prefill tokens by provenance (the "tokens
+    saved" side of the ledger).
+
+    Economics per tenant: ``reuse_ratio`` = store tokens over all
+    prompt tokens, and ``store_tokens_per_gb_s`` = store-served tokens
+    per GB·second of store occupancy held — the "is the cache paying
+    for itself" number (0 occupancy with reuse = free rider on shared
+    prefixes; high occupancy with 0 reuse = paying rent for nothing)."""
+    tenants: Dict[str, Dict[str, Any]] = {}
+    nodes = 0
+    sharer_overflow = 0
+    for u in store_usages:
+        if not u or not u.get("accounts"):
+            if u:
+                nodes += 1
+                sharer_overflow += int(u.get("sharer_overflow", 0))
+            continue
+        nodes += 1
+        sharer_overflow += int(u.get("sharer_overflow", 0))
+        for a, rec in u["accounts"].items():
+            t = tenants.setdefault(a, _blank_tenant())
+            for tier in ("dram", "disk"):
+                t["resident_bytes"][tier] += float(
+                    (rec.get("resident_bytes") or {}).get(tier, 0.0))
+                t["byte_seconds"][tier] += float(
+                    (rec.get("byte_seconds") or {}).get(tier, 0.0))
+            for k in ("hits", "evictions", "dead_on_arrival",
+                      "bytes_written"):
+                t[k] += int(rec.get(k, 0))
+    for tenant, toks in (tenant_tokens or {}).items():
+        t = tenants.setdefault(str(tenant), _blank_tenant())
+        for src in ("store", "local", "computed"):
+            t["tokens"][src] += float(toks.get(src, 0.0))
+    for t in tenants.values():
+        bs_total = (t["byte_seconds"]["dram"] + t["byte_seconds"]["disk"])
+        toks = t["tokens"]
+        prompt_total = toks["store"] + toks["local"] + toks["computed"]
+        t["reuse_ratio"] = (round(toks["store"] / prompt_total, 4)
+                            if prompt_total else 0.0)
+        t["store_tokens_per_gb_s"] = (
+            round(toks["store"] / (bs_total / 1e9), 3) if bs_total else None
+        )
+
+    def top(key, reverse=True):
+        rows = [(a, key(t)) for a, t in tenants.items()]
+        rows = [(a, v) for a, v in rows if v]
+        rows.sort(key=lambda kv: kv[1], reverse=reverse)
+        return [{"tenant": a, "value": round(v, 3)}
+                for a, v in rows[:top_n]]
+
+    return {
+        "enabled": True,
+        "nodes": nodes,
+        "tenants": tenants,
+        "sharer_overflow": sharer_overflow,
+        # the doctor/top headline: who fills the cache, who it pays for,
+        # whose writes die unread
+        "top_occupants": top(
+            lambda t: t["byte_seconds"]["dram"] + t["byte_seconds"]["disk"]
+        ),
+        "top_savers": top(lambda t: t["tokens"]["store"]),
+        "doa_offenders": top(lambda t: t["dead_on_arrival"]),
+    }
+
+
+def merge_usage_reports(reports: Iterable[Dict[str, Any]],
+                        top_n: int = 5) -> Dict[str, Any]:
+    """Fold several already-joined ``usage_report`` payloads (one per
+    serve worker) into one fleet ledger — the router rollup.  Store-side
+    byte·seconds may appear in several workers' reports when they share
+    manage endpoints; the MAX per tenant+tier is taken (same fleet seen
+    from several windows), while token counts SUM (each worker serves
+    distinct requests)."""
+    tenants: Dict[str, Dict[str, Any]] = {}
+    nodes = 0
+    for rep in reports:
+        if not rep or not rep.get("enabled"):
+            continue
+        nodes = max(nodes, int(rep.get("nodes", 0)))
+        for a, rec in (rep.get("tenants") or {}).items():
+            t = tenants.setdefault(a, _blank_tenant())
+            for tier in ("dram", "disk"):
+                t["resident_bytes"][tier] = max(
+                    t["resident_bytes"][tier],
+                    float((rec.get("resident_bytes") or {}).get(tier, 0.0)))
+                t["byte_seconds"][tier] = max(
+                    t["byte_seconds"][tier],
+                    float((rec.get("byte_seconds") or {}).get(tier, 0.0)))
+            for k in ("hits", "evictions", "dead_on_arrival",
+                      "bytes_written"):
+                t[k] = max(t[k], int(rec.get(k, 0)))
+            for src in ("store", "local", "computed"):
+                t["tokens"][src] += float(
+                    (rec.get("tokens") or {}).get(src, 0.0))
+    out = usage_report([], tenant_tokens=None, top_n=top_n)
+    out["tenants"] = tenants
+    out["nodes"] = nodes
+    for t in tenants.values():
+        bs_total = (t["byte_seconds"]["dram"] + t["byte_seconds"]["disk"])
+        toks = t["tokens"]
+        prompt_total = toks["store"] + toks["local"] + toks["computed"]
+        t["reuse_ratio"] = (round(toks["store"] / prompt_total, 4)
+                            if prompt_total else 0.0)
+        t["store_tokens_per_gb_s"] = (
+            round(toks["store"] / (bs_total / 1e9), 3) if bs_total else None
+        )
+
+    def top(key, reverse=True):
+        rows = [(a, key(t)) for a, t in tenants.items()]
+        rows = [(a, v) for a, v in rows if v]
+        rows.sort(key=lambda kv: kv[1], reverse=reverse)
+        return [{"tenant": a, "value": round(v, 3)}
+                for a, v in rows[:top_n]]
+
+    out["top_occupants"] = top(
+        lambda t: t["byte_seconds"]["dram"] + t["byte_seconds"]["disk"])
+    out["top_savers"] = top(lambda t: t["tokens"]["store"])
+    out["doa_offenders"] = top(lambda t: t["dead_on_arrival"])
+    return out

@@ -363,6 +363,21 @@ class MetricsRegistry:
                 return None
             return max(vals) if agg == "max" else sum(vals)
 
+    def family_items(self, name: str) -> List[Tuple[Dict[str, str], float]]:
+        """Per-child read of one counter/gauge family: ``[(labels dict,
+        value)]`` — the per-label breakdown ``family_value`` aggregates
+        away (the usage ledger joins the per-tenant provenance counter
+        this way).  Empty for unknown names, histograms, and fn-backed
+        families (which have no labeled children)."""
+        with self.lock:
+            m = self._metrics.get(name)
+            if m is None or isinstance(m, Histogram):
+                return []
+            return [
+                (dict(zip(m.labelnames, lv)), child.value.v)
+                for lv, child in m._children.items()
+            ]
+
     def family_hist(self, name: str) -> Optional[Tuple[float, float]]:
         """``(count, sum)`` totals over a histogram family's children
         (every label combination), or None when the family is absent —

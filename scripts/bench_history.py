@@ -86,6 +86,10 @@ METRICS = {
     # peak (graceful degradation), 0 = collapse — a round that loses
     # the plateau regressed the control loop itself
     "goodput_plateau": ("up", "goodput plateau under overload"),
+    # usage-attribution plane (PR 15): fleet-wide share of prompt tokens
+    # served from the store per bench_serve's /debug/usage join — the
+    # cache paying for itself, trended
+    "usage_reuse_ratio": ("up", "store-served prompt-token share"),
     # the multi-node cluster leg (bench.py --endpoints N): aggregate
     # fleet bandwidth through the consistent-hash router
     "cluster_put_gbps": ("up", "cluster put GB/s (aggregate)"),

@@ -1,0 +1,66 @@
+#!/usr/bin/env python3
+"""Watchdog-rule ↔ runbook drift lint.
+
+Every registered ``WatchdogRule`` name (the default serve + store rule
+sets in ``infinistore_tpu/health.py``) must have a matching row in
+``docs/runbook.md``'s rule tables, and every rule the runbook names must
+actually be registered — the same both-directions contract the metrics
+lint enforces for ``docs/observability.md``.  A runbook that silently
+rots is worse than none, because it is the 3am map.
+
+Imports the rule constructors (cheap — health.py pulls no jax) instead
+of regex-scanning the source: rule names are built by factory calls
+(``spike_rule("disk_errors", ...)``), which a static scan would have to
+re-implement.  Fails the build (exit 1) on drift in either direction.
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+RUNBOOK = REPO / "docs" / "runbook.md"
+
+# a rule row: a table line whose first cell is a backticked rule name
+_ROW = re.compile(r"^\|\s*`([a-z0-9_]+)`\s*\|", re.MULTILINE)
+
+
+def registered_rules() -> set:
+    sys.path.insert(0, str(REPO))
+    from infinistore_tpu.health import (
+        default_serve_rules,
+        default_store_rules,
+    )
+
+    return {r.name for r in default_serve_rules() + default_store_rules()}
+
+
+def documented_rules(text: str) -> set:
+    return set(_ROW.findall(text))
+
+
+def main() -> int:
+    registered = registered_rules()
+    documented = documented_rules(RUNBOOK.read_text())
+    undocumented = sorted(registered - documented)
+    unregistered = sorted(documented - registered)
+    if undocumented:
+        print("watchdog rules registered in code but MISSING from "
+              f"{RUNBOOK.relative_to(REPO)}:")
+        for n in undocumented:
+            print(f"  - {n}")
+    if unregistered:
+        print(f"rules documented in {RUNBOOK.relative_to(REPO)} but "
+              "registered NOWHERE in the default rule sets:")
+        for n in unregistered:
+            print(f"  - {n}")
+    if undocumented or unregistered:
+        return 1
+    print(f"runbook lint OK: {len(registered)} rules in sync")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
