@@ -42,7 +42,7 @@ def _free_port() -> int:
     return port
 
 
-def start_server():
+def start_server(backend=None):
     service, manage = _free_port(), _free_port()
     proc = subprocess.Popen(
         [
@@ -50,7 +50,8 @@ def start_server():
             "--service-port", str(service), "--manage-port", str(manage),
             "--prealloc-size", "2", "--minimal-allocate-size", "64",
             "--log-level", "warning", "--auto-increase",
-        ],
+        ]
+        + (["--backend", backend] if backend else []),
         stdout=subprocess.DEVNULL,
         stderr=subprocess.DEVNULL,
     )
@@ -240,7 +241,72 @@ def bench_cluster(n_nodes: int, rounds: int = 4) -> dict:
                 for ep, idxs in groups.items():
                     pool.node(ep).conn.delete_keys(
                         [keys[i] for i in idxs])
+
         pool.close()
+        # the native fleet is done — free its CPU before the reshape
+        # leg so the two migration passes aren't measured under the
+        # native servers' polling load
+        for proc, _ in procs:
+            proc.terminate()
+        for proc, _ in procs:
+            proc.wait(timeout=10)
+        procs.clear()
+
+        # -- reshape leg: join one spare node into the loaded fleet,
+        # once over the pre-PR-16 per-key path (``_copy_batch``
+        # disabled) and once over the descriptor-batched path — same
+        # key population, same node, so the two ``migrate_gbps``
+        # numbers are directly comparable.  The leg runs its own
+        # python-backend mini-fleet with a python-client pool
+        # (``op_timeout_s``): migration needs the key-listing surface,
+        # which neither the native server nor the native client speaks
+        for _ in range(n_nodes):
+            procs.append(start_server(backend="python"))
+        rpool = RoutedStorePool(
+            [f"127.0.0.1:{port}" for _, port in procs[-n_nodes:]],
+            connection_type=TYPE_SHM, op_timeout_s=30.0, replicas=1,
+        )
+        for node in rpool.nodes():
+            buf = np.random.randint(0, 256, size=ROUND_BYTES,
+                                    dtype=np.uint8)
+            node.conn.register_mr(buf)
+            bufs[node.endpoint] = buf
+        mig_keys = [f"mig-L{layer}-c{c}"
+                    for layer in range(N_LAYERS) for c in range(CHUNKS)]
+        for ep, idxs in rpool.partition(mig_keys).items():
+            blocks = [(mig_keys[i], j * PAGE_BYTES)
+                      for j, i in enumerate(idxs)]
+            rpool.node(ep).conn.write_cache(blocks, PAGE_BYTES,
+                                            bufs[ep].ctypes.data)
+        spare = start_server(backend="python")
+        procs.append(spare)
+        spare_ep = f"127.0.0.1:{spare[1]}"
+
+        def _join_and_measure(per_key_only):
+            if per_key_only:  # the old path, for the comparison row
+                rpool._copy_batch = lambda *a, **kw: None
+            try:
+                rpool.join_node(spare_ep)
+                while not rpool.migration_idle():
+                    time.sleep(0.02)
+                return rpool.migration_report()
+            finally:
+                rpool.__dict__.pop("_copy_batch", None)
+
+        rep_new = _join_and_measure(per_key_only=False)
+        rpool.drain_node(spare_ep)
+        while not rpool.migration_idle():
+            time.sleep(0.02)
+        # the drained spare still holds the copied bytes — purge so the
+        # second join moves real bytes instead of skipping everything
+        cfg = ClientConfig(host_addr="127.0.0.1", service_port=spare[1],
+                           connection_type=TYPE_SHM, log_level="warning")
+        spare_conn = InfinityConnection(cfg)
+        spare_conn.connect()
+        spare_conn.purge()
+        spare_conn.close()
+        rep_old = _join_and_measure(per_key_only=True)
+        rpool.close()
     finally:
         for proc, _ in procs:
             proc.terminate()
@@ -251,6 +317,9 @@ def bench_cluster(n_nodes: int, rounds: int = 4) -> dict:
         "cluster_nodes": n_nodes,
         "cluster_put_gbps": round(gb / put_t, 3),
         "cluster_get_gbps": round(gb / get_t, 3),
+        "migrate_gbps": rep_new.get("migrate_gbps", 0.0),
+        "migrate_gbps_per_key": rep_old.get("migrate_gbps", 0.0),
+        "migrate_bytes": rep_new.get("bytes", 0),
         "cluster_per_node": {
             ep: {
                 "put_gbps": round(s["bytes"] / 1e9 / s["put_s"], 3)
@@ -326,6 +395,14 @@ def main(argv=None):
                 cluster["cluster_get_gbps"],
                 {ep: f"{s['put_gbps']}/{s['get_gbps']}"
                  for ep, s in cluster["cluster_per_node"].items()},
+            ),
+            file=sys.stderr,
+        )
+        print(
+            "# reshape: migrate {} GB/s batched vs {} GB/s per-key "
+            "({} bytes moved)".format(
+                cluster["migrate_gbps"], cluster["migrate_gbps_per_key"],
+                cluster["migrate_bytes"],
             ),
             file=sys.stderr,
         )

@@ -445,6 +445,20 @@ def main(argv=None) -> int:
                 usage_dbg = payload
         except Exception:  # noqa: BLE001 — observability, not the bench
             pass
+        # the reshape plane's verdict (best-effort, same contract):
+        # if the store ring behind the server migrated during the run,
+        # /debug/cluster carries the last migration's throughput
+        cluster_dbg = None
+        try:
+            import urllib.request
+
+            with urllib.request.urlopen(url + "/debug/cluster",
+                                        timeout=5) as r:
+                payload = json.loads(r.read())
+            if payload.get("enabled"):
+                cluster_dbg = payload
+        except Exception:  # noqa: BLE001 — observability, not the bench
+            pass
         disagg = None
         if args.self_disagg:
             disagg = _gather_disagg(url, fleet_workers, args)
@@ -592,6 +606,14 @@ def main(argv=None) -> int:
         record["health"] = health
         record["alerts_fired"] = health["alerts_fired"]
         record["burn_rate_peak"] = health["burn_rate_peak"]
+    if cluster_dbg is not None:
+        # reshape throughput mirrored top-level for the trend table
+        # (up is good) — only when a migration actually ran: a sweep
+        # with no membership change emits no row, and bench_history
+        # skips absent keys
+        mig = cluster_dbg.get("migration") or {}
+        if mig.get("migrate_gbps") is not None:
+            record["migrate_gbps"] = mig["migrate_gbps"]
     print(json.dumps(record))
     if args.json_out:
         with open(args.json_out, "w") as f:

@@ -401,6 +401,12 @@ class RoutedStorePool:
             "(copied / skipped already-present / error)",
             labelnames=("result",),
         )
+        self._c_mig_bytes = reg.counter(
+            "istpu_cluster_migrate_bytes_total",
+            "Bytes moved by background membership migration, by copy "
+            "path (batched descriptor runs vs the per-key fallback)",
+            labelnames=("path",),
+        )
         self._refresh_ring_gauges()
         self._refresh_membership_gauges()
         if connect:
@@ -517,6 +523,14 @@ class RoutedStorePool:
         if rep.get("started_at") and rep.get("state") == "running":
             rep["elapsed_s"] = round(time.monotonic() - rep["started_at"], 2)
         rep.pop("started_at", None)
+        # reshape-plane throughput: bytes over the live window while
+        # running, over the recorded wall clock once done
+        wall = rep.get("elapsed_s") if rep.get("state") == "running" \
+            else rep.get("wall_s")
+        if wall:
+            rep["migrate_gbps"] = round(rep.get("bytes", 0) / wall / 1e9, 3)
+            rep["keys_per_s"] = round(
+                (rep.get("copied", 0) + rep.get("skipped", 0)) / wall, 1)
         return rep
 
     def migration_idle(self) -> bool:
@@ -552,6 +566,7 @@ class RoutedStorePool:
             self._migration = {
                 "state": "running", "mode": "join", "endpoint": ep,
                 "copied": 0, "skipped": 0, "errors": 0, "sources": 0,
+                "bytes": 0, "batched": 0,
                 "started_at": time.monotonic(),
             }
             self._refresh_ring_gauges()
@@ -584,6 +599,7 @@ class RoutedStorePool:
             self._migration = {
                 "state": "running", "mode": "drain", "endpoint": ep,
                 "copied": 0, "skipped": 0, "errors": 0, "sources": 1,
+                "bytes": 0, "batched": 0,
                 "started_at": time.monotonic(),
             }
             self._refresh_ring_gauges()
@@ -594,13 +610,26 @@ class RoutedStorePool:
             )
             self._mig_thread.start()
 
-    def _node_keys(self, ep: str) -> List[str]:
+    def _node_keys(self, ep: str) -> Dict[str, Optional[int]]:
+        """Enumerate a node's retrievable keys as ``{key: size | None}``.
+        Sized listings (LIST_KEYS_F_SIZES) feed the descriptor-batched
+        copy path; a peer that predates the flag — or a test double that
+        only implements the names-only surface — yields ``None`` sizes
+        and those keys ride the per-key fallback."""
         node = self._nodes.get(ep)
         if node is None:
-            return []
+            return {}
         with node.lock:
             node.ensure_connected()
-            return node.conn.list_keys()
+            sized = getattr(node.conn, "list_keys_sizes", None)
+            if sized is not None:
+                try:
+                    rows = sized()
+                except Exception:  # noqa: BLE001 — old peer / test double
+                    rows = None
+                if rows is not None:
+                    return {k: int(sz) for k, sz in rows}
+            return dict.fromkeys(node.conn.list_keys())
 
     def _copy_key(self, key: str, src_ep: str, dst_ep: str) -> str:
         """Move one key's bytes src → dst (reads and writes ride the
@@ -631,20 +660,144 @@ class RoutedStorePool:
         except Exception:  # noqa: BLE001 — counted; lazy rebalance heals
             return "error"
 
+    def _copy_batch(self, keys: List[str], size: int,
+                    src_ep: str, dst_ep: str, have=None):
+        """Move a same-size run of keys src → dst over the PR-7 batched
+        descriptor machinery pointed at a peer store: one batched
+        ``ALLOC_PUT`` reserves the whole run at the destination, bulk
+        descriptor reads stream it out of the source pool, and ONE
+        ``COMMIT_PUT`` (shm) / one atomic inline frame (tcp) commits —
+        so a torn run is never committed; the pending-TTL reaper
+        reclaims any uncommitted allocation if this thread dies mid-run.
+
+        ``have`` is an optional snapshot of the destination's key set
+        (one listing per destination, taken by the caller) — it replaces
+        the per-key ``check_exist`` round trip that would otherwise
+        dominate a batched run.  The skip it implements is best-effort
+        either way: a push can land between any existence check and the
+        batch commit, so the snapshot only widens an existing race
+        window, it doesn't open one.
+
+        Returns ``(copied, skipped, errors, nbytes)``, or ``None`` when
+        the batch cannot complete as a unit (a source key vanished
+        mid-run, a transport error, or a peer without the batched
+        surface) — the caller re-walks that run per-key, which skips
+        vanished keys individually and counts real failures."""
+        src = self._nodes.get(src_ep)
+        dst = self._nodes.get(dst_ep)
+        if src is None or dst is None or size <= 0:
+            return None
+        if not (hasattr(src.conn, "read_cache")
+                and hasattr(dst.conn, "write_cache")):
+            return None
+        import numpy as np
+
+        try:
+            if have is not None:
+                todo = [key for key in keys if key not in have]
+                skipped = len(keys) - len(todo)
+            else:
+                todo = []
+                skipped = 0
+                with dst.lock:
+                    dst.ensure_connected()
+                    for key in keys:
+                        if dst.conn.check_exist(key):
+                            skipped += 1  # a push since the ring changed
+                        else:
+                            todo.append(key)
+            if not todo:
+                return (0, skipped, 0, 0)
+            buf = np.empty(len(todo) * size, dtype=np.uint8)
+            blocks = [(key, i * size) for i, key in enumerate(todo)]
+            with src.lock:
+                src.ensure_connected()
+                src.conn.read_cache(blocks, size, buf.ctypes.data)
+            with dst.lock:
+                dst.ensure_connected()
+                dst.conn.write_cache(blocks, size, buf.ctypes.data)
+            return (len(todo), skipped, 0, len(todo) * size)
+        except Exception:  # noqa: BLE001 — incl. KeyNotFound: the run
+            # is re-walked per-key so one vanished entry costs only its
+            # own skip, never the batch
+            return None
+
     def _migrate_pairs(self, pairs, ep: str) -> None:
         """Drive the copy loop and settle the transition.  ``pairs`` is
-        an iterable of (key, src, dst)."""
-        copied = skipped = errors = 0
-        for i, (key, src, dst) in enumerate(pairs):
-            result = self._copy_key(key, src, dst)
-            self._c_migrated.labels(result).inc()
-            copied += result == "copied"
-            skipped += result == "skipped"
-            errors += result == "error"
+        a sequence of (key, src, dst, size-or-None).  Consecutive keys
+        with the same (src, dst, size) move as ONE descriptor-batched
+        run of up to ``MIGRATE_BATCH`` keys; unsized keys (old peer,
+        names-only listing) and failed runs fall back to the per-key
+        copy, which is also the monkeypatch point the membership tests
+        pace on."""
+        # group-friendly order: same (src, dst, size) keys become
+        # adjacent so batched runs form even from interleaved listings
+        pairs = sorted(pairs, key=lambda p: (p[1], p[2], p[3] or 0))
+        copied = skipped = errors = moved_bytes = batched = 0
+
+        def _account(c, s, e, nb, via_batch):
+            nonlocal copied, skipped, errors, moved_bytes, batched
+            copied += c
+            skipped += s
+            errors += e
+            moved_bytes += nb
+            batched += c if via_batch else 0
+            if nb:
+                self._c_mig_bytes.labels(
+                    "batched" if via_batch else "per_key").inc(nb)
             with self._mig_lock:
                 self._migration.update(
-                    copied=copied, skipped=skipped, errors=errors)
-            if (i + 1) % MIGRATE_BATCH == 0:
+                    copied=copied, skipped=skipped, errors=errors,
+                    bytes=moved_bytes, batched=batched)
+
+        def _per_key(run):
+            for key, src, dst, size in run:
+                result = self._copy_key(key, src, dst)
+                self._c_migrated.labels(result).inc()
+                _account(result == "copied", result == "skipped",
+                         result == "error",
+                         (size or 0) if result == "copied" else 0, False)
+
+        # one key-listing snapshot per destination feeds every batched
+        # run's already-present filter (``None`` = listing unavailable,
+        # fall back to per-key existence checks inside the batch)
+        dst_have: Dict[str, Optional[set]] = {}
+
+        i = 0
+        n = len(pairs)
+        since_breath = 0
+        while i < n:
+            key, src, dst, size = pairs[i]
+            run = [pairs[i]]
+            i += 1
+            while (i < n and len(run) < MIGRATE_BATCH
+                   and pairs[i][1:] == (src, dst, size)):
+                run.append(pairs[i])
+                i += 1
+            res = None
+            if size:
+                if dst not in dst_have:
+                    try:
+                        dst_have[dst] = set(self._node_keys(dst))
+                    except Exception:  # noqa: BLE001 — per-key checks
+                        dst_have[dst] = None
+                res = self._copy_batch(
+                    [p[0] for p in run], size, src, dst,
+                    have=dst_have[dst])
+                if res is not None and dst_have[dst] is not None:
+                    dst_have[dst].update(p[0] for p in run)
+            if res is None:
+                _per_key(run)
+            else:
+                c, s, e, nb = res
+                for _ in range(c):
+                    self._c_migrated.labels("copied").inc()
+                for _ in range(s):
+                    self._c_migrated.labels("skipped").inc()
+                _account(c, s, e, nb, True)
+            since_breath += len(run)
+            if since_breath >= MIGRATE_BATCH:
+                since_breath = 0
                 time.sleep(MIGRATE_SLEEP_S)  # breathe under live traffic
 
     def _migrate_join(self, ep: str, old: HashRing) -> None:
@@ -661,12 +814,12 @@ class RoutedStorePool:
                         self._migration["errors"] = (
                             self._migration.get("errors", 0) + 1)
                     continue
-                for key in keys:
+                for key, size in keys.items():
                     # copy exactly the new node's range: keys it now owns
                     # that lived on this (pre-join) owner
                     if (self.ring.owner(key) == ep
                             and old.owner(key) == src):
-                        pairs.append((key, src, ep))
+                        pairs.append((key, src, ep, size))
             with self._mig_lock:
                 self._migration["sources"] = sources
                 self._migration["total"] = len(pairs)
@@ -675,7 +828,11 @@ class RoutedStorePool:
             with self._mig_lock:
                 self._membership[ep] = "active"
                 self._old_ring = None
+                started = self._migration.get("started_at")
                 self._migration.update(state="done")
+                if started:
+                    self._migration["wall_s"] = round(
+                        time.monotonic() - started, 3)
                 self._refresh_membership_gauges()
 
     def _migrate_drain(self, ep: str, old: HashRing) -> None:
@@ -685,13 +842,13 @@ class RoutedStorePool:
             except Exception:  # noqa: BLE001 — draining a dead node:
                 # nothing to copy, its range recomputes (same outcome as
                 # the crash the drain exists to avoid)
-                keys = []
+                keys = {}
                 with self._mig_lock:
                     self._migration["errors"] = (
                         self._migration.get("errors", 0) + 1)
             pairs = [
-                (key, ep, self.ring.owner(key))
-                for key in keys
+                (key, ep, self.ring.owner(key), size)
+                for key, size in keys.items()
                 if old.owner(key) == ep
             ]
             with self._mig_lock:
@@ -702,7 +859,11 @@ class RoutedStorePool:
                 node = self._nodes.pop(ep, None)
                 self._membership.pop(ep, None)
                 self._old_ring = None
+                started = self._migration.get("started_at")
                 self._migration.update(state="done")
+                if started:
+                    self._migration["wall_s"] = round(
+                        time.monotonic() - started, 3)
                 self._g_member.labels(ep).set(0.0)
                 self._refresh_membership_gauges()
             if node is not None:

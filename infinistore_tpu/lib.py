@@ -1376,6 +1376,22 @@ class Connection:
         _raise_for_status(status, "list_keys")
         return json.loads(body.decode())
 
+    def list_keys_sizes(self, limit: int = 0):
+        """``[(key, size), ...]`` for every retrievable key, or ``None``
+        when the server predates LIST_KEYS_F_SIZES (it ignores the
+        trailing flags i32 and answers names-only — the caller falls
+        back to the per-key path).  Sizes let the batched migration
+        plane group descriptor reads by exact entry size."""
+        status, body = self._request(
+            P.OP_LIST_KEYS,
+            P.pack_list_keys(limit, P.LIST_KEYS_F_SIZES),
+        )
+        _raise_for_status(status, "list_keys_sizes")
+        rows = json.loads(body.decode())
+        if rows and not isinstance(rows[0], list):
+            return None  # pre-flag server: names-only response
+        return [(k, int(sz)) for k, sz in rows]
+
     def register_mr(self, ptr: int, size: int) -> int:
         """Record a client buffer region for zero-copy ops.  No NIC to
         register with on a TPU-VM; kept for API parity and sanity checks
@@ -1711,6 +1727,12 @@ class InfinityConnection:
         """Every retrievable key on the server, both tiers (wire
         OP_LIST_KEYS; python runtimes only)."""
         return self._call("list_keys", limit)
+
+    def list_keys_sizes(self, limit: int = 0):
+        """``[(key, size), ...]`` for every retrievable key, or ``None``
+        from a server that predates the sizes flag (the migration plane
+        then falls back to per-key copies)."""
+        return self._call("list_keys_sizes", limit)
 
     def evict(self, min_threshold: float, max_threshold: float) -> None:
         """Run one eviction pass with explicit thresholds (wire OP_EVICT).

@@ -84,7 +84,16 @@ OP_RELEASE_DESC = 17
 # answer INVALID_REQ if one arrives — the python-runtime-only rule the
 # trace/stats dumps already follow).  Body: optional u32 cap (0 = server
 # cap); response body: JSON list of key strings.
+#
+# Body extension (reshape plane): an optional SECOND i32 of flags after
+# the cap.  ``unpack_i32`` reads from offset 0 and ignores trailing
+# bytes, so a server that predates the flag sees a plain capped listing
+# — the same trailing-bytes extension point the HELLO trailer uses.
+# With LIST_KEYS_F_SIZES set, a flag-aware server answers
+# ``[[key, size], ...]`` instead of ``[key, ...]``; callers detect the
+# response shape and fall back, so either side may be old.
 OP_LIST_KEYS = 18
+LIST_KEYS_F_SIZES = 1
 
 _OP_NAMES = {
     OP_HELLO: "HELLO",
@@ -537,6 +546,15 @@ pack_i32 = _I32.pack
 def unpack_i32(buf) -> int:
     (v,) = _I32.unpack_from(buf, 0)
     return v
+
+
+def pack_list_keys(limit: int = 0, flags: int = 0) -> bytes:
+    """LIST_KEYS body.  ``flags == 0`` emits the legacy 4-byte form so
+    the frame stays byte-identical for existing callers; a nonzero flag
+    rides as a trailing i32 that pre-flag servers ignore."""
+    if not flags:
+        return _I32.pack(limit)
+    return _I32.pack(limit) + _I32.pack(flags)
 
 
 pack_u64 = _U64.pack

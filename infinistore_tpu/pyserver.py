@@ -84,10 +84,42 @@ class FaultInjector:
     stalled connections poll rule liveness, so ``clear()`` releases them.
     """
 
+    # named scenarios: canned rule sets for the failure walks the docs
+    # promise (docs/robustness.md §4), armed by name via POST /faults
+    # {"scenario": ...} so a chaos driver or an operator drill never
+    # re-derives the op list.  ``migration_receiver_slow`` is the
+    # reshape plane's slow_op rule: it delays every op a batched
+    # migration lands on the RECEIVING store (the alloc reservation,
+    # the atomic inline frame, the shm commit), stretching the copy
+    # window the receiver-death chaos walk kills into.
+    # ``compaction_disk_fault`` fails spill-tier I/O under a running
+    # compaction until the tier degrades DRAM-only.
+    SCENARIOS = {
+        "migration_receiver_slow": [
+            {"op": "ALLOC_PUT", "action": "delay", "delay_s": 0.25},
+            {"op": "PUT_INLINE_BATCH", "action": "delay", "delay_s": 0.25},
+            {"op": "COMMIT_PUT", "action": "delay", "delay_s": 0.25},
+        ],
+        "compaction_disk_fault": [
+            {"op": "DISK", "action": "disk_error", "times": 8},
+        ],
+    }
+
     def __init__(self):
         self._lock = threading.Lock()
         self._rules: List[dict] = []
         self._next_id = 1
+
+    def arm_scenario(self, name: str) -> int:
+        """Arm a named canned rule set (replaces the active rules, like
+        ``arm``)."""
+        rules = self.SCENARIOS.get(name)
+        if rules is None:
+            raise ValueError(
+                f"unknown fault scenario {name!r}; have "
+                f"{sorted(self.SCENARIOS)}"
+            )
+        return self.arm([dict(r) for r in rules])
 
     def arm(self, rules) -> int:
         """Replace the active rule set; returns how many rules are armed.
@@ -357,10 +389,17 @@ class StoreServer:
                         "Corrupt spill pages caught by checksum at promote "
                         "and dropped (a counted miss, never served bytes)",
                         fn=lambda: st.disk.verify_failures)
-            # per-slab occupancy (ROADMAP 4c groundwork): fill fraction
-            # per sizeclass spill slab — the signal the future
-            # compaction pass acts on.  Synced at scrape time next to
-            # the usage families.
+            reg.counter("istpu_store_compaction_slabs_total",
+                        "Low-fill spill slabs compacted and truncated by "
+                        "the background tier worker",
+                        fn=lambda: st.disk.compacted_slabs)
+            reg.counter("istpu_store_compaction_bytes_total",
+                        "Spill-file bytes released to the filesystem by "
+                        "background slab compaction",
+                        fn=lambda: st.disk.compacted_bytes)
+            # per-slab occupancy: fill fraction per sizeclass spill
+            # slab — the signal the compaction pass above acts on.
+            # Synced at scrape time next to the usage families.
             self._g_slab_fill = reg.gauge(
                 "istpu_store_spill_slab_fill",
                 "Used/allocated slot fraction per sizeclass spill slab "
@@ -551,8 +590,10 @@ class StoreServer:
         driven demotion passes (cold committed entries move to disk
         while the pool is above the watermark — so pressure eviction
         finds room already made, and demotion NEVER runs on the put
-        critical path) plus periodic manifest saves, so a crash loses at
-        most a couple of seconds of spill index."""
+        critical path), paced slab-compaction slides (low-fill spill
+        files slide tight and truncate, at most ``ISTPU_COMPACT_RATE``
+        bytes per second of wall clock), plus periodic manifest saves,
+        so a crash loses at most a couple of seconds of spill index."""
         if self.store.disk is None or self._tier_task is not None:
             return
 
@@ -561,6 +602,7 @@ class StoreServer:
             while True:
                 try:
                     n = st.demote_step()
+                    st.compact_step()
                     st.disk.maybe_save(2.0)
                     await asyncio.sleep(0.05 if n else 0.5)
                 except asyncio.CancelledError:
@@ -798,6 +840,13 @@ class StoreServer:
             )
         if op == P.OP_LIST_KEYS:
             limit = P.unpack_i32(body) if len(body) >= 4 else 0
+            # trailing-i32 flags extension (reshape plane): pre-flag
+            # clients send 4 bytes and get the legacy names-only list
+            flags = P.unpack_i32(body[4:]) if len(body) >= 8 else 0
+            if flags & P.LIST_KEYS_F_SIZES:
+                return P.pack_resp(
+                    P.FINISH, json.dumps(st.list_keys_sizes(limit)).encode()
+                )
             return P.pack_resp(
                 P.FINISH, json.dumps(st.list_keys(limit)).encode()
             )

@@ -277,8 +277,15 @@ def _manage_handler(server_ref):
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     body = json.loads(self.rfile.read(n) or b"[]")
-                    rules = body.get("rules", []) if isinstance(body, dict) else body
-                    armed = srv.faults.arm(rules)
+                    if isinstance(body, dict) and body.get("scenario"):
+                        # canned rule set by name (the documented
+                        # failure-walk scenarios)
+                        armed = srv.faults.arm_scenario(
+                            str(body["scenario"]))
+                    else:
+                        rules = body.get("rules", []) \
+                            if isinstance(body, dict) else body
+                        armed = srv.faults.arm(rules)
                 except (ValueError, TypeError) as e:
                     self._json({"error": str(e)}, 400)
                     return

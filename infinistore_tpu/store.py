@@ -258,6 +258,29 @@ class _Slab:
         self._f.truncate(0)
         self.slots = 0
 
+    def shrink(self, new_slots: int) -> None:
+        """Give the file's tail back to the filesystem — compaction's
+        final step.  Caller guarantees every slot >= ``new_slots`` is
+        free; never grows.  Raises OSError on the truncate (the caller's
+        I/O-failure path), leaving the slab usable at its old size."""
+        new_slots = max(new_slots, 0)
+        if new_slots >= self.slots:
+            return
+        if self._map is not None:
+            self._map.close()
+            self._map = None
+        try:
+            self._f.truncate(new_slots * self.slot_size)
+        except OSError:
+            if self.slots:
+                self._remap()  # restore the old mapping; nothing changed
+            raise
+        self.slots = new_slots
+        self.free = [s for s in self.free if s < new_slots]
+        self._next = min(self._next, new_slots)
+        if self.slots:
+            self._remap()
+
     def close(self) -> None:
         if self._map is not None:
             self._map.close()
@@ -305,6 +328,13 @@ class DiskTier:
         self.verify_failures = 0
         self.orphans_reaped = 0
         self.warm_entries = 0
+        # background compaction (the consumer of the per-slab fill
+        # signal): slabs truncated, file bytes released, payload bytes
+        # slid, and the sizeclass the last pass worked on
+        self.compacted_slabs = 0
+        self.compacted_bytes = 0
+        self.compact_moved_bytes = 0
+        self._compact_cls: Optional[int] = None
         self.fault: Optional[Callable[[str], None]] = None
         self.corrupt_sink: Optional[Callable[[bytes], None]] = None
         # usage attribution: fired on EVERY index insert/remove with
@@ -519,6 +549,101 @@ class DiskTier:
             return False
         return True
 
+    # -- background compaction (the slab-fill signal's consumer) --
+
+    def compact_step(self, fill_threshold: float = 0.5,
+                     budget_bytes: int = 32 << 20) -> int:
+        """One paced compaction slide: pick the lowest-fill slab under
+        ``fill_threshold``, move its tail records down into free head
+        slots (checksum-verified, at most ``budget_bytes`` of payload
+        per call), and — once the tail is clear — truncate the file.
+
+        Crash-safe by ordering, never by fsync: the manifest is saved
+        BEFORE any slot is overwritten (so every head slot written to is
+        unreferenced by the persisted index) and again before the
+        truncate (so no persisted record points past the new end of
+        file).  A kill anywhere in between replays to records whose
+        bytes are intact — or, at worst, to entries lost since the last
+        save, the tier's existing crash contract.  Torn bytes never
+        promote: the per-record checksum quarantines them.
+
+        Returns file bytes released (0 = nothing eligible, budget spent
+        mid-slide — progress is kept — or the disk is degraded)."""
+        if self.degraded():
+            return 0
+        # eligibility: a grown file whose aggregate fill dropped under
+        # the threshold with at least one grow-batch of slack, so a slab
+        # hovering at its high-water mark never thrashes shrink/grow
+        best = None
+        for cls, slab in self._slabs.items():
+            if not slab.slots or slab.slots - slab.used() < slab._grow:
+                continue
+            fill = slab.used() / slab.slots
+            if fill >= fill_threshold:
+                continue
+            if best is None or fill < best[0]:
+                best = (fill, cls, slab)
+        if best is None:
+            self._compact_cls = None
+            return 0
+        _fill, cls, slab = best
+        self._compact_cls = cls
+        target = slab.used()  # every record fits below this mark
+        tail = sorted(
+            ((k, rec) for k, rec in self.index.items()
+             if rec.cls == cls and rec.slot >= target),
+            key=lambda kr: kr[1].slot,
+        )
+        try:
+            if self._dirty:
+                # persist BEFORE overwriting any free slot: every head
+                # slot this pass fills is now unreferenced on disk
+                self.save_manifest()
+            moved = 0
+            if tail:
+                head_free = sorted(
+                    (s for s in slab.free if s < target), reverse=True)
+                for key, rec in tail:
+                    if moved >= budget_bytes:
+                        self.compact_moved_bytes += moved
+                        return 0  # budget spent; next tick continues
+                    self._io("read")
+                    data = slab.read(rec.slot, rec.size)
+                    if _checksum.checksum(data, self.alg) != rec.crc:
+                        # quarantine exactly like a failed promote
+                        self.pop(key)
+                        self.verify_failures += 1
+                        if self.corrupt_sink is not None:
+                            self.corrupt_sink(key)
+                        continue
+                    new_slot = head_free.pop()
+                    self._io("write")
+                    slab.write(new_slot, data)
+                    slab.free.remove(new_slot)
+                    slab.free.append(rec.slot)
+                    rec.slot = new_slot
+                    self._dirty = True
+                    moved += rec.size
+            self.compact_moved_bytes += moved
+            # tail clear: persist the slid index, THEN give the file
+            # tail back
+            high = max((rec.slot for rec in self.index.values()
+                        if rec.cls == cls), default=-1)
+            new_slots = high + 1
+            freed = (slab.slots - new_slots) * cls
+            if freed <= 0:
+                return 0
+            self.save_manifest()
+            slab.shrink(new_slots)
+        except OSError:
+            self._io_failed()
+            return 0
+        self._io_ok()
+        self._dirty = True
+        self.compacted_slabs += 1
+        self.compacted_bytes += freed
+        return freed
+
     def _spill_files(self) -> List[str]:
         try:
             return [f for f in os.listdir(self.path)
@@ -606,9 +731,18 @@ class DiskTier:
             "orphans_reaped": self.orphans_reaped,
             "warm_entries": self.warm_entries,
             "degraded": self.degraded(),
-            # per-slab occupancy (the future compaction pass's signal):
-            # slots allocated in the file vs slots actually holding a
-            # record — fill << 1.0 on a grown slab is reclaimable space
+            # the compaction pass that consumes the fill signal below:
+            # slabs truncated, file bytes released, payload bytes slid,
+            # and the sizeclass the current/last pass worked on
+            "compaction": {
+                "slabs": self.compacted_slabs,
+                "bytes": self.compacted_bytes,
+                "moved_bytes": self.compact_moved_bytes,
+                "active_cls": self._compact_cls,
+            },
+            # per-slab occupancy (the compaction pass's signal): slots
+            # allocated in the file vs slots actually holding a record —
+            # fill << 1.0 on a grown slab is reclaimable space
             "sizeclasses": {
                 str(cls): {
                     "slots": slab.slots, "used": slab.used(),
@@ -729,6 +863,19 @@ class Store:
             getattr(config, "disk_doa_gate", 0)
             or os.environ.get("ISTPU_DISK_DOA_GATE", 0) or 0.8
         )
+        # background slab compaction: a sizeclass whose aggregate fill
+        # drops under ``compact_fill`` gets its lowest-fill slab slid
+        # and truncated, paced at ``compact_rate`` payload bytes/s so
+        # the pass never starves foreground ops.  Rate 0 = off.
+        self.compact_fill = float(
+            getattr(config, "compact_fill", 0)
+            or os.environ.get("ISTPU_COMPACT_FILL", 0) or 0.5
+        )
+        self.compact_rate = float(
+            getattr(config, "compact_rate", 0)
+            or os.environ.get("ISTPU_COMPACT_RATE", 0) or (32 << 20)
+        )
+        self._compact_last_t: Optional[float] = None
         # per-account usage ledger (usage.py): byte·seconds of occupancy
         # per tier, hits/evictions/DOA per account, shared-prefix bytes
         # split across sharer sets.  Initialized here so hand-built test
@@ -974,6 +1121,22 @@ class Store:
             self.disk._io_failed()
         return done
 
+    def compact_step(self, now: Optional[float] = None) -> int:
+        """One paced background-compaction slide (tier-worker cadence):
+        converts wall clock into a byte budget at ``compact_rate`` and
+        hands it to the tier.  Returns spill-file bytes released."""
+        if self.disk is None or self.compact_rate <= 0:
+            return 0
+        now = self._clock() if now is None else now
+        last = self._compact_last_t
+        self._compact_last_t = now
+        if last is None:
+            return 0  # first tick only arms the clock
+        budget = int(self.compact_rate * min(max(now - last, 0.0), 1.0))
+        if budget <= 0:
+            return 0
+        return self.disk.compact_step(self.compact_fill, budget)
+
     def list_keys(self, limit: int = 0) -> List[str]:
         """Every retrievable key, both tiers (wire OP_LIST_KEYS — the
         migration plane's enumeration primitive).  Bounded: 0 means the
@@ -990,6 +1153,24 @@ class Store:
                     break
                 if k not in self.kv:
                     out.append(k.decode(errors="replace"))
+        return out
+
+    def list_keys_sizes(self, limit: int = 0) -> List[list]:
+        """``[[key, size], ...]`` across both tiers — the sized form of
+        ``list_keys`` (LIST_KEYS_F_SIZES) that lets the migration plane
+        batch descriptor reads by exact entry size.  Same cap rules."""
+        cap = limit if 0 < limit < 100_000 else 100_000
+        out: List[list] = []
+        for k, e in self.kv.items():
+            if len(out) >= cap:
+                return out
+            out.append([k.decode(errors="replace"), e.size])
+        if self.disk is not None:
+            for k, rec in self.disk.index.items():
+                if len(out) >= cap:
+                    break
+                if k not in self.kv:
+                    out.append([k.decode(errors="replace"), rec.size])
         return out
 
     def _allocate(self, size: int, n: int):

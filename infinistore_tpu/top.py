@@ -464,7 +464,7 @@ class Console:
             )
         mig = cl.get("migration") or {}
         if mig.get("state") == "running":
-            out.append(
+            line = (
                 "  migration {} {}: {}/{} copied  {} skipped  {} errors"
                 .format(
                     mig.get("mode", "?"), mig.get("endpoint", "?"),
@@ -473,6 +473,16 @@ class Console:
                     int(mig.get("skipped", 0)), int(mig.get("errors", 0)),
                 )
             )
+            d_mb = self.deltas.setdefault("mig_bytes", _Delta()).update(
+                float(mig.get("bytes", 0) or 0))
+            if mig.get("bytes"):
+                line += "  {:.1f} MB ({}/frame)".format(
+                    float(mig["bytes"]) / 1e6,
+                    "-" if d_mb is None else f"+{d_mb / 1e6:.1f} MB",
+                )
+            if mig.get("migrate_gbps"):
+                line += "  {:.2f} GB/s".format(float(mig["migrate_gbps"]))
+            out.append(line)
         return out
 
     def _fleet(self, snap: Snapshot) -> List[str]:
@@ -664,6 +674,24 @@ class Console:
             if extras:
                 line += "   " + "  ".join(extras)
             out.append(line)
+            # -- background compaction: live pass + per-frame progress --
+            comp = disk.get("compaction") or {}
+            if (comp.get("active_cls") is not None or comp.get("slabs")
+                    or comp.get("bytes")):
+                d_cb = self.deltas.setdefault(
+                    "spill_comp", _Delta()).update(
+                        float(comp.get("bytes", 0) or 0)
+                        + float(comp.get("moved_bytes", 0) or 0))
+                out.append(
+                    "compaction      {}   slabs {:>4}  "
+                    "freed {:>8.1f} MB  {} /frame".format(
+                        "idle" if comp.get("active_cls") is None
+                        else f"cls {int(comp['active_cls'])}",
+                        int(comp.get("slabs", 0)),
+                        float(comp.get("bytes", 0) or 0) / 1e6,
+                        "-" if d_cb is None else f"+{d_cb / 1e3:.0f} KB",
+                    )
+                )
         doa = cache.get("dead_on_arrival",
                         snap.value("istpu_cache_dead_on_arrival_total"))
         evicted = cache.get("evicted", snap.value("istpu_store_evicted_total"))

@@ -94,6 +94,12 @@ METRICS = {
     # fleet bandwidth through the consistent-hash router
     "cluster_put_gbps": ("up", "cluster put GB/s (aggregate)"),
     "cluster_get_gbps": ("up", "cluster get GB/s (aggregate)"),
+    # the reshape plane (same leg): descriptor-batched membership
+    # migration throughput, with the per-key fallback's number kept as
+    # the comparison row — a round where the two converge means the
+    # batched path silently degraded to per-key copies
+    "migrate_gbps": ("up", "reshape migrate GB/s (batched)"),
+    "migrate_gbps_per_key": ("up", "reshape migrate GB/s (per-key)"),
 }
 
 

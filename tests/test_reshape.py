@@ -1,0 +1,742 @@
+"""The fast reshape plane: descriptor-batched node-to-node migration,
+background slab compaction, and the chaos contract that reshaping never
+loses or tears a byte.
+
+Units drive ``RoutedStorePool`` migration over in-memory connections
+that speak the full batched surface (sized listings + read_cache/
+write_cache), and ``DiskTier.compact_step`` directly — including the
+kill -9 crash windows the manifest-before-mutate ordering exists for.
+The live half boots a real 3+1-node store fleet, arms the
+``migration_receiver_slow`` fault scenario FIRST (house rule), and
+SIGKILLs the receiving node mid-batched-migration: zero lost bytes on
+the surviving nodes, zero torn records, lazy rebalance heals."""
+
+import ctypes
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import textwrap
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from infinistore_tpu.cluster import RoutedStorePool
+from infinistore_tpu.store import DISK_DEGRADE_AFTER, DiskTier
+from infinistore_tpu.utils import metrics as m
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BLK = 4096
+
+
+# ---------------------------------------------------------------------------
+# batched migration units over fake connections
+# ---------------------------------------------------------------------------
+
+
+STORES = {}
+
+
+class BatchedFakeConn:
+    """test_membership's FakeConn plus the batched surface the
+    descriptor-batched migration negotiates: sized listings and
+    read_cache/write_cache over an in-memory dict per endpoint.
+    write_cache is frame-atomic (stage all, then commit all) — the same
+    torn-run contract the real server gives one inline batch frame."""
+
+    def __init__(self, ep):
+        self.ep = ep
+
+    def connect(self):
+        if STORES.get(self.ep) is None:
+            raise ConnectionError(f"{self.ep} unreachable")
+
+    def close(self):
+        pass
+
+    def list_keys(self, limit=0):
+        return list(STORES[self.ep])
+
+    def list_keys_sizes(self, limit=0):
+        return [(k, len(v)) for k, v in STORES[self.ep].items()]
+
+    def check_exist(self, key):
+        return key in STORES[self.ep]
+
+    def tcp_read_cache(self, key):
+        from infinistore_tpu.lib import InfiniStoreKeyNotFound
+
+        if key not in STORES[self.ep]:
+            raise InfiniStoreKeyNotFound(key)
+        return np.frombuffer(STORES[self.ep][key], dtype=np.uint8).copy()
+
+    def tcp_write_cache(self, key, ptr, size):
+        STORES[self.ep][key] = bytes(
+            (ctypes.c_ubyte * size).from_address(ptr))
+
+    def read_cache(self, blocks, block_size, ptr):
+        from infinistore_tpu.lib import InfiniStoreKeyNotFound
+
+        for key, off in blocks:
+            if key not in STORES[self.ep]:
+                raise InfiniStoreKeyNotFound(key)
+            data = STORES[self.ep][key]
+            ctypes.memmove(ptr + off, data, len(data))
+
+    def write_cache(self, blocks, block_size, ptr):
+        staged = {
+            key: bytes((ctypes.c_ubyte * block_size).from_address(ptr + off))
+            for key, off in blocks
+        }
+        STORES[self.ep].update(staged)  # commit point: all or nothing
+
+
+def _fake_pool(n=3, conn_factory=BatchedFakeConn, **kw):
+    eps = [f"10.8.0.{i}:5000" for i in range(1, n + 1)]
+    for ep in eps:
+        STORES[ep] = {}
+    return RoutedStorePool(eps, conn_factory=conn_factory, **kw), eps
+
+
+def _seed(pool, n=200, size=256):
+    keys = [f"rs:k{i}#L0" for i in range(n)]
+    payloads = {}
+    for i, k in enumerate(keys):
+        payloads[k] = bytes([i % 251]) * size
+        STORES[pool.ring.owner(k)][k] = payloads[k]
+    return keys, payloads
+
+
+def _wait_idle(pool, timeout=15.0):
+    deadline = time.time() + timeout
+    while not pool.migration_idle():
+        assert time.time() < deadline, "migration did not finish"
+        time.sleep(0.02)
+
+
+def test_batched_join_moves_range_and_reports_throughput():
+    """A join over batched-capable peers rides the descriptor-batched
+    path end to end: every moved key travels in a batched run (byte
+    accounting proves it), the data lands byte-exact, and the report
+    carries the reshape-plane throughput fields."""
+    pool, eps = _fake_pool()
+    keys, payloads = _seed(pool, n=200, size=256)
+    new_ep = "10.8.0.9:5000"
+    STORES[new_ep] = {}
+    pool.join_node(new_ep)
+    _wait_idle(pool)
+    rep = pool.migration_report()
+    moved = [k for k in keys if pool.ring.owner(k) == new_ep]
+    assert moved and rep["errors"] == 0
+    assert rep["copied"] == len(moved)
+    # the batched path really carried the bytes: every copy batched,
+    # byte count exact, throughput derived
+    assert rep["batched"] == len(moved)
+    assert rep["bytes"] == len(moved) * 256
+    assert rep["migrate_gbps"] >= 0 and rep["keys_per_s"] > 0
+    assert rep["wall_s"] > 0
+    for k in moved:
+        assert STORES[new_ep][k] == payloads[k]
+    # and the new family counted the same bytes under the batched path
+    text = m.default_registry().to_prometheus_text()
+    assert 'istpu_cluster_migrate_bytes_total{path="batched"}' in text
+    pool.close()
+
+
+def test_vanished_key_mid_batch_is_skipped_not_torn():
+    """A source key deleted between enumeration and its batch read
+    (LRU aged out mid-migration) fails that ONE batch, which re-walks
+    per-key: the vanished key counts skipped, every other key in the
+    batch still lands byte-exact, zero errors — lazy rebalance heals."""
+    pool, eps = _fake_pool()
+    keys, payloads = _seed(pool, n=120, size=128)
+    new_ep = "10.8.0.9:5000"
+    STORES[new_ep] = {}
+    # arrange the vanish: drop one to-be-moved key right after the
+    # sized listing is taken
+    victim = {}
+    real_sizes = BatchedFakeConn.list_keys_sizes
+
+    def listing_then_vanish(self, limit=0):
+        rows = real_sizes(self, limit)
+        for k, _sz in rows:
+            owner = pool.ring.owner(k)
+            if owner == new_ep and k in STORES[self.ep] and not victim:
+                victim[k] = STORES[self.ep].pop(k)
+        return rows
+
+    BatchedFakeConn.list_keys_sizes = listing_then_vanish
+    try:
+        pool.join_node(new_ep)
+        _wait_idle(pool)
+    finally:
+        BatchedFakeConn.list_keys_sizes = real_sizes
+    assert victim, "no key vanished — the scenario never armed"
+    rep = pool.migration_report()
+    moved = [k for k in keys if pool.ring.owner(k) == new_ep]
+    vk = next(iter(victim))
+    assert rep["errors"] == 0
+    assert rep["skipped"] >= 1
+    assert rep["copied"] == len(moved) - 1
+    for k in moved:
+        if k == vk:
+            assert k not in STORES[new_ep]  # skipped, never torn
+        else:
+            assert STORES[new_ep][k] == payloads[k]
+    pool.close()
+
+
+def test_names_only_peer_falls_back_per_key():
+    """A peer without the sized-listing capability (old wire, or a
+    minimal test double) migrates over the per-key path: correct
+    bytes, zero batched copies."""
+
+    class NamesOnlyConn(BatchedFakeConn):
+        list_keys_sizes = None  # getattr finds None -> names-only
+        read_cache = None
+        write_cache = None
+
+    pool, eps = _fake_pool(conn_factory=NamesOnlyConn)
+    keys, payloads = _seed(pool, n=80, size=64)
+    new_ep = "10.8.0.9:5000"
+    STORES[new_ep] = {}
+    pool.join_node(new_ep)
+    _wait_idle(pool)
+    rep = pool.migration_report()
+    moved = [k for k in keys if pool.ring.owner(k) == new_ep]
+    assert rep["errors"] == 0 and rep["copied"] == len(moved)
+    assert rep["batched"] == 0, "no batched surface, no batched copies"
+    for k in moved:
+        assert STORES[new_ep][k] == payloads[k]
+    pool.close()
+
+
+def test_batch_write_failure_falls_back_and_heals():
+    """A transport error on the batched write (receiver hiccup) costs
+    that batch nothing: the run re-walks per-key and every byte still
+    arrives — the chaos walk's unit shape."""
+    fails = {"n": 0}
+    real_write = BatchedFakeConn.write_cache
+
+    def flaky_write(self, blocks, block_size, ptr):
+        fails["n"] += 1
+        raise ConnectionError("injected receiver hiccup")
+
+    BatchedFakeConn.write_cache = flaky_write
+    pool, eps = _fake_pool()
+    keys, payloads = _seed(pool, n=100, size=96)
+    new_ep = "10.8.0.9:5000"
+    STORES[new_ep] = {}
+    try:
+        pool.join_node(new_ep)
+        _wait_idle(pool)
+    finally:
+        BatchedFakeConn.write_cache = real_write
+    assert fails["n"] >= 1, "the batched write never fired"
+    rep = pool.migration_report()
+    moved = [k for k in keys if pool.ring.owner(k) == new_ep]
+    assert rep["errors"] == 0 and rep["copied"] == len(moved)
+    assert rep["batched"] == 0  # every batch fell back
+    for k in moved:
+        assert STORES[new_ep][k] == payloads[k]
+    pool.close()
+
+
+def test_drain_rides_batched_path_too():
+    pool, eps = _fake_pool()
+    keys, payloads = _seed(pool, n=150, size=512)
+    victim = eps[1]
+    owned = [k for k in keys if pool.ring.owner(k) == victim]
+    assert owned
+    pool.drain_node(victim)
+    _wait_idle(pool)
+    rep = pool.migration_report()
+    assert rep["mode"] == "drain" and rep["errors"] == 0
+    assert rep["copied"] == len(owned) == rep["batched"]
+    assert rep["bytes"] == len(owned) * 512
+    for k in owned:
+        assert STORES[pool.ring.owner(k)][k] == payloads[k]
+    pool.close()
+
+
+# ---------------------------------------------------------------------------
+# fault scenarios (the named slow_op rule)
+# ---------------------------------------------------------------------------
+
+
+def test_fault_scenarios_arm_by_name():
+    """The canned failure-walk rule sets: ``migration_receiver_slow``
+    delays exactly the ops a batched migration lands on the receiver;
+    ``compaction_disk_fault`` fails spill I/O; unknown names 400."""
+    from infinistore_tpu.pyserver import _DISK_ACTIONS, FaultInjector
+
+    fi = FaultInjector()
+    assert fi.arm_scenario("migration_receiver_slow") == 3
+    for op in ("ALLOC_PUT", "PUT_INLINE_BATCH", "COMMIT_PUT"):
+        act = fi.match(op)
+        assert act is not None and act["action"] == "delay", op
+        assert act["delay_s"] > 0
+    assert fi.match("GET_DESC") is None, "reads must not slow"
+    assert fi.arm_scenario("compaction_disk_fault") == 1
+    act = fi.match("DISK", actions=_DISK_ACTIONS)
+    assert act is not None and act["action"] == "disk_error"
+    with pytest.raises(ValueError):
+        fi.arm_scenario("no_such_walk")
+    fi.clear()
+
+
+# ---------------------------------------------------------------------------
+# slab compaction units
+# ---------------------------------------------------------------------------
+
+
+def _fill_tier(path, n=64, keep_every=5):
+    """A tier with one BLK sizeclass slab grown to ``n`` slots, then
+    80%-deleted: the low-fill shape compaction exists for.  Returns
+    (tier, resident payload dict)."""
+    t = DiskTier(str(path), 1 << 20, BLK)
+    data = {}
+    for i in range(n):
+        k = f"c{i}".encode()
+        v = bytes([i % 251]) * BLK
+        assert t.put(k, v)
+        data[k] = v
+    for i in range(n):
+        if i % keep_every:
+            t.pop(f"c{i}".encode())
+            del data[f"c{i}".encode()]
+    return t, data
+
+
+def test_compaction_releases_low_fill_slab_on_80pct_delete(tmp_path):
+    """THE acceptance scenario: fill, delete 80%, compact — the slab
+    file truncates, ≥1 slab counted, every resident key byte-exact, and
+    the state rides ``report()`` (the /debug/cache payload)."""
+    t, data = _fill_tier(tmp_path)
+    slab_path = tmp_path / f"spill_{BLK}.dat"
+    before = os.path.getsize(slab_path)
+    freed = 0
+    for _ in range(64):
+        freed += t.compact_step(0.5, 1 << 30)
+        if freed:
+            break
+    assert freed > 0 and t.compacted_slabs >= 1
+    assert os.path.getsize(slab_path) == before - freed
+    # the slab is tight now: exactly one slot per resident record
+    assert os.path.getsize(slab_path) == len(data) * BLK
+    for k, v in data.items():
+        assert t.get(k) == v
+    assert t.verify_failures == 0
+    rep = t.report()["compaction"]
+    assert rep["slabs"] >= 1 and rep["bytes"] == freed
+    assert rep["moved_bytes"] > 0
+    # and the compacted tier warm-boots byte-exact
+    t.close()
+    t2 = DiskTier(str(tmp_path), 1 << 20, BLK)
+    assert t2.warm_entries == len(data)
+    for k, v in data.items():
+        assert t2.get(k) == v
+    assert t2.verify_failures == 0
+    t2.close()
+
+
+def test_compaction_budget_paces_and_resumes(tmp_path):
+    """A budget smaller than the tail pauses the slide mid-pass (return
+    0, progress kept) and later calls finish it; resident keys stay
+    byte-exact at EVERY pause point."""
+    t, data = _fill_tier(tmp_path)
+    calls = freed = 0
+    while freed == 0:
+        calls += 1
+        assert calls < 64, "compaction never finished under budget"
+        freed = t.compact_step(0.5, 2 * BLK)
+        for k, v in data.items():  # consistent at every pause
+            assert t.get(k) == v
+    assert calls > 1, "the budget never paced the slide"
+    assert freed > 0 and t.compacted_slabs == 1
+    t.close()
+
+
+def test_compaction_leaves_healthy_slabs_alone(tmp_path):
+    """Full slabs and slabs without a grow-batch of slack never
+    compact — the anti-thrash guard."""
+    t = DiskTier(str(tmp_path), 1 << 20, BLK)
+    for i in range(32):
+        assert t.put(f"h{i}".encode(), bytes([i]) * BLK)
+    assert t.compact_step(0.5, 1 << 30) == 0  # fill 1.0
+    for i in range(4):  # fill 28/32 — above threshold AND under slack
+        t.pop(f"h{i}".encode())
+    assert t.compact_step(0.5, 1 << 30) == 0
+    assert t.compacted_slabs == 0
+    t.close()
+
+
+def test_compaction_during_disk_fault_degrades_not_corrupts(tmp_path):
+    """FaultInjector action FIRST: spill I/O fails under a running
+    compaction — the pass stops (counted), enough consecutive failures
+    degrade the tier DRAM-only, and when the disk recovers the resident
+    data is byte-exact and the compaction completes."""
+    clock = [0.0]
+    t = DiskTier(str(tmp_path), 1 << 20, BLK, clock=lambda: clock[0])
+    data = {}
+    for i in range(64):
+        k, v = f"c{i}".encode(), bytes([i % 251]) * BLK
+        assert t.put(k, v)
+        data[k] = v
+    for i in range(64):
+        if i % 5:
+            t.pop(f"c{i}".encode())
+            del data[f"c{i}".encode()]
+    boom = [True]
+
+    def fault(kind):
+        if boom[0]:
+            raise OSError(5, "injected EIO")
+
+    t.fault = fault
+    for _ in range(DISK_DEGRADE_AFTER):
+        assert t.compact_step(0.5, 1 << 30) == 0
+    assert t.io_errors >= DISK_DEGRADE_AFTER and t.degraded()
+    assert t.compact_step(0.5, 1 << 30) == 0  # degraded: no disk touch
+    # disk recovers, cooldown passes: the pass completes and the data
+    # was never torn
+    boom[0] = False
+    clock[0] += 1e6
+    freed = 0
+    for _ in range(16):
+        freed += t.compact_step(0.5, 1 << 30)
+        if freed:
+            break
+    assert freed > 0
+    for k, v in data.items():
+        assert t.get(k) == v
+    assert t.verify_failures == 0
+    t.close()
+
+
+_CHILD = textwrap.dedent("""\
+    import os, signal, sys
+    sys.path.insert(0, sys.argv[3])
+    from infinistore_tpu.store import DiskTier, _Slab
+    path, phase = sys.argv[1], sys.argv[2]
+    BLK = 4096
+    t = DiskTier(path, 1 << 20, BLK)
+    if phase == "fill":
+        for i in range(64):
+            assert t.put(("c%d" % i).encode(), bytes([i % 251]) * BLK)
+        for i in range(64):
+            if i % 5:
+                t.pop(("c%d" % i).encode())
+        t.save_manifest()
+        os._exit(0)
+    if phase == "kill_mid_slide":
+        # tiny budget: one record slides (index mutated in memory, old
+        # manifest still points at old slots), then die
+        t.compact_step(0.9, 1)
+        os.kill(os.getpid(), signal.SIGKILL)
+    if phase == "kill_before_truncate":
+        # die in the window between the manifest save (new slots) and
+        # the file truncate
+        _Slab.shrink = lambda self, n: os.kill(os.getpid(), signal.SIGKILL)
+        t.compact_step(0.9, 1 << 30)
+""")
+
+
+def _run_child(tmp_path, phase, expect_kill):
+    proc = subprocess.run(
+        [sys.executable, "-c", _CHILD, str(tmp_path), phase, REPO],
+        capture_output=True, timeout=60,
+    )
+    if expect_kill:
+        assert proc.returncode == -signal.SIGKILL, (
+            phase, proc.returncode, proc.stderr.decode())
+    else:
+        assert proc.returncode == 0, (phase, proc.stderr.decode())
+
+
+@pytest.mark.parametrize("crash_phase", ["kill_mid_slide",
+                                         "kill_before_truncate"])
+def test_manifest_replays_after_kill9_mid_compaction(tmp_path, crash_phase):
+    """kill -9 inside BOTH compaction crash windows — mid-slide (old
+    manifest, old slots, bytes untouched) and post-save/pre-truncate
+    (new manifest, new slots, file untruncated): the warm restart
+    replays every resident record byte-exact, zero verify failures,
+    and the restarted tier finishes the compaction."""
+    _run_child(tmp_path, "fill", expect_kill=False)
+    _run_child(tmp_path, crash_phase, expect_kill=True)
+    expected = {f"c{i}".encode(): bytes([i % 251]) * BLK
+                for i in range(64) if i % 5 == 0}
+    t = DiskTier(str(tmp_path), 1 << 20, BLK)
+    assert t.warm_entries == len(expected)
+    for k, v in expected.items():
+        assert t.get(k) == v, f"{k} torn or lost across {crash_phase}"
+    assert t.verify_failures == 0
+    # the survivor finishes the job
+    freed = 0
+    for _ in range(64):
+        freed += t.compact_step(0.5, 1 << 30)
+        if freed:
+            break
+    assert freed > 0
+    for k, v in expected.items():
+        assert t.get(k) == v
+    t.close()
+
+
+def test_store_compact_step_paces_by_rate(tmp_path):
+    """The Store-level wrapper converts wall clock into a byte budget
+    at ``compact_rate`` — and rate 0 is the kill switch."""
+    from test_store_unit import make_tiered_store
+
+    s = make_tiered_store(tmp_path, block_kb=4, disk_slots=256)
+    for i in range(64):
+        assert s.disk.put(f"c{i}".encode(), bytes([i % 251]) * BLK)
+    for i in range(64):
+        if i % 5:
+            s.disk.pop(f"c{i}".encode())
+    s.compact_fill = 0.5
+    s.compact_rate = 0
+    assert s.compact_step(now=0.0) == 0  # killed
+    s.compact_rate = float(2 * BLK)  # 2 records/s of budget
+    assert s.compact_step(now=1.0) == 0  # first tick arms the clock
+    freed = calls = 0
+    while freed == 0:
+        calls += 1
+        assert calls < 64
+        freed = s.compact_step(now=1.0 + calls)
+    assert calls > 1, "rate pacing never split the slide"
+    assert freed > 0 and s.disk.compacted_slabs == 1
+    s.close()
+
+
+# ---------------------------------------------------------------------------
+# live half: receiver SIGKILL mid-batched-migration
+# ---------------------------------------------------------------------------
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _boot(port, mport):
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "infinistore_tpu.server",
+         "--service-port", str(port), "--manage-port", str(mport),
+         "--prealloc-size", "1", "--minimal-allocate-size", "16",
+         "--log-level", "warning", "--backend", "python"],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    deadline = time.time() + 25
+    for p in (port, mport):
+        while True:
+            if proc.poll() is not None:
+                pytest.fail("store node failed to start")
+            try:
+                socket.create_connection(("127.0.0.1", p),
+                                         timeout=0.5).close()
+                break
+            except OSError:
+                if time.time() >= deadline:
+                    proc.kill()
+                    pytest.fail(f"store port {p} did not come up")
+                time.sleep(0.1)
+    return proc
+
+
+def _post_json(port, path, payload):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def test_receiver_sigkill_mid_batched_migration_no_lost_bytes():
+    """THE reshape chaos walk.  Fault action FIRST: the receiving node
+    is armed with the ``migration_receiver_slow`` scenario (the named
+    slow_op rule), holding the batched-migration window open; then
+    SIGKILL lands on it mid-copy.  The contract: the migration settles
+    without hanging, every byte is still served by the surviving nodes
+    (zero lost), nothing is torn (byte-exact reads THROUGH the window),
+    and removing the dead node (lazy rebalance) heals routing."""
+    KEY_BYTES = 16 << 10
+    N_KEYS = 240
+    ports = [(_free_port(), _free_port()) for _ in range(4)]
+    procs = [_boot(p, mp) for p, mp in ports]
+    members = [f"127.0.0.1:{p}" for p, _ in ports[:3]]
+    spare = f"127.0.0.1:{ports[3][0]}"
+    spare_proc, spare_mport = procs[3], ports[3][1]
+    pool = None
+    try:
+        pool = RoutedStorePool(members, op_timeout_s=5.0, replicas=1)
+        rng = np.random.RandomState(11)
+        payloads = {}
+        for i in range(N_KEYS):
+            k = f"chaos:k{i}#L0"
+            v = rng.randint(0, 256, KEY_BYTES, dtype=np.uint8).tobytes()
+            payloads[k] = v
+            node = pool.node(pool.ring.owner(k))
+            buf = np.frombuffer(v, dtype=np.uint8)
+            with node.lock:
+                node.ensure_connected()
+                node.conn.tcp_write_cache(k, buf.ctypes.data, len(v))
+
+        # FAULT FIRST (house rule): slow every op the batched copy
+        # lands on the receiver, so the window stays open
+        status, body = _post_json(spare_mport, "/faults",
+                                  {"scenario": "migration_receiver_slow"})
+        assert status == 200 and body["armed"] == 3, body
+
+        pool.join_node(spare)
+        # wait until the batched copy is demonstrably in flight
+        deadline = time.time() + 30
+        while True:
+            rep = pool.migration_report()
+            if rep.get("bytes", 0) > 0 or rep.get("copied", 0) > 0:
+                break
+            assert time.time() < deadline, rep
+            assert rep["state"] == "running", rep
+            time.sleep(0.02)
+
+        # reads are correct THROUGH the open window (old owner rides
+        # the candidate walk) — the in-flight-requests-succeed half
+        probe = [k for k in payloads][:8]
+        for k in probe:
+            got = None
+            for ep in pool.candidates(k):
+                node = pool.node_or_none(ep)
+                if node is None or ep == spare:
+                    continue
+                try:
+                    with node.lock:
+                        got = node.conn.tcp_read_cache(k).tobytes()
+                    break
+                except Exception:
+                    continue
+            assert got == payloads[k], f"mid-window read tore on {k}"
+
+        # the chaos action: the receiver dies mid-batched-migration
+        spare_proc.send_signal(signal.SIGKILL)
+        spare_proc.wait(timeout=10)
+        _wait_idle(pool, timeout=120)
+        rep = pool.migration_report()
+        assert rep["state"] == "done", rep
+
+        # lazy rebalance heals: forget the dead node; every key's owner
+        # reverts to a surviving node that still holds its bytes
+        pool.remove_endpoint(spare)
+        lost = torn = 0
+        for k, v in payloads.items():
+            owner = pool.ring.owner(k)
+            assert owner in members
+            node = pool.node(owner)
+            try:
+                with node.lock:
+                    node.ensure_connected()
+                    got = node.conn.tcp_read_cache(k).tobytes()
+            except Exception:
+                lost += 1
+                continue
+            if got != v:
+                torn += 1
+        assert lost == 0, f"{lost} keys lost to the receiver death"
+        assert torn == 0, f"{torn} keys torn by the receiver death"
+    finally:
+        if pool is not None:
+            pool.close()
+        for proc in procs:
+            if proc.poll() is None:
+                proc.terminate()
+        for proc in procs:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+
+# ---------------------------------------------------------------------------
+# istpu-top reshape rows (offline Console.frame fixture)
+# ---------------------------------------------------------------------------
+
+
+def test_console_renders_migration_throughput_and_compaction_rows():
+    """istpu-top's reshape surface: the migration line grows moved-bytes
+    and GB/s (with a per-frame byte delta), and the spill-tier block
+    gains a compaction row — pure ``Console.frame`` on synthetic
+    snapshots, no sockets."""
+    from infinistore_tpu.top import Console, Snapshot
+
+    def cluster(mig_bytes):
+        return {
+            "enabled": True, "replicas": 1, "vnodes": 64,
+            "hot": {"hot_after": 3, "tracked": 2, "hot": 0, "pinned": 0},
+            "replica_reads": {"hit": 0, "miss": 0},
+            "migration": {"state": "running", "mode": "join",
+                          "endpoint": "10.0.0.4:5000", "copied": 17,
+                          "skipped": 2, "errors": 0, "total": 40,
+                          "bytes": mig_bytes, "batched": 17,
+                          "migrate_gbps": 3.21},
+            "nodes": [
+                {"endpoint": "10.0.0.1:5000", "state": "closed",
+                 "membership": "active", "connected": True, "epoch": 1,
+                 "ownership": 0.6,
+                 "requests": {"ok": 10, "error": 0, "skipped": 0,
+                              "miss": 0}},
+            ],
+        }
+
+    def cache(comp_bytes, active_cls):
+        return {
+            "entries": 4, "hits": 1, "misses": 1, "hit_ratio": 0.5,
+            "disk": {
+                "capacity_bytes": 1 << 20, "slot_bytes": 1 << 18,
+                "entries": 64, "demoted": 64, "promoted": 0,
+                "compaction": {"slabs": 2, "bytes": comp_bytes,
+                               "moved_bytes": comp_bytes // 2,
+                               "active_cls": active_cls},
+            },
+        }
+
+    console = Console()
+    console.frame(Snapshot(cluster=cluster(4 << 20),
+                           cache=cache(10 << 20, 4096)))
+    out = console.frame(Snapshot(cluster=cluster(6 << 20),
+                                 cache=cache(12 << 20, 4096)))
+    # the pre-existing progress text survives untouched...
+    assert "migration join 10.0.0.4:5000: 17/40 copied" in out
+    # ...and grows throughput: total MB, per-frame delta, GB/s
+    assert "6.3 MB (+2.1 MB/frame)" in out
+    assert "3.21 GB/s" in out
+    # the compaction row: active sizeclass, slabs freed, byte flow
+    assert "compaction      cls 4096" in out
+    assert "slabs    2" in out
+    assert "freed     12.6 MB" in out
+    assert "+3146 KB /frame" in out
+
+    # idle pass with history still renders (frozen counters, zero delta)
+    out2 = Console().frame(Snapshot(cache=cache(12 << 20, None)))
+    out3 = console.frame(Snapshot(cache=cache(12 << 20, None)))
+    assert "compaction      idle" in out2
+    assert "+0 KB /frame" in out3
+    # a disk tier that never compacted renders no row at all
+    quiet = cache(0, None)
+    quiet["disk"]["compaction"] = {"slabs": 0, "bytes": 0,
+                                   "moved_bytes": 0, "active_cls": None}
+    assert "compaction" not in Console().frame(Snapshot(cache=quiet))
+    # migration without byte accounting (old server) keeps the old line
+    old = cluster(0)
+    old["migration"].pop("bytes")
+    old["migration"].pop("migrate_gbps")
+    f_old = Console().frame(Snapshot(cluster=old))
+    assert "migration join 10.0.0.4:5000: 17/40 copied" in f_old
+    assert "GB/s" not in f_old
