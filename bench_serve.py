@@ -30,6 +30,13 @@ client-observed 429 shed counts per lane, the server's
 ``GET /debug/admission`` shed/quota tallies, and the ``plateau`` flag
 (goodput at the highest offered rate held ≥50% of the curve's peak
 instead of collapsing), with ``goodput_plateau`` mirrored top-level.
+
+``--conversation`` switches the sweep to multi-turn session traffic
+(``SessionConfig`` in loadgen.py): rates become session arrivals/s and
+the record gains a ``sessions`` block — ``reprefill_waste_frac`` and
+``affinity_hit_rate`` (both mirrored top-level for the trend table)
+plus the client-observed per-turn TTFT slope, the three numbers of the
+cross-turn KV-persistence contract.
 """
 
 from __future__ import annotations
@@ -59,6 +66,24 @@ def parse_mix(s: str):
         w, p, m = part.split(":")
         mix.append((float(w), int(p), int(m)))
     return mix
+
+
+def parse_weighted_ints(s: str):
+    """``weight:value`` pairs, comma-separated — the turn-count and
+    turn-token mixes of ``--conversation`` (e.g. ``3:4,1:8`` = 3/4 of
+    sessions run 4 turns, 1/4 run 8)."""
+    out = []
+    for part in s.split(","):
+        w, v = part.split(":")
+        out.append((float(w), int(v)))
+    return out
+
+
+def parse_think(s: str):
+    """``lo:hi`` uniform think-time range in seconds (``0:0`` =
+    agent-loop speed)."""
+    lo, hi = s.split(":")
+    return (float(lo), float(hi))
 
 
 def parse_lanes(s: str):
@@ -299,6 +324,31 @@ def main(argv=None) -> int:
                     dest="quotas", metavar="TENANT:TOKS_PER_S[:BURST_S]",
                     help="--self-serve only: per-tenant token quotas "
                          "passed through to the in-process server")
+    ap.add_argument("--conversation", action="store_true",
+                    help="conversation mode: --rates become SESSION "
+                         "arrivals/s, each session runs its turns "
+                         "sequentially with per-turn context growth and "
+                         "a 'session' id end to end; the record gains a "
+                         "`sessions` block (reprefill_waste_frac, "
+                         "affinity_hit_rate, per-turn TTFT slope)")
+    ap.add_argument("--sessions", type=int, default=16,
+                    help="--conversation: sessions per rate point")
+    ap.add_argument("--turns", type=parse_weighted_ints,
+                    default=[(1.0, 4)],
+                    help="--conversation: weight:n_turns mix "
+                         "(default 1:4)")
+    ap.add_argument("--turn-tokens", type=parse_weighted_ints,
+                    default=[(1.0, 16)],
+                    help="--conversation: weight:new_user_tokens mix "
+                         "per turn (default 1:16)")
+    ap.add_argument("--system-prompt-len", type=int, default=32,
+                    help="--conversation: shared system-prompt tokens "
+                         "every session opens on")
+    ap.add_argument("--think", type=parse_think, default=(0.0, 0.0),
+                    help="--conversation: lo:hi uniform think-time "
+                         "seconds between turns (default 0:0)")
+    ap.add_argument("--conv-max-tokens", type=int, default=8,
+                    help="--conversation: max_tokens per turn")
     ap.add_argument("--timeout", type=float, default=120.0)
     ap.add_argument("--slo-ttft", type=float,
                     default=float(os.environ.get("ISTPU_SLO_TTFT_S", 2.0)),
@@ -372,8 +422,40 @@ def main(argv=None) -> int:
                 if not r["ok"]:
                     print(f"# warmup request failed: {r['error']}",
                           file=sys.stderr)
-        curve = sweep(url, base, args.rates, args.slo_ttft, args.slo_tpot,
-                      cooldown_s=args.cooldown, on_point=show)
+        if args.conversation:
+            # conversation sweep: open-loop SESSION arrivals per rate
+            # point, each point summarized like a load point (same
+            # lanes/goodput math over the per-turn results) PLUS the
+            # per-turn contract numbers from session_summary
+            from infinistore_tpu.loadgen import (SessionConfig,
+                                                 run_sessions,
+                                                 session_summary,
+                                                 summarize)
+
+            curve = []
+            for i, rate in enumerate(args.rates):
+                scfg = SessionConfig(
+                    rate=float(rate), n_sessions=args.sessions,
+                    process=args.process, seed=args.seed + i,
+                    turns=args.turns, think_s=args.think,
+                    system_prompt_len=args.system_prompt_len,
+                    turn_tokens=args.turn_tokens,
+                    max_tokens=args.conv_max_tokens, lanes=args.lanes,
+                    vocab=vocab, stream=not args.no_stream,
+                    timeout_s=args.timeout,
+                )
+                results, makespan = run_sessions(url, scfg)
+                point = summarize(results, makespan, args.slo_ttft,
+                                  args.slo_tpot, rate=float(rate))
+                point["sessions"] = session_summary(results)
+                curve.append(point)
+                show(point)
+                if args.cooldown and rate != args.rates[-1]:
+                    time.sleep(args.cooldown)
+        else:
+            curve = sweep(url, base, args.rates, args.slo_ttft,
+                          args.slo_tpot, cooldown_s=args.cooldown,
+                          on_point=show)
         # the step profiler's summary for the whole sweep (best-effort:
         # older servers have no /debug/engine) — host-stall share,
         # retrace pressure, dispatch counts next to the goodput curve
@@ -443,6 +525,37 @@ def main(argv=None) -> int:
                 payload = json.loads(r.read())
             if payload.get("enabled"):
                 usage_dbg = payload
+        except Exception:  # noqa: BLE001 — observability, not the bench
+            pass
+        # the session ledger's verdict (best-effort, same contract):
+        # lifetime waste/computed totals from /debug/sessions — against
+        # a fleet the decode workers hold the ledgers, so aggregate
+        # their endpoints too; the front door itself answers the
+        # affinity tallies via /debug/fleet
+        sessions_dbg = []
+        sess_targets = [url]
+        for s in (fleet_workers or {}).get("decode", ()):
+            sess_targets.append(f"http://127.0.0.1:{s.port}")
+        for tgt in sess_targets:
+            try:
+                import urllib.request
+
+                with urllib.request.urlopen(tgt + "/debug/sessions",
+                                            timeout=5) as r:
+                    payload = json.loads(r.read())
+                if payload.get("enabled"):
+                    sessions_dbg.append(payload)
+            except Exception:  # noqa: BLE001 — observability, not the bench
+                pass
+        fleet_sessions = None
+        try:
+            import urllib.request
+
+            with urllib.request.urlopen(url + "/debug/fleet",
+                                        timeout=5) as r:
+                payload = json.loads(r.read())
+            if payload.get("enabled"):
+                fleet_sessions = payload.get("sessions")
         except Exception:  # noqa: BLE001 — observability, not the bench
             pass
         # the reshape plane's verdict (best-effort, same contract):
@@ -566,6 +679,51 @@ def main(argv=None) -> int:
     # mirrored top-level (0/1) for the scripts/bench_history.py trend
     # table: an overload round whose plateau flag drops to 0 regressed
     record["goodput_plateau"] = int(plateau)
+    if args.conversation:
+        # sessions block (docs/observability.md §Session attribution):
+        # the persistence-contract numbers for the run — the fraction of
+        # computed prompt tokens that were re-prefill waste (down is
+        # good; a warm store holds it ~0), the session-affinity hit rate
+        # among RE-visits (up is good; fallback is every session's first
+        # placement, not a miss), and the client-observed per-turn TTFT
+        # slope at the top offered rate — with the first two mirrored
+        # top-level for scripts/bench_history.py
+        record["config"]["conversation"] = {
+            "sessions_per_rate": args.sessions,
+            "turns": [list(t) for t in args.turns],
+            "turn_tokens": [list(t) for t in args.turn_tokens],
+            "system_prompt_len": args.system_prompt_len,
+            "think_s": list(args.think),
+            "max_tokens": args.conv_max_tokens,
+        }
+        sess_block = {
+            "per_turn": (curve[-1].get("sessions") or {}).get("per_turn"),
+            "ttft_slope_ms_per_turn":
+                (curve[-1].get("sessions") or {})
+                .get("ttft_slope_ms_per_turn"),
+        }
+        if sessions_dbg:
+            waste = sum((p.get("totals") or {}).get("waste_tokens", 0)
+                        for p in sessions_dbg)
+            computed = sum(
+                (p.get("totals") or {}).get("computed_tokens", 0)
+                for p in sessions_dbg)
+            sess_block["waste_tokens"] = waste
+            sess_block["computed_tokens"] = computed
+            sess_block["reprefill_waste_frac"] = (
+                round(waste / computed, 4) if computed else 0.0)
+            record["reprefill_waste_frac"] = \
+                sess_block["reprefill_waste_frac"]
+        if fleet_sessions is not None:
+            aff = fleet_sessions.get("affinity") or {}
+            sess_block["affinity"] = aff
+            revisits = (aff.get("hit") or 0) + (aff.get("miss") or 0)
+            if revisits:
+                sess_block["affinity_hit_rate"] = round(
+                    (aff.get("hit") or 0) / revisits, 4)
+                record["affinity_hit_rate"] = \
+                    sess_block["affinity_hit_rate"]
+        record["sessions"] = sess_block
     if disagg is not None:
         # disaggregation block (docs/observability.md): per-role worker
         # counts, handoff leg percentiles, decode-pool adoption hit

@@ -59,6 +59,8 @@ SERVE_ENDPOINTS: Tuple[Tuple[str, str, str], ...] = (
     ("fleet", "/debug/fleet", "debug_fleet.json"),
     # the tenant usage ledger (per-tenant occupancy vs tokens saved)
     ("usage", "/debug/usage", "debug_usage.json"),
+    # the session ledger (per-conversation turn rows + re-prefill waste)
+    ("sessions", "/debug/sessions", "debug_sessions.json"),
 )
 STORE_ENDPOINTS: Tuple[Tuple[str, str, str], ...] = (
     ("metrics", "/metrics", "metrics.prom"),
@@ -301,6 +303,41 @@ def summarize_capture(cap: Dict[str, Any], top_n: int = 5) -> str:
                     + f"), evictions {t.get('evictions', 0)} "
                     f"doa {t.get('dead_on_arrival', 0)}"
                 )
+            lines.append("")
+
+    # -- the session ledger: is cross-turn context being re-paid? --
+    if serve:
+        sess = _json_of(serve, "sessions")
+        if sess and sess.get("enabled"):
+            lines.append("## Sessions / re-prefill waste")
+            tot = sess.get("totals") or {}
+            lines.append(
+                f"- {sess.get('recorded_sessions', 0)} sessions recorded "
+                f"({sess.get('active_sessions', 0)} active), "
+                f"{tot.get('turns', 0)} turns"
+            )
+            lines.append(
+                f"- waste {tot.get('waste_tokens', 0)} of "
+                f"{tot.get('computed_tokens', 0)} computed prompt tokens "
+                f"(**{tot.get('reprefill_waste_frac', 0.0):.1%}** "
+                f"re-prefill waste; reused "
+                f"{tot.get('reused_tokens', 0)} from local+store)"
+            )
+            worst = sorted(
+                (e for e in sess.get("sessions") or []
+                 if e.get("waste_tokens")),
+                key=lambda e: e["waste_tokens"], reverse=True,
+            )[:top_n]
+            for e in worst:
+                lines.append(
+                    f"- session {e.get('session')} (tenant "
+                    f"{e.get('tenant')}): {e.get('turns', 0)} turns, "
+                    f"ctx {e.get('max_prompt_tokens', 0)} tok, waste "
+                    f"{e.get('waste_tokens', 0)} tok"
+                )
+            if not worst:
+                lines.append("- no session paid re-prefill waste "
+                             "(the persistence contract held)")
             lines.append("")
 
     # -- slowest requests, joined to their steps and traces --

@@ -91,6 +91,11 @@ class Request:
     # (the label is then str(priority)); named tenants ("acme") ride
     # here while ``priority`` keeps carrying admission ORDER.
     tenant: Optional[str] = None
+    # conversation id (session-attribution plane): turns of one
+    # conversation share this id; the SessionLedger folds them into
+    # per-session turn rows and the re-prefill waste accounting.  None =
+    # single-shot traffic (no session bookkeeping at all).
+    session: Optional[str] = None
     adapter_id: int = 0  # LoRA adapter slot (0 = base model)
     # OpenAI logprobs: collect the chosen token's logprob + the top-k
     # alternatives per generated token (0 = off); records land in lp_data
@@ -150,7 +155,7 @@ class Scheduler:
                  spec_batch: int = 1,
                  ngram_spec: bool = False, spec_g: int = 2,
                  metrics: Optional[MetricsRegistry] = None,
-                 ledger=None,
+                 ledger=None, session_ledger=None,
                  slo_ttft_s: Optional[float] = None,
                  slo_tpot_s: Optional[float] = None,
                  stepprof=None, admission=None):
@@ -175,6 +180,10 @@ class Scheduler:
         # request that leaves the scheduler — retired, cancelled, or
         # dropped by fault_reset — is recorded exactly once
         self.ledger = ledger
+        # session-grain attribution (infinistore_tpu.sessions): requests
+        # carrying a session id additionally fold into their session's
+        # turn history at the same exit point.  None = no session plane.
+        self.session_ledger = session_ledger
         # SLO targets for the per-lane violation counters; None falls
         # back to env (ISTPU_SLO_TTFT_S / ISTPU_SLO_TPOT_S), which
         # itself defaults to 2 s TTFT / 250 ms TPOT — the bench-serve
@@ -305,6 +314,7 @@ class Scheduler:
         logit_bias: Optional[Dict[int, float]] = None,
         priority: int = 0,
         tenant: Optional[str] = None,
+        session: Optional[str] = None,
         adapter_id: int = 0,
         logprobs: int = 0,
         on_token: Optional[Callable[[List[int], bool], None]] = None,
@@ -367,7 +377,8 @@ class Scheduler:
             frequency_penalty=frequency_penalty,
             repetition_penalty=repetition_penalty, seed=seed,
             logit_bias=dict(logit_bias) if logit_bias else None,
-            priority=priority, tenant=tenant, adapter_id=adapter_id,
+            priority=priority, tenant=tenant, session=session,
+            adapter_id=adapter_id,
             logprobs=min(max(int(logprobs), 0), self.LOGPROBS_K),
             on_token=on_token, trace_id=trace_id,
         )
@@ -1068,6 +1079,11 @@ class Scheduler:
                 self.ledger.record(req, outcome)
             except Exception:  # noqa: BLE001 — observability must not
                 pass           # take the engine loop down
+        if self.session_ledger is not None:
+            try:
+                self.session_ledger.record_turn(req, outcome)
+            except Exception:  # noqa: BLE001 — same contract as above
+                pass
 
     def record_latency(self, req: Request) -> None:
         """Fold a finished request's stamps into the rolling latency

@@ -58,10 +58,11 @@ from __future__ import annotations
 
 import hashlib
 import json
+import os
 import threading
 import time
 import http.client
-from collections import deque
+from collections import OrderedDict, deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Tuple
 from urllib.parse import urlsplit
@@ -228,6 +229,22 @@ class FrontDoor:
         self.affinity_tokens = affinity_tokens
         self.stats = {"2xx": 0, "4xx": 0, "5xx": 0, "error": 0}
         self._handoff_ms: deque = deque(maxlen=512)  # recent leg times
+        # session-id decode affinity, LAYERED over the rendezvous prefix
+        # affinity: a conversation's first turn routes by prefix stem
+        # (fallback), every later turn goes back to the worker that
+        # served it — the worker whose PrefixPageCache holds the
+        # session's pages, so cross-turn reuse is LOCAL, not a store
+        # round-trip.  Bounded LRU (ISTPU_FD_SESSION_CAP sessions);
+        # losing an entry is safe — the next turn falls back to prefix
+        # affinity and re-pins, store adoption covers the reuse.
+        try:
+            self.session_cap = int(
+                os.environ.get("ISTPU_FD_SESSION_CAP", "") or 4096)
+        except ValueError:
+            self.session_cap = 4096
+        self.session_cap = max(1, self.session_cap)
+        self._session_map: "OrderedDict[str, str]" = OrderedDict()
+        self._session_lock = threading.Lock()
         self._register_metrics()
         self._stop = threading.Event()
         self._poller = threading.Thread(target=self._poll_loop,
@@ -282,6 +299,17 @@ class FrontDoor:
             "istpu_fd_decode_retries_total",
             "Decode dispatches that failed over to another worker",
         )
+        self._c_session_aff = reg.counter(
+            "istpu_serve_session_affinity_total",
+            "Session-carrying decode dispatches by placement result: "
+            "hit (served by the session's pinned worker), miss (pin "
+            "existed, another worker served — drain/failover; re-pinned "
+            "there), fallback (first turn / evicted pin — routed by "
+            "prefix affinity, then pinned)",
+            labelnames=("result",),
+        )
+        for res in ("hit", "miss", "fallback"):
+            self._c_session_aff.labels(res)
         self._c_abort = reg.counter(
             "istpu_fd_stream_aborts_total",
             "Streams cut mid-flight by a decode-worker failure after "
@@ -402,6 +430,25 @@ class FrontDoor:
         pool = usable or [w for w in self.decode
                           if w.breaker.state != "open"] or list(self.decode)
         return rendezvous_order(pool, stem)
+
+    def session_pin(self, session: Optional[str]) -> Optional[str]:
+        """The decode endpoint this session is pinned to (LRU-touched),
+        or None for unpinned/unknown sessions."""
+        if not session:
+            return None
+        with self._session_lock:
+            ep = self._session_map.get(session)
+            if ep is not None:
+                self._session_map.move_to_end(session)
+            return ep
+
+    def session_bind(self, session: str, endpoint: str) -> None:
+        """(Re)pin a session to the worker that just served it."""
+        with self._session_lock:
+            self._session_map[session] = endpoint
+            self._session_map.move_to_end(session)
+            while len(self._session_map) > self.session_cap:
+                self._session_map.popitem(last=False)
 
     # -- the prefill leg --
 
@@ -593,6 +640,16 @@ class FrontDoor:
                         "p99_ms": pct(0.99)},
             "adoption": {"store_tokens": store_tok,
                          "local_tokens": local_tok},
+            "sessions": {
+                "pinned": len(self._session_map),
+                "capacity": self.session_cap,
+                "affinity": {
+                    res: self.metrics.family_value(
+                        "istpu_serve_session_affinity_total",
+                        where={"result": res}) or 0.0
+                    for res in ("hit", "miss", "fallback")
+                },
+            },
             "requests": dict(self.stats),
         }
 
@@ -721,6 +778,18 @@ def _make_handler(fd: FrontDoor):
             stem = affinity_stem(body, fd.affinity_tokens)
             raw = json.dumps(body)
             cands = fd.decode_candidates(stem)
+            # session affinity layered over the rendezvous order: a
+            # pinned session's worker moves to the head of the SAME
+            # failover list — the pin makes placement fast, never
+            # correct (any decode worker adopts from the store)
+            sid = body.get("session")
+            sid = sid if isinstance(sid, str) and sid else None
+            pinned = fd.session_pin(sid)
+            if pinned is not None:
+                head = next((w for w in cands if w.endpoint == pinned),
+                            None)
+                if head is not None:
+                    cands = [head] + [w for w in cands if w is not head]
             attempts = 0
             with tracing.span("fd.decode_dispatch"):
                 for w in cands:
@@ -735,6 +804,17 @@ def _make_handler(fd: FrontDoor):
                     finally:
                         w.end()
                     if status is not None:
+                        if sid is not None:
+                            # result judged by who actually SERVED:
+                            # hit = the pinned worker; miss = a pin
+                            # existed but a survivor served (drain /
+                            # failover — re-pin there); fallback =
+                            # no pin yet (prefix-affinity placement)
+                            res = ("fallback" if pinned is None else
+                                   "hit" if w.endpoint == pinned
+                                   else "miss")
+                            fd._c_session_aff.labels(res).inc()
+                            fd.session_bind(sid, w.endpoint)
                         return status
                     # transport failure before any byte forwarded:
                     # fail over to the next affinity candidate

@@ -556,6 +556,41 @@ def mem_slope_rule(horizon_s: float = 600.0,
                         description="live device memory ramping to limit")
 
 
+def reprefill_waste_rule(budget_frac: float = 0.25,
+                         min_tokens: float = 4096.0,
+                         severity: str = "warn") -> WatchdogRule:
+    """The KV-persistence contract as an alert: of the prompt tokens
+    session turns COMPUTED in the window, more than ``budget_frac`` were
+    re-prefill waste — context a prior turn of the same session already
+    paid for (sessions.py derives both series).  A warm store holds the
+    fraction near 0; sustained waste means sessions are not finding
+    their pages (store churn, affinity collapse, store outage).  The
+    ``min_tokens`` volume guard keeps single tiny turns from paging."""
+    slow = burn_windows()[1]
+
+    def check(ring: TimeSeriesRing, now: float) -> Optional[dict]:
+        dc = ring.delta("serve.session_computed", slow, now)
+        if dc is None or dc < min_tokens:
+            return None  # too little session prefill volume to judge
+        dw = ring.delta("serve.reprefill_waste", slow, now) or 0.0
+        frac = dw / dc
+        if frac >= budget_frac:
+            return {
+                "reason": (
+                    f"{int(dw)} of {int(dc)} computed prompt tokens "
+                    f"were re-prefill waste ({frac:.0%} ≥ "
+                    f"{budget_frac:.0%}) in {int(slow)}s"
+                ),
+                "value": round(frac, 4),
+            }
+        return None
+
+    return WatchdogRule(
+        "reprefill_waste", severity, check,
+        description="session context recomputed despite the store",
+    )
+
+
 def default_serve_rules() -> List[WatchdogRule]:
     """The serving plane's watchdog set."""
     return [
@@ -568,6 +603,7 @@ def default_serve_rules() -> List[WatchdogRule]:
         retrace_rule(),
         host_stall_rule(),
         mem_slope_rule(),
+        reprefill_waste_rule(),
     ]
 
 
@@ -671,6 +707,14 @@ def serve_probes(server) -> Dict[str, Callable[[], Any]]:
         "serve.shed": admission("shed_total"),
         "serve.quota_throttled": admission("throttled_total"),
         "serve.admission_mode": admission("mode_code"),
+        # session-attribution series (infinistore_tpu/sessions.py): the
+        # ledger's lifetime waste/computed tallies feed the
+        # reprefill_waste rule as deltas; 0.0 (not None) so the series
+        # exists before the first session turn lands
+        "serve.reprefill_waste": lambda: float(getattr(
+            getattr(server, "sessions", None), "waste_tokens", 0)),
+        "serve.session_computed": lambda: float(getattr(
+            getattr(server, "sessions", None), "computed_tokens", 0)),
         "store.circuit": circuit,
         "store.streamer": streamer,
         "store.push_dropped": lambda: dreg.family_value(

@@ -30,6 +30,17 @@ Pieces:
 * ``sweep`` — the goodput-vs-rate curve: one ``run_load`` +
   ``summarize`` per arrival rate.
 
+Conversation mode (``SessionConfig`` / ``make_sessions`` /
+``run_sessions``) layers multi-turn sessions over the same open-loop
+pacer: SESSION arrivals are open-loop (Poisson/deterministic, exactly
+like single-shot requests), while turns WITHIN a session are closed-loop
+by construction — a user cannot type turn 3 before reading turn 2.
+Each turn's prompt is the prior context plus new user tokens and carries
+a ``"session"`` id, which is the traffic shape that makes the store
+tier's cross-turn KV persistence measurable (sessions.py derives the
+re-prefill waste from it).  ``session_summary`` reduces the per-turn
+results to the contract numbers: per-turn TTFT and its slope.
+
 ``bench_serve.py`` (repo root) is the CLI over this module; its
 ``--json-out`` record joins the bench-schema family
 (docs/observability.md).
@@ -372,6 +383,197 @@ def summarize(results: List[Dict[str, Any]], makespan_s: float,
         else 0.0,
         "tokens": sum(r.get("tokens") or 0 for r in results),
         "lanes": lanes,
+    }
+
+
+# -- conversation mode ------------------------------------------------------
+
+
+@dataclass
+class SessionConfig:
+    """One conversation run's shape.  ``rate`` paces SESSION arrivals
+    (the open-loop knob); turns inside a session run sequentially with
+    a think-time gap.  ``turns`` rows are ``(weight, n_turns)``;
+    ``turn_tokens`` rows are ``(weight, new_user_tokens)``; ``lanes``
+    rows are ``(lane, weight)`` exactly as in ``LoadConfig`` — lane
+    weights ARE the tenant-skewed session popularity."""
+
+    rate: float = 2.0          # session arrivals per second
+    n_sessions: int = 16
+    process: str = "poisson"
+    seed: int = 0
+    turns: Sequence[Tuple[float, int]] = ((1.0, 4),)
+    # uniform think-time range (seconds) between a reply and the next
+    # turn — 0 means agent-loop speed, humans are (2, 20)-ish
+    think_s: Tuple[float, float] = (0.0, 0.0)
+    # every session opens on the SAME shared system prompt: the
+    # population-wide prefix the store tier should serve once
+    system_prompt_len: int = 32
+    turn_tokens: Sequence[Tuple[float, int]] = ((1.0, 16),)
+    max_tokens: int = 8
+    lanes: Sequence[Tuple[Any, float]] = ((0, 1.0),)
+    vocab: int = 256
+    stream: bool = True
+    timeout_s: float = 120.0
+    extra_body: Dict[str, Any] = field(default_factory=dict)
+
+
+def make_sessions(cfg: SessionConfig) -> List[Dict[str, Any]]:
+    """The session population: per session a lane, a turn count, and
+    per-turn new-user-token runs + think times.  Deterministic in
+    ``cfg.seed`` (same discipline as ``make_requests``), so tests
+    assert the shape without a server."""
+    rng = random.Random(cfg.seed)
+    system = [rng.randrange(cfg.vocab)
+              for _ in range(max(0, cfg.system_prompt_len))]
+    lo, hi = cfg.think_s
+    out = []
+    for i in range(cfg.n_sessions):
+        _w, n_turns = _weighted_choice(rng, list(cfg.turns),
+                                       key=lambda r: r[0])
+        lane, _w = _weighted_choice(rng, list(cfg.lanes))
+        turns = []
+        for _t in range(max(1, int(n_turns))):
+            _w, ntok = _weighted_choice(rng, list(cfg.turn_tokens),
+                                        key=lambda r: r[0])
+            turns.append({
+                "user_tokens": [rng.randrange(cfg.vocab)
+                                for _ in range(max(1, int(ntok)))],
+                "think_s": round(rng.uniform(lo, hi), 6) if hi > 0
+                else 0.0,
+            })
+        out.append({
+            "session": f"s{cfg.seed}-{i:04d}",
+            "lane": lane if isinstance(lane, str) else int(lane),
+            "system": system,
+            "turns": turns,
+        })
+    return out
+
+
+def run_sessions(url: str, cfg: SessionConfig,
+                 clock: Callable[[], float] = time.monotonic,
+                 sleep: Callable[[float], None] = time.sleep,
+                 post: Optional[Callable[[Dict[str, Any]],
+                                         Dict[str, Any]]] = None
+                 ) -> Tuple[List[Dict[str, Any]], float]:
+    """Fire the session population open-loop: one thread per session at
+    its scheduled arrival, turns sequential inside it — each turn's
+    prompt is the accumulated context (system prompt + every prior
+    user turn) plus this turn's new tokens, carrying the ``"session"``
+    id end to end.  Returns ``(results, makespan_s)``, results ordered
+    session-major/turn-minor, each row tagged ``session``/``turn``/
+    ``prompt_tokens`` on top of the usual per-request fields."""
+    sessions = make_sessions(cfg)
+    offsets = arrival_offsets(cfg.rate, len(sessions), cfg.process,
+                              random.Random(cfg.seed))
+    do_post = post or (lambda b: _http_post(url, b, cfg.timeout_s))
+    per_session: List[List[Dict[str, Any]]] = [[] for _ in sessions]
+    threads: List[threading.Thread] = []
+    t0 = clock()
+
+    def converse(i: int, sess: Dict[str, Any], late_s: float) -> None:
+        context = list(sess["system"])
+        for t, turn in enumerate(sess["turns"], start=1):
+            if t > 1 and turn["think_s"]:
+                sleep(turn["think_s"])
+            context += turn["user_tokens"]
+            body = {
+                "prompt": list(context),
+                "max_tokens": int(cfg.max_tokens),
+                "temperature": 0,
+                "priority": sess["lane"],
+                "stream": bool(cfg.stream),
+                "session": sess["session"],
+            }
+            body.update(cfg.extra_body)
+            r = do_post(body)
+            r["session"] = sess["session"]
+            r["turn"] = t
+            r["prompt_tokens"] = len(context)
+            r["sched_off_s"] = round(offsets[i], 6)
+            r["late_s"] = round(late_s, 6) if t == 1 else 0.0
+            per_session[i].append(r)
+
+    for i, off in enumerate(offsets):
+        wait = off - (clock() - t0)
+        if wait > 0:
+            sleep(wait)
+        late = max(0.0, (clock() - t0) - off)
+        th = threading.Thread(target=converse,
+                              args=(i, sessions[i], late), daemon=True)
+        th.start()
+        threads.append(th)
+    for i, th in enumerate(threads):
+        # a session's worst case is every turn timing out back to back
+        think = sum(t["think_s"] for t in sessions[i]["turns"])
+        th.join(timeout=cfg.timeout_s * len(sessions[i]["turns"])
+                + think + 5)
+    makespan = clock() - t0
+    results: List[Dict[str, Any]] = []
+    for i, sess in enumerate(sessions):
+        rows = per_session[i]
+        results.extend(rows)
+        # a hung session leaves tombstones for its unreached turns
+        for t in range(len(rows) + 1, len(sess["turns"]) + 1):
+            results.append({
+                "ok": False, "status": 0, "error": "timeout",
+                "tokens": 0, "lane": sess["lane"], "rejected": False,
+                "retry_after_s": None, "ttft_s": None, "tpot_s": None,
+                "e2e_s": None, "session": sess["session"], "turn": t,
+                "prompt_tokens": None,
+                "sched_off_s": round(offsets[i], 6), "late_s": 0.0,
+            })
+    return results, makespan
+
+
+def session_summary(results: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Reduce conversation-mode results to the persistence-contract
+    numbers: per-turn completion counts and mean TTFT, plus the
+    least-squares TTFT-vs-turn slope — the one scalar that says "flat"
+    (store holding context across turns) or "growing" (every turn
+    re-prefilling).  Pure, so tests feed synthetic rows."""
+    by_turn: Dict[int, Dict[str, Any]] = {}
+    for r in results:
+        t = r.get("turn")
+        if t is None:
+            continue
+        d = by_turn.setdefault(int(t), {"n": 0, "completed": 0,
+                                        "ttfts": []})
+        d["n"] += 1
+        if r.get("ok"):
+            d["completed"] += 1
+            if r.get("ttft_s") is not None:
+                d["ttfts"].append(r["ttft_s"])
+    per_turn: Dict[str, Any] = {}
+    pts: List[Tuple[float, float]] = []
+    for t in sorted(by_turn):
+        d = by_turn[t]
+        mean = (sum(d["ttfts"]) / len(d["ttfts"])) if d["ttfts"] else None
+        per_turn[str(t)] = {
+            "n": d["n"], "completed": d["completed"],
+            "ttft_mean_ms": round(mean * 1e3, 2) if mean is not None
+            else None,
+        }
+        if mean is not None:
+            pts.append((float(t), mean))
+    slope = None
+    if len(pts) >= 2:
+        n = len(pts)
+        mx = sum(x for x, _ in pts) / n
+        my = sum(y for _, y in pts) / n
+        den = sum((x - mx) ** 2 for x, _ in pts)
+        if den > 0:
+            slope = sum((x - mx) * (y - my) for x, y in pts) / den
+    sessions = {r["session"] for r in results if r.get("session")}
+    turn_rows = [r for r in results if r.get("turn") is not None]
+    return {
+        "sessions": len(sessions),
+        "turns": len(turn_rows),
+        "completed": len([r for r in turn_rows if r.get("ok")]),
+        "per_turn": per_turn,
+        "ttft_slope_ms_per_turn": round(slope * 1e3, 3)
+        if slope is not None else None,
     }
 
 

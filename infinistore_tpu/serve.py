@@ -72,6 +72,7 @@ class ServingServer:
                  slo_ttft_s: Optional[float] = None,
                  slo_tpot_s: Optional[float] = None,
                  ledger_ring: Optional[int] = None,
+                 session_ring: Optional[int] = None,
                  store_manage_endpoints: Optional[List[str]] = None,
                  quotas=None, role: str = "monolith"):
         """``tokenizer``: any object with ``encode(str) -> [int]`` and
@@ -118,6 +119,20 @@ class ServingServer:
         # logged through the shared logger (trace_id-joinable) — the
         # scheduler records into it at every request exit
         self.ledger = RequestLedger(capacity=ledger_ring)
+        # session-grain attribution (infinistore_tpu/sessions.py):
+        # requests carrying a "session" id fold into per-session turn
+        # rows + the re-prefill waste accounting, exported at
+        # GET /debug/sessions; the derived istpu_serve_reprefill_* /
+        # istpu_serve_session_* families land on this registry.
+        # Capacity: --session-ring / ISTPU_SESSION_RING (sessions, LRU).
+        from .sessions import SessionLedger
+
+        self.sessions = SessionLedger(
+            capacity=session_ring,
+            block_tokens=getattr(getattr(engine, "pc", None),
+                                 "block_tokens", 1),
+            metrics=self.metrics,
+        )
         # per-step engine/device attribution (engine/stepprof.py),
         # exported at /debug/engine: one record per scheduler step —
         # dispatch counts, sampled host-stall/device-drain, retraces,
@@ -133,6 +148,7 @@ class ServingServer:
                                ngram_spec=ngram_spec, spec_g=spec_g,
                                prefill_concurrency=prefill_concurrency,
                                metrics=self.metrics, ledger=self.ledger,
+                               session_ledger=self.sessions,
                                slo_ttft_s=slo_ttft_s, slo_tpot_s=slo_tpot_s,
                                stepprof=self.stepprof)
         self._register_metrics()
@@ -553,6 +569,19 @@ class ServingServer:
                 raise ValueError(
                     "tenant must be 1-64 chars of [A-Za-z0-9._-]"
                 )
+        # conversation id, next to the tenant and under its contract:
+        # turns of one conversation share a "session" id and fold into
+        # the SessionLedger (/debug/sessions, re-prefill waste
+        # attribution); the frontdoor keys decode affinity on it too
+        session = body.get("session")
+        if session is not None:
+            import re as _re
+
+            if not (isinstance(session, str) and 1 <= len(session) <= 64
+                    and _re.fullmatch(r"[A-Za-z0-9._\-]+", session)):
+                raise ValueError(
+                    "session must be 1-64 chars of [A-Za-z0-9._-]"
+                )
         raw_bias = body.get("logit_bias")
         logit_bias = None
         if raw_bias is not None:
@@ -662,6 +691,7 @@ class ServingServer:
             "logit_bias": logit_bias,
             "priority": prio,
             "tenant": tenant,
+            "session": session,
             "logprobs": lp_k,
         }
 
@@ -1304,6 +1334,20 @@ def _make_handler(server: ServingServer):
                 except (KeyError, ValueError, IndexError):
                     limit = None
                 self._json(200, server.ledger.snapshot(limit=limit))
+            elif self.path.split("?", 1)[0] == "/debug/sessions":
+                # the session ledger: per-conversation turn histories
+                # (context growth, TTFT, provenance split) + the
+                # re-prefill waste totals, joinable to /debug/requests
+                # by trace_id.  ?limit=N caps the session rows (LRU
+                # capacity itself is ISTPU_SESSION_RING).
+                from urllib.parse import parse_qs, urlsplit
+
+                q = parse_qs(urlsplit(self.path).query)
+                try:
+                    limit = int(q["limit"][0])
+                except (KeyError, ValueError, IndexError):
+                    limit = None
+                self._json(200, server.sessions.snapshot(limit=limit))
             elif self.path.split("?", 1)[0] == "/debug/engine":
                 # the step profiler's ring: one record per engine step
                 # (kind, batch, dispatch counts, sampled host-stall and
@@ -1660,16 +1704,23 @@ def _make_handler(server: ServingServer):
 
         def _client_gone(self) -> bool:
             """A request-less peek at the socket: readable + EOF means the
-            client hung up (it sent nothing further on this connection)."""
-            import select
+            client hung up (it sent nothing further on this connection).
+            selectors (epoll on Linux) rather than select.select — the
+            latter raises ValueError on fds >= FD_SETSIZE, which a large
+            session fleet reaches."""
+            import selectors
             import socket as socketlib
 
             try:
-                r, _, _ = select.select([self.connection], [], [], 0)
-                if not r:
-                    return False
+                sel = selectors.DefaultSelector()
+                try:
+                    sel.register(self.connection, selectors.EVENT_READ)
+                    if not sel.select(0):
+                        return False
+                finally:
+                    sel.close()
                 return self.connection.recv(1, socketlib.MSG_PEEK) == b""
-            except OSError:
+            except (OSError, ValueError):
                 return True
 
         def _collect(self, req_ids: List[int], qs: List["queue.Queue"],
@@ -2094,6 +2145,10 @@ def main(argv: Optional[List[str]] = None) -> None:
                     help="request-ledger ring capacity for "
                          "/debug/requests (default env "
                          "ISTPU_LEDGER_RING, else 256)")
+    ap.add_argument("--session-ring", type=int, default=None,
+                    help="session-ledger LRU capacity (sessions) for "
+                         "/debug/sessions (default env "
+                         "ISTPU_SESSION_RING, else 256)")
     ap.add_argument("--store-manage-endpoints", default=None,
                     help="store MANAGE-plane endpoints "
                          "(host:manage_port, comma-separated; default "
@@ -2275,6 +2330,7 @@ def main(argv: Optional[List[str]] = None) -> None:
                         prefill_concurrency=args.prefill_concurrency,
                         slo_ttft_s=args.slo_ttft, slo_tpot_s=args.slo_tpot,
                         ledger_ring=args.ledger_ring,
+                        session_ring=args.session_ring,
                         store_manage_endpoints=manage_eps,
                         quotas=args.quotas or None, role=args.role)
     if args.role == "prefill" and conn is None:

@@ -1,0 +1,249 @@
+"""Session-grain attribution: per-conversation turn rows and the
+re-prefill waste number nobody had.
+
+The request ledger (`ledger.py`) answers "where did THIS request's
+1.4 s go"; it cannot answer the question multi-turn traffic actually
+poses — did turn N re-pay for the context turns 1..N-1 already
+computed?  The store tier exists so it doesn't (PAPER.md §1c: cross
+host prefix-cache reuse), but until now nothing measured the failure
+mode.  The ``SessionLedger`` is that measurement: requests carrying a
+``"session"`` id (validated next to ``tenant`` in serve.py) fold into
+per-session entries at the scheduler's one request exit point, each
+holding a bounded ring of per-turn rows — turn index, accumulated
+context length, TTFT, the provenance split (local/store/computed) —
+joined to the request ledger by trace id.
+
+The headline derivation, per turn::
+
+    overlap = min(prompt_tokens, max prompt_tokens of any prior turn)
+    waste   = clamp(overlap - reused_tokens, 0, computed_tokens)
+
+``overlap`` is the slice of this turn's prompt a prior turn of the SAME
+session already prefilled; any of it not covered by reuse (local pages
+or store adoption) was recomputed — **re-prefill waste**, the tokens
+the KV-persistence contract says should never be paid twice.  A warm
+store holds waste at ~0 while context accumulates; a cold store makes
+it grow linearly with turn depth.  The derived families ride the
+serving registry:
+
+* ``istpu_serve_reprefill_waste_tokens_total{tenant}`` — the headline;
+* ``istpu_serve_session_turns_total{tenant}`` — turn volume;
+* ``istpu_serve_active_sessions`` — sessions with a turn in the last
+  ``ACTIVE_WINDOW_S``;
+* ``istpu_serve_session_turn_ttft_seconds{band}`` — TTFT by turn-depth
+  band (``1`` / ``2-3`` / ``4-7`` / ``8+``): the persistence contract
+  as a histogram — warm bands stay near the first-turn band.
+
+Sessions live in a bounded LRU (``ISTPU_SESSION_RING``, default 256
+sessions; eviction = least recently active) with a bounded per-session
+turn ring, exported at ``GET /debug/sessions`` (``?limit=N`` caps the
+session rows).  The ``reprefill_waste`` watchdog rule (health.py) reads
+the waste and computed-token probes this module's counters feed.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Any, Dict, List, Optional
+
+# per-session turn rows kept: deep agent loops stay observable without
+# letting one 10k-turn session own the ring
+MAX_TURNS = 64
+
+# a session counts as ACTIVE while its newest turn is this recent — the
+# gauge window, not an eviction policy (eviction is LRU capacity)
+ACTIVE_WINDOW_S = 300.0
+
+# turn-depth histogram bands: (label, first turn, last turn inclusive)
+TTFT_BANDS = (("1", 1, 1), ("2-3", 2, 3), ("4-7", 4, 7),
+              ("8+", 8, None))
+
+
+def ttft_band(turn: int) -> str:
+    for label, lo, hi in TTFT_BANDS:
+        if turn >= lo and (hi is None or turn <= hi):
+            return label
+    return TTFT_BANDS[-1][0]
+
+
+def _r(x: Optional[float], nd: int = 6) -> Optional[float]:
+    return None if x is None else round(x, nd)
+
+
+class SessionLedger:
+    """Bounded LRU of per-session turn histories + the derived waste
+    accounting.
+
+    Thread-safe the same way the request ledger is: the scheduler
+    records from the engine thread, HTTP handler threads read
+    ``snapshot``.  Pure in the request (reads stamps and provenance,
+    mutates nothing on it), so tests feed synthetic requests."""
+
+    def __init__(self, capacity: Optional[int] = None,
+                 block_tokens: int = 1, metrics=None,
+                 max_turns: int = MAX_TURNS):
+        if capacity is None:
+            try:
+                capacity = int(os.environ.get("ISTPU_SESSION_RING", "")
+                               or 256)
+            except ValueError:
+                capacity = 256
+        self.capacity = max(1, capacity)
+        self.block_tokens = max(1, int(block_tokens))
+        self.max_turns = max(1, max_turns)
+        self._sessions: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+        self._lock = threading.Lock()
+        # lifetime tallies (ring overflow observable, totals exact even
+        # after sessions scroll away)
+        self.recorded_sessions = 0
+        self.recorded_turns = 0
+        self.waste_tokens = 0
+        self.overlap_tokens = 0
+        self.reused_tokens = 0
+        self.computed_tokens = 0
+        self._metrics = metrics
+        if metrics is not None:
+            self._c_waste = metrics.counter(
+                "istpu_serve_reprefill_waste_tokens_total",
+                "Prompt tokens recomputed this turn that a prior turn of "
+                "the same session already computed", ("tenant",))
+            self._c_turns = metrics.counter(
+                "istpu_serve_session_turns_total",
+                "Session turns recorded", ("tenant",))
+            metrics.gauge(
+                "istpu_serve_active_sessions",
+                "Sessions with a turn in the last 5 minutes",
+                fn=self.active_count)
+            self._h_ttft = metrics.histogram(
+                "istpu_serve_session_turn_ttft_seconds",
+                "TTFT by turn-depth band", ("band",))
+            # pre-create every band series so the contract is readable
+            # (flat vs growing) before deep turns ever land
+            for label, _lo, _hi in TTFT_BANDS:
+                self._h_ttft.labels(band=label)
+        else:
+            self._c_waste = self._c_turns = self._h_ttft = None
+
+    # -- recording (engine thread) --------------------------------------
+
+    def record_turn(self, req, outcome: str,
+                    wall: Optional[float] = None) -> Optional[Dict[str, Any]]:
+        """Fold one finished request into its session.  No-op (None)
+        for requests that carried no session id."""
+        sid = getattr(req, "session", None)
+        if not sid:
+            return None
+        wall = wall if wall is not None else time.time()
+        tenant = getattr(req, "tenant", None) or str(req.priority)
+        prompt_tokens = len(req.tokens)
+        t_first = req.t_first or None
+        ttft = (t_first - req.t_submit) if t_first else None
+        st = req.state
+        bt = self.block_tokens
+        local = (getattr(st, "local_chunks", 0) if st is not None else 0) * bt
+        store = (getattr(st, "store_chunks", 0) if st is not None else 0) * bt
+        reused = local + store
+        computed = max(0, prompt_tokens - reused)
+        with self._lock:
+            ent = self._sessions.get(sid)
+            if ent is None:
+                ent = {
+                    "session": sid, "tenant": tenant,
+                    "first_seen": wall, "last_seen": wall,
+                    "turns": 0, "max_prompt_tokens": 0,
+                    "waste_tokens": 0, "reused_tokens": 0,
+                    "computed_tokens": 0,
+                    "rows": deque(maxlen=self.max_turns),
+                }
+                self._sessions[sid] = ent
+                self.recorded_sessions += 1
+                while len(self._sessions) > self.capacity:
+                    self._sessions.popitem(last=False)
+            else:
+                self._sessions.move_to_end(sid)
+            turn = ent["turns"] + 1
+            overlap = min(prompt_tokens, ent["max_prompt_tokens"])
+            waste = max(0, min(overlap - reused, computed))
+            row = {
+                "turn": turn,
+                "req_id": req.req_id,
+                "trace_id": getattr(req, "trace_id", None),
+                "outcome": outcome,
+                "prompt_tokens": prompt_tokens,
+                "new_tokens": max(0, prompt_tokens
+                                  - ent["max_prompt_tokens"]),
+                "ttft_s": _r(ttft),
+                "local_tokens": local, "store_tokens": store,
+                "computed_tokens": computed,
+                "overlap_tokens": overlap,
+                "waste_tokens": waste,
+            }
+            ent["rows"].append(row)
+            ent["turns"] = turn
+            ent["last_seen"] = wall
+            ent["tenant"] = tenant
+            ent["max_prompt_tokens"] = max(ent["max_prompt_tokens"],
+                                           prompt_tokens)
+            ent["waste_tokens"] += waste
+            ent["reused_tokens"] += reused
+            ent["computed_tokens"] += computed
+            self.recorded_turns += 1
+            self.waste_tokens += waste
+            self.overlap_tokens += overlap
+            self.reused_tokens += reused
+            self.computed_tokens += computed
+        if self._c_turns is not None:
+            self._c_turns.labels(tenant=tenant).inc()
+            if waste:
+                self._c_waste.labels(tenant=tenant).inc(waste)
+            elif turn == 1:
+                # series exists from the first turn so delta reads and
+                # the watchdog probe never start from an absent family
+                self._c_waste.labels(tenant=tenant)
+            if ttft is not None:
+                self._h_ttft.labels(band=ttft_band(turn)).observe(ttft)
+        return row
+
+    # -- reading (handler threads) --------------------------------------
+
+    def active_count(self, now: Optional[float] = None) -> int:
+        now = now if now is not None else time.time()
+        with self._lock:
+            return sum(1 for e in self._sessions.values()
+                       if now - e["last_seen"] <= ACTIVE_WINDOW_S)
+
+    def snapshot(self, limit: Optional[int] = None) -> Dict[str, Any]:
+        """The ``GET /debug/sessions`` payload: lifetime totals (exact,
+        survive eviction) + the newest-last session rows."""
+        with self._lock:
+            ents = list(self._sessions.values())
+            if limit is not None and limit >= 0:
+                ents = ents[len(ents) - min(limit, len(ents)):]
+            sessions = [
+                {k: (list(v) if k == "rows" else v) for k, v in e.items()}
+                for e in ents
+            ]
+            totals = {
+                "turns": self.recorded_turns,
+                "waste_tokens": self.waste_tokens,
+                "overlap_tokens": self.overlap_tokens,
+                "reused_tokens": self.reused_tokens,
+                "computed_tokens": self.computed_tokens,
+            }
+            recorded = self.recorded_sessions
+        computed = totals["computed_tokens"]
+        totals["reprefill_waste_frac"] = round(
+            totals["waste_tokens"] / computed, 4) if computed else 0.0
+        return {
+            "enabled": True,
+            "capacity": self.capacity,
+            "block_tokens": self.block_tokens,
+            "recorded_sessions": recorded,
+            "active_sessions": self.active_count(),
+            "returned": len(sessions),
+            "totals": totals,
+            "sessions": sessions,
+        }

@@ -83,7 +83,8 @@ class Snapshot:
                  health: Optional[dict] = None,
                  admission: Optional[dict] = None,
                  fleet: Optional[dict] = None,
-                 usage: Optional[dict] = None):
+                 usage: Optional[dict] = None,
+                 sessions: Optional[dict] = None):
         self.serve = serve_metrics or {}
         self.store = store_metrics or {}
         self.cache = cache
@@ -104,6 +105,8 @@ class Snapshot:
         self.fleet = fleet
         # the serve/router /debug/usage payload (per-tenant ledger)
         self.usage = usage
+        # the serving /debug/sessions payload (session ledger)
+        self.sessions = sessions
 
     def lanes(self) -> List[str]:
         """Priority lanes seen in the serving TTFT family, numeric
@@ -588,6 +591,53 @@ class Console:
             out.append("  " + "   ".join(calls))
         return out
 
+    def _sessions(self, snap: Snapshot) -> List[str]:
+        """The session view (serve /debug/sessions + the session-affinity
+        family): active sessions, per-frame turn and waste-token deltas,
+        the lifetime waste fraction, and the affinity hit share among
+        re-visits (fallback is every session's FIRST placement, so it is
+        excluded from the hit denominator), plus the newest sessions'
+        turn depth / context / waste."""
+        ss = snap.sessions
+        if not ss or not ss.get("enabled"):
+            return []
+        out: List[str] = [""]
+        tot = ss.get("totals") or {}
+        d_turns = self.deltas.setdefault("sess_turns", _Delta()).update(
+            float(tot.get("turns", 0)))
+        d_waste = self.deltas.setdefault("sess_waste", _Delta()).update(
+            float(tot.get("waste_tokens", 0)))
+        aff = {
+            res: snap.value("istpu_serve_session_affinity_total",
+                            (("result", res),)) or 0.0
+            for res in ("hit", "miss", "fallback")
+        }
+        revisits = aff["hit"] + aff["miss"]
+        out.append(
+            "sessions  active {:>5}  turns {:>7} ({}/frame)  "
+            "waste-frac {:>6s}  Δwaste-tok {}  affinity hit {}".format(
+                int(ss.get("active_sessions", 0)),
+                int(tot.get("turns", 0)),
+                "-" if d_turns is None else f"+{d_turns:.0f}",
+                f"{tot.get('reprefill_waste_frac', 0.0):.1%}",
+                "-" if d_waste is None else f"+{d_waste:.0f}",
+                (f"{aff['hit'] / revisits:5.1%}" if revisits else "-"),
+            )
+        )
+        rows = ss.get("sessions") or []
+        for e in rows[-4:][::-1]:  # newest (most recently active) first
+            out.append(
+                "  {:18s} {:10s} turns {:>3d}  ctx {:>6d} tok  "
+                "waste {:>6d} tok".format(
+                    str(e.get("session", "?"))[:18],
+                    str(e.get("tenant", "?"))[:10],
+                    int(e.get("turns", 0)),
+                    int(e.get("max_prompt_tokens", 0)),
+                    int(e.get("waste_tokens", 0)),
+                )
+            )
+        return out
+
     def frame(self, snap: Snapshot) -> str:
         out: List[str] = []
         w = 24
@@ -730,6 +780,7 @@ class Console:
                    if pages is not None else "")
             )
         out.extend(self._usage(snap))
+        out.extend(self._sessions(snap))
         out.extend(self._serving_slo(snap))
         out.extend(self._alerts(snap))
         out.extend(self._admission(snap))
@@ -814,6 +865,9 @@ def poll(serve_url: Optional[str], store_url: Optional[str]) -> Snapshot:
     usage = js(serve_url, "/debug/usage")
     if usage is not None and not usage.get("enabled"):
         usage = None
+    sessions = js(serve_url, "/debug/sessions?limit=6")
+    if sessions is not None and not sessions.get("enabled"):
+        sessions = None
     return Snapshot(
         serve_metrics=prom(serve_url, "/metrics"),
         store_metrics=prom(store_url, "/metrics"),
@@ -828,6 +882,7 @@ def poll(serve_url: Optional[str], store_url: Optional[str]) -> Snapshot:
         admission=admission,
         fleet=fleet,
         usage=usage,
+        sessions=sessions,
     )
 
 

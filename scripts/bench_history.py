@@ -100,6 +100,14 @@ METRICS = {
     # batched path silently degraded to per-key copies
     "migrate_gbps": ("up", "reshape migrate GB/s (batched)"),
     "migrate_gbps_per_key": ("up", "reshape migrate GB/s (per-key)"),
+    # the session plane (bench_serve.py --conversation `sessions`
+    # block): fraction of computed prompt tokens that were re-prefill
+    # waste — context a prior turn already paid for — and the
+    # session-affinity hit rate among re-visits.  A round where waste
+    # climbs or stickiness drops broke the cross-turn KV-persistence
+    # contract, not just a latency number
+    "reprefill_waste_frac": ("down", "session re-prefill waste frac"),
+    "affinity_hit_rate": ("up", "session affinity hit rate"),
 }
 
 
