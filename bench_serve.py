@@ -572,6 +572,22 @@ def main(argv=None) -> int:
                 cluster_dbg = payload
         except Exception:  # noqa: BLE001 — observability, not the bench
             pass
+        # the stage ledger's verdict (best-effort, same contract): the
+        # canonical TTFT decomposition at sweep end — /debug/critpath
+        # answers worker-grain on a monolith and router-grain against a
+        # fleet with the same shape, so two captures are diffable by
+        # scripts/trace_diff.py either way
+        critpath_dbg = None
+        try:
+            import urllib.request
+
+            with urllib.request.urlopen(url + "/debug/critpath?limit=0",
+                                        timeout=5) as r:
+                payload = json.loads(r.read())
+            if payload.get("enabled"):
+                critpath_dbg = payload
+        except Exception:  # noqa: BLE001 — observability, not the bench
+            pass
         disagg = None
         if args.self_disagg:
             disagg = _gather_disagg(url, fleet_workers, args)
@@ -772,6 +788,23 @@ def main(argv=None) -> int:
         mig = cluster_dbg.get("migration") or {}
         if mig.get("migrate_gbps") is not None:
             record["migrate_gbps"] = mig["migrate_gbps"]
+    if critpath_dbg is not None:
+        # critpath block (docs/observability.md §Latency attribution):
+        # the per-stage TTFT decomposition at sweep end, row tail
+        # dropped (the aggregates are the diffable artifact).  Each
+        # stage's p99 mirrors top-level as stage_p99_<stage>_ms so
+        # scripts/bench_history.py trends the decomposition and
+        # scripts/trace_diff.py names a regressed stage from two of
+        # these captures
+        overall = critpath_dbg.get("overall") or {}
+        record["critpath"] = {
+            "role": critpath_dbg.get("role"),
+            "stages": critpath_dbg.get("stages"),
+            "overall": overall,
+            "lanes": critpath_dbg.get("lanes"),
+        }
+        for s, v in (overall.get("stage_p99_ms") or {}).items():
+            record[f"stage_p99_{s}_ms"] = v
     print(json.dumps(record))
     if args.json_out:
         with open(args.json_out, "w") as f:

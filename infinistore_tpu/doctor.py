@@ -61,6 +61,10 @@ SERVE_ENDPOINTS: Tuple[Tuple[str, str, str], ...] = (
     ("usage", "/debug/usage", "debug_usage.json"),
     # the session ledger (per-conversation turn rows + re-prefill waste)
     ("sessions", "/debug/sessions", "debug_sessions.json"),
+    # the stage ledger (canonical TTFT decomposition + worst offenders);
+    # the capture follows it with the worst offender's mesh-stitched
+    # /debug/trace/{id} timeline (serve/debug_trace_worst.json)
+    ("critpath", "/debug/critpath", "debug_critpath.json"),
 )
 STORE_ENDPOINTS: Tuple[Tuple[str, str, str], ...] = (
     ("metrics", "/metrics", "metrics.prom"),
@@ -130,6 +134,21 @@ def capture(serve_url: Optional[str], store_urls: Sequence[str],
     if serve_url:
         cap["serve"] = capture_plane(serve_url, SERVE_ENDPOINTS, timeout)
         discovered = discover_store_urls(cap["serve"])
+        # follow the stage ledger to its worst offender: one extra
+        # fetch turns "p99 TTFT is owned by store_transfer" into the
+        # exact request's mesh-stitched timeline, inside the bundle
+        cp = _json_of(cap["serve"], "critpath") or {}
+        worst = (cp.get("overall") or {}).get("worst") or []
+        tid = worst[0].get("trace_id") if worst else None
+        if tid:
+            base = _norm(serve_url).rstrip("/")
+            data, err = _fetch(f"{base}/debug/trace/{tid}", timeout)
+            cap["serve"]["worst_trace"] = {
+                "path": f"/debug/trace/{tid}",
+                "file": "debug_trace_worst.json",
+                "ok": err is None, "error": err,
+                "bytes": len(data) if data else 0, "data": data,
+            }
     else:
         cap["serve"] = None
         discovered = []
@@ -340,6 +359,34 @@ def summarize_capture(cap: Dict[str, Any], top_n: int = 5) -> str:
                              "(the persistence contract held)")
             lines.append("")
 
+    # -- the stage ledger: who owns TTFT? --
+    if serve:
+        cp = _json_of(serve, "critpath")
+        if cp and cp.get("enabled"):
+            ov = cp.get("overall") or {}
+            lines.append("## Critical path (stage ledger)")
+            lines.append(
+                f"- {ov.get('count', 0)} requests, TTFT p50 "
+                f"{ov.get('ttft_p50_ms', 0)} ms / p99 "
+                f"{ov.get('ttft_p99_ms', 0)} ms; dominant stage "
+                f"**{ov.get('dominant_stage') or '-'}**"
+            )
+            p99 = ov.get("stage_p99_ms") or {}
+            top = sorted(p99.items(), key=lambda kv: -(kv[1] or 0))[:4]
+            if top:
+                lines.append("- stage p99 ms: " + ", ".join(
+                    f"{s} {v}" for s, v in top))
+            for w in (ov.get("worst") or [])[:top_n]:
+                lines.append(
+                    f"- worst: trace {w.get('trace_id')} ttft "
+                    f"{w.get('ttft_ms')} ms dominated by "
+                    f"{w.get('dominant_stage')}"
+                )
+            if serve.get("worst_trace", {}).get("ok"):
+                lines.append("- worst offender's stitched timeline: "
+                             "serve/debug_trace_worst.json")
+            lines.append("")
+
     # -- slowest requests, joined to their steps and traces --
     if serve:
         reqs = (_json_of(serve, "requests") or {}).get("records") or []
@@ -496,6 +543,18 @@ def write_bundle(cap: Dict[str, Any], out_path: str) -> Dict[str, Any]:
                 if e["data"]:
                     path = f"serve/{e['file']}"
                     _add_bytes(tar, path, e["data"])
+                    manifest["files"].append(path)
+            extra = serve.get("worst_trace")
+            if extra:  # the stage ledger's worst offender, stitched
+                manifest["serve"]["endpoints"].append({
+                    "endpoint": extra["path"],
+                    "file": f"serve/{extra['file']}",
+                    "ok": extra["ok"], "error": extra["error"],
+                    "bytes": extra["bytes"],
+                })
+                if extra["data"]:
+                    path = f"serve/{extra['file']}"
+                    _add_bytes(tar, path, extra["data"])
                     manifest["files"].append(path)
         for i, store in enumerate(cap.get("stores", [])):
             prefix = f"store-{i}"

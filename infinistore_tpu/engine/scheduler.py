@@ -132,6 +132,11 @@ class Request:
     t_done: float = 0.0
     t_stream_s: float = 0.0
     stamps: List[tuple] = field(default_factory=list)
+    # handler-thread staging stamp (perf_counter, taken by serve.py when
+    # the body was parsed and queued for the engine loop): admission_wait
+    # = t_submit - t_stage, the pre-scheduler share of client TTFT the
+    # stage ledger attributes explicitly.  0.0 = direct library callers.
+    t_stage: float = 0.0
     # the trace id the submitting HTTP handler had bound (serve.py
     # captures it on the handler thread) — joins this request's ledger
     # record and log lines to its http.request trace
@@ -319,6 +324,7 @@ class Scheduler:
         logprobs: int = 0,
         on_token: Optional[Callable[[List[int], bool], None]] = None,
         trace_id: Optional[str] = None,
+        t_stage: float = 0.0,
     ) -> int:
         # boundary validation: a bad request must be rejected HERE, not
         # explode inside a later engine step and fault out every in-flight
@@ -380,7 +386,7 @@ class Scheduler:
             priority=priority, tenant=tenant, session=session,
             adapter_id=adapter_id,
             logprobs=min(max(int(logprobs), 0), self.LOGPROBS_K),
-            on_token=on_token, trace_id=trace_id,
+            on_token=on_token, trace_id=trace_id, t_stage=t_stage,
         )
         self._next_id += 1
         req.t_submit = time.perf_counter()

@@ -51,7 +51,13 @@ Operator surface: ``GET /healthz`` (role=router + per-role rollup),
 ``GET /metrics`` (istpu_fd_* families, docs/observability.md),
 ``GET /debug/fleet`` (per-worker role/state/inflight rows — the
 istpu-top fleet view), ``GET /debug/traces`` (fleet-stitched Perfetto
-export).  Start with ``istpu-frontdoor`` or ``serve.py --role router``.
+export), ``GET /debug/trace/{trace_id}`` (ONE request's mesh-stitched
+timeline: router + workers + each worker's store fleet, one pid row
+per process), ``GET /debug/critpath`` (router-grain stage ledger:
+worker rows merged by trace id, p50/p99 TTFT by stage, dominant
+stage, worst-offender trace ids — docs/observability.md "Latency
+attribution").  Start with ``istpu-frontdoor`` or ``serve.py --role
+router``.
 """
 
 from __future__ import annotations
@@ -73,6 +79,7 @@ from .utils.logging import Logger
 from .utils.metrics import (
     MetricsRegistry,
     PROMETHEUS_CONTENT_TYPE,
+    default_registry,
     parse_prometheus_text,
 )
 
@@ -245,6 +252,20 @@ class FrontDoor:
         self.session_cap = max(1, self.session_cap)
         self._session_map: "OrderedDict[str, str]" = OrderedDict()
         self._session_lock = threading.Lock()
+        # router-grain critpath notes: the router's OWN measurement of
+        # each request (handler entry → first forwarded byte → done),
+        # joined to the workers' stage rows by trace id at
+        # /debug/critpath — the note's TTFT is what the CLIENT saw, so
+        # the gap between it and the mapped stage sum is the
+        # `unattributed` remainder.  Bounded LRU like the session map.
+        try:
+            self._cp_cap = int(
+                os.environ.get("ISTPU_CRITPATH_RING", "") or 256)
+        except ValueError:
+            self._cp_cap = 256
+        self._cp_cap = max(1, self._cp_cap)
+        self._cp_notes: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+        self._cp_lock = threading.Lock()
         self._register_metrics()
         self._stop = threading.Event()
         self._poller = threading.Thread(target=self._poll_loop,
@@ -674,10 +695,137 @@ class FrontDoor:
             offset = float(dump.get("clock", 0.0)) - (t0 + t1) / 2.0
             remotes.append((dump, offset))
         return json.dumps(trace_stitch.stitch_chrome(
-            tracing.TRACER, remotes, limit=limit))
+            tracing.TRACER, remotes, limit=limit,
+            local_role="router"))
+
+    def stitched_trace_json(self, trace_id: str) -> str:
+        """Mesh-wide single-request export (``GET /debug/trace/{id}``):
+        every worker's span ring — PLUS each worker's attached store
+        rings, which the worker pre-maps into its own clock
+        (``/debug/traces?raw=1&stores=1``) — stitched onto the router
+        timeline as one Perfetto-loadable trace with one ``pid`` row
+        per process.  Worker offsets come from the round-trip-midpoint
+        estimate of the gather fetch; store rows reuse the SAME worker
+        offset transitively and add the worker→store error bound on
+        top, so the export's skew is self-describing end to end.
+        Every gather outcome is counted in
+        ``istpu_trace_stitch_total``."""
+        from .utils import trace_stitch
+
+        remotes = []
+        local_pid = os.getpid()
+        seen_pids = set()
+        for w in self.prefill + self.decode:
+            if not w.reachable:
+                continue
+            q = f"/debug/traces?raw=1&stores=1&trace_id={trace_id}"
+            t0 = time.perf_counter()
+            dump = self._fetch_json(w, q, timeout=5.0)
+            t1 = time.perf_counter()
+            if dump is None or "traces" not in dump:
+                trace_stitch.count_stitch(
+                    "error" if dump is None else "unnegotiated")
+                continue
+            trace_stitch.count_stitch("ok")
+            offset = float(dump.get("clock", 0.0)) - (t0 + t1) / 2.0
+            err = (t1 - t0) / 2.0
+            dump.setdefault("role", w.role)
+            # a worker's store remotes arrive PRE-MAPPED into the
+            # worker clock, so the worker's single offset carries them
+            # onto the router timeline; dedupe by pid — two workers
+            # sharing one store node both return its ring
+            for rem in dump.pop("remotes", None) or ():
+                rpid = rem.get("pid")
+                if rpid in seen_pids or rpid == local_pid:
+                    continue
+                seen_pids.add(rpid)
+                rem_err = float(rem.get("clock_offset_err_s") or 0.0)
+                remotes.append((rem, offset, err + rem_err))
+            # an in-process worker (local_fleet) shares the router's
+            # ring — its spans are already in the local tracer
+            wpid = int(dump.get("pid", -1))
+            if wpid != local_pid and wpid not in seen_pids:
+                seen_pids.add(wpid)
+                remotes.append((dump, offset, err))
+        return json.dumps(trace_stitch.stitch_chrome(
+            tracing.TRACER, remotes, trace_id=trace_id,
+            local_role="router"))
+
+    # -- critical-path attribution (router grain) --
+
+    def critpath_note(self, trace_id: str, **fields) -> None:
+        """Record/extend the router's own measurement of one request."""
+        with self._cp_lock:
+            note = self._cp_notes.get(trace_id)
+            if note is None:
+                note = {"trace_id": trace_id}
+                self._cp_notes[trace_id] = note
+                while len(self._cp_notes) > self._cp_cap:
+                    self._cp_notes.popitem(last=False)
+            note.update(fields)
+
+    def critpath_report(self,
+                        limit: Optional[int] = None) -> Dict[str, Any]:
+        """The router's ``GET /debug/critpath``: every worker's stage
+        rows grouped by trace id and remapped to router grain
+        (``critpath.merge_mesh_rows`` — a decode worker's queue is the
+        fleet's ``decode_queue``, a prefill worker's whole row is
+        TTFT-side), with the router's own note supplying the measured
+        TTFT so the unclaimed remainder lands in ``unattributed``.
+        Same answer shape as a worker's snapshot: p50/p99 per stage,
+        dominant stage, worst-offender trace ids, per lane and
+        overall."""
+        from . import critpath
+
+        with self._cp_lock:
+            notes = {tid: dict(n) for tid, n in self._cp_notes.items()}
+        by_trace: "OrderedDict[str, List[Dict[str, Any]]]" = OrderedDict()
+        workers = []
+        for w in self.prefill + self.decode:
+            snap = self._fetch_json(w, "/debug/critpath", timeout=5.0) \
+                if w.reachable else None
+            workers.append({"endpoint": w.endpoint, "role": w.role,
+                            "reachable": snap is not None,
+                            "rows": len((snap or {}).get("rows") or ())})
+            for row in (snap or {}).get("rows") or ():
+                tid = row.get("trace_id")
+                if not tid:
+                    continue
+                row.setdefault("role", (snap or {}).get("role") or w.role)
+                by_trace.setdefault(tid, []).append(row)
+        merged = [critpath.merge_mesh_rows(rows, note=notes.get(tid))
+                  for tid, rows in by_trace.items()]
+        lanes: Dict[str, List[Dict[str, Any]]] = {}
+        for r in merged:
+            lanes.setdefault(r.get("lane") or "-", []).append(r)
+        out = {
+            "enabled": True,
+            "role": "router",
+            "stages": list(critpath.STAGES),
+            "ttft_stages": list(critpath.TTFT_STAGES),
+            "generated_at": round(time.time(), 3),
+            "workers": workers,
+            "notes": len(notes),
+            "overall": critpath.aggregate(merged),
+            "lanes": {lane: critpath.aggregate(rws)
+                      for lane, rws in lanes.items()},
+        }
+        tail = merged
+        if limit is not None and limit >= 0:
+            tail = tail[len(tail) - min(limit, len(tail)):]
+        out["rows"] = tail
+        out["returned"] = len(tail)
+        return out
 
     def metrics_text(self) -> str:
-        return self.metrics.to_prometheus_text()
+        """Router registry plus the process-global one (the stitch
+        gather counter ``istpu_trace_stitch_total`` lives there, shared
+        with the library's wire-side gathers)."""
+        text = self.metrics.to_prometheus_text()
+        shared = default_registry()
+        if shared is not self.metrics:
+            text += shared.to_prometheus_text(exclude=self.metrics.names())
+        return text
 
 
 def _make_handler(fd: FrontDoor):
@@ -729,6 +877,28 @@ def _make_handler(fd: FrontDoor):
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
                 self.wfile.write(data)
+            elif path == "/debug/critpath":
+                from urllib.parse import parse_qs
+
+                q = parse_qs(urlsplit(self.path).query)
+                try:
+                    limit = int(q["limit"][0])
+                except (KeyError, ValueError, IndexError):
+                    limit = None
+                self._json(200, fd.critpath_report(limit=limit))
+            elif path.startswith("/debug/trace/"):
+                # one request's mesh-stitched timeline (?stitched=1 is
+                # accepted and implied — this endpoint always stitches)
+                tid = path[len("/debug/trace/"):]
+                if not tid:
+                    self._json(400, {"error": "trace id required"})
+                else:
+                    data = fd.stitched_trace_json(tid).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
             else:
                 self._json(404, {"error": "not found"})
 
@@ -737,11 +907,30 @@ def _make_handler(fd: FrontDoor):
                 self._json(404, {"error": "not found"})
                 fd.count_code(404)
                 return
-            with tracing.trace("http.request", path=self.path,
-                               tier="frontdoor") as tr:
+            self._cp_t0 = time.perf_counter()
+            self._cp_first: Optional[float] = None
+            self._cp_lane: Optional[str] = None
+            # an inbound X-Istpu-Trace CONTINUES the caller's trace (a
+            # loadgen-minted id joins the client's own TTFT measurement
+            # to this request's stage rows and stitched timeline)
+            tid = self.headers.get("X-Istpu-Trace") or None
+            with tracing.trace("http.request", trace_id=tid,
+                               path=self.path, tier="frontdoor") as tr:
                 status = self._route(tr.trace_id)
             if status is not None:
                 fd.count_code(status)
+            # the router's own measurement (client-observed TTFT/e2e):
+            # what /debug/critpath joins to worker stage rows by trace
+            # id to name the unattributed remainder
+            fd.critpath_note(
+                tr.trace_id,
+                lane=self._cp_lane or "-",
+                status=status,
+                ttft_s=((self._cp_first - self._cp_t0)
+                        if self._cp_first is not None else None),
+                e2e_s=time.perf_counter() - self._cp_t0,
+                wall_done=round(time.time(), 3),
+            )
 
         def _route(self, trace_id: str) -> Optional[int]:
             """One request through both legs.  Returns the status sent
@@ -756,6 +945,11 @@ def _make_handler(fd: FrontDoor):
                 self._json(400, {"error": "body must be a JSON object"})
                 return 400
             body.pop("_chat", None)
+            # the critpath lane mirrors the workers' lane label: the
+            # named tenant when one was given, the priority otherwise
+            tenant = body.get("tenant")
+            self._cp_lane = tenant if isinstance(tenant, str) and tenant \
+                else str(body.get("priority", 0) or 0)
             # prefill leg — skipped for scoring-only requests (nothing
             # to decode, nothing worth handing off)
             try:
@@ -848,6 +1042,8 @@ def _make_handler(fd: FrontDoor):
                         "text/event-stream"):
                     return self._relay_sse(w, resp)
                 data = resp.read()
+                if self._cp_first is None:
+                    self._cp_first = time.perf_counter()
                 self.send_response(resp.status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
@@ -879,6 +1075,8 @@ def _make_handler(fd: FrontDoor):
                     line = resp.readline()
                     if not line:
                         break
+                    if self._cp_first is None:  # first forwarded byte:
+                        self._cp_first = time.perf_counter()  # router TTFT
                     self.wfile.write(line)
                     if line == b"\n":  # event boundary: flush the chunk
                         self.wfile.flush()

@@ -591,6 +591,82 @@ def reprefill_waste_rule(budget_frac: float = 0.25,
     )
 
 
+# overhead stages budgeted by default: each may own at most this share
+# of p99 TTFT before the watchdog names it.  The COMPUTE stages
+# (prefill_compute, first_token) are unbudgeted by default — compute is
+# supposed to dominate a healthy TTFT; name overhead, not work.
+DEFAULT_STAGE_BUDGETS: Dict[str, float] = {
+    "admission_wait": 0.50,
+    "queue_wait": 0.50,
+    "kv_flush": 0.50,
+    "store_transfer": 0.50,
+    "decode_queue": 0.50,
+    "unattributed": 0.50,
+}
+
+
+def stage_budget_rule(budgets: Optional[Dict[str, float]] = None,
+                      min_count: int = 8,
+                      severity: str = "warn") -> WatchdogRule:
+    """Automated critical-path regression naming as an alert: the stage
+    ledger's per-stage share of p99 TTFT (``critpath.share.<stage>``
+    series, fed by the serve probe from ``StageLedger.shares()``)
+    breaching its budget NAMES the regressed stage in the alert reason —
+    "TTFT burned" plus "store_transfer owns 61% of it" in one read.
+    Budgets come from ``ISTPU_STAGE_BUDGET``: a bare float rebudgets
+    every default-budgeted overhead stage, ``stage=frac`` pairs
+    (comma-separated) budget individual stages — including the compute
+    stages, which are unbudgeted by default.  ``min_count`` rows must
+    back the shares before the rule judges (one slow request is an
+    offender trace id, not a regression)."""
+    if budgets is None:
+        budgets = dict(DEFAULT_STAGE_BUDGETS)
+        for part in os.environ.get("ISTPU_STAGE_BUDGET", "").split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if "=" in part:
+                k, _, v = part.partition("=")
+                try:
+                    budgets[k.strip()] = float(v)
+                except ValueError:
+                    pass
+            else:
+                try:
+                    f = float(part)
+                except ValueError:
+                    continue
+                budgets = {k: f for k in budgets}
+
+    def check(ring: TimeSeriesRing, now: float) -> Optional[dict]:
+        n = ring.latest("critpath.count")
+        if n is None or n[1] < min_count:
+            return None
+        worst = None  # (breach ratio, stage, share, budget)
+        for stage, budget in budgets.items():
+            if budget <= 0:
+                continue
+            got = ring.latest(f"critpath.share.{stage}")
+            if got is None:
+                continue
+            share = got[1]
+            if share >= budget and (worst is None or
+                                    share / budget > worst[0]):
+                worst = (share / budget, stage, share, budget)
+        if worst is None:
+            return None
+        _, stage, share, budget = worst
+        return {"reason": f"stage {stage} owns {share:.0%} of p99 TTFT "
+                          f"(budget {budget:.0%}) over {int(n[1])} "
+                          f"requests",
+                "value": round(share, 4)}
+
+    return WatchdogRule(
+        "stage_budget", severity, check,
+        description="one stage's share of p99 TTFT over its budget",
+    )
+
+
 def default_serve_rules() -> List[WatchdogRule]:
     """The serving plane's watchdog set."""
     return [
@@ -604,6 +680,7 @@ def default_serve_rules() -> List[WatchdogRule]:
         host_stall_rule(),
         mem_slope_rule(),
         reprefill_waste_rule(),
+        stage_budget_rule(),
     ]
 
 
@@ -721,6 +798,10 @@ def serve_probes(server) -> Dict[str, Callable[[], Any]]:
             "istpu_store_push_dropped_total") or 0.0,
         "store.integrity_failures": lambda: dreg.family_value(
             "istpu_integrity_failures_total") or 0.0,
+        # dict probe: critpath.count + critpath.share.<stage> — the
+        # stage ledger's per-stage share of p99 TTFT, the stage_budget
+        # rule's input (resolved lazily; quiet while the ring is empty)
+        "critpath": lambda: _critpath_probe(server),
         "engine.steps": lambda: prof.steps,
         "engine.retraces": lambda: _total_traces(),
         # dict probe: fans out to engine.stall_s / engine.sampled_wall_s
@@ -734,6 +815,21 @@ def _total_traces() -> int:
     from .engine import stepprof as _sp
 
     return _sp.total_traces()
+
+
+def _critpath_probe(server) -> Optional[dict]:
+    cp = getattr(server, "critpath", None)
+    if cp is None:
+        return None
+    rows = cp.rows()
+    if not rows:
+        return None
+    from . import critpath as _cp
+
+    agg = _cp.aggregate(rows)
+    out = {f"share.{s}": v for s, v in agg["stage_share_p99"].items()}
+    out["count"] = float(agg["count"])
+    return out
 
 
 def _stall_probe(prof) -> dict:

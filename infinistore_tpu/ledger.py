@@ -105,6 +105,11 @@ def build_record(req, outcome: str,
     if t_done:
         events.append((outcome if outcome != "done" else "done",
                        _r(t_done - t_submit)))
+    # handler staging -> scheduler submit (the pre-engine share of the
+    # CLIENT's TTFT; outside the e2e window, so reported beside the
+    # waterfall rather than inside it).  0.0 for direct library callers.
+    t_stage = getattr(req, "t_stage", 0.0)
+    admission_wait_s = max(0.0, t_submit - t_stage) if t_stage else 0.0
     return {
         "req_id": req.req_id,
         "trace_id": getattr(req, "trace_id", None),
@@ -119,6 +124,7 @@ def build_record(req, outcome: str,
         "ttft_s": _r(ttft),
         "tpot_s": _r(tpot),
         "e2e_s": _r(e2e),
+        "admission_wait_s": _r(admission_wait_s),
         "store": {
             "reused_chunks": reused, "local_chunks": local,
             "store_chunks": store, "hit": store > 0, "load_s": _r(store_s),
@@ -144,7 +150,8 @@ class RequestLedger:
     threads.  ``recorded`` counts lifetime records, so ring overflow is
     observable (``recorded - len(tail())`` records scrolled away)."""
 
-    def __init__(self, capacity: Optional[int] = None, log: bool = True):
+    def __init__(self, capacity: Optional[int] = None, log: bool = True,
+                 sink=None):
         if capacity is None:
             try:
                 capacity = int(os.environ.get("ISTPU_LEDGER_RING", "") or 256)
@@ -154,6 +161,10 @@ class RequestLedger:
         self._ring: "deque" = deque(maxlen=self.capacity)
         self._lock = threading.Lock()
         self._log = log
+        # called with each finished record (the stage ledger's fold
+        # hook); guarded — a raising sink must never take down the
+        # engine loop that records retirements
+        self._sink = sink
         self.recorded = 0
 
     def record(self, req, outcome: str) -> Dict[str, Any]:
@@ -161,6 +172,11 @@ class RequestLedger:
         with self._lock:
             self._ring.append(rec)
             self.recorded += 1
+        if self._sink is not None:
+            try:
+                self._sink(rec)
+            except Exception:  # noqa: BLE001 — observability stays off
+                pass           # the engine loop's failure path
         if self._log:
             # one line per request through the SHARED logger, stamped
             # with the request's own trace id (the logging filter

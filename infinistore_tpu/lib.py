@@ -511,6 +511,11 @@ class Connection:
         # server_pid — rendering hint for the stitched Perfetto rows.
         self.trace_ctx = False
         self.clock_offset: Optional[float] = None
+        # half the HELLO RTT: the offset estimate's error bound, carried
+        # into stitched exports so timeline skew is self-describing.
+        # Re-estimated whenever connect() runs again (reconnect/failover
+        # builds a fresh Connection), never a stale one-shot value.
+        self.clock_offset_err: Optional[float] = None
         self.server_pid: Optional[int] = None
         # integrity state (negotiated at HELLO): when the server answers
         # the EPOC capability trailer, every GET_DESC / inline-get on
@@ -610,6 +615,7 @@ class Connection:
             # same-host shm topology this estimate matters for.
             self.trace_ctx = True
             self.clock_offset = t_server - (t0 + t1) / 2
+            self.clock_offset_err = (t1 - t0) / 2
         if self.config.connection_type == TYPE_SHM:
             try:
                 self._map_pools()

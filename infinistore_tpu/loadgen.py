@@ -53,6 +53,7 @@ import json
 import random
 import threading
 import time
+import uuid
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 from urllib.parse import urlsplit
@@ -184,6 +185,11 @@ def _http_post_once(url: str, body: Dict[str, Any],
     per-request result dict (``ok``/``status``/``ttft_s``/``tpot_s``/
     ``e2e_s``/``tokens``/``error``/``rejected``/``retry_after_s``)."""
     parts = urlsplit(url)
+    # a client-minted trace id: the server/front door CONTINUES it, so
+    # this request's client-observed TTFT joins its server-side stage
+    # rows (/debug/critpath) and stitched timeline (/debug/trace/{id})
+    # by one key — no response-header round trip needed
+    trace_id = uuid.uuid4().hex
     t0 = time.perf_counter()
     first = last = None
     tokens = 0
@@ -197,7 +203,8 @@ def _http_post_once(url: str, body: Dict[str, Any],
         try:
             conn.request(
                 "POST", "/v1/completions", json.dumps(body),
-                {"Content-Type": "application/json"},
+                {"Content-Type": "application/json",
+                 "X-Istpu-Trace": trace_id},
             )
             resp = conn.getresponse()
             status = resp.status
@@ -253,6 +260,7 @@ def _http_post_once(url: str, body: Dict[str, Any],
     ok = status == 200 and err is None and tokens > 0
     return {
         "ok": ok, "status": status, "error": err, "tokens": tokens,
+        "trace_id": trace_id,
         "lane": body.get("priority", 0),
         # a shed is not a failure: summarize counts it separately so
         # goodput/error math stays honest under admission control

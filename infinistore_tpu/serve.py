@@ -43,6 +43,7 @@ boundary (pages freed, batchmates unaffected — scheduler.cancel semantics).
 from __future__ import annotations
 
 import json
+import os
 import queue
 import threading
 import time
@@ -115,10 +116,25 @@ class ServingServer:
         # the scheduler's queue-wait/prefill/decode histograms land here,
         # next to this server's own request counters
         self.metrics = MetricsRegistry()
+        # stage ledger (infinistore_tpu/critpath.py): every retired
+        # request folds into the canonical latency-attribution stages,
+        # exported at GET /debug/critpath and as the
+        # istpu_critpath_stage_seconds histogram family.  The fold rides
+        # the request ledger's sink — one dict of float math per
+        # retirement, nothing on the step hot path.
+        from .critpath import StageLedger
+
+        try:
+            _cp_ring = int(os.environ.get("ISTPU_CRITPATH_RING", "") or 256)
+        except ValueError:
+            _cp_ring = 256
+        self.critpath = StageLedger(capacity=_cp_ring,
+                                    metrics=self.metrics, role=role)
         # per-request lifecycle ledger, exported at /debug/requests and
         # logged through the shared logger (trace_id-joinable) — the
         # scheduler records into it at every request exit
-        self.ledger = RequestLedger(capacity=ledger_ring)
+        self.ledger = RequestLedger(capacity=ledger_ring,
+                                    sink=self.critpath.fold)
         # session-grain attribution (infinistore_tpu/sessions.py):
         # requests carrying a "session" id fold into per-session turn
         # rows + the re-prefill waste accounting, exported at
@@ -291,8 +307,11 @@ class ServingServer:
         # happens later on the engine thread, where the ambient trace is
         # an engine.step — the ledger must join to the request's own
         # http.request trace
+        # staging stamp for the stage ledger: handler staging ->
+        # scheduler submit is the admission_wait share of client TTFT
         item: Dict[str, Any] = {"body": body, "q": q,
-                                "trace_id": tracing.current_trace_id()}
+                                "trace_id": tracing.current_trace_id(),
+                                "t_stage": time.perf_counter()}
         if body.get("echo") and not body.get("_chat"):
             # scoring forwards are real TPU work: the admission limit must
             # bound them like anything else.  Check-and-reserve is ONE _cv
@@ -779,6 +798,7 @@ class ServingServer:
             # here on the engine thread
             kwargs = item.get("kwargs") or self._validate(body)
             kwargs.setdefault("trace_id", item.get("trace_id"))
+            kwargs.setdefault("t_stage", item.get("t_stage") or 0.0)
             tally["budget"] = kwargs["max_new_tokens"]
             tally["eos_set"] = frozenset(kwargs["eos_ids"] or ())
             req_id = self.sched.submit(on_token=on_token, **kwargs)
@@ -928,6 +948,19 @@ class ServingServer:
             out["cluster"] = cluster
         return out
 
+    def _store_conns(self) -> List[Any]:
+        """Every stitchable store connection behind this engine (one for
+        a plain transfer, every node's for a clustered pool)."""
+        conns: List[Any] = []
+        transfer = getattr(self.engine, "transfer", None)
+        if transfer is not None:
+            srcs = getattr(transfer, "trace_srcs", None)
+            if srcs is not None:  # clustered: every node's span ring
+                conns.extend(srcs())
+            else:
+                conns.append(transfer._src)
+        return conns
+
     def debug_traces_json(self, limit: Optional[int] = None) -> str:
         """The /debug/traces payload: the process trace ring, STITCHED
         with the attached store's server-side span ring when the store
@@ -938,27 +971,70 @@ class ServingServer:
         stitchable store."""
         from .utils import trace_stitch
 
-        conns = []
-        transfer = getattr(self.engine, "transfer", None)
-        if transfer is not None:
-            srcs = getattr(transfer, "trace_srcs", None)
-            if srcs is not None:  # clustered: every node's span ring
-                conns.extend(srcs())
-            else:
-                conns.append(transfer._src)
         return trace_stitch.stitched_chrome_json(
-            tracing.TRACER, conns, limit=limit
+            tracing.TRACER, self._store_conns(), limit=limit
         )
 
-    def debug_traces_raw(self, limit: Optional[int] = None) -> Dict[str, Any]:
+    def debug_trace_json(self, trace_id: str) -> str:
+        """ONE request's stitched timeline (``/debug/trace/{id}``): the
+        local ring plus every attached store's ring, narrowed to the
+        trace id — the worker-grain half of the frontdoor's mesh-wide
+        single-trace download."""
+        from .utils import trace_stitch
+
+        return trace_stitch.stitched_chrome_json(
+            tracing.TRACER, self._store_conns(), trace_id=trace_id,
+            local_role=self.role,
+        )
+
+    def debug_traces_raw(self, limit: Optional[int] = None,
+                         trace_id: Optional[str] = None,
+                         include_stores: bool = False) -> Dict[str, Any]:
         """Raw span-ring dump with process-clock stamps plus ``clock`` =
         now on the same clock — the HTTP twin of the wire
         ``OP_TRACE_DUMP`` (``/debug/traces?raw=1``).  The fleet front
         door polls this from every worker and maps the stamps into its
         own timeline (round-trip-midpoint offset estimate, the HELLO
         clock-sync trick over HTTP), which is what turns N worker rings
-        into ONE stitched Perfetto file."""
-        return tracing.TRACER.dump(limit)
+        into ONE stitched Perfetto file.
+
+        ``include_stores`` adds each attached store's ring under
+        ``remotes``, with stamps PRE-MAPPED into this worker's clock
+        (the wire-HELLO offset applied here), so the frontdoor's one
+        worker offset carries store spans onto the router timeline
+        transitively; each entry keeps the residual error bound.
+        ``trace_id`` narrows everything to one trace."""
+        from .utils import trace_stitch
+
+        d = tracing.TRACER.dump(limit, trace_id=trace_id)
+        d["role"] = self.role
+        if not include_stores:
+            return d
+        remotes = []
+        for conn in self._store_conns():
+            got = trace_stitch.gather_remote(conn)
+            if got is None:
+                continue
+            dump, offset, err = got
+            traces = []
+            for tr in dump.get("traces", []):
+                if trace_id is not None and tr.get("trace_id") != trace_id:
+                    continue
+                traces.append({
+                    "trace_id": tr.get("trace_id"),
+                    "name": tr.get("name"),
+                    "events": [[n, t0 - offset, t1 - offset, tid, a]
+                               for (n, t0, t1, tid, a)
+                               in tr.get("events", [])],
+                })
+            remotes.append({
+                "pid": dump.get("pid"), "role": "store",
+                "dropped": dump.get("dropped"),
+                "clock_offset_err_s": err,
+                "traces": traces,
+            })
+        d["remotes"] = remotes
+        return d
 
     def cluster_report(self) -> Dict[str, Any]:
         """The /debug/cluster payload: ring + per-node state when the
@@ -1398,6 +1474,20 @@ def _make_handler(server: ServingServer):
                 # the cache-economics view (docs/observability.md
                 # §Usage attribution)
                 self._json(200, server.usage_debug())
+            elif self.path.split("?", 1)[0] == "/debug/critpath":
+                # the stage ledger: p50/p99 TTFT by canonical stage,
+                # dominant stage, worst-offender trace ids — per lane
+                # and overall (docs/observability.md §Latency
+                # attribution).  ?limit=N caps the row tail returned;
+                # ring capacity itself is ISTPU_CRITPATH_RING.
+                from urllib.parse import parse_qs, urlsplit
+
+                q = parse_qs(urlsplit(self.path).query)
+                try:
+                    limit = int(q["limit"][0])
+                except (KeyError, ValueError, IndexError):
+                    limit = None
+                self._json(200, server.critpath.snapshot(limit=limit))
             elif self.path.split("?", 1)[0] == "/debug/traces":
                 # recent completed request/step traces as Chrome trace-
                 # event JSON — stitched with the attached store's server-
@@ -1414,10 +1504,32 @@ def _make_handler(server: ServingServer):
                     limit = None
                 if q.get("raw", ["0"])[0] not in ("0", ""):
                     # raw dump (process-clock stamps + `clock`): the
-                    # front door's cross-process stitch input
-                    self._json(200, server.debug_traces_raw(limit=limit))
+                    # front door's cross-process stitch input.
+                    # ?stores=1 folds the attached store rings in
+                    # (pre-mapped into this worker's clock) for the
+                    # transitive mesh gather; ?trace_id= narrows to one
+                    # request.
+                    self._json(200, server.debug_traces_raw(
+                        limit=limit,
+                        trace_id=q.get("trace_id", [None])[0] or None,
+                        include_stores=(q.get("stores", ["0"])[0]
+                                        not in ("0", "")),
+                    ))
                     return
                 data = server.debug_traces_json(limit=limit).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+            elif self.path.startswith("/debug/trace/"):
+                # ONE request's stitched timeline by trace id (local
+                # ring + attached store rings, clock-mapped)
+                tid = self.path[len("/debug/trace/"):].split("?", 1)[0]
+                if not tid:
+                    self._json(400, {"error": "trace id required"})
+                    return
+                data = server.debug_trace_json(tid).encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(data)))
@@ -1674,6 +1786,7 @@ def _make_handler(server: ServingServer):
             flushed = False
             flush_error = None
             if server.engine.transfer is not None:
+                t_flush = time.perf_counter()
                 try:
                     # the durability barrier of the handoff contract
                     # (relaxed-mode pushes drain here) — scoped to THIS
@@ -1688,6 +1801,14 @@ def _make_handler(server: ServingServer):
                 except Exception as e:  # noqa: BLE001 — degrade, don't 500:
                     # the router falls back to recompute-on-decode
                     flush_error = repr(e)
+                # the flush barrier runs AFTER the request retired, so
+                # its cost is annotated into the stage ledger row by
+                # trace id (kv_flush: the handoff's TTFT share the
+                # waterfall cannot see)
+                server.critpath.annotate(
+                    tracing.current_trace_id(), "kv_flush",
+                    time.perf_counter() - t_flush,
+                )
             T = server.engine.pc.block_tokens
             out = {
                 "object": "prefill", "model_id": server.model_id,

@@ -84,7 +84,8 @@ class Snapshot:
                  admission: Optional[dict] = None,
                  fleet: Optional[dict] = None,
                  usage: Optional[dict] = None,
-                 sessions: Optional[dict] = None):
+                 sessions: Optional[dict] = None,
+                 critpath: Optional[dict] = None):
         self.serve = serve_metrics or {}
         self.store = store_metrics or {}
         self.cache = cache
@@ -107,6 +108,8 @@ class Snapshot:
         self.usage = usage
         # the serving /debug/sessions payload (session ledger)
         self.sessions = sessions
+        # the serve/router /debug/critpath payload (stage ledger)
+        self.critpath = critpath
 
     def lanes(self) -> List[str]:
         """Priority lanes seen in the serving TTFT family, numeric
@@ -638,6 +641,54 @@ class Console:
             )
         return out
 
+    def _critpath(self, snap: Snapshot) -> List[str]:
+        """The stage-breakdown view (serve/router /debug/critpath): the
+        canonical TTFT decomposition — per-stage p50/p99 with each
+        TTFT-path stage's share of p99 TTFT as a bar — the dominant
+        stage, and the worst-offender trace ids.  Zero-valued stages are
+        elided; the section renders identically for a worker's own grain
+        and a front door's merged router grain."""
+        cp = snap.critpath
+        if not cp or not cp.get("enabled"):
+            return []
+        ov = cp.get("overall") or {}
+        if not ov.get("count"):
+            return []
+        out: List[str] = [""]
+        out.append(
+            "critical path ({}, {} req)   TTFT p50 {:.1f}ms  "
+            "p99 {:.1f}ms   dominant: {}".format(
+                str(cp.get("role", "?")), int(ov.get("count", 0)),
+                float(ov.get("ttft_p50_ms", 0.0)),
+                float(ov.get("ttft_p99_ms", 0.0)),
+                str(ov.get("dominant_stage") or "-"),
+            )
+        )
+        p50 = ov.get("stage_p50_ms") or {}
+        p99 = ov.get("stage_p99_ms") or {}
+        share = ov.get("stage_share_p99") or {}
+        for stage in cp.get("stages") or sorted(p99):
+            v99 = float(p99.get(stage) or 0.0)
+            if v99 <= 0.0:
+                continue
+            sh = share.get(stage)
+            out.append(
+                "  {:18s} p50 {:>8.2f}ms  p99 {:>8.2f}ms  {}".format(
+                    stage, float(p50.get(stage) or 0.0), v99,
+                    (f"[{bar(min(1.0, sh), 12)}] {sh:5.1%} of p99 TTFT"
+                     if sh is not None else ""),
+                ).rstrip()
+            )
+        for w in (ov.get("worst") or [])[:2]:
+            out.append(
+                "  worst: {}  {:.1f}ms  ({})".format(
+                    str(w.get("trace_id") or "-")[:16],
+                    float(w.get("ttft_ms") or 0.0),
+                    str(w.get("dominant_stage") or "-"),
+                )
+            )
+        return out
+
     def frame(self, snap: Snapshot) -> str:
         out: List[str] = []
         w = 24
@@ -785,6 +836,7 @@ class Console:
         out.extend(self._alerts(snap))
         out.extend(self._admission(snap))
         out.extend(self._engine(snap))
+        out.extend(self._critpath(snap))
         out.extend(self._cluster(snap))
         out.extend(self._fleet(snap))
         # -- latency sparklines --
@@ -868,6 +920,12 @@ def poll(serve_url: Optional[str], store_url: Optional[str]) -> Snapshot:
     sessions = js(serve_url, "/debug/sessions?limit=6")
     if sessions is not None and not sessions.get("enabled"):
         sessions = None
+    # the stage ledger: a worker answers its own grain, a front door
+    # the merged router grain — same shape either way (limit=0 drops
+    # the row tail; the view renders the aggregates)
+    critpath = js(serve_url, "/debug/critpath?limit=0")
+    if critpath is not None and not critpath.get("enabled"):
+        critpath = None
     return Snapshot(
         serve_metrics=prom(serve_url, "/metrics"),
         store_metrics=prom(store_url, "/metrics"),
@@ -883,6 +941,7 @@ def poll(serve_url: Optional[str], store_url: Optional[str]) -> Snapshot:
         fleet=fleet,
         usage=usage,
         sessions=sessions,
+        critpath=critpath,
     )
 
 

@@ -4,17 +4,24 @@ store server's span ring into ONE Perfetto-loadable Chrome trace.
 The two halves record on different clocks (each process's
 ``perf_counter``).  The client estimated the offset between them at HELLO
 (``Connection.clock_offset``: server clock minus client clock, round-trip
-midpoint estimate, error bounded by half the HELLO RTT), so server span
-stamps map into the client timeline as ``t_client = t_server - offset``.
-Server events keep their own ``pid`` row in the export, which is what
-makes the wire hop visible in Perfetto: the client's
+midpoint estimate, error bounded by half the HELLO RTT — carried as
+``Connection.clock_offset_err`` and stamped into the export's
+``process_name`` metadata so timeline skew is self-describing), so server
+span stamps map into the client timeline as ``t_client = t_server -
+offset``.  Server events keep their own ``pid`` row in the export, which
+is what makes the wire hop visible in Perfetto: the client's
 ``read_cache.desc`` span on one process track, the server's
 ``store.GET_DESC`` → ``store.desc_build`` spans nested inside the same
 wall-clock window on the other, every event tagged with the shared
 ``args.trace_id``.
 
+Every gather attempt is counted in ``istpu_trace_stitch_total{result}``
+(``ok`` / ``unnegotiated`` / ``error``) so a stitched timeline with a
+missing process row is a visible gather failure, not an invisible hole.
+
 Used by ``serve.py /debug/traces`` (stitches the attached store in when
-trace context negotiated) and directly by tests/tools via
+trace context negotiated), by the frontdoor's mesh-wide
+``/debug/trace/{id}`` gather, and directly by tests/tools via
 ``gather_remote`` + ``stitch_chrome``.
 """
 
@@ -24,56 +31,118 @@ import json
 import os
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from . import metrics as _metrics
 
-def gather_remote(conn) -> Optional[Tuple[dict, float]]:
+_stitch_counter = _metrics.default_registry().counter(
+    "istpu_trace_stitch_total",
+    "Remote span-ring gather attempts by result: ok (dump merged), "
+    "unnegotiated (peer has no trace context), error (dump failed) — "
+    "a non-ok count explains a missing process row in a stitched export",
+    labelnames=("result",),
+)
+for _r in ("ok", "unnegotiated", "error"):
+    _stitch_counter.labels(result=_r)  # series exist before first gather
+
+
+def count_stitch(result: str) -> None:
+    """Count one gather attempt (shared with the frontdoor's HTTP-side
+    gathers so every stitch source reports into one family)."""
+    _stitch_counter.labels(result=result).inc()
+
+
+def gather_remote(conn) -> Optional[Tuple[dict, float, float]]:
     """Fetch a server's span ring over the wire (``OP_TRACE_DUMP``).
 
     ``conn`` may be the public ``InfinityConnection`` wrapper or the raw
-    wire ``Connection``.  Returns ``(dump, clock_offset)`` or None when
-    the peer never negotiated trace context (old server, native client,
-    ``ISTPU_TRACE_CTX=0``) or the dump fails — stitching is best-effort
-    observability, never a request-path error.
+    wire ``Connection``.  Returns ``(dump, clock_offset,
+    clock_offset_err)`` or None when the peer never negotiated trace
+    context (old server, native client, ``ISTPU_TRACE_CTX=0``) or the
+    dump fails — stitching is best-effort observability, never a
+    request-path error.  Non-ok outcomes are counted in
+    ``istpu_trace_stitch_total`` so the gap is visible.
     """
     raw = getattr(conn, "conn", conn)
     raw = getattr(raw, "conn", raw)  # InfinityConnection -> Connection
     if not getattr(raw, "trace_ctx", False):
+        count_stitch("unnegotiated")
         return None
     dump_fn = getattr(raw, "trace_dump", None)
     if dump_fn is None:
+        count_stitch("unnegotiated")
         return None
     try:
         dump = dump_fn()
     except Exception:  # noqa: BLE001 — a dead store must not break /debug
+        count_stitch("error")
         return None
-    return dump, float(getattr(raw, "clock_offset", 0.0) or 0.0)
+    count_stitch("ok")
+    return (dump, float(getattr(raw, "clock_offset", 0.0) or 0.0),
+            float(getattr(raw, "clock_offset_err", 0.0) or 0.0))
 
 
-def stitch_chrome(tracer, remotes: Sequence[Tuple[dict, float]] = (),
-                  limit: Optional[int] = None) -> dict:
+def _unpack(remote) -> Tuple[dict, float, float]:
+    """A remote is ``(dump, offset)`` or ``(dump, offset, err)`` — the
+    2-tuple shape predates the error bound and stays accepted."""
+    if len(remote) >= 3:
+        return remote[0], remote[1], remote[2]
+    return remote[0], remote[1], 0.0
+
+
+def stitch_chrome(tracer, remotes: Sequence = (),
+                  limit: Optional[int] = None,
+                  trace_id: Optional[str] = None,
+                  local_role: Optional[str] = None) -> dict:
     """One Chrome trace-event dict from the local ``tracer``'s ring plus
-    any number of remote ``(dump, clock_offset)`` pairs, all on the local
-    timeline (``ts`` relative to the earliest exported span)."""
+    any number of remote ``(dump, clock_offset[, clock_offset_err])``
+    tuples, all on the local timeline (``ts`` relative to the earliest
+    exported span).  ``trace_id`` narrows the export to one request's
+    spans across every process.  Process rows are named by each dump's
+    ``role`` when present (``prefill@1234``), and remote rows carry the
+    clock-offset estimate and its error bound in the ``process_name``
+    metadata args, so timeline skew is self-describing."""
     # rows: (name, t0, t1, thread key, pid, trace_id, args) in LOCAL time
     rows: List[tuple] = []
     pid = os.getpid()
-    for tr in tracer.recent(limit):
-        with tr._lock:
-            evs = list(tr.events)
-        for name, t0, t1, tident, args in evs:
-            rows.append((name, t0, t1, (pid, tident), pid, tr.trace_id, args))
-    for dump, offset in remotes:
+    pid_meta: Dict[int, dict] = {}
+    if tracer is not None:
+        for tr in tracer.recent(limit):
+            if trace_id is not None and tr.trace_id != trace_id:
+                continue
+            with tr._lock:
+                evs = list(tr.events)
+            for name, t0, t1, tident, args in evs:
+                rows.append((name, t0, t1, (pid, tident), pid,
+                             tr.trace_id, args))
+        pid_meta.setdefault(pid, {"role": local_role or "client",
+                                  "local": True})
+    for remote in remotes:
+        dump, offset, err = _unpack(remote)
         rpid = int(dump.get("pid", 0))
+        meta = pid_meta.setdefault(rpid, {
+            "role": dump.get("role") or ("client" if rpid == pid
+                                         else "store-server"),
+            # only role-labelled dumps (the mesh gather) get the
+            # `role@pid` row name; bare store dumps keep the
+            # pre-existing "store-server" name
+            "named": bool(dump.get("role")),
+            "local": rpid == pid and offset == 0.0,
+        })
+        if not meta.get("local"):
+            meta["clock_offset_s"] = offset
+            meta["clock_offset_err_s"] = err
         for tr in dump.get("traces", []):
-            trace_id = tr.get("trace_id")
+            tr_id = tr.get("trace_id")
+            if trace_id is not None and tr_id != trace_id:
+                continue
             for name, t0, t1, tident, args in tr.get("events", []):
                 rows.append((name, t0 - offset, t1 - offset,
-                             (rpid, tident), rpid, trace_id, args))
+                             (rpid, tident), rpid, tr_id, args))
     if not rows:
         return {"traceEvents": [], "displayTimeUnit": "ms"}
     base = min(r[1] for r in rows)
     tids: Dict[tuple, int] = {}
     events: List[dict] = []
-    for name, t0, t1, tkey, epid, trace_id, args in rows:
+    for name, t0, t1, tkey, epid, tr_id, args in rows:
         tid = tids.setdefault(tkey, len(tids) + 1)
         events.append({
             "name": name,
@@ -83,33 +152,42 @@ def stitch_chrome(tracer, remotes: Sequence[Tuple[dict, float]] = (),
             "dur": max(0.0, (t1 - t0) * 1e6),
             "pid": epid,
             "tid": tid,
-            "args": {"trace_id": trace_id, **(args or {})},
+            "args": {"trace_id": tr_id, **(args or {})},
         })
     # outer-before-inner so equal-start parents precede their children
     # (Perfetto nests by containment per track)
     events.sort(key=lambda e: (e["ts"], -e["dur"]))
     seen_pids = set()
     for (tpid, tident), tid in tids.items():
-        role = "store-server" if tpid != pid else "thread"
+        meta = pid_meta.get(tpid) or {}
+        row_role = "thread" if meta.get("local") else \
+            (meta.get("role") or "store-server")
         # string idents are synthetic tracks named verbatim — the step
         # profiler's "device" sub-track keeps its name across stitching
-        name = tident if isinstance(tident, str) else f"{role}-{tident}"
+        name = tident if isinstance(tident, str) else f"{row_role}-{tident}"
         events.append({
             "name": "thread_name", "ph": "M", "pid": tpid, "tid": tid,
             "args": {"name": name},
         })
         if tpid not in seen_pids:
             seen_pids.add(tpid)
+            role = meta.get("role") or "store-server"
+            pargs = {"name": (f"{role}@{tpid}" if meta.get("named")
+                              else role)}
+            for k in ("clock_offset_s", "clock_offset_err_s"):
+                if k in meta:
+                    pargs[k] = meta[k]
             events.append({
                 "name": "process_name", "ph": "M", "pid": tpid, "tid": 0,
-                "args": {"name": ("store-server" if tpid != pid
-                                  else "client")},
+                "args": pargs,
             })
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 
 def stitched_chrome_json(tracer, conns: Sequence = (),
-                         limit: Optional[int] = None) -> str:
+                         limit: Optional[int] = None,
+                         trace_id: Optional[str] = None,
+                         local_role: Optional[str] = None) -> str:
     """JSON convenience used by the serving ``/debug/traces`` endpoint:
     gather every stitchable peer in ``conns``, merge, dump."""
     remotes = []
@@ -117,4 +195,6 @@ def stitched_chrome_json(tracer, conns: Sequence = (),
         got = gather_remote(conn)
         if got is not None:
             remotes.append(got)
-    return json.dumps(stitch_chrome(tracer, remotes, limit=limit))
+    return json.dumps(stitch_chrome(tracer, remotes, limit=limit,
+                                    trace_id=trace_id,
+                                    local_role=local_role))

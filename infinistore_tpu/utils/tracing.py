@@ -55,13 +55,23 @@ _CURRENT: contextvars.ContextVar[Optional["Trace"]] = contextvars.ContextVar(
 _ids = itertools.count(1)
 
 # traces pushed out of ANY ring by overflow, process-wide (fn-backed
-# counter on the default registry; serving /metrics picks it up)
+# counter on the default registry; serving /metrics picks it up).  The
+# total is shared by every Tracer instance, so the increment takes its
+# own module lock — each Tracer's ring lock only serializes that ring.
 _ring_dropped = 0
+_ring_dropped_lock = threading.Lock()
 
 
 def _count_ring_dropped() -> None:
     global _ring_dropped
-    _ring_dropped += 1
+    with _ring_dropped_lock:
+        _ring_dropped += 1
+
+
+def ring_dropped_total() -> int:
+    """Process-wide overflow total (test/metric read side)."""
+    with _ring_dropped_lock:
+        return _ring_dropped
 
 
 class Trace:
@@ -242,14 +252,19 @@ class Tracer:
             traces = list(self._done)
         return traces[-limit:] if limit else traces
 
-    def dump(self, limit: Optional[int] = None) -> dict:
+    def dump(self, limit: Optional[int] = None,
+             trace_id: Optional[str] = None) -> dict:
         """JSON-able snapshot of the ring with RAW ``perf_counter`` stamps
         (this process's clock).  The wire shape behind ``OP_TRACE_DUMP``:
         the stitcher maps these stamps into the caller's timebase using
         the HELLO-derived clock offset.  ``clock`` is *now* on the same
-        clock, so a receiver can sanity-check the offset."""
+        clock, so a receiver can sanity-check the offset.  ``trace_id``
+        narrows the snapshot to ONE trace (the ``/debug/trace/{id}``
+        single-request gather)."""
         out = []
         for tr in self.recent(limit):
+            if trace_id is not None and tr.trace_id != trace_id:
+                continue
             with tr._lock:
                 evs = [[n, t0, t1, tid, a] for (n, t0, t1, tid, a)
                        in tr.events]
@@ -316,7 +331,7 @@ _metrics.default_registry().counter(
     "istpu_trace_ring_dropped_total",
     "Completed traces pushed out of a trace ring by overflow "
     "(raise ISTPU_TRACE_RING if this climbs during an investigation)",
-    fn=lambda: _ring_dropped,
+    fn=ring_dropped_total,
 )
 
 

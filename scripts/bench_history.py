@@ -108,6 +108,20 @@ METRICS = {
     # contract, not just a latency number
     "reprefill_waste_frac": ("down", "session re-prefill waste frac"),
     "affinity_hit_rate": ("up", "session affinity hit rate"),
+    # the stage ledger's TTFT decomposition (bench_serve.py `critpath`
+    # block, infinistore_tpu/critpath.py): per-stage p99 at sweep end —
+    # a round where one stage's p99 climbs is a NAMED regression
+    # (scripts/trace_diff.py diffs two captures the same way); absent
+    # keys (no /debug/critpath on older rounds) skip silently
+    "stage_p99_admission_wait_ms": ("down", "p99 admission_wait ms"),
+    "stage_p99_queue_wait_ms": ("down", "p99 queue_wait ms"),
+    "stage_p99_prefill_compute_ms": ("down", "p99 prefill_compute ms"),
+    "stage_p99_kv_flush_ms": ("down", "p99 kv_flush ms"),
+    "stage_p99_store_transfer_ms": ("down", "p99 store_transfer ms"),
+    "stage_p99_decode_queue_ms": ("down", "p99 decode_queue ms"),
+    "stage_p99_first_token_ms": ("down", "p99 first_token ms"),
+    "stage_p99_per_token_decode_ms": ("down", "p99 per_token_decode ms"),
+    "stage_p99_unattributed_ms": ("down", "p99 unattributed ms"),
 }
 
 

@@ -1,0 +1,160 @@
+#!/usr/bin/env python3
+"""Automated critical-path regression naming: diff the stage
+decompositions of two captures and NAME the stage that regressed.
+
+"TTFT went from 80 ms to 130 ms" starts an argument; "store_transfer
+went from 6 ms to 54 ms and owns 96% of the regression" ends one.  This
+script takes two stage-decomposition captures — ``bench_serve.py
+--json-out`` records (their ``critpath`` block / ``stage_p99_*_ms``
+mirrors) or raw ``GET /debug/critpath`` payloads from two live windows —
+and, per quantile, attributes the TTFT delta to the canonical stages
+(infinistore_tpu/critpath.py), naming the dominant regressed stage with
+its effect size:
+
+    python scripts/trace_diff.py baseline.json candidate.json
+    python scripts/trace_diff.py --quantile p50 before.json after.json
+    python scripts/trace_diff.py --json a.json b.json   # machine-readable
+
+Exit code: 0 when no stage regressed past ``--threshold-ms`` (default
+5 ms), 2 when one did — usable as a perf gate.  The pure half
+(:func:`diff_stages`) is imported by the chaos test that asserts a
+FaultInjector-induced store delay is named ``store_transfer`` here, not
+eyeballed from a timeline.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+from typing import Any, Dict, Optional
+
+# keep the canonical stage order without importing the package (the
+# script must run from a bare checkout); cross-checked by the tier-1
+# test against infinistore_tpu.critpath.STAGES
+STAGES = (
+    "admission_wait",
+    "queue_wait",
+    "prefill_compute",
+    "kv_flush",
+    "store_transfer",
+    "decode_queue",
+    "first_token",
+    "per_token_decode",
+    "unattributed",
+)
+
+
+def load_stages(obj: Dict[str, Any],
+                quantile: str = "p99") -> Dict[str, float]:
+    """Per-stage milliseconds out of any capture shape we emit:
+
+    * a live ``/debug/critpath`` payload (``overall.stage_<q>_ms``);
+    * a ``bench_serve --json-out`` record (its ``critpath`` block, or
+      the flat ``stage_<q>_<stage>_ms`` mirrors);
+    * an already-flat ``{stage: ms}`` dict (tests).
+    """
+    key = f"stage_{quantile}_ms"
+    for block in (obj, obj.get("critpath") or {}):
+        overall = block.get("overall") or block
+        if isinstance(overall.get(key), dict):
+            return {s: float(overall[key].get(s) or 0.0) for s in STAGES}
+    flat = {s: obj.get(f"stage_{quantile}_{s}_ms") for s in STAGES}
+    if any(v is not None for v in flat.values()):
+        return {s: float(v or 0.0) for s, v in flat.items()}
+    if all(isinstance(obj.get(s), (int, float)) for s in STAGES
+           if s in obj) and any(s in obj for s in STAGES):
+        return {s: float(obj.get(s) or 0.0) for s in STAGES}
+    raise ValueError(
+        f"no stage_{quantile} decomposition found (expected a "
+        "/debug/critpath payload, a bench_serve --json-out record with "
+        "a critpath block, or a flat stage dict)")
+
+
+def diff_stages(base: Dict[str, float], cand: Dict[str, float],
+                threshold_ms: float = 5.0) -> Dict[str, Any]:
+    """Attribute the TTFT movement between two per-stage decompositions
+    (pure; milliseconds in, a named verdict out).
+
+    The regressed stage is the one with the largest positive delta; its
+    effect size is reported absolutely (``delta_ms``), relatively
+    (``ratio`` — candidate over baseline), and as its share of the
+    total positive movement (``share_of_regression``).  ``regressed``
+    is True only when that delta clears ``threshold_ms``, so noise-level
+    jitter never names a culprit."""
+    deltas = {s: round((cand.get(s) or 0.0) - (base.get(s) or 0.0), 3)
+              for s in STAGES}
+    total_up = sum(d for d in deltas.values() if d > 0)
+    worst = max(STAGES, key=lambda s: deltas[s])
+    worst_delta = deltas[worst]
+    base_v = base.get(worst) or 0.0
+    out = {
+        "ttft_delta_ms": round(sum(deltas.values()), 3),
+        "deltas_ms": deltas,
+        "regressed": bool(worst_delta >= threshold_ms),
+        "stage": worst if worst_delta > 0 else None,
+        "delta_ms": worst_delta,
+        "ratio": round((cand.get(worst) or 0.0) / base_v, 3)
+        if base_v > 0 else None,
+        "share_of_regression": round(worst_delta / total_up, 4)
+        if total_up > 0 else 0.0,
+    }
+    return out
+
+
+def _load(path: str) -> Dict[str, Any]:
+    return json.loads(Path(path).read_text())
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        "trace_diff.py",
+        description="name the regressed stage between two stage-"
+                    "decomposition captures")
+    ap.add_argument("baseline", help="baseline capture (bench_serve "
+                                     "--json-out or /debug/critpath JSON)")
+    ap.add_argument("candidate", help="candidate capture, same shapes")
+    ap.add_argument("--quantile", default="p99", choices=("p50", "p99"),
+                    help="which per-stage quantile to diff (default p99)")
+    ap.add_argument("--threshold-ms", type=float, default=5.0,
+                    help="minimum stage delta before a regression is "
+                         "named (default 5 ms)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the verdict as JSON")
+    args = ap.parse_args(argv)
+    try:
+        base = load_stages(_load(args.baseline), args.quantile)
+        cand = load_stages(_load(args.candidate), args.quantile)
+    except (OSError, ValueError) as e:
+        print(f"trace_diff: {e}", file=sys.stderr)
+        return 1
+    verdict = diff_stages(base, cand, threshold_ms=args.threshold_ms)
+    if args.json:
+        print(json.dumps(verdict, indent=2))
+    else:
+        print(f"{'stage':22s}{'base ms':>10s}{'cand ms':>10s}"
+              f"{'delta ms':>10s}")
+        print("-" * 52)
+        for s in STAGES:
+            print(f"{s:22s}{base[s]:>10.3f}{cand[s]:>10.3f}"
+                  f"{verdict['deltas_ms'][s]:>+10.3f}")
+        print("-" * 52)
+        print(f"{'TTFT-path total':22s}{sum(base.values()):>10.3f}"
+              f"{sum(cand.values()):>10.3f}"
+              f"{verdict['ttft_delta_ms']:>+10.3f}")
+        if verdict["regressed"]:
+            ratio = (f", {verdict['ratio']:.2f}x"
+                     if verdict["ratio"] is not None else "")
+            print(f"\nREGRESSED stage: {verdict['stage']} "
+                  f"(+{verdict['delta_ms']:.1f} ms{ratio}; "
+                  f"{verdict['share_of_regression']:.0%} of the total "
+                  f"positive movement)")
+        else:
+            print(f"\nno stage regressed past "
+                  f"{args.threshold_ms:.1f} ms at {args.quantile}")
+    return 2 if verdict["regressed"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
