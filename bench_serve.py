@@ -158,6 +158,7 @@ def self_disagg(args):
             store_port, args.prefill_workers, args.decode_workers,
             n_blocks=args.self_serve_blocks,
             max_batch=args.self_serve_batch,
+            n_routers=max(1, args.routers),
         )
     except BaseException:
         proc.send_signal(signal.SIGINT)
@@ -283,6 +284,17 @@ def main(argv=None) -> int:
                     help="--self-disagg: prefill pool size")
     ap.add_argument("--decode-workers", type=int, default=1,
                     help="--self-disagg: decode pool size")
+    ap.add_argument("--routers", type=int, default=1,
+                    help="--self-disagg: router replicas over the same "
+                         "pools (each names the others as --peers); the "
+                         "load generator spreads clients across all of "
+                         "them and fails over on connect errors")
+    ap.add_argument("--pacer", choices=["auto", "thread", "async"],
+                    default="auto",
+                    help="arrival pacer: 'async' drives every request "
+                         "from one asyncio event loop (the 10k-session "
+                         "path), 'thread' keeps one thread per in-flight "
+                         "request; 'auto' picks async for live targets")
     ap.add_argument("--no-monolith-baseline", action="store_true",
                     help="--self-disagg: skip the monolith comparison "
                          "sweep (faster; no ratio in the output)")
@@ -382,6 +394,15 @@ def main(argv=None) -> int:
     elif args.self_disagg:
         fleet_close, url, model_vocab, fleet_workers = self_disagg(args)
         vocab = min(vocab, model_vocab)
+    pacer = None if args.pacer == "auto" else args.pacer
+    # the load generator's target: every router replica when the fleet
+    # has more than one (clients spread across them round-robin and
+    # fail over on connect errors); `url` stays the primary replica for
+    # the debug-endpoint gathering below
+    urls = url
+    if fleet_workers is not None and len(fleet_workers.get("router", ())) > 1:
+        urls = [f"http://127.0.0.1:{r.port}"
+                for r in fleet_workers["router"]]
     base = LoadConfig(
         rate=args.rates[0], n_requests=args.n, process=args.process,
         seed=args.seed, mix=args.mix, lanes=args.lanes,
@@ -444,7 +465,7 @@ def main(argv=None) -> int:
                     vocab=vocab, stream=not args.no_stream,
                     timeout_s=args.timeout,
                 )
-                results, makespan = run_sessions(url, scfg)
+                results, makespan = run_sessions(urls, scfg, pacer=pacer)
                 point = summarize(results, makespan, args.slo_ttft,
                                   args.slo_tpot, rate=float(rate))
                 point["sessions"] = session_summary(results)
@@ -453,9 +474,9 @@ def main(argv=None) -> int:
                 if args.cooldown and rate != args.rates[-1]:
                     time.sleep(args.cooldown)
         else:
-            curve = sweep(url, base, args.rates, args.slo_ttft,
+            curve = sweep(urls, base, args.rates, args.slo_ttft,
                           args.slo_tpot, cooldown_s=args.cooldown,
-                          on_point=show)
+                          on_point=show, pacer=pacer)
         # the step profiler's summary for the whole sweep (best-effort:
         # older servers have no /debug/engine) — host-stall share,
         # retrace pressure, dispatch counts next to the goodput curve
@@ -588,6 +609,43 @@ def main(argv=None) -> int:
                 critpath_dbg = payload
         except Exception:  # noqa: BLE001 — observability, not the bench
             pass
+        # the resumption plane's fleet-side half (best-effort, same
+        # contract): the router-merged stream ledger ("did any stream
+        # die?" — aborts + resumes summed across replicas) and the
+        # decode workers' checkpoint-overhead counters
+        fleet_merged = None
+        try:
+            import urllib.request
+
+            with urllib.request.urlopen(url + "/debug/fleet?merged=1",
+                                        timeout=5) as r:
+                payload = json.loads(r.read())
+            if payload.get("enabled"):
+                fleet_merged = payload
+        except Exception:  # noqa: BLE001 — observability, not the bench
+            pass
+        ckpt_writes = ckpt_tokens = 0.0
+        ckpt_seen = False
+        for s in (fleet_workers or {}).get("decode", ()):
+            try:
+                import urllib.request
+
+                from infinistore_tpu.utils.metrics import \
+                    parse_prometheus_text
+
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{s.port}/metrics",
+                        timeout=5) as r:
+                    fams = parse_prometheus_text(r.read().decode())
+            except Exception:  # noqa: BLE001
+                continue
+            for (name, _labels), v in fams.items():
+                if name == "istpu_serve_resume_ckpt_writes_total":
+                    ckpt_writes += v
+                    ckpt_seen = True
+                elif name == "istpu_serve_resume_ckpt_tokens_total":
+                    ckpt_tokens += v
+                    ckpt_seen = True
         disagg = None
         if args.self_disagg:
             disagg = _gather_disagg(url, fleet_workers, args)
@@ -695,6 +753,37 @@ def main(argv=None) -> int:
     # mirrored top-level (0/1) for the scripts/bench_history.py trend
     # table: an overload round whose plateau flag drops to 0 regressed
     record["goodput_plateau"] = int(plateau)
+    # resumption block (docs/observability.md §Resumption): the
+    # client-observed splice ledger over the whole sweep (resumed =
+    # streams that crossed at least one splice, stalled = the same
+    # requests as the client's stall accounting sees them, max_stall_ms
+    # = the worst client-visible gap), the router-merged server-side
+    # view when a fleet answered /debug/fleet?merged=1, and the decode
+    # pool's checkpoint-overhead counters.  stream_resumes mirrors
+    # top-level for scripts/bench_history.py (direction: down — a quiet
+    # fleet resumes nothing)
+    resumption = {
+        "resumed": sum(p.get("resumed") or 0 for p in curve),
+        "stalled": sum(p.get("stalled") or 0 for p in curve),
+        "max_stall_ms": max(
+            (p.get("max_stall_ms") for p in curve
+             if p.get("max_stall_ms") is not None), default=None),
+        "routers": args.routers if args.self_disagg else None,
+    }
+    if fleet_merged is not None:
+        resumption["fleet"] = {
+            "replicas": fleet_merged.get("replicas"),
+            "reachable": fleet_merged.get("reachable"),
+            "stream": fleet_merged.get("stream"),
+        }
+    if ckpt_seen:
+        resumption["checkpoint"] = {
+            "writes": ckpt_writes, "tokens": ckpt_tokens,
+        }
+    record["resumption"] = resumption
+    record["stream_resumes"] = resumption["resumed"]
+    if resumption["max_stall_ms"] is not None:
+        record["max_stall_ms"] = resumption["max_stall_ms"]
     if args.conversation:
         # sessions block (docs/observability.md §Session attribution):
         # the persistence-contract numbers for the run — the fraction of

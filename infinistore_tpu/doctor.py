@@ -57,6 +57,10 @@ SERVE_ENDPOINTS: Tuple[Tuple[str, str, str], ...] = (
     # router), a 404 everywhere else — per-endpoint degradation keeps
     # the bundle whole either way
     ("fleet", "/debug/fleet", "debug_fleet.json"),
+    # the router-merged view: EVERY replica's /debug/fleet report
+    # embedded (reachable or flagged), with the request/stream counters
+    # summed — the bundle's one answer to "did any stream die?"
+    ("fleet_merged", "/debug/fleet?merged=1", "debug_fleet_merged.json"),
     # the tenant usage ledger (per-tenant occupancy vs tokens saved)
     ("usage", "/debug/usage", "debug_usage.json"),
     # the session ledger (per-conversation turn rows + re-prefill waste)
@@ -240,6 +244,52 @@ def summarize_capture(cap: Dict[str, Any], top_n: int = 5) -> str:
             f"{ad.get('store_tokens', 0):.0f} local-tokens "
             f"{ad.get('local_tokens', 0):.0f}"
         )
+        lines.append("")
+
+    # -- router replicas + the stream-death verdict --
+    merged = _json_of(serve, "fleet_merged") if serve else None
+    rt = (fleet or {}).get("router") if fleet else None
+    if (merged and merged.get("enabled")) or rt:
+        lines.append("## Streams — did any die?")
+        if merged and merged.get("enabled"):
+            st = merged.get("stream") or {}
+            ok = float(st.get("resumes_ok") or 0)
+            failed = float(st.get("resumes_failed") or 0)
+            aborts = float(st.get("aborts") or 0)
+            lines.append(
+                f"- router replicas: {merged.get('reachable', 0)}/"
+                f"{merged.get('replicas', 0)} reachable"
+            )
+            for r in merged.get("routers") or []:
+                who = "self" if r.get("self") else "peer"
+                lines.append(
+                    f"- router[{who}] {r.get('endpoint')}: "
+                    + ("reachable" if r.get("reachable")
+                       else "**UNREACHABLE**")
+                )
+        else:  # single pre-merge router: its own stream block
+            st = (rt or {}).get("stream") or {}
+            rs = st.get("resumes") or {}
+            ok = float(rs.get("ok") or 0)
+            failed = float(rs.get("failed") or 0)
+            aborts = float(st.get("aborts") or 0)
+            lines.append(f"- router replicas: "
+                         f"{(rt or {}).get('replicas', 1)} (not merged)")
+        if failed or aborts:
+            lines.append(
+                f"- **YES — streams were LOST**: {int(failed)} resume "
+                f"failure(s), {int(aborts)} client-visible abort(s) "
+                f"(clients got an SSE error; they had to retry)"
+            )
+        elif ok:
+            lines.append(
+                f"- streams died but none were lost: {int(ok)} "
+                f"mid-stream splice(s) resumed byte-exact on survivors "
+                f"(clients saw a stall, not an error)"
+            )
+        else:
+            lines.append("- no: zero aborts, zero resumes — every "
+                         "stream finished where it started")
         lines.append("")
 
     # -- admission / shedding state, next to the alerts it reacts to --

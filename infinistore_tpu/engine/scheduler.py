@@ -325,6 +325,7 @@ class Scheduler:
         on_token: Optional[Callable[[List[int], bool], None]] = None,
         trace_id: Optional[str] = None,
         t_stage: float = 0.0,
+        resume_output: Optional[Sequence[int]] = None,
     ) -> int:
         # boundary validation: a bad request must be rejected HERE, not
         # explode inside a later engine step and fault out every in-flight
@@ -388,6 +389,20 @@ class Scheduler:
             logprobs=min(max(int(logprobs), 0), self.LOGPROBS_K),
             on_token=on_token, trace_id=trace_id, t_stage=t_stage,
         )
+        if resume_output:
+            # mid-stream resumption (serve.py restore path; docs/design.md
+            # resumption contract): the survivor adopts a died worker's
+            # generated-so-far tokens as pre-seeded output.  ``_admit``
+            # prefills tokens + output, so the adopted KV pages come back
+            # through the normal guarded store probe and decoding
+            # continues from the checkpointed position.  ``on_token``
+            # re-delivers the pre-seed (``_sent`` starts at 0) — the
+            # serving layer's emitted-count watermark suppresses the
+            # duplicates.  Capped one short of the budget so at least one
+            # real decode step runs and the request retires through the
+            # normal done path.
+            req.output = [int(t) for t in resume_output][
+                :max(0, max_new_tokens - 1)]
         self._next_id += 1
         req.t_submit = time.perf_counter()
         self._enqueue(req)

@@ -222,10 +222,33 @@ class FrontDoor:
                  host: str = "127.0.0.1", port: int = 8080,
                  poll_s: float = 1.0, handoff_timeout_s: float = 120.0,
                  request_timeout_s: float = 600.0,
-                 affinity_tokens: int = 16):
+                 affinity_tokens: int = 16,
+                 peers: Optional[List[str]] = None):
         if not decode_urls:
             raise ValueError("need at least one decode worker")
         self.metrics = MetricsRegistry()
+        # replicated routers (docs/design.md resumption + replication):
+        # N front doors over the SAME worker pools need zero
+        # coordination — rendezvous placement is deterministic, so every
+        # replica computes the same affinity order, and health / breaker
+        # / session-pin state stays per-router SOFT state (a pin missing
+        # on replica 2 costs one affinity miss, which store adoption
+        # already tolerates).  ``peers`` (--peers / ISTPU_FD_PEERS) only
+        # names the siblings for the fleet-merged /debug/fleet view and
+        # the replica gauge; routing never consults them.
+        self.peers = [
+            (u if "//" in u else f"http://{u}").rstrip("/")
+            for u in (peers or [])
+        ]
+        # router-plane fault injection (house rule: the failure mode
+        # lands as an injectable fault before its mitigation).  The
+        # ``router_death`` scenario drops every client connection at
+        # request entry — the loadgen's router-list failover is the
+        # mitigation under test.  Armed via POST /debug/faults (never
+        # itself gated).
+        from .pyserver import FaultInjector
+
+        self.faults = FaultInjector()
         self.prefill = [WorkerState(u, "prefill", self.metrics)
                         for u in prefill_urls]
         self.decode = [WorkerState(u, "decode", self.metrics)
@@ -337,6 +360,23 @@ class FrontDoor:
             "bytes were already forwarded (client sees an SSE error "
             "event, not a broken socket)",
         )
+        self._c_resume = reg.counter(
+            "istpu_fd_stream_resumes_total",
+            "Mid-stream decode-death re-dispatches by result: ok (the "
+            "stream spliced onto a survivor and continued byte-exact "
+            "under the emitted-count watermark), failed (no survivor "
+            "could continue — the stream aborted)",
+            labelnames=("result",),
+        )
+        for res in ("ok", "failed"):
+            self._c_resume.labels(res)
+        self._g_replicas = reg.gauge(
+            "istpu_fd_router_replicas",
+            "Router replicas this process knows of (itself + --peers).  "
+            "Configuration, not membership: rendezvous placement needs "
+            "no coordination, so replicas never handshake",
+        )
+        self._g_replicas.set(1 + len(self.peers))
         self._g_workers = reg.gauge(
             "istpu_fd_workers",
             "Configured workers per role", labelnames=("role",),
@@ -672,6 +712,62 @@ class FrontDoor:
                 },
             },
             "requests": dict(self.stats),
+            "router": {
+                "replicas": 1 + len(self.peers),
+                "peers": list(self.peers),
+                "stream": {
+                    "aborts": self.metrics.family_value(
+                        "istpu_fd_stream_aborts_total") or 0.0,
+                    "resumes": {
+                        res: self.metrics.family_value(
+                            "istpu_fd_stream_resumes_total",
+                            where={"result": res}) or 0.0
+                        for res in ("ok", "failed")
+                    },
+                },
+            },
+        }
+
+    def fleet_report_merged(self) -> Dict[str, Any]:
+        """``GET /debug/fleet?merged=1``: this replica's report plus
+        every peer's, with the request/stream counters SUMMED — the one
+        place a fleet-wide "did any stream die?" answer exists without
+        scraping N routers by hand.  Per-replica reports stay truthful
+        (each router only ever counts its own traffic); unreachable
+        peers degrade the merge, never fail it."""
+        mine = self.fleet_report()
+        routers = [{"endpoint": f"127.0.0.1:{self.port}", "self": True,
+                    "reachable": True, "report": mine}]
+        for url in self.peers:
+            try:
+                host, port = _hostport(url)
+            except ValueError:
+                continue
+            peer = WorkerState.__new__(WorkerState)
+            peer.host, peer.port = host, port
+            rep = self._fetch_json(peer, "/debug/fleet", timeout=5.0)
+            routers.append({"endpoint": f"{host}:{port}", "self": False,
+                            "reachable": rep is not None, "report": rep})
+        total = {"2xx": 0.0, "4xx": 0.0, "5xx": 0.0, "error": 0.0}
+        stream = {"aborts": 0.0, "resumes_ok": 0.0, "resumes_failed": 0.0}
+        for r in routers:
+            rep = r.get("report") or {}
+            for cls, v in (rep.get("requests") or {}).items():
+                if cls in total:
+                    total[cls] += float(v or 0)
+            st = (rep.get("router") or {}).get("stream") or {}
+            stream["aborts"] += float(st.get("aborts") or 0)
+            rs = st.get("resumes") or {}
+            stream["resumes_ok"] += float(rs.get("ok") or 0)
+            stream["resumes_failed"] += float(rs.get("failed") or 0)
+        return {
+            "enabled": True,
+            "role": "router-fleet",
+            "replicas": len(routers),
+            "reachable": sum(1 for r in routers if r["reachable"]),
+            "routers": routers,
+            "requests": total,
+            "stream": stream,
         }
 
     def stitched_traces_json(self, limit: Optional[int] = None) -> str:
@@ -848,6 +944,8 @@ def _make_handler(fd: FrontDoor):
 
         def do_GET(self):
             path = self.path.split("?", 1)[0]
+            if not self._fault_gate():
+                return
             if path == "/healthz":
                 self._json(200, fd.health())
             elif path == "/metrics":
@@ -858,7 +956,15 @@ def _make_handler(fd: FrontDoor):
                 self.end_headers()
                 self.wfile.write(data)
             elif path == "/debug/fleet":
-                self._json(200, fd.fleet_report())
+                from urllib.parse import parse_qs
+
+                q = parse_qs(urlsplit(self.path).query)
+                if (q.get("merged") or ["0"])[0] not in ("", "0", "false"):
+                    # fleet-merged view across router replicas (peers
+                    # from --peers / ISTPU_FD_PEERS)
+                    self._json(200, fd.fleet_report_merged())
+                else:
+                    self._json(200, fd.fleet_report())
             elif path == "/debug/usage":
                 # the fleet usage ledger: every worker's joined
                 # /debug/usage folded into one per-tenant view
@@ -902,10 +1008,69 @@ def _make_handler(fd: FrontDoor):
             else:
                 self._json(404, {"error": "not found"})
 
+        def _fault_gate(self) -> bool:
+            """Router-plane fault gate (scenario ``router_death``), the
+            serve-plane grammar matched on the request path at entry.
+            ``/debug/*`` is never gated — it IS the chaos control plane,
+            and a ``*`` rule must not lock out its own clear."""
+            path = self.path.split("?", 1)[0]
+            if path.startswith("/debug/"):
+                return True
+            if not fd.faults.armed:
+                return True
+            rule = fd.faults.match(path.upper())
+            if rule is None:
+                return True
+            action = rule["action"]
+            if action == "delay":
+                time.sleep(rule["delay_s"])
+                return True
+            if action == "stall":
+                while fd.faults.active(rule["id"]):
+                    time.sleep(0.05)
+                return True
+            if action == "drop_conn":
+                # an abrupt close with no status line: what a SIGKILLed
+                # router looks like to its clients — the loadgen's
+                # router-list failover is the mitigation under test
+                try:
+                    self.connection.close()
+                except OSError:
+                    pass
+                return False
+            if action == "error":
+                status = int(rule.get("error_status") or 500)
+                self._json(status, {"error": "injected fault"})
+                fd.count_code(status)
+                return False
+            return True
+
         def do_POST(self):
+            if self.path.split("?", 1)[0] == "/debug/faults":
+                # arm/clear router-plane fault rules (chaos only; never
+                # itself fault-matched).  Body: a rule list,
+                # {"rules": [...]}, or {"scenario": name} — e.g.
+                # {"scenario": "router_death"}.
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(n) or b"[]")
+                    if isinstance(body, dict) and body.get("scenario"):
+                        armed = fd.faults.arm_scenario(
+                            str(body["scenario"]))
+                    else:
+                        rules = body.get("rules", []) \
+                            if isinstance(body, dict) else body
+                        armed = fd.faults.arm(rules)
+                except (ValueError, TypeError) as e:
+                    self._json(400, {"error": str(e)})
+                    return
+                self._json(200, {"armed": armed})
+                return
             if self.path not in ("/v1/completions", "/v1/chat/completions"):
                 self._json(404, {"error": "not found"})
                 fd.count_code(404)
+                return
+            if not self._fault_gate():
                 return
             self._cp_t0 = time.perf_counter()
             self._cp_first: Optional[float] = None
@@ -993,30 +1158,35 @@ def _make_handler(fd: FrontDoor):
                         fd._c_retry.inc()
                     attempts += 1
                     w.begin()
+                    # the worker that ultimately served (a mid-stream
+                    # resume splices onto a survivor; _relay_sse updates)
+                    self._served_w = w
                     try:
-                        status = self._proxy_one(w, raw, trace_id)
+                        status = self._proxy_one(w, raw, trace_id, stem)
                     finally:
                         w.end()
                     if status is not None:
+                        sw = self._served_w or w
                         if sid is not None:
                             # result judged by who actually SERVED:
                             # hit = the pinned worker; miss = a pin
                             # existed but a survivor served (drain /
-                            # failover — re-pin there); fallback =
-                            # no pin yet (prefix-affinity placement)
+                            # failover / mid-stream resume — re-pin
+                            # there); fallback = no pin yet (prefix-
+                            # affinity placement)
                             res = ("fallback" if pinned is None else
-                                   "hit" if w.endpoint == pinned
+                                   "hit" if sw.endpoint == pinned
                                    else "miss")
                             fd._c_session_aff.labels(res).inc()
-                            fd.session_bind(sid, w.endpoint)
+                            fd.session_bind(sid, sw.endpoint)
                         return status
                     # transport failure before any byte forwarded:
                     # fail over to the next affinity candidate
             self._json(503, {"error": "no decode worker available"})
             return 503
 
-        def _proxy_one(self, w: WorkerState, raw: str,
-                       trace_id: str) -> Optional[int]:
+        def _proxy_one(self, w: WorkerState, raw: str, trace_id: str,
+                       stem: Optional[str] = None) -> Optional[int]:
             """Forward the request to one decode worker and stream the
             answer back.  None = transport failure with NOTHING yet
             forwarded (caller may fail over); any int = a status line
@@ -1040,7 +1210,7 @@ def _make_handler(fd: FrontDoor):
                 ctype = resp.getheader("Content-Type", "application/json")
                 if resp.status == 200 and ctype.startswith(
                         "text/event-stream"):
-                    return self._relay_sse(w, resp)
+                    return self._relay_sse(w, resp, raw, trace_id, stem)
                 data = resp.read()
                 if self._cp_first is None:
                     self._cp_first = time.perf_counter()
@@ -1058,44 +1228,150 @@ def _make_handler(fd: FrontDoor):
             finally:
                 conn.close()
 
-        def _relay_sse(self, w: WorkerState, resp) -> int:
-            """Stream an SSE body through unmodified.  An upstream death
-            AFTER bytes went out cannot fail over (tokens already left);
-            it surfaces as an SSE error event + [DONE], counted in
-            istpu_fd_stream_aborts_total — the client retries, the
-            router never half-duplicates a stream."""
+        def _relay_sse(self, w: WorkerState, resp, raw: str,
+                       trace_id: str, stem: Optional[str]) -> int:
+            """Stream an SSE body through, resuming across decode
+            deaths (docs/design.md resumption contract).  The relay
+            counts forwarded completion tokens as the emitted-count
+            WATERMARK; on an upstream transport death it re-dispatches
+            the same body + trace id to a survivor with the resume
+            headers and splices the survivor's stream onto the SAME
+            client socket after a ``: istpu-resume`` SSE comment — the
+            client sees a stall, never an error, and the watermark
+            suppression on the survivor keeps the splice byte-exact.
+            Only when NO survivor can continue does the old abort
+            contract apply: an SSE error event + [DONE], counted in
+            istpu_fd_stream_aborts_total (and resumes{failed})."""
             self.send_response(200)
             self.send_header("Content-Type", "text/event-stream")
             self.send_header("Cache-Control", "no-cache")
             self.send_header("Connection", "close")
             self.end_headers()
             self.close_connection = True
+            cur_w, cur_resp = w, resp
+            cur_conn = None  # resume-opened upstream (caller owns resp's)
+            watermark = 0    # completion tokens already forwarded
+            saw_done = False
             try:
                 while True:
-                    line = resp.readline()
-                    if not line:
-                        break
-                    if self._cp_first is None:  # first forwarded byte:
-                        self._cp_first = time.perf_counter()  # router TTFT
-                    self.wfile.write(line)
-                    if line == b"\n":  # event boundary: flush the chunk
-                        self.wfile.flush()
-                self.wfile.flush()
-            except (BrokenPipeError, ConnectionResetError):
-                return -1  # client disconnect: worker sees it and cancels
-            except OSError:
-                w.breaker.record_failure()
-                fd._c_abort.inc()
+                    try:
+                        while True:
+                            line = cur_resp.readline()
+                            if not line:
+                                break
+                            if self._cp_first is None:  # first byte out:
+                                self._cp_first = time.perf_counter()
+                            if line.startswith(b"data: "):
+                                data = line[6:].strip()
+                                if data == b"[DONE]":
+                                    saw_done = True
+                                else:
+                                    try:
+                                        ev = json.loads(data)
+                                        ch = (ev.get("choices") or [{}])[0]
+                                        watermark += len(
+                                            ch.get("token_ids") or ())
+                                    except (ValueError, AttributeError,
+                                            TypeError):
+                                        pass
+                            self.wfile.write(line)
+                            if line == b"\n":  # event boundary: flush
+                                self.wfile.flush()
+                        if saw_done:
+                            self.wfile.flush()
+                            return 200
+                        # EOF before [DONE]: the upstream died TIDILY —
+                        # a SIGKILLed worker's socket closes with a FIN,
+                        # not an RST, so truncation (not an exception) is
+                        # what death usually looks like here
+                        raise OSError("upstream EOF before [DONE]")
+                    except (BrokenPipeError, ConnectionResetError):
+                        return -1  # client disconnect: workers cancel
+                    except OSError:
+                        cur_w.breaker.record_failure()
+                        got = self._resume_stream(
+                            cur_w, raw, trace_id, stem, watermark)
+                        if got is None:
+                            fd._c_resume.labels("failed").inc()
+                            fd._c_abort.inc()
+                            try:
+                                err = json.dumps(
+                                    {"error": f"decode worker "
+                                              f"{cur_w.endpoint} died "
+                                              f"mid-stream; retry"})
+                                self.wfile.write(
+                                    f"data: {err}\n\ndata: [DONE]\n\n"
+                                    .encode())
+                                self.wfile.flush()
+                            except OSError:
+                                pass
+                            return 200
+                        if cur_conn is not None:
+                            cur_conn.close()
+                            cur_w.end()
+                        cur_w, cur_conn, cur_resp = got
+                        self._served_w = cur_w
+                        fd._c_resume.labels("ok").inc()
+                        try:
+                            # an SSE comment is protocol-transparent:
+                            # clients that care (loadgen resumption
+                            # accounting) count the splice marker,
+                            # everyone else ignores it
+                            self.wfile.write(b": istpu-resume\n\n")
+                            self.wfile.flush()
+                        except OSError:
+                            return -1
+            finally:
+                if cur_conn is not None:
+                    cur_conn.close()
+                    cur_w.end()
+
+        def _resume_stream(self, dead: WorkerState, raw: str,
+                           trace_id: str, stem: Optional[str],
+                           watermark: int
+                           ) -> Optional[Tuple[WorkerState, Any, Any]]:
+            """Re-dispatch a died-mid-stream request to a survivor.  The
+            survivor gets the SAME body and trace id plus the resume
+            headers: it fetches the store checkpoint by trace id, adopts
+            the KV pages through its normal guarded prefill probe, and
+            suppresses everything below the forwarded-token watermark.
+            Returns ``(worker, conn, resp)`` with inflight begun on the
+            worker (the caller owns end()/close()), or None when no
+            survivor could continue the stream."""
+            for nw in fd.decode_candidates(stem):
+                if nw.endpoint == dead.endpoint:
+                    continue
+                if not nw.breaker.allow():
+                    continue
+                nw.begin()
+                conn = None
                 try:
-                    err = json.dumps(
-                        {"error": f"decode worker {w.endpoint} died "
-                                  f"mid-stream; retry"})
-                    self.wfile.write(f"data: {err}\n\ndata: [DONE]\n\n"
-                                     .encode())
-                    self.wfile.flush()
+                    conn = http.client.HTTPConnection(
+                        nw.host, nw.port, timeout=fd.request_timeout_s)
+                    conn.request(
+                        "POST", self.path, raw,
+                        {"Content-Type": "application/json",
+                         "X-Istpu-Trace": trace_id,
+                         "X-Istpu-Resume": "1",
+                         "X-Istpu-Resume-Watermark": str(watermark)})
+                    resp = conn.getresponse()
                 except OSError:
-                    pass
-            return 200
+                    nw.breaker.record_failure()
+                    if conn is not None:
+                        conn.close()
+                    nw.end()
+                    continue
+                if resp.status == 200 and resp.getheader(
+                        "Content-Type", "").startswith("text/event-stream"):
+                    nw.breaker.record_success()
+                    fd._c_retry.inc()
+                    return nw, conn, resp
+                # a non-stream answer (409 resume-unsupported request,
+                # 429 shed, 5xx fault): this survivor cannot continue
+                # the splice — try the next candidate
+                conn.close()
+                nw.end()
+            return None
 
     return Handler
 
@@ -1104,16 +1380,20 @@ def local_fleet(store_port: int, n_prefill: int = 1, n_decode: int = 1,
                 *, block_tokens: int = 4, n_blocks: int = 256,
                 max_batch: int = 8, decode_chunk: int = 4,
                 model_id: str = "fleet-tiny", port: int = 0,
-                poll_s: float = 0.5, max_queue: Optional[int] = None):
+                poll_s: float = 0.5, max_queue: Optional[int] = None,
+                n_routers: int = 1):
     """An in-process tiny-model fleet over a running store node: N
     prefill + M decode ``ServingServer``s (own SHM connections, shared
-    deterministic TINY weights) behind one ``FrontDoor`` — the
-    zero-setup target for the disagg smoke, bench_serve.py
+    deterministic TINY weights) behind ``n_routers`` ``FrontDoor``
+    replicas over the SAME pools (each naming the others as peers) —
+    the zero-setup target for the disagg smoke, bench_serve.py
     ``--self-disagg``, and the chaos tests.  ``kv_quant=None`` keeps
     handoff byte-exact, so fleet decode tokens must equal a monolith's.
 
-    Returns ``(fd, workers, close)`` — ``workers`` maps role → list of
-    servers; ``close()`` tears everything down (not the store)."""
+    Returns ``(fd, workers, close)`` — ``fd`` is the FIRST router
+    replica (existing callers unchanged), ``workers`` maps role → list
+    of servers and additionally ``"router"`` → every replica;
+    ``close()`` tears everything down (not the store)."""
     import jax
     import jax.numpy as jnp
 
@@ -1150,15 +1430,26 @@ def local_fleet(store_port: int, n_prefill: int = 1, n_decode: int = 1,
                                 max_queue=max_queue)
             srv.start()
             servers[role].append(srv)
-    fd = FrontDoor(
-        [f"http://127.0.0.1:{s.port}" for s in servers["prefill"]],
-        [f"http://127.0.0.1:{s.port}" for s in servers["decode"]],
-        port=port, poll_s=poll_s,
-    )
-    fd.start()
+    prefill_urls = [f"http://127.0.0.1:{s.port}" for s in servers["prefill"]]
+    decode_urls = [f"http://127.0.0.1:{s.port}" for s in servers["decode"]]
+    routers: List[FrontDoor] = []
+    for i in range(max(1, n_routers)):
+        r = FrontDoor(prefill_urls, decode_urls,
+                      port=port if i == 0 else 0, poll_s=poll_s)
+        r.start()
+        routers.append(r)
+    # each replica names its siblings (the fleet-merged /debug/fleet
+    # view); routing itself never consults peers — zero coordination
+    for r in routers:
+        r.peers = [f"http://127.0.0.1:{o.port}"
+                   for o in routers if o is not r]
+        r._g_replicas.set(1 + len(r.peers))
+    servers["router"] = routers
+    fd = routers[0]
 
     def close() -> None:
-        fd.close()
+        for r in routers:
+            r.close()
         for role in ("prefill", "decode"):
             for s in servers[role]:
                 s.close()
@@ -1200,6 +1491,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--affinity-tokens", type=int, default=16,
                     help="prompt-stem length (tokens) keying decode "
                          "placement: same stem, same decode worker")
+    ap.add_argument("--peers", default=None,
+                    help="comma-separated sibling router base URLs "
+                         "(default env ISTPU_FD_PEERS).  Replicas need "
+                         "no coordination — peers only feed the "
+                         "istpu_fd_router_replicas gauge and the "
+                         "fleet-merged /debug/fleet?merged=1 view")
     ap.add_argument("--log-level", default="info")
     args = ap.parse_args(argv)
     Logger.set_log_level(args.log_level)
@@ -1216,7 +1513,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                    poll_s=args.poll_interval,
                    handoff_timeout_s=args.handoff_timeout,
                    request_timeout_s=args.request_timeout,
-                   affinity_tokens=args.affinity_tokens)
+                   affinity_tokens=args.affinity_tokens,
+                   peers=split(args.peers, "ISTPU_FD_PEERS"))
     fd.start()
     try:
         while True:

@@ -681,6 +681,12 @@ def default_serve_rules() -> List[WatchdogRule]:
         mem_slope_rule(),
         reprefill_waste_rule(),
         stage_budget_rule(),
+        # resumption plane (docs/design.md §resumption): each restore a
+        # worker serves is a stream that DIED somewhere else in the
+        # fleet — a spike means workers are dying or being bounced
+        # faster than a rolling restart should ever look
+        spike_rule("stream_resume_spike", "serve.stream_resumes",
+                   threshold=3, what="mid-stream resumes landed"),
     ]
 
 
@@ -784,6 +790,11 @@ def serve_probes(server) -> Dict[str, Callable[[], Any]]:
         "serve.shed": admission("shed_total"),
         "serve.quota_throttled": admission("throttled_total"),
         "serve.admission_mode": admission("mode_code"),
+        # resumption series: restores this worker served for streams
+        # that died elsewhere (ok + miss — a miss still marks a death);
+        # 0.0 so the series exists before the first splice
+        "serve.stream_resumes": lambda: sreg.family_value(
+            "istpu_serve_resume_restores_total") or 0.0,
         # session-attribution series (infinistore_tpu/sessions.py): the
         # ledger's lifetime waste/computed tallies feed the
         # reprefill_waste rule as deltas; 0.0 (not None) so the series

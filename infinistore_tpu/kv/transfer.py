@@ -561,3 +561,47 @@ class KVTransferEngine:
             return cache, False
         self.breaker.record_success()
         return out, True
+
+    # -- small-blob sidecar (stream-resume checkpoints) --
+    #
+    # Resumable SSE streams (docs/design.md, resumption contract)
+    # checkpoint the little that KV pages don't cover — emitted tokens,
+    # effective sampling seed, session id — through the SAME store fleet
+    # the pages live in, as inline single-key blobs (OP_PUT_INLINE /
+    # OP_GET_INLINE).  Both hops are best-effort by contract: a failed
+    # checkpoint write costs replay work at resume time, a failed read
+    # degrades the survivor to deterministic re-generation under the
+    # watermark — never a request.
+
+    def put_blob(self, key: str, data: bytes) -> bool:
+        """Write one inline blob under ``key``.  Returns False instead of
+        raising on any failure (open circuit, transport death, or a
+        clustered pool whose ``_call`` routes per-chunk and refuses
+        single-key inline ops)."""
+        if not self.breaker.allow():
+            return False
+        try:
+            self._call("w_tcp_bytes", key, data)
+        except _resilience.transport_errors():
+            self.breaker.record_failure()
+            return False
+        except Exception:  # noqa: BLE001 — checkpoints are best-effort
+            return False
+        self.breaker.record_success()
+        return True
+
+    def get_blob(self, key: str) -> Optional[bytes]:
+        """Read one inline blob, or None.  A miss (KeyNotFound — normal
+        after TTL/eviction or before the first checkpoint landed) never
+        touches the circuit."""
+        if not self.breaker.allow():
+            return None
+        try:
+            arr = self._call("r_tcp", key)
+        except _resilience.transport_errors():
+            self.breaker.record_failure()
+            return None
+        except Exception:  # noqa: BLE001 — a miss is a normal answer
+            return None
+        self.breaker.record_success()
+        return bytes(bytearray(arr))

@@ -94,6 +94,16 @@ class FaultInjector:
     # window the receiver-death chaos walk kills into.
     # ``compaction_disk_fault`` fails spill-tier I/O under a running
     # compaction until the tier degrades DRAM-only.
+    # ``decode_death_mid_stream`` is the SERVE-plane resumption walk's
+    # trigger: the pseudo-op "STREAM" is matched by the SSE streamer at
+    # every chunk boundary (serve.py _stream), so drop_conn with
+    # ``after`` kills the stream only AFTER tokens already reached the
+    # client — the exact window the pre-first-byte failover cannot
+    # cover and store-checkpointed resumption must.
+    # ``router_death`` is armed on a FRONTDOOR's injector: every client
+    # connection is dropped at accept, which is what a dead router
+    # looks like to a client holding a replica list (the failover the
+    # replicated-router walk exercises).
     SCENARIOS = {
         "migration_receiver_slow": [
             {"op": "ALLOC_PUT", "action": "delay", "delay_s": 0.25},
@@ -102,6 +112,13 @@ class FaultInjector:
         ],
         "compaction_disk_fault": [
             {"op": "DISK", "action": "disk_error", "times": 8},
+        ],
+        "decode_death_mid_stream": [
+            {"op": "STREAM", "action": "drop_conn", "after": 2,
+             "times": 1},
+        ],
+        "router_death": [
+            {"op": "*", "action": "drop_conn", "times": -1},
         ],
     }
 

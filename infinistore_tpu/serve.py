@@ -203,6 +203,22 @@ class ServingServer:
         # the manage plane must be named explicitly
         # (--store-manage-endpoints / ISTPU_STORE_MANAGE_ENDPOINTS)
         self.store_manage_endpoints = list(store_manage_endpoints or [])
+        # resumable streams (docs/design.md, resumption contract): the
+        # SSE streamer checkpoints what the KV pages don't cover —
+        # emitted tokens, effective sampling seed, session id — through
+        # the store's inline-blob path every ISTPU_RESUME_CKPT_TOKENS
+        # emitted tokens (0 disables).  Writes ride a background writer
+        # thread fed from the handler threads, so neither the decode hot
+        # loop nor the emit path ever blocks on the store.
+        try:
+            self.resume_every = int(os.environ.get(
+                "ISTPU_RESUME_CKPT_TOKENS", "") or 8)
+        except ValueError:
+            self.resume_every = 8
+        self._ckpt_q: "queue.Queue" = queue.Queue()
+        self._ckpt_thread = threading.Thread(
+            target=self._ckpt_loop, name="istpu-resume-ckpt", daemon=True,
+        )
         self._cv = threading.Condition()
         self._staged: List[Dict[str, Any]] = []   # submissions from handlers
         self._cancels: List[int] = []
@@ -233,6 +249,7 @@ class ServingServer:
 
     def start(self) -> None:
         self._engine_thread.start()
+        self._ckpt_thread.start()
         threading.Thread(
             target=self.httpd.serve_forever, name="istpu-http", daemon=True
         ).start()
@@ -244,9 +261,22 @@ class ServingServer:
         with self._cv:
             self._stop = True
             self._cv.notify()
+            # every in-flight or staged request gets an "abort": its
+            # handler drops the connection ABRUPTLY (no [DONE], no SSE
+            # error event), so a relaying router sees a mid-stream
+            # transport death and resumes on a survivor — a graceful
+            # goodbye here would surface the restart to clients as an
+            # error instead of a stall
+            aborts = list(self._queues.values()) + \
+                [it["q"] for it in self._staged]
+        for q in aborts:
+            q.put(("abort", "server restarting"))
+        self._ckpt_q.put(None)  # writer drains the backlog, then exits
         self.httpd.shutdown()
         self.httpd.server_close()
         self._engine_thread.join(timeout=30)
+        if self._ckpt_thread.is_alive():
+            self._ckpt_thread.join(timeout=5)
 
     # -- handler-side API (any thread) --
 
@@ -359,6 +389,12 @@ class ServingServer:
                 # counted via _staged before _scoring drops, so the depth
                 # never dips mid-handoff
                 with self._cv:
+                    if self._stop:
+                        # close() already broadcast aborts to the staged
+                        # queues it could see; a submit racing past that
+                        # snapshot must abort itself or it hangs forever
+                        q.put(("abort", "server restarting"))
+                        return q
                     self._staged.append(item)
                     self._cv.notify()
                 return q
@@ -366,6 +402,9 @@ class ServingServer:
                 with self._cv:
                     self._scoring -= 1
         with self._cv:
+            if self._stop:
+                q.put(("abort", "server restarting"))
+                return q
             self._staged.append(item)
             self._cv.notify()
         return q
@@ -404,6 +443,62 @@ class ServingServer:
             self._cancels.append(req_id)
             self._cv.notify()
 
+    # -- stream-resume checkpoints (docs/design.md, resumption) --
+
+    @staticmethod
+    def resume_key(trace_id: str) -> str:
+        """Store key of a stream's resume checkpoint.  Keyed by trace id
+        — the one identifier that survives the router re-dispatching the
+        request to a different worker."""
+        return f"istpu:resume:{trace_id}"
+
+    def resume_stage(self, ckpt: Dict[str, Any]) -> None:
+        """Hand one checkpoint to the background writer.  Called from the
+        SSE handler thread at the chunk boundary that crossed the
+        cadence; never blocks (unbounded queue, tiny JSON payloads)."""
+        if self.engine.transfer is None or not ckpt.get("trace_id"):
+            return
+        self._ckpt_q.put(ckpt)
+
+    def _ckpt_loop(self) -> None:
+        """Writer thread: drain staged checkpoints into the store as
+        inline blobs.  Best-effort by contract — a failed write costs
+        replay work at resume time, never a request."""
+        while True:
+            ckpt = self._ckpt_q.get()
+            if ckpt is None:
+                return
+            delta = int(ckpt.pop("_delta", 0))
+            data = json.dumps(ckpt).encode()
+            if self.engine.transfer.put_blob(
+                    self.resume_key(ckpt["trace_id"]), data):
+                with self.metrics.lock:
+                    self._ckpt_stats["writes"] += 1
+                    self._ckpt_stats["tokens"] += delta
+
+    def resume_fetch(self, trace_id: Optional[str]) -> Optional[Dict[str, Any]]:
+        """Survivor side: the last checkpoint a died worker wrote for
+        this trace, or None (store down, evicted, or death before the
+        first cadence tick — the caller degrades to deterministic
+        re-generation under the watermark)."""
+        if self.engine.transfer is None or not trace_id:
+            self._c_restore.labels("miss").inc()
+            return None
+        raw = self.engine.transfer.get_blob(self.resume_key(trace_id))
+        if raw is None:
+            self._c_restore.labels("miss").inc()
+            return None
+        try:
+            ckpt = json.loads(bytes(raw).decode())
+        except (ValueError, UnicodeDecodeError):
+            self._c_restore.labels("miss").inc()
+            return None
+        if not isinstance(ckpt, dict) or ckpt.get("v") != 1:
+            self._c_restore.labels("miss").inc()
+            return None
+        self._c_restore.labels("ok").inc()
+        return ckpt
+
     # -- engine thread --
 
     def _engine_loop(self) -> None:
@@ -437,6 +532,14 @@ class ServingServer:
                            or self.sched.has_work):
                     self._cv.wait()
                 if self._stop:
+                    # second abort sweep: items this loop popped from
+                    # _staged before close() snapshotted (and registered
+                    # into _queues since) were invisible to close()'s
+                    # broadcast; duplicates are harmless — a queue whose
+                    # handler already returned just holds an unread event
+                    for q in (list(self._queues.values())
+                              + [it["q"] for it in self._staged]):
+                        q.put(("abort", "server restarting"))
                     return
                 staged, self._staged = self._staged, []
                 cancels, self._cancels = self._cancels, []
@@ -695,6 +798,22 @@ class ServingServer:
                     f"unknown model/adapter {model!r}; have "
                     f"{[self.model_id] + bank.names[1:]}"
                 ) from None
+        # restore-path pre-seed (the resumption contract): generated-so-
+        # far tokens a survivor adopts from a died worker's checkpoint.
+        # Internal — the HTTP layer pops any wire-supplied value and only
+        # injects what it fetched from the store itself.
+        resume_output = body.get("_resume_output")
+        if resume_output is not None:
+            if not (isinstance(resume_output, list)
+                    and all(isinstance(t, int) and not isinstance(t, bool)
+                            and 0 <= t < vocab for t in resume_output)):
+                raise ValueError(
+                    "_resume_output must be a list of in-vocab token ids"
+                )
+            if lp_k:
+                raise ValueError(
+                    "stream resumption does not support logprobs"
+                )
         return {
             "tokens": prompt, "max_new_tokens": max_tokens,
             "adapter_id": adapter_id,
@@ -712,6 +831,7 @@ class ServingServer:
             "tenant": tenant,
             "session": session,
             "logprobs": lp_k,
+            "resume_output": resume_output,
         }
 
     def logprobs_display_k(self, body: Dict[str, Any],
@@ -851,6 +971,28 @@ class ServingServer:
                     "Requests completed", fn=stat("completed"))
         reg.counter("istpu_serve_tokens_total",
                     "Tokens generated", fn=stat("tokens"))
+        # resumable-stream accounting (docs/design.md, resumption):
+        # checkpoint writes land on the writer thread under the registry
+        # lock; restores count on the SURVIVOR at adoption time — the
+        # stream_resume_spike watchdog rule rides the restore series
+        self._ckpt_stats = {"writes": 0, "tokens": 0}
+        reg.counter("istpu_serve_resume_ckpt_writes_total",
+                    "Stream-resume checkpoints written to the store "
+                    "(cadence: ISTPU_RESUME_CKPT_TOKENS emitted tokens)",
+                    fn=lambda: self._ckpt_stats["writes"])
+        reg.counter("istpu_serve_resume_ckpt_tokens_total",
+                    "Emitted tokens covered by written resume checkpoints "
+                    "(ckpt-to-ckpt deltas; lag behind tokens_total is the "
+                    "worst-case replay window on resume)",
+                    fn=lambda: self._ckpt_stats["tokens"])
+        self._c_restore = reg.counter(
+            "istpu_serve_resume_restores_total",
+            "Survivor-side mid-stream restores by result: ok (checkpoint "
+            "found and adopted), miss (none found — full deterministic "
+            "re-generation under the router's watermark)",
+            labelnames=("result",))
+        for res in ("ok", "miss"):
+            self._c_restore.labels(res)
         reg.gauge("istpu_serve_free_kv_pages", "Free KV cache pages",
                   fn=lambda: self.engine.free_pages)
         # TTFT split (rolling window): queue-wait vs prefill/compute —
@@ -1359,6 +1501,37 @@ def _make_handler(server: ServingServer):
                 return False
             return True  # "corrupt" is a store-plane action: no-op here
 
+        def _stream_fault(self) -> bool:
+            """Mid-stream fault point, matched at every SSE chunk
+            boundary against the pseudo-op ``STREAM`` — the request-entry
+            gate above cannot kill a stream AFTER bytes went out, which
+            is exactly the window the resumption walk needs
+            (``decode_death_mid_stream`` uses ``after`` to let N chunks
+            through first).  Returns False when the stream should die
+            abruptly now (connection already closed)."""
+            if not server.faults.armed:
+                return True
+            rule = server.faults.match("STREAM")
+            if rule is None:
+                return True
+            action = rule["action"]
+            if action == "delay":
+                time.sleep(rule["delay_s"])
+                return True
+            if action == "stall":
+                while server.faults.active(rule["id"]):
+                    time.sleep(0.05)
+                return True
+            if action == "drop_conn":
+                try:
+                    # an abrupt RST, not a tidy FIN after [DONE]: the
+                    # relay must see a mid-stream transport death
+                    self.connection.close()
+                except OSError:
+                    pass
+                return False
+            return True
+
         def _json(self, code: int, obj: Dict[str, Any],
                   headers: Optional[Dict[str, str]] = None) -> None:
             data = json.dumps(obj).encode()
@@ -1541,11 +1714,20 @@ def _make_handler(server: ServingServer):
         def do_POST(self):
             if self.path.split("?", 1)[0] == "/debug/faults":
                 # arm/clear serve-plane fault rules (chaos only; never
-                # itself fault-matched — see _fault_gate)
+                # itself fault-matched — see _fault_gate).  Body: a rule
+                # list, {"rules": [...]}, or {"scenario": name} for a
+                # canned set (the store manage plane's idiom) — e.g.
+                # {"scenario": "decode_death_mid_stream"}.
                 try:
                     n = int(self.headers.get("Content-Length", 0))
-                    rules = json.loads(self.rfile.read(n) or b"[]")
-                    armed = server.faults.arm(rules)
+                    body = json.loads(self.rfile.read(n) or b"[]")
+                    if isinstance(body, dict) and body.get("scenario"):
+                        armed = server.faults.arm_scenario(
+                            str(body["scenario"]))
+                    else:
+                        rules = body.get("rules", []) \
+                            if isinstance(body, dict) else body
+                        armed = server.faults.arm(rules)
                 except (ValueError, TypeError) as e:
                     self._json(400, {"error": str(e)})
                     return
@@ -1631,6 +1813,32 @@ def _make_handler(server: ServingServer):
                     and 1 <= n <= 8):
                 self._json(400, {"error": "n must be an integer in [1, 8]"})
                 return
+            # mid-stream resumption (router re-dispatch after a decode
+            # death; docs/design.md resumption contract): the resume
+            # headers carry the client's emitted-count watermark, the
+            # store checkpoint (when one landed) carries the generated-
+            # so-far tokens and the effective sampling seed.  Wire
+            # bodies must never spoof the pre-seed — only what THIS
+            # handler fetched from the store is injected.
+            body.pop("_resume_output", None)
+            resume_wm = 0
+            if self.headers.get("X-Istpu-Resume"):
+                if n != 1 or server.logprobs_display_k(body, chat) is not None:
+                    self._json(409, {"error": "stream resumption supports "
+                                              "single-choice requests "
+                                              "without logprobs"})
+                    return
+                try:
+                    resume_wm = max(0, int(self.headers.get(
+                        "X-Istpu-Resume-Watermark", "0") or 0))
+                except ValueError:
+                    resume_wm = 0
+                ckpt = server.resume_fetch(tracing.current_trace_id())
+                if ckpt is not None:
+                    if (body.get("seed") is None
+                            and ckpt.get("seed") is not None):
+                        body["seed"] = ckpt["seed"]
+                    body["_resume_output"] = list(ckpt.get("output") or [])
             # n choices = n scheduler requests sharing the prompt (the
             # prefix cache pins one set of prompt pages; each choice
             # decodes its own continuation — the vLLM n>1 model).  A
@@ -1649,6 +1857,7 @@ def _make_handler(server: ServingServer):
                 for i in range(n)
             ]
             req_ids, err, busy, fault, shed = [], None, None, None, None
+            aborted = None
             for q in qs:
                 kind, val = q.get()
                 if kind == "error":
@@ -1663,8 +1872,21 @@ def _make_handler(server: ServingServer):
                     # the admission controller refused it (quota /
                     # shed-on-burn): 429 + Retry-After below
                     shed = val
+                elif kind == "abort":
+                    # the server is restarting: drop the connection with
+                    # no status at all so the caller (router _proxy_one)
+                    # treats it as transport death and fails over
+                    aborted = val
                 else:
                     req_ids.append(val)
+            if aborted is not None:
+                for rid in req_ids:
+                    server.cancel(rid)
+                try:
+                    self.connection.close()
+                except OSError:
+                    pass
+                return
             if (err is not None or busy is not None or fault is not None
                     or shed is not None):
                 for rid in req_ids:
@@ -1706,8 +1928,21 @@ def _make_handler(server: ServingServer):
                 elif server.tokenizer is not None:
                     echo_text = server.tokenizer.decode(echo_ids)
             if body.get("stream"):
+                # resume-checkpoint template: n==1 streams on a store-
+                # backed worker checkpoint their progress on the cadence
+                # (the output list starts EMPTY — a restore's pre-seed is
+                # re-delivered through on_token and re-accumulates here)
+                ck = None
+                if (n == 1 and server.resume_every > 0
+                        and server.engine.transfer is not None):
+                    ck = {"v": 1, "trace_id": tracing.current_trace_id(),
+                          "session": body.get("session"),
+                          "prompt_len": prompt_len,
+                          "seed": body.get("seed"),
+                          "output": []}
                 self._stream(req_ids, qs, accums, chat, model_name,
-                             prompt_len, lp_k, echo_ids, echo_text)
+                             prompt_len, lp_k, echo_ids, echo_text,
+                             suppress=resume_wm, ck=ck)
             else:
                 self._collect(req_ids, qs, accums, chat, model_name,
                               prompt_len, lp_k, echo_ids, echo_text)
@@ -1779,6 +2014,17 @@ def _make_handler(server: ServingServer):
                     return
                 elif kind == "fault":
                     self._json(500, {"error": val})
+                    return
+                elif kind == "abort":
+                    # restart in progress: no status — the router's
+                    # prefill_handoff records "failed" and decode
+                    # recomputes, never a client-visible error
+                    if req_id is not None:
+                        server.cancel(req_id)
+                    try:
+                        self.connection.close()
+                    except OSError:
+                        pass
                     return
                 elif kind == "done":
                     break
@@ -1877,6 +2123,16 @@ def _make_handler(server: ServingServer):
                             # batch slot) instead of decoding to the budget
                             server.cancel(req_id)
                             break
+                    elif kind == "abort":
+                        # restart in progress: drop with no status so the
+                        # router fails this attempt over to a survivor
+                        for rid in req_ids:
+                            server.cancel(rid)
+                        try:
+                            self.connection.close()
+                        except OSError:
+                            pass
+                        return
                     elif kind in ("error", "fault"):
                         for rid in req_ids:
                             server.cancel(rid)
@@ -1945,7 +2201,9 @@ def _make_handler(server: ServingServer):
                     model_name: Optional[str], prompt_len: int,
                     lp_k: Optional[int],
                     echo_ids: Optional[List[int]] = None,
-                    echo_text: str = "") -> None:
+                    echo_text: str = "",
+                    suppress: int = 0,
+                    ck: Optional[Dict[str, Any]] = None) -> None:
             self.send_response(200)
             self.send_header("Content-Type", "text/event-stream")
             self.send_header("Cache-Control", "no-cache")
@@ -1956,6 +2214,11 @@ def _make_handler(server: ServingServer):
             ids_sent = [0] * n
             lps: List[List[tuple]] = [[] for _ in range(n)]
             live = [True] * n
+            # resumption state: tokens still to drop below the client's
+            # emitted-count watermark (per choice), and the emitted count
+            # the last staged checkpoint covered
+            sup_left = [max(0, int(suppress))] * n
+            ck_mark = [0]
 
             # n>1: one SSE stream carries every choice; per-queue pump
             # threads merge the scheduler's per-request queues into one,
@@ -1970,7 +2233,7 @@ def _make_handler(server: ServingServer):
                     while True:
                         ev = qi.get()
                         merged.put((i, ev))
-                        if ev[0] in ("done", "error", "fault"):
+                        if ev[0] in ("done", "error", "fault", "abort"):
                             return
 
                 for i, qi in enumerate(qs):
@@ -2069,6 +2332,38 @@ def _make_handler(server: ServingServer):
                     elif kind == "lp":
                         lps[i].extend(val)
                     elif kind == "tokens":
+                        if not self._stream_fault():
+                            # injected mid-stream death (the worker-side
+                            # view of a decode-process kill): free the
+                            # batch slots like a client disconnect; the
+                            # router's resume path owns the client now
+                            for rid in req_ids:
+                                server.cancel(rid)
+                            return
+                        if ck is not None:
+                            # checkpoint cadence: stage a write once the
+                            # emitted count crossed resume_every since
+                            # the last one (the writer thread owns the
+                            # store hop; this thread only copies a list)
+                            ck["output"].extend(val)
+                            if (len(ck["output"]) - ck_mark[0]
+                                    >= server.resume_every):
+                                server.resume_stage({
+                                    **ck, "output": list(ck["output"]),
+                                    "_delta": len(ck["output"]) - ck_mark[0],
+                                })
+                                ck_mark[0] = len(ck["output"])
+                        if sup_left[i]:
+                            # watermark suppression (resumption contract):
+                            # everything below the client's emitted-count
+                            # watermark was already delivered by the died
+                            # worker — drop the replay so the spliced
+                            # stream carries no duplicate tokens
+                            skip = min(sup_left[i], len(val))
+                            sup_left[i] -= skip
+                            val = val[skip:]
+                            if not val:
+                                continue
                         if accum is None:
                             emit(i, val, None)
                             ids_sent[i] += len(val)
@@ -2093,6 +2388,19 @@ def _make_handler(server: ServingServer):
                         if horizon > ids_sent[i] or delta:
                             emit(i, accum.ids[ids_sent[i]:horizon], delta)
                             ids_sent[i] = horizon
+                    elif kind == "abort":
+                        # restart in progress: kill the socket mid-stream
+                        # WITHOUT an SSE error or [DONE] — the relaying
+                        # router sees EOF-before-[DONE] (transport death)
+                        # and resumes the stream on a survivor, so the
+                        # client sees a stall, never an error
+                        for rid in req_ids:
+                            server.cancel(rid)
+                        try:
+                            self.connection.close()
+                        except OSError:
+                            pass
+                        return
                     elif kind in ("error", "fault"):
                         # a post-submit failure (e.g. the scoring forward)
                         # must not orphan already-admitted requests

@@ -515,6 +515,26 @@ class Console:
                 int(reqs.get("5xx", 0)),
             )
         )
+        # router-replica + resumption row (absent on pre-replication
+        # payloads, which render exactly as before): how many frontdoor
+        # replicas this router knows of, and the stream-splice ledger —
+        # a nonzero Δresume/frame means streams are dying RIGHT NOW
+        rt = fl.get("router") or {}
+        if rt:
+            st = rt.get("stream") or {}
+            rs = st.get("resumes") or {}
+            ok = float(rs.get("ok") or 0)
+            d_res = self.deltas.setdefault(
+                "fd_resumes", _Delta()).update(ok)
+            out.append(
+                "router   replicas {}  resumes ok {} failed {}  "
+                "aborts {}  Δresume/frame {}".format(
+                    int(rt.get("replicas") or 1), int(ok),
+                    int(float(rs.get("failed") or 0)),
+                    int(float(st.get("aborts") or 0)),
+                    "-" if d_res is None else f"+{d_res:.0f}",
+                )
+            )
         out.append(f"  {'role':8s} {'endpoint':22s} {'state':12s} "
                    f"{'circuit':10s} {'inflight':>8s} {'req':>8s}  "
                    f"Δadopt-tok/frame")

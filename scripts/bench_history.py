@@ -108,6 +108,13 @@ METRICS = {
     # contract, not just a latency number
     "reprefill_waste_frac": ("down", "session re-prefill waste frac"),
     "affinity_hit_rate": ("up", "session affinity hit rate"),
+    # the resumption plane (bench_serve.py `resumption` block): streams
+    # that crossed at least one mid-stream splice during the sweep, and
+    # the worst client-visible stall the splices cost — both down-good:
+    # a healthy fleet resumes nothing, and when chaos rounds DO splice,
+    # the stall ceiling is the client-experience number to hold
+    "stream_resumes": ("down", "streams resumed mid-sweep"),
+    "max_stall_ms": ("down", "worst client stall ms"),
     # the stage ledger's TTFT decomposition (bench_serve.py `critpath`
     # block, infinistore_tpu/critpath.py): per-stage p99 at sweep end —
     # a round where one stage's p99 climbs is a NAMED regression

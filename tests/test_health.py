@@ -404,6 +404,51 @@ def test_doctor_summary_joins_requests_to_steps(tmp_path):
     assert manifest["stores"][0]["endpoints"][0]["ok"] is False
 
 
+def test_doctor_summary_answers_did_any_stream_die():
+    """The router-merged capture (/debug/fleet?merged=1) feeds a
+    'Streams — did any die?' section: every replica's reachability plus
+    the fleet-summed splice ledger, with a three-way verdict (nothing
+    died / died-but-resumed / LOST)."""
+    from infinistore_tpu.doctor import SERVE_ENDPOINTS, summarize_capture
+
+    def cap_for(stream):
+        merged = {
+            "enabled": True, "role": "router-fleet",
+            "replicas": 2, "reachable": 1,
+            "routers": [
+                {"endpoint": "127.0.0.1:9000", "self": True,
+                 "reachable": True, "report": {}},
+                {"endpoint": "127.0.0.1:9001", "self": False,
+                 "reachable": False, "report": None},
+            ],
+            "requests": {"2xx": 20.0, "4xx": 0.0, "5xx": 0.0,
+                         "error": 0.0},
+            "stream": stream,
+        }
+        payloads = {"/debug/fleet?merged=1": merged}
+        return {"fetched_at": 1754000000.0, "stores": [],
+                "serve": _plane("http://s:8000", [
+                    (name, path, fname, payloads.get(path))
+                    for name, path, fname in SERVE_ENDPOINTS
+                ])}
+
+    quiet = summarize_capture(cap_for(
+        {"aborts": 0.0, "resumes_ok": 0.0, "resumes_failed": 0.0}))
+    assert "Streams — did any die?" in quiet
+    assert "router replicas: 1/2 reachable" in quiet
+    assert "**UNREACHABLE**" in quiet  # the dead peer is named
+    assert "no: zero aborts, zero resumes" in quiet
+
+    spliced = summarize_capture(cap_for(
+        {"aborts": 0.0, "resumes_ok": 3.0, "resumes_failed": 0.0}))
+    assert "streams died but none were lost: 3" in spliced
+
+    lost = summarize_capture(cap_for(
+        {"aborts": 2.0, "resumes_ok": 1.0, "resumes_failed": 2.0}))
+    assert "**YES — streams were LOST**" in lost
+    assert "2 resume failure(s), 2 client-visible abort(s)" in lost
+
+
 # ---------------------------------------------------------------------------
 # live halves: serve + store planes, the chaos walk, the doctor bundle
 # ---------------------------------------------------------------------------

@@ -1,0 +1,782 @@
+"""The survives-anything mesh: replicated routers, store-checkpointed
+mid-stream resumption, and rolling restarts.
+
+Fault-first (house rule): the FaultInjector scenarios
+``decode_death_mid_stream`` and ``router_death`` reproduce each death
+on demand BEFORE any mitigation is asserted — a decode worker whose
+socket dies mid-SSE, and a router that drops every connection.
+
+Unit half (no sockets): pacer selection (injected clock/post seams
+still drive the thread pacer deterministically), router-list failover
+in both HTTP clients, and the resumption ledger in ``summarize`` /
+``session_summary`` (stalled/resumed are NOT errors).
+
+Live half (real store subprocess + in-process fleet, 1 prefill +
+2 decode behind 2 router replicas):
+
+* the acceptance walk — kill the serving decode worker mid-stream
+  (scenario armed first), the router splices the stream onto the
+  survivor and the client's token ids are BYTE-EXACT against the
+  no-fault baseline, with the splice visible as a ``: istpu-resume``
+  SSE comment, `istpu_fd_stream_resumes_total{result="ok"}` on the
+  router, checkpoint writes + a restore on the survivor, and store
+  adoption in the survivor's ledger;
+* resume-contract validation (multi-choice / logprobs → 409);
+* router death under a swarm: half the clients start on the dead
+  replica and every request fails over with zero errors;
+* the rolling-restart walk: store ``POST /spill``, a decode worker, a
+  prefill worker, and a router replica each restart IN SEQUENCE under
+  an open-loop async swarm — zero client-visible errors, zero 5xx
+  from any router's ledger.
+
+The 10k-concurrency capability test drives ten thousand simultaneous
+SSE sessions from ONE process (async pacer) against a stub asyncio
+SSE server in a subprocess — the real fleet on a 1-core CI box cannot
+decode 10k streams, so scale capability and mesh behavior are proven
+separately (CHANGES.md).
+"""
+
+import asyncio
+import json
+import http.client
+import os
+import signal
+import socket
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+from infinistore_tpu import loadgen
+from infinistore_tpu.utils.metrics import parse_prometheus_text
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _post(port, path, body, headers=None, timeout=120.0):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("POST", path, json.dumps(body),
+                     {"Content-Type": "application/json", **(headers or {})})
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read() or b"{}")
+    finally:
+        conn.close()
+
+
+def _get(port, path, timeout=30.0):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+def _metric(prom_text, family, **labels):
+    parsed = parse_prometheus_text(prom_text)
+    key = (family, tuple(sorted((k, str(v)) for k, v in labels.items())))
+    return parsed.get(key)
+
+
+def _stream(port, body, headers=None, timeout=120.0):
+    """POST a streaming completion; return (status, token_ids,
+    resume_comment_count)."""
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("POST", "/v1/completions", json.dumps(body),
+                     {"Content-Type": "application/json", **(headers or {})})
+        resp = conn.getresponse()
+        if resp.status != 200:
+            return resp.status, [], 0
+        toks, resumes = [], 0
+        while True:
+            line = resp.readline()
+            if not line:
+                break
+            if line.startswith(b": istpu-resume"):
+                resumes += 1
+            if line.startswith(b"data: "):
+                data = line[6:].strip()
+                if data == b"[DONE]":
+                    break
+                ev = json.loads(data)
+                if "error" in ev:
+                    return resp.status, toks, resumes
+                ch = (ev.get("choices") or [{}])[0]
+                toks.extend(ch.get("token_ids") or ())
+        return resp.status, toks, resumes
+    finally:
+        conn.close()
+
+
+# ---------------------------------------------------------------------------
+# fault-first: the scenarios exist before any mitigation is tested
+# ---------------------------------------------------------------------------
+
+
+def test_fault_scenarios_registered():
+    from infinistore_tpu.pyserver import FaultInjector
+
+    assert "decode_death_mid_stream" in FaultInjector.SCENARIOS
+    assert "router_death" in FaultInjector.SCENARIOS
+    rules = FaultInjector.SCENARIOS["decode_death_mid_stream"]
+    # the death is mid-STREAM: pseudo-op matched at SSE chunk
+    # boundaries, after the first chunks went out, exactly once
+    assert rules[0]["op"] == "STREAM"
+    assert rules[0]["action"] == "drop_conn"
+    assert rules[0].get("after", 0) >= 1
+    death = FaultInjector.SCENARIOS["router_death"]
+    assert death[0]["op"] == "*" and death[0]["action"] == "drop_conn"
+    assert death[0]["times"] == -1  # dead until cleared
+
+
+# ---------------------------------------------------------------------------
+# pacer selection + failover + resumption ledger (no fleet)
+# ---------------------------------------------------------------------------
+
+
+def test_pacer_selection_seams_force_thread():
+    from infinistore_tpu.loadgen import _pick_pacer
+
+    assert _pick_pacer(None, time.monotonic, time.sleep, None) == "async"
+    # any injected seam selects the deterministic thread pacer
+    assert _pick_pacer(None, lambda: 0.0, time.sleep, None) == "thread"
+    assert _pick_pacer(None, time.monotonic, lambda s: None, None) \
+        == "thread"
+    assert _pick_pacer(None, time.monotonic, time.sleep,
+                       lambda b: {}) == "thread"
+    # explicit always wins
+    assert _pick_pacer("thread", time.monotonic, time.sleep, None) \
+        == "thread"
+    assert _pick_pacer("async", lambda: 0.0, time.sleep, None) == "async"
+    with pytest.raises(ValueError):
+        _pick_pacer("warp", time.monotonic, time.sleep, None)
+
+
+def test_thread_pacer_math_still_virtual_clock_driven():
+    """The injected clock/sleep/post seams drive the pacing loop with
+    no sockets and no real time — the contract every earlier loadgen
+    test relies on survives the async rewrite."""
+    cfg = loadgen.LoadConfig(rate=2.0, n_requests=4,
+                             process="deterministic", seed=1)
+    now = [0.0]
+    slept = []
+
+    def clock():
+        return now[0]
+
+    def sleep(s):
+        slept.append(round(s, 9))
+        now[0] += s
+
+    def post(body):
+        r = loadgen._base_result(body, "t")
+        r["status"], r["tokens"], r["ok"] = 200, 1, True
+        return r
+
+    results, makespan = loadgen.run_load("http://x", cfg, clock=clock,
+                                         sleep=sleep, post=post)
+    # deterministic 2 req/s: the pacer sleeps exactly the inter-arrival
+    # gaps (first arrival at t=0 sleeps nothing)
+    assert slept == [0.5, 0.5, 0.5], slept
+    assert len(results) == 4 and all(r["ok"] for r in results)
+    assert all(r["late_s"] == 0.0 for r in results)
+
+
+class _StubHTTP(threading.Thread):
+    """A one-shot plain-HTTP completion server for failover tests."""
+
+    def __init__(self, stream=False, n_events=2, die_after=None):
+        super().__init__(daemon=True)
+        self.sock = socket.socket()
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(8)
+        self.port = self.sock.getsockname()[1]
+        self.stream, self.n_events = stream, n_events
+        self.die_after = die_after
+        self.served = 0
+
+    def run(self):
+        while True:
+            try:
+                c, _ = self.sock.accept()
+            except OSError:
+                return
+            try:
+                buf = b""
+                while b"\r\n\r\n" not in buf:
+                    chunk = c.recv(4096)
+                    if not chunk:
+                        raise OSError("eof")
+                    buf += chunk
+                if self.stream:
+                    c.sendall(b"HTTP/1.1 200 OK\r\nContent-Type: "
+                              b"text/event-stream\r\nConnection: "
+                              b"close\r\n\r\n")
+                    for i in range(self.n_events):
+                        if self.die_after is not None \
+                                and i >= self.die_after:
+                            raise OSError("injected death")
+                        ev = json.dumps(
+                            {"choices": [{"token_ids": [i]}]})
+                        c.sendall(f"data: {ev}\n\n".encode())
+                    c.sendall(b"data: [DONE]\n\n")
+                else:
+                    body = json.dumps(
+                        {"choices": [{"token_ids": [1, 2, 3]}]}).encode()
+                    c.sendall(b"HTTP/1.1 200 OK\r\nContent-Type: "
+                              b"application/json\r\nContent-Length: "
+                              + str(len(body)).encode() + b"\r\n\r\n"
+                              + body)
+                self.served += 1
+            except OSError:
+                pass
+            finally:
+                c.close()
+
+    def close(self):
+        self.sock.close()
+
+
+def test_sync_client_fails_over_to_next_router():
+    stub = _StubHTTP()
+    stub.start()
+    dead = f"http://127.0.0.1:{_free_port()}"  # nothing listens
+    try:
+        r = loadgen._http_post_once(
+            [dead, f"http://127.0.0.1:{stub.port}"],
+            {"prompt": [1], "max_tokens": 2}, timeout_s=10.0)
+        assert r["ok"] and r["tokens"] == 3 and r["error"] is None
+        # rotation start spreads clients across replicas
+        r2 = loadgen._http_post_once(
+            [f"http://127.0.0.1:{stub.port}", dead],
+            {"prompt": [1], "max_tokens": 2}, timeout_s=10.0, start=1)
+        assert r2["ok"], r2
+    finally:
+        stub.close()
+
+
+def test_async_client_fails_over_and_counts_resume_comments():
+    stub = _StubHTTP(stream=True)
+    stub.start()
+    dead = f"http://127.0.0.1:{_free_port()}"
+    try:
+        r = asyncio.run(loadgen._a_http_post_once(
+            [dead, f"http://127.0.0.1:{stub.port}"],
+            {"prompt": [1], "max_tokens": 2, "stream": True},
+            timeout_s=10.0))
+        assert r["ok"] and r["tokens"] == 2  # one id per stub event
+        assert r["resumed"] == 0 and not r["stalled"]
+    finally:
+        stub.close()
+
+
+def test_summarize_counts_stalls_separately_from_errors():
+    def row(ok=True, lane=0, resumed=0, stall=None):
+        r = loadgen._base_result({"priority": lane}, "t")
+        r["ok"], r["status"] = ok, (200 if ok else 0)
+        r["tokens"] = 4 if ok else 0
+        r["ttft_s"], r["tpot_s"], r["e2e_s"] = 0.01, 0.01, 0.1
+        if not ok:
+            r["error"], r["ttft_s"] = "boom", None
+        r["resumed"], r["stalled"] = resumed, resumed > 0
+        r["max_stall_s"] = stall
+        return r
+
+    rows = [row(), row(resumed=1, stall=0.75), row(ok=False),
+            row(lane=1, resumed=2, stall=1.5)]
+    s = loadgen.summarize(rows, 2.0, slo_ttft_s=1.0, slo_tpot_s=1.0)
+    assert s["errors"] == 1          # the stalled rows are NOT errors
+    assert s["stalled"] == 2
+    assert s["resumed"] == 3
+    assert s["max_stall_ms"] == 1500.0
+    assert s["lanes"]["0"]["stalled"] == 1
+    assert s["lanes"]["0"]["resumed"] == 1
+    assert s["lanes"]["1"]["resumed"] == 2
+
+
+def test_session_summary_reports_resumption_ledger():
+    def turn(t, resumed=0, stall=None):
+        r = loadgen._base_result({"priority": 0}, "t")
+        r.update(ok=True, status=200, tokens=2, ttft_s=0.01,
+                 session="s-1", turn=t, resumed=resumed,
+                 stalled=resumed > 0, max_stall_s=stall)
+        return r
+
+    s = loadgen.session_summary(
+        [turn(1), turn(2, resumed=1, stall=0.25)])
+    assert s["stalled"] == 1 and s["resumed"] == 1
+    assert s["max_stall_ms"] == 250.0
+
+
+def test_resume_key_and_checkpoint_json_contract():
+    from infinistore_tpu.serve import ServingServer
+
+    assert ServingServer.resume_key("abc123") == "istpu:resume:abc123"
+
+
+# ---------------------------------------------------------------------------
+# live fleet: 1 prefill + 2 decode behind 2 router replicas
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def live_store(tmp_path_factory):
+    port, mport = _free_port(), _free_port()
+    spill = tmp_path_factory.mktemp("spill")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "infinistore_tpu.server",
+         "--service-port", str(port), "--manage-port", str(mport),
+         "--prealloc-size", "1", "--minimal-allocate-size", "16",
+         "--log-level", "warning", "--backend", "python",
+         # a disk tier so POST /spill (the graceful pre-restart drain)
+         # is live for the rolling-restart walk
+         "--disk-tier-path", str(spill), "--disk-tier-size", "1"],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    deadline = time.time() + 25
+    while True:
+        if proc.poll() is not None:
+            pytest.fail("store server failed to start")
+        try:
+            socket.create_connection(("127.0.0.1", port),
+                                     timeout=0.5).close()
+            break
+        except OSError:
+            if time.time() >= deadline:
+                proc.kill()
+                pytest.fail("store server did not come up")
+            time.sleep(0.1)
+    yield port, mport
+    proc.send_signal(signal.SIGINT)
+    try:
+        proc.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+
+
+@pytest.fixture(scope="module")
+def fleet(live_store):
+    """1 prefill + 2 decode behind TWO router replicas.  SLO targets
+    loosened (CPU compile storms must never shed); a tight checkpoint
+    cadence so short streams cross it."""
+    from infinistore_tpu.frontdoor import local_fleet
+
+    saved = {k: os.environ.get(k)
+             for k in ("ISTPU_SLO_TTFT_S", "ISTPU_SLO_TPOT_S",
+                       "ISTPU_RESUME_CKPT_TOKENS")}
+    os.environ["ISTPU_SLO_TTFT_S"] = "60"
+    os.environ["ISTPU_SLO_TPOT_S"] = "10"
+    os.environ["ISTPU_RESUME_CKPT_TOKENS"] = "4"
+    fd, workers, close = local_fleet(live_store[0], 1, 2, poll_s=0.3,
+                                     n_routers=2)
+    status, _ = _post(fd.port, "/v1/completions",
+                      {"prompt": [7, 7, 7, 7, 7], "max_tokens": 2,
+                       "temperature": 0})
+    assert status == 200
+    yield fd, workers
+    close()
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+
+def _clear_faults(workers):
+    for srv in workers["decode"] + workers["prefill"]:
+        _post(srv.port, "/debug/faults", [])
+    for r in workers["router"]:
+        _post(r.port, "/debug/faults", [])
+
+
+def test_decode_death_mid_stream_resumes_byte_exact(fleet):
+    """THE acceptance walk: scenario armed on the serving decode
+    worker, the stream dies after 2 chunks, the router splices onto
+    the survivor, and the client's token ids equal the no-fault
+    baseline — no duplicated, no missing tokens across the splice."""
+    from infinistore_tpu.frontdoor import affinity_stem
+
+    fd, workers = fleet
+    body = {"prompt": [3, 1, 4, 1, 5, 9, 2, 6], "max_tokens": 12,
+            "temperature": 0, "stream": True}
+
+    status, baseline, res = _stream(fd.port, dict(body))
+    assert status == 200 and len(baseline) == 12 and res == 0
+
+    stem = affinity_stem(body, fd.affinity_tokens)
+    victim = fd.decode_candidates(stem)[0]
+    survivor_srv = next(s for s in workers["decode"]
+                        if s.port != victim.port)
+    victim_srv = next(s for s in workers["decode"]
+                      if s.port == victim.port)
+    _s, before = _get(survivor_srv.port, "/metrics")
+    restores_before = (_metric(before.decode(),
+                               "istpu_serve_resume_restores_total",
+                               result="ok") or 0.0) + \
+                      (_metric(before.decode(),
+                               "istpu_serve_resume_restores_total",
+                               result="miss") or 0.0)
+
+    # the fault FIRST (house rule): the serving worker's stream dies
+    # at the socket after 2 chunks — the unmitigated shape is a
+    # truncated SSE body, which is what _relay_sse must now survive
+    status, out = _post(victim.port, "/debug/faults",
+                        {"scenario": "decode_death_mid_stream"})
+    assert status == 200 and out["armed"] == 1
+
+    try:
+        status, toks, res = _stream(fd.port, dict(body))
+        assert status == 200
+        assert res == 1, f"expected exactly one splice, saw {res}"
+        assert toks == baseline, \
+            f"splice not byte-exact:\n  want {baseline}\n  got  {toks}"
+
+        # router accounting: the resume counted as ok, NOT as an abort
+        _s, data = _get(fd.port, "/metrics")
+        prom = data.decode()
+        assert (_metric(prom, "istpu_fd_stream_resumes_total",
+                        result="ok") or 0.0) >= 1.0
+        assert (_metric(prom, "istpu_fd_stream_resumes_total",
+                        result="failed") or 0.0) == 0.0
+
+        # survivor accounting: a restore attempt was counted (ok when
+        # the checkpoint write won the race, miss = full deterministic
+        # replay under the watermark — byte-exact either way)
+        _s, data = _get(survivor_srv.port, "/metrics")
+        sprom = data.decode()
+        restores = (_metric(sprom, "istpu_serve_resume_restores_total",
+                            result="ok") or 0.0) + \
+                   (_metric(sprom, "istpu_serve_resume_restores_total",
+                            result="miss") or 0.0)
+        assert restores >= restores_before + 1.0
+
+        # the victim checkpointed through the store before dying
+        # (cadence 4 tokens, death after 8): writes and tokens counted
+        _s, data = _get(victim_srv.port, "/metrics")
+        vprom = data.decode()
+        assert (_metric(vprom,
+                        "istpu_serve_resume_ckpt_writes_total")
+                or 0.0) >= 1.0
+        assert (_metric(vprom,
+                        "istpu_serve_resume_ckpt_tokens_total")
+                or 0.0) >= 4.0
+
+        # survivor ledger: the resumed request adopted the prefix from
+        # the store (its own guarded probe), not a full recompute
+        _s, data = _get(survivor_srv.port, "/debug/requests")
+        rec = json.loads(data)["records"][-1]
+        assert ((rec.get("store") or {}).get("store_chunks") or 0) >= 1, \
+            rec
+    finally:
+        _clear_faults(workers)
+
+
+def test_resume_rejects_multi_choice_and_logprobs(fleet):
+    """The resume contract is single-choice, no logprobs: anything
+    else 409s at the worker instead of emitting a misaligned splice."""
+    fd, workers = fleet
+    dec = workers["decode"][0]
+    status, out = _post(dec.port, "/v1/completions",
+                        {"prompt": [1, 2, 3, 4], "max_tokens": 2,
+                         "temperature": 0, "stream": True, "n": 2},
+                        headers={"X-Istpu-Resume": "1"})
+    assert status == 409, out
+    status, out = _post(dec.port, "/v1/completions",
+                        {"prompt": [1, 2, 3, 4], "max_tokens": 2,
+                         "temperature": 0, "stream": True,
+                         "logprobs": 2},
+                        headers={"X-Istpu-Resume": "1"})
+    assert status == 409, out
+
+
+def test_router_replica_metrics_and_merged_fleet_view(fleet):
+    fd, workers = fleet
+    routers = workers["router"]
+    assert len(routers) == 2
+    for r in routers:
+        _s, data = _get(r.port, "/metrics")
+        assert (_metric(data.decode(), "istpu_fd_router_replicas")
+                or 0.0) == 2.0
+    # per-router truth stays per-router; ?merged=1 stitches the fleet
+    _s, data = _get(fd.port, "/debug/fleet?merged=1")
+    merged = json.loads(data)
+    assert merged["role"] == "router-fleet"
+    assert merged["replicas"] == 2
+    assert merged["reachable"] == 2
+    assert len(merged["routers"]) == 2
+    assert merged["requests"]["2xx"] >= 1
+    # the per-router report carries its own stream/resume ledger
+    _s, data = _get(fd.port, "/debug/fleet")
+    rep = json.loads(data)
+    assert rep["router"]["replicas"] == 2
+    assert "resumes" in rep["router"]["stream"]
+
+
+def test_router_death_swarm_fails_over_with_zero_errors(fleet):
+    """Scenario ``router_death`` on replica 2: every connection to it
+    dies with no status line.  A swarm whose start indices spread
+    across the replica list fails over with zero client errors, and
+    the survivor's ledger carries the traffic."""
+    fd, workers = fleet
+    routers = workers["router"]
+    dead = routers[1]
+    status, out = _post(dead.port, "/debug/faults",
+                        {"scenario": "router_death"})
+    assert status == 200 and out["armed"] == 1
+    try:
+        urls = [f"http://127.0.0.1:{r.port}" for r in routers]
+        cfg = loadgen.LoadConfig(rate=4.0, n_requests=8,
+                                 process="deterministic", seed=3,
+                                 mix=((1.0, 10, 3),), timeout_s=90.0)
+        results, makespan = loadgen.run_load(urls, cfg)
+        s = loadgen.summarize(results, makespan, 60, 10)
+        assert s["completed"] == 8, s
+        assert s["errors"] == 0, s
+    finally:
+        _clear_faults(workers)
+    # the chaos control plane stayed reachable on the "dead" replica
+    _s, data = _get(dead.port, "/debug/fleet")
+    assert json.loads(data)["router"]["replicas"] == 2
+
+
+@pytest.mark.slow
+def test_rolling_restart_every_role_zero_5xx(fleet, live_store):
+    """The rolling-restart walk: under an open-loop async swarm across
+    both routers, restart the store (POST /spill warm drain), a decode
+    worker, a prefill worker, and a router replica IN SEQUENCE.  Zero
+    client-visible errors (a mid-restart decode death is a resumed
+    stall, not an error), zero 5xx from any router's ledger."""
+    import jax
+    import jax.numpy as jnp
+
+    from infinistore_tpu import lib as ist
+    from infinistore_tpu.engine import InferenceEngine
+    from infinistore_tpu.frontdoor import FrontDoor
+    from infinistore_tpu.kv import PagedCacheConfig
+    from infinistore_tpu.models import TINY, init_params, scaled
+    from infinistore_tpu.serve import ServingServer
+
+    fd, workers = fleet
+    store_port, store_mport = live_store
+    routers = workers["router"]
+    urls = [f"http://127.0.0.1:{r.port}" for r in routers]
+
+    def fd_5xx(r):
+        _s, data = _get(r.port, "/metrics")
+        return (_metric(data.decode(), "istpu_fd_requests_total",
+                        code="5xx") or 0.0)
+
+    before_5xx = [fd_5xx(r) for r in routers]
+
+    # the swarm: open-loop arrivals spanning the whole restart walk
+    cfg = loadgen.LoadConfig(rate=1.5, n_requests=18,
+                             process="deterministic", seed=11,
+                             mix=((1.0, 10, 8),), timeout_s=120.0)
+    box = {}
+
+    def drive():
+        box["out"] = loadgen.run_load(urls, cfg)
+
+    t = threading.Thread(target=drive, daemon=True)
+    t.start()
+
+    mdl = scaled(TINY, dtype=jnp.float32)
+    params = init_params(mdl, jax.random.PRNGKey(0))  # same weights
+
+    def pagecfg():
+        return PagedCacheConfig(
+            n_layers=mdl.n_layers, n_kv_heads=mdl.n_kv_heads,
+            head_dim=mdl.head_dim, n_blocks=256, block_tokens=4,
+            dtype=mdl.dtype)
+
+    def restart_worker(role, idx):
+        """Close one in-process worker and boot a fresh one (new store
+        connection, same weights, SAME port) — a real deploy's bounce
+        at CPU-feasible scale."""
+        old = workers[role][idx]
+        port = old.port
+        old.close()
+        conn = ist.InfinityConnection(ist.ClientConfig(
+            host_addr="127.0.0.1", service_port=store_port,
+            connection_type=ist.TYPE_SHM, op_timeout_s=30.0,
+            log_level="warning"))
+        conn.connect()
+        eng = InferenceEngine(params, mdl, pagecfg(), conn=conn,
+                              model_id="fleet-tiny", kv_quant=None)
+        eng.decode_chunk = 4
+        srv = ServingServer(eng, port=port, max_batch=8,
+                            model_id="fleet-tiny", role=role)
+        srv.start()
+        workers[role][idx] = srv
+        return srv
+
+    try:
+        time.sleep(1.0)
+        # 1. store: graceful pre-restart drain (warm handover)
+        st, rep = _post(store_mport, "/spill", {})
+        assert st == 200, rep
+
+        time.sleep(1.5)
+        # 2. decode worker bounce (any in-flight stream on it resumes
+        # on the survivor via the store checkpoint)
+        restart_worker("decode", 1)
+
+        time.sleep(1.5)
+        # 3. prefill worker bounce (handoff degrades to decode-side
+        # recompute — correct, never 5xx)
+        restart_worker("prefill", 0)
+
+        time.sleep(1.5)
+        # 4. router replica bounce: close, fresh FrontDoor on the same
+        # port; clients fail over to the sibling during the gap
+        old = routers[1]
+        rport = old.port
+        peers = list(old.peers)
+        old.close()
+        nr = FrontDoor([f"http://127.0.0.1:{s.port}"
+                        for s in workers["prefill"]],
+                       [f"http://127.0.0.1:{s.port}"
+                        for s in workers["decode"]],
+                       port=rport, poll_s=0.3, peers=peers)
+        nr.start()
+        routers[1] = nr
+        workers["router"][1] = nr
+
+        t.join(timeout=300)
+        assert not t.is_alive(), "swarm did not drain"
+        results, makespan = box["out"]
+        s = loadgen.summarize(results, makespan, 60, 10)
+        # zero lost streams, zero errors — restarts surface as stalls
+        # (resumed) or rendezvous moves, never as client failures
+        bad = [r for r in results if not r.get("ok")]
+        assert s["completed"] == cfg.n_requests, (s, bad)
+        assert s["errors"] == 0, (s, bad)
+        # zero 5xx from EVERY router's ledger (the restarted replica
+        # starts a fresh ledger at 0 — also asserted clean)
+        for i, r in enumerate(routers):
+            assert fd_5xx(r) - (before_5xx[i] if r is not nr else 0.0) \
+                == 0.0, f"router {i} served a 5xx"
+    finally:
+        _clear_faults(workers)
+
+
+# ---------------------------------------------------------------------------
+# 10k-concurrency capability (stub SSE upstream, real async client)
+# ---------------------------------------------------------------------------
+
+
+_STUB_SSE = textwrap.dedent("""
+    import asyncio, json, sys
+
+    PEAK = [0, 0]  # current, peak
+
+    async def handle(reader, writer):
+        try:
+            buf = b""
+            while b"\\r\\n\\r\\n" not in buf:
+                chunk = await reader.read(4096)
+                if not chunk:
+                    return
+                buf += chunk
+            head, _, body = buf.partition(b"\\r\\n\\r\\n")
+            cl = 0
+            for ln in head.split(b"\\r\\n"):
+                if ln.lower().startswith(b"content-length:"):
+                    cl = int(ln.split(b":", 1)[1])
+            while len(body) < cl:
+                body += await reader.read(cl - len(body))
+            req = json.loads(body or b"{}")
+            hold = float(req.get("hold_s", 0.0))
+            PEAK[0] += 1
+            PEAK[1] = max(PEAK[1], PEAK[0])
+            writer.write(b"HTTP/1.1 200 OK\\r\\nContent-Type: "
+                         b"text/event-stream\\r\\nConnection: "
+                         b"close\\r\\n\\r\\n")
+            ev = json.dumps({"choices": [{"token_ids": [1, 2]}]})
+            writer.write(f"data: {ev}\\n\\n".encode())
+            await writer.drain()
+            await asyncio.sleep(hold)
+            writer.write(f"data: {ev}\\n\\ndata: [DONE]\\n\\n".encode())
+            await writer.drain()
+        except (OSError, ValueError):
+            pass
+        finally:
+            PEAK[0] -= 1
+            try:
+                writer.close()
+            except OSError:
+                pass
+
+    async def peek(reader, writer):
+        writer.write(str(PEAK[1]).encode())
+        await writer.drain()
+        writer.close()
+
+    async def main():
+        srv = await asyncio.start_server(
+            handle, "127.0.0.1", int(sys.argv[1]), backlog=32768)
+        ctl = await asyncio.start_server(
+            peek, "127.0.0.1", int(sys.argv[2]))
+        print("READY", flush=True)
+        async with srv, ctl:
+            await srv.serve_forever()
+
+    asyncio.run(main())
+""")
+
+
+def test_async_pacer_sustains_10k_concurrent_sse_sessions():
+    """One process, one event loop, 10 000 simultaneously-open SSE
+    streams: every stream is HELD open by the stub upstream for
+    ``hold_s`` while arrivals complete, so peak concurrency reaches
+    the full population — the swarm scale a thread-per-stream pacer
+    cannot reach.  Asserted from the upstream's own peak-concurrency
+    ledger AND client accounting (zero errors, every stream ≥hold)."""
+    import resource
+
+    soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    if soft < 15000:
+        pytest.skip(f"needs ≥15k fds (soft limit {soft})")
+
+    port, ctl = _free_port(), _free_port()
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _STUB_SSE, str(port), str(ctl)],
+        stdout=subprocess.PIPE)
+    try:
+        assert b"READY" in proc.stdout.readline()
+        n, hold = 10_000, 25.0
+        cfg = loadgen.LoadConfig(
+            rate=2000.0, n_requests=n, process="deterministic",
+            seed=0, mix=((1.0, 2, 2),), timeout_s=120.0,
+            extra_body={"hold_s": hold})
+        t0 = time.monotonic()
+        results, makespan = loadgen.run_load(
+            f"http://127.0.0.1:{port}", cfg)
+        s = loadgen.summarize(results, makespan, 60, 60)
+        assert s["completed"] == n, {k: s[k] for k in
+                                     ("completed", "errors")}
+        assert s["errors"] == 0
+        # every stream stayed open through its hold window
+        e2es = [r["e2e_s"] for r in results]
+        assert min(e2es) >= hold
+        # the upstream saw the whole population open AT ONCE
+        c = socket.create_connection(("127.0.0.1", ctl), timeout=10)
+        peak = int(c.recv(64) or b"0")
+        c.close()
+        assert peak >= n, f"peak concurrency {peak} < {n}"
+        assert makespan < (n / cfg.rate) + hold + 60, makespan
+        assert time.monotonic() - t0 < 240
+    finally:
+        proc.kill()

@@ -168,6 +168,46 @@ def test_console_renders_fleet_view():
     assert "prefill 0/1 ok" in out
     # a fleet-less snapshot renders no fleet section
     assert "fleet" not in Console().frame(Snapshot())
+    # pre-replication payloads (no "router" block) render exactly as
+    # before: no replica/resume row appears
+    assert "replicas" not in first and "resumes" not in first
+
+
+def test_console_renders_router_replica_and_resume_rows():
+    """Replicated-router payloads grow a `router` block in /debug/fleet
+    (replicas, stream splice ledger); the fleet view renders it as one
+    row with a per-frame resume delta.  Old payloads (previous test)
+    must render unchanged — the row is strictly additive."""
+    from infinistore_tpu.top import Console, Snapshot
+
+    def fleet(resumes_ok):
+        return {
+            "enabled": True, "role": "router",
+            "workers": [{
+                "endpoint": "10.0.0.3:8003", "role": "decode",
+                "reachable": True, "status": "ok", "circuit": "closed",
+                "inflight": 1, "requests_total": 9,
+                "prefix_tokens": {"local": 0.0, "store": 0.0},
+            }],
+            "rollup": {"decode": {"workers": 1, "ok": 1, "degraded": 0,
+                                  "unreachable": 0, "circuit_open": 0}},
+            "handoff": {"count": 0, "p50_ms": None, "p99_ms": None},
+            "requests": {"2xx": 9, "4xx": 0, "5xx": 0, "error": 0},
+            "router": {
+                "replicas": 3, "peers": ["http://127.0.0.1:9001",
+                                         "http://127.0.0.1:9002"],
+                "stream": {"aborts": 1.0,
+                           "resumes": {"ok": resumes_ok, "failed": 1.0}},
+            },
+        }
+
+    console = Console()
+    first = console.frame(Snapshot(fleet=fleet(2.0)))
+    assert "router   replicas 3" in first
+    assert "resumes ok 2 failed 1" in first and "aborts 1" in first
+    # second frame: two more splices landed — the delta names them
+    out = console.frame(Snapshot(fleet=fleet(4.0)))
+    assert "resumes ok 4" in out and "+2" in out
 
 
 def test_console_renders_engine_view():
